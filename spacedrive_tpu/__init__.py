@@ -1,0 +1,32 @@
+"""spacedrive_tpu — a TPU-native virtual-distributed-filesystem engine.
+
+A brand-new framework with the capabilities of Spacedrive's sd-core (reference:
+/root/reference, studied in SURVEY.md): content-addressable filesystem indexing
+into SQLite libraries, BLAKE3 cas_id dedup, a pausable/checkpointable stateful
+job system, CRDT library sync with HLC ordering, p2p block transfer, and a typed
+query/mutation/subscription API.
+
+Unlike the reference's CPU-only Rust core, the indexing hot path (the
+``file_identifier`` step, reference core/src/object/cas.rs:23-62) is TPU-first:
+fixed-shape chunk batches stream into JAX BLAKE3 kernels sharded with
+``jax.sharding`` over a device mesh; MinHash dedup reductions ride ``psum`` over
+ICI. See ``spacedrive_tpu.ops`` for kernels and ``spacedrive_tpu.parallel`` for
+the mesh layer.
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+  api/        typed router (queries/mutations/subscriptions + invalidation)
+  node.py     Node bootstrap: config, event bus, managers, ordered start
+  library.py  Library / Libraries manager (per-library DB + sync + identity)
+  jobs/       stateful job engine (init/steps/finalize, checkpoint/resume)
+  locations/  locations, indexer rules, walker, watcher
+  objects/    cas hashing, file_identifier, validator, media, fs ops
+  sync/       CRDT ops + HLC + manager/ingest actors
+  p2p/        control plane (discovery, pairing, sync sessions, block transfer)
+  models/     declarative SQLite model layer (replaces prisma-client-rust)
+  ops/        TPU compute: BLAKE3 kernels, MinHash, batched image ops
+  parallel/   device mesh, shardings, multi-host init
+  utils/      migrator, version manager, misc infra
+"""
+
+__version__ = "0.1.0"

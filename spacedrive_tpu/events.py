@@ -1,0 +1,117 @@
+"""Core event bus.
+
+Equivalent of the reference's ``CoreEvent`` broadcast channel
+(core/src/api/mod.rs:18-23) and ``Node::emit`` (core/src/lib.rs:203-229):
+a typed broadcast bus that API subscriptions and the job system publish to.
+
+Implemented as a lock-guarded fan-out of bounded per-subscriber queues, the
+Python analogue of tokio's ``broadcast`` channel: slow subscribers drop the
+oldest events rather than block producers (the job hot path must never stall
+on a UI listener).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+from typing import Any, Callable, Iterator
+
+
+@dataclasses.dataclass(frozen=True)
+class CoreEvent:
+    """A broadcast event. ``kind`` mirrors the reference's enum variants:
+
+    - ``job_progress``      (JobProgress, api/mod.rs:20)
+    - ``invalidate_query``  (InvalidateOperation, api/mod.rs:21)
+    - ``new_thumbnail``     (NewThumbnail, api/mod.rs:19)
+    - ``notification``      (notifications.rs)
+    - ``sync_message``      (sync lib.rs:21-24 SyncMessage Created/Ingested)
+    """
+
+    kind: str
+    payload: Any = None
+    library_id: str | None = None
+
+
+class Subscription:
+    """One subscriber's bounded queue. Iterate to receive; ``close()`` to drop."""
+
+    def __init__(self, bus: "EventBus", capacity: int) -> None:
+        self._bus = bus
+        self._q: queue.Queue[CoreEvent | None] = queue.Queue(maxsize=capacity)
+        self.closed = False
+
+    def _offer(self, event: CoreEvent) -> None:
+        while True:
+            try:
+                self._q.put_nowait(event)
+                return
+            except queue.Full:
+                try:  # lossy broadcast: drop oldest, like tokio broadcast lag
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
+
+    def get(self, timeout: float | None = None) -> CoreEvent | None:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def __iter__(self) -> Iterator[CoreEvent]:
+        while not self.closed:
+            event = self._q.get()
+            if event is None:
+                return
+            yield event
+
+    def close(self) -> None:
+        self.closed = True
+        self._bus._unsubscribe(self)
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+
+
+class EventBus:
+    """Multi-producer broadcast bus with lossy bounded subscribers."""
+
+    def __init__(self, capacity: int = 1024) -> None:
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._subs: list[Subscription] = []
+        self._hooks: list[Callable[[CoreEvent], None]] = []
+
+    def subscribe(self, capacity: int | None = None) -> Subscription:
+        sub = Subscription(self, capacity or self._capacity)
+        with self._lock:
+            self._subs.append(sub)
+        return sub
+
+    def _unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    def on(self, hook: Callable[[CoreEvent], None]) -> None:
+        """Synchronous in-process hook (used by invalidation bookkeeping)."""
+        with self._lock:
+            self._hooks.append(hook)
+
+    def emit(self, event: CoreEvent) -> None:
+        with self._lock:
+            subs = list(self._subs)
+            hooks = list(self._hooks)
+        for hook in hooks:
+            try:
+                hook(event)
+            except Exception:  # a broken listener must never stall the hot path
+                logging.getLogger(__name__).exception("event hook failed for %s", event.kind)
+        for sub in subs:
+            sub._offer(event)
+
+    def emit_kind(self, kind: str, payload: Any = None, library_id: str | None = None) -> None:
+        self.emit(CoreEvent(kind=kind, payload=payload, library_id=library_id))

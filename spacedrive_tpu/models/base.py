@@ -1,0 +1,344 @@
+"""Declarative SQLite model layer.
+
+Replaces the reference's prisma-client-rust + sd-sync-generator codegen pair
+(core/prisma/schema.prisma, crates/sync-generator/src/lib.rs:22-36): models are
+declared once in Python with field specs AND sync annotations; the same
+declaration drives (a) CREATE TABLE DDL, (b) typed row access, and (c) the
+CRDT sync layer's per-model dispatch (which fields replicate, what the stable
+sync id is) — no codegen step needed.
+
+Sync annotations mirror ModelSyncType (sync-generator lib.rs:22-36):
+  - ``sync=None``                → local-only model (not replicated)
+  - ``sync=Shared(id="pub_id")`` → record-level LWW replication
+  - ``sync=Relation(item, group)`` → many-many link replication
+
+Writes flow through a single-writer connection (SQLite WAL single-writer
+discipline the reference keeps with MAX_WORKERS=1, job/manager.rs:31-32).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import sqlite3
+import threading
+from pathlib import Path
+from typing import Any, ClassVar, Iterable
+
+
+# --------------------------------------------------------------------------
+# field + sync specs
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    type: str  # INTEGER | TEXT | REAL | BLOB | BOOLEAN | DATETIME | JSON | BYTES
+    primary_key: bool = False
+    nullable: bool = True
+    unique: bool = False
+    default: Any = None
+    references: str | None = None  # "table.column"
+    on_delete: str = "CASCADE"  # CASCADE | RESTRICT | "SET NULL" (FK policy)
+    autoincrement: bool = False
+
+    SQL_TYPES: ClassVar[dict[str, str]] = {
+        "INTEGER": "INTEGER",
+        "TEXT": "TEXT",
+        "REAL": "REAL",
+        "BLOB": "BLOB",
+        "BYTES": "BLOB",
+        "BOOLEAN": "INTEGER",
+        "DATETIME": "TEXT",
+        "JSON": "TEXT",
+        "BIGINT": "INTEGER",
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class Shared:
+    """Record-level last-write-wins replication (``/// @shared(id: ...)``)."""
+
+    id: str = "pub_id"
+
+
+@dataclasses.dataclass(frozen=True)
+class Relation:
+    """Many-many link replication (``/// @relation(item, group)``)."""
+
+    item: str
+    group: str
+
+
+MODEL_REGISTRY: dict[str, type["Model"]] = {}
+
+
+class Model:
+    """Base class. Subclasses set TABLE, FIELDS, optional UNIQUES/INDEXES/SYNC."""
+
+    TABLE: ClassVar[str]
+    FIELDS: ClassVar[dict[str, Field]]
+    UNIQUES: ClassVar[tuple[tuple[str, ...], ...]] = ()
+    INDEXES: ClassVar[tuple[tuple[str, ...], ...]] = ()
+    SYNC: ClassVar[Shared | Relation | None] = None
+    # fields excluded from sync replication even on shared models (local ids)
+    SYNC_SKIP: ClassVar[tuple[str, ...]] = ("id",)
+
+    def __init_subclass__(cls, **kw: Any) -> None:
+        super().__init_subclass__(**kw)
+        if hasattr(cls, "TABLE"):
+            MODEL_REGISTRY[cls.TABLE] = cls
+
+    # -- DDL ----------------------------------------------------------------
+    @classmethod
+    def ddl(cls) -> list[str]:
+        cols = []
+        for name, f in cls.FIELDS.items():
+            parts = [f'"{name}"', Field.SQL_TYPES[f.type]]
+            if f.primary_key:
+                parts.append("PRIMARY KEY")
+                if f.autoincrement:
+                    parts.append("AUTOINCREMENT")
+            if not f.nullable and not f.primary_key:
+                parts.append("NOT NULL")
+            if f.unique:
+                parts.append("UNIQUE")
+            if f.default is not None:
+                parts.append(f"DEFAULT {json.dumps(f.default)}")
+            if f.references:
+                table, col = f.references.split(".")
+                parts.append(f"REFERENCES {table}({col}) ON DELETE {f.on_delete}")
+            cols.append(" ".join(parts))
+        for unique in cls.UNIQUES:
+            quoted = ", ".join(f'"{c}"' for c in unique)
+            cols.append(f"UNIQUE ({quoted})")
+        stmts = [f"CREATE TABLE IF NOT EXISTS {cls.TABLE} ({', '.join(cols)})"]
+        for idx in cls.INDEXES:
+            quoted = ", ".join(f'"{c}"' for c in idx)
+            stmts.append(
+                f"CREATE INDEX IF NOT EXISTS idx_{cls.TABLE}_{'_'.join(idx)} "
+                f"ON {cls.TABLE} ({quoted})"
+            )
+        return stmts
+
+    # -- value encoding -----------------------------------------------------
+    @classmethod
+    def encode(cls, name: str, value: Any) -> Any:
+        f = cls.FIELDS[name]
+        if value is None:
+            return None
+        if f.type == "BOOLEAN":
+            return int(bool(value))
+        if f.type == "DATETIME":
+            if isinstance(value, _dt.datetime):
+                return value.astimezone(_dt.timezone.utc).isoformat()
+            return value
+        if f.type == "JSON":
+            return json.dumps(value, sort_keys=True)
+        return value
+
+    @classmethod
+    def decode(cls, name: str, value: Any) -> Any:
+        f = cls.FIELDS.get(name)
+        if value is None or f is None:
+            return value
+        if f.type == "BOOLEAN":
+            return bool(value)
+        if f.type == "DATETIME":
+            return _dt.datetime.fromisoformat(value) if isinstance(value, str) else value
+        if f.type == "JSON":
+            return json.loads(value) if isinstance(value, str) else value
+        return value
+
+    @classmethod
+    def decode_row(cls, row: sqlite3.Row) -> dict[str, Any]:
+        return {k: cls.decode(k, row[k]) for k in row.keys()}
+
+
+def utc_now() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+# --------------------------------------------------------------------------
+# database handle
+# --------------------------------------------------------------------------
+
+
+class Database:
+    """A single SQLite library database with single-writer discipline.
+
+    The reference leans on SQLite's WAL single-writer ("db is single threaded,
+    nerd", job/manager.rs:31-32); here all writes funnel through one mutex'd
+    connection while reads may come from any thread (``check_same_thread`` off,
+    serialized mode). Good enough for the job-engine cadence; the TPU hashing
+    fan-out happens outside the write lock.
+    """
+
+    def __init__(self, path: str | Path, models: Iterable[type[Model]]) -> None:
+        self.path = str(path)
+        if self.path != ":memory:":
+            Path(self.path).parent.mkdir(parents=True, exist_ok=True)
+        self.models = list(models)
+        self._lock = threading.RLock()
+        # autocommit mode; transactions are managed explicitly by _Txn so a
+        # single connection can serve both one-shot writes and atomic batches
+        self._conn = sqlite3.connect(self.path, check_same_thread=False, isolation_level=None)
+        self._conn.row_factory = sqlite3.Row
+        self._txn_depth = 0
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self.migrate()
+
+    def migrate(self) -> None:
+        with self._lock:
+            for model in self.models:
+                for stmt in model.ddl():
+                    self._conn.execute(stmt)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- low-level ----------------------------------------------------------
+    def execute(self, sql: str, params: tuple | list = ()) -> sqlite3.Cursor:
+        with self._lock:
+            return self._conn.execute(sql, params)
+
+    def executemany(self, sql: str, seq: list[tuple]) -> None:
+        with self._lock:
+            if self._txn_depth:
+                self._conn.executemany(sql, seq)
+            else:  # batch inserts get their own transaction for speed
+                with _Txn(self):
+                    self._conn.executemany(sql, seq)
+
+    def query(self, sql: str, params: tuple | list = ()) -> list[sqlite3.Row]:
+        with self._lock:
+            return self._conn.execute(sql, params).fetchall()
+
+    def transaction(self):
+        """Context manager for an atomic multi-statement write (the analogue of
+        prisma's ``_batch`` used by sync write_ops, manager.rs:62-99)."""
+        return _Txn(self)
+
+    # -- model helpers ------------------------------------------------------
+    @staticmethod
+    def _insert_sql(model: type[Model], cols: list[str], or_ignore: bool) -> str:
+        collist = ", ".join(f'"{c}"' for c in cols)
+        return (
+            f"INSERT {'OR IGNORE ' if or_ignore else ''}INTO {model.TABLE} "
+            f"({collist}) VALUES ({', '.join('?' for _ in cols)})"
+        )
+
+    @staticmethod
+    def _where_sql(model: type[Model], where: dict[str, Any]) -> tuple[str, list[Any]]:
+        """None values compare with IS NULL (``col = NULL`` matches nothing)."""
+        parts: list[str] = []
+        params: list[Any] = []
+        for c, v in where.items():
+            if v is None:
+                parts.append(f'"{c}" IS NULL')
+            else:
+                parts.append(f'"{c}" = ?')
+                params.append(model.encode(c, v))
+        return " AND ".join(parts), params
+
+    def insert(self, model: type[Model], row: dict[str, Any], or_ignore: bool = False) -> int:
+        cols = [c for c in row.keys() if c in model.FIELDS]
+        sql = self._insert_sql(model, cols, or_ignore)
+        cur = self.execute(sql, [model.encode(c, row[c]) for c in cols])
+        return cur.lastrowid
+
+    def insert_many(self, model: type[Model], rows: list[dict[str, Any]], or_ignore: bool = False) -> int:
+        if not rows:
+            return 0
+        cols = [c for c in rows[0].keys() if c in model.FIELDS]
+        sql = self._insert_sql(model, cols, or_ignore)
+        self.executemany(sql, [tuple(model.encode(c, r.get(c)) for c in cols) for r in rows])
+        return len(rows)
+
+    def update(self, model: type[Model], where: dict[str, Any], values: dict[str, Any]) -> int:
+        if not values:
+            return 0
+        set_sql = ", ".join(f'"{c}" = ?' for c in values)
+        where_sql, where_params = self._where_sql(model, where)
+        params = [model.encode(c, v) for c, v in values.items()] + where_params
+        cur = self.execute(f"UPDATE {model.TABLE} SET {set_sql} WHERE {where_sql}", params)
+        return cur.rowcount
+
+    def delete(self, model: type[Model], where: dict[str, Any]) -> int:
+        where_sql, params = self._where_sql(model, where)
+        cur = self.execute(f"DELETE FROM {model.TABLE} WHERE {where_sql}", params)
+        return cur.rowcount
+
+    def find(
+        self,
+        model: type[Model],
+        where: dict[str, Any] | None = None,
+        order_by: str | None = None,
+        limit: int | None = None,
+        offset: int | None = None,
+    ) -> list[dict[str, Any]]:
+        sql = f"SELECT * FROM {model.TABLE}"
+        params: list[Any] = []
+        if where:
+            where_sql, params = self._where_sql(model, where)
+            sql += f" WHERE {where_sql}"
+        if order_by:
+            sql += f" ORDER BY {order_by}"
+        if limit is not None:
+            sql += " LIMIT ?"
+            params.append(limit)
+        if offset is not None:
+            sql += " OFFSET ?"
+            params.append(offset)
+        return [model.decode_row(r) for r in self.query(sql, params)]
+
+    def find_one(self, model: type[Model], where: dict[str, Any]) -> dict[str, Any] | None:
+        rows = self.find(model, where, limit=1)
+        return rows[0] if rows else None
+
+    def count(self, model: type[Model], where: dict[str, Any] | None = None) -> int:
+        sql = f"SELECT COUNT(*) AS n FROM {model.TABLE}"
+        params: list[Any] = []
+        if where:
+            where_sql, params = self._where_sql(model, where)
+            sql += f" WHERE {where_sql}"
+        return self.query(sql, params)[0]["n"]
+
+    def upsert(
+        self, model: type[Model], where: dict[str, Any], create: dict[str, Any], update: dict[str, Any]
+    ) -> None:
+        with self._lock:
+            if self.find_one(model, where) is None:
+                self.insert(model, {**where, **create})
+            else:
+                self.update(model, where, update)
+
+
+class _Txn:
+    """Re-entrant transaction scope: nested uses join the outer transaction."""
+
+    def __init__(self, db: Database) -> None:
+        self.db = db
+
+    def __enter__(self) -> Database:
+        self.db._lock.acquire()
+        try:
+            if self.db._txn_depth == 0:
+                self.db._conn.execute("BEGIN IMMEDIATE")
+            self.db._txn_depth += 1
+        except BaseException:
+            self.db._lock.release()
+            raise
+        return self.db
+
+    def __exit__(self, exc_type, *_: Any) -> None:
+        try:
+            self.db._txn_depth -= 1
+            if self.db._txn_depth == 0:
+                self.db._conn.execute("COMMIT" if exc_type is None else "ROLLBACK")
+        finally:
+            self.db._lock.release()

@@ -1,0 +1,1 @@
+"""Misc infrastructure: versioned-JSON migrator, version manager, helpers."""

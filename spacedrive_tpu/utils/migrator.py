@@ -1,0 +1,124 @@
+"""Versioned-JSON config migration framework.
+
+Equivalent of the reference's generic migrator (core/src/util/migrator.rs:15-40,
+``load_and_migrate`` :41+): configs are stored as JSON with a ``version`` field;
+loading a file at an older version runs each registered migration step in order,
+persisting after every step so a crash mid-upgrade resumes cleanly.
+
+Usage::
+
+    class NodeConfig(VersionedConfig):
+        VERSION = 2
+        FILENAME = "node_state.sdconfig"
+
+        @migration(1, 2)
+        def _one_to_two(data: dict) -> dict: ...
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Callable, ClassVar
+
+
+class MigratorError(Exception):
+    pass
+
+
+def migration(from_version: int, to_version: int):
+    """Decorator registering a pure dict→dict migration step."""
+    if to_version != from_version + 1:
+        raise MigratorError(f"migrations must be sequential: {from_version}→{to_version}")
+
+    def wrap(fn: Callable[[dict], dict]):
+        fn.__migration__ = (from_version, to_version)
+        return staticmethod(fn)
+
+    return wrap
+
+
+class VersionedConfig:
+    """Base for JSON configs with sequential versioned migrations.
+
+    Subclasses define ``VERSION`` (current), field defaults via ``defaults()``,
+    and migration steps with the ``@migration`` decorator. The on-disk form is
+    ``{"version": N, ...fields}`` (the reference flattens the same way,
+    migrator.rs ``BaseConfig{version, flattened}``).
+    """
+
+    VERSION: ClassVar[int] = 1
+
+    def __init__(self, path: str | Path, data: dict[str, Any]) -> None:
+        self.path = Path(path)
+        self.data = data
+
+    # -- subclass surface ---------------------------------------------------
+    @classmethod
+    def defaults(cls) -> dict[str, Any]:
+        return {}
+
+    # -- persistence --------------------------------------------------------
+    @classmethod
+    def _migrations(cls) -> dict[int, Callable[[dict], dict]]:
+        steps: dict[int, Callable[[dict], dict]] = {}
+        for name in dir(cls):
+            fn = getattr(cls, name)
+            meta = getattr(fn, "__migration__", None)
+            if meta is not None:
+                steps[meta[0]] = fn
+        return steps
+
+    @classmethod
+    def load_and_migrate(cls, path: str | Path) -> "VersionedConfig":
+        path = Path(path)
+        if not path.exists():
+            cfg = cls(path, {"version": cls.VERSION, **cls.defaults()})
+            cfg.save()
+            return cfg
+
+        data = json.loads(path.read_text())
+        version = data.get("version")
+        if version is None:
+            raise MigratorError(f"{path}: missing version field")
+        if version > cls.VERSION:
+            raise MigratorError(
+                f"{path}: version {version} is newer than supported {cls.VERSION}"
+            )
+        steps = cls._migrations()
+        cfg = cls(path, data)
+        while version < cls.VERSION:
+            step = steps.get(version)
+            if step is None:
+                raise MigratorError(f"{path}: no migration from version {version}")
+            cfg.data = step(cfg.data)
+            version += 1
+            cfg.data["version"] = version
+            cfg.save()  # persist each step, like load_and_migrate does
+        # backfill any new defaults without clobbering existing values; persist
+        # so generated defaults (ids, keypair seeds) are stable across boots
+        backfilled = False
+        for key, value in cls.defaults().items():
+            if key not in cfg.data:
+                cfg.data[key] = value
+                backfilled = True
+        if backfilled:
+            cfg.save()
+        return cfg
+
+    def save(self) -> None:
+        tmp = self.path.with_suffix(self.path.suffix + ".tmp")
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        tmp.write_text(json.dumps(self.data, indent=2, sort_keys=True))
+        os.replace(tmp, self.path)
+
+    # -- dict-ish access ----------------------------------------------------
+    def __getitem__(self, key: str) -> Any:
+        return self.data[key]
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.data.get(key, default)
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        self.data[key] = value

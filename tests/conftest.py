@@ -1,0 +1,22 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX import.
+
+Multi-chip hardware is not available in CI; all sharding tests run on
+XLA's host-platform virtual devices. The real-TPU path is exercised by
+bench.py and the driver's __graft_entry__ checks.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_data_dir(tmp_path):
+    d = tmp_path / "sd_data"
+    d.mkdir()
+    return d

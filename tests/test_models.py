@@ -1,0 +1,147 @@
+"""Storage-layer tests: DDL for all 23 tables, CRUD round-trips, encoding,
+unique constraints (file_path's two uniques, schema.prisma:196-197),
+transactions, and single-writer thread safety."""
+
+import datetime as dt
+import sqlite3
+import threading
+import uuid
+
+import pytest
+
+from spacedrive_tpu.models import (
+    ALL_MODELS,
+    Database,
+    FilePath,
+    Location,
+    Object,
+    Preference,
+    Tag,
+    TagOnObject,
+    utc_now,
+)
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = Database(tmp_path / "library.db", ALL_MODELS)
+    yield d
+    d.close()
+
+
+def test_ddl_creates_all_tables(db):
+    rows = db.query("SELECT name FROM sqlite_master WHERE type='table'")
+    tables = {r["name"] for r in rows}
+    for model in ALL_MODELS:
+        assert model.TABLE in tables
+
+
+def test_crud_roundtrip_with_encoding(db):
+    now = utc_now()
+    loc_id = db.insert(
+        Location,
+        {"pub_id": str(uuid.uuid4()), "name": "Photos", "path": "/data/photos",
+         "hidden": False, "date_created": now, "hasher": "tpu"},
+    )
+    row = db.find_one(Location, {"id": loc_id})
+    assert row["name"] == "Photos"
+    assert row["hidden"] is False
+    assert row["date_created"] == now
+    assert row["hasher"] == "tpu"
+
+    db.update(Location, {"id": loc_id}, {"hidden": True})
+    assert db.find_one(Location, {"id": loc_id})["hidden"] is True
+    assert db.count(Location) == 1
+    db.delete(Location, {"id": loc_id})
+    assert db.count(Location) == 0
+
+
+def test_file_path_unique_constraints(db):
+    loc = db.insert(Location, {"pub_id": str(uuid.uuid4()), "path": "/x"})
+    base = {
+        "location_id": loc, "materialized_path": "/", "name": "a", "extension": "txt",
+        "inode": 42, "device": 7,
+    }
+    db.insert(FilePath, {"pub_id": str(uuid.uuid4()), **base})
+    with pytest.raises(sqlite3.IntegrityError):  # same (loc, path, name, ext)
+        db.insert(FilePath, {"pub_id": str(uuid.uuid4()), **base, "inode": 43})
+    with pytest.raises(sqlite3.IntegrityError):  # same (loc, inode, device)
+        db.insert(FilePath, {"pub_id": str(uuid.uuid4()), **base, "name": "b"})
+    # or_ignore path used by the indexer's batched saves
+    assert db.insert_many(FilePath, [{"pub_id": str(uuid.uuid4()), **base}], or_ignore=True) == 1
+    assert db.count(FilePath) == 1
+
+
+def test_transaction_rollback(db):
+    with pytest.raises(RuntimeError):
+        with db.transaction():
+            db.insert(Tag, {"pub_id": str(uuid.uuid4()), "name": "red"})
+            raise RuntimeError("boom")
+    assert db.count(Tag) == 0
+
+    with db.transaction():  # nested scopes join
+        db.insert(Tag, {"pub_id": str(uuid.uuid4()), "name": "red"})
+        with db.transaction():
+            db.insert(Tag, {"pub_id": str(uuid.uuid4()), "name": "blue"})
+    assert db.count(Tag) == 2
+
+
+def test_relation_link_table(db):
+    tag = db.insert(Tag, {"pub_id": str(uuid.uuid4()), "name": "t"})
+    obj = db.insert(Object, {"pub_id": str(uuid.uuid4()), "kind": 5})
+    db.insert(TagOnObject, {"tag_id": tag, "object_id": obj})
+    with pytest.raises(sqlite3.IntegrityError):
+        db.insert(TagOnObject, {"tag_id": tag, "object_id": obj})
+
+
+def test_preference_json_and_upsert(db):
+    db.upsert(Preference, {"key": "explorer.layout"}, {"value": {"mode": "grid"}}, {})
+    db.upsert(Preference, {"key": "explorer.layout"}, {}, {"value": {"mode": "list"}})
+    assert db.find_one(Preference, {"key": "explorer.layout"})["value"] == {"mode": "list"}
+
+
+def test_concurrent_writers(db):
+    errs = []
+
+    def write(n):
+        try:
+            for i in range(50):
+                db.insert(Tag, {"pub_id": str(uuid.uuid4()), "name": f"{n}-{i}"})
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=write, args=(n,)) for n in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert db.count(Tag) == 200
+
+
+def test_none_where_uses_is_null(db):
+    """file_identifier's orphan query filters object_id IS NULL."""
+    loc = db.insert(Location, {"pub_id": str(uuid.uuid4()), "path": "/x"})
+    db.insert(FilePath, {"pub_id": str(uuid.uuid4()), "location_id": loc,
+                         "materialized_path": "/", "name": "orphan", "extension": "txt",
+                         "inode": 1, "device": 1, "object_id": None})
+    obj = db.insert(Object, {"pub_id": str(uuid.uuid4())})
+    db.insert(FilePath, {"pub_id": str(uuid.uuid4()), "location_id": loc,
+                         "materialized_path": "/", "name": "linked", "extension": "txt",
+                         "inode": 2, "device": 1, "object_id": obj})
+    orphans = db.find(FilePath, {"location_id": loc, "object_id": None})
+    assert [r["name"] for r in orphans] == ["orphan"]
+    assert db.count(FilePath, {"object_id": None}) == 1
+    assert db.update(FilePath, {"object_id": None}, {"object_id": obj}) == 1
+
+
+def test_instance_delete_restricted_by_oplog(db):
+    from spacedrive_tpu.models import Instance, SharedOperationRow
+    inst = db.insert(Instance, {"pub_id": str(uuid.uuid4()), "identity": "i",
+                                "node_id": "n", "node_name": "n", "node_platform": 3,
+                                "last_seen": utc_now(), "date_created": utc_now()})
+    db.insert(SharedOperationRow, {"id": str(uuid.uuid4()), "timestamp": 1,
+                                   "model": "tag", "record_id": "r", "kind": "c",
+                                   "data": {}, "instance_id": inst})
+    with pytest.raises(sqlite3.IntegrityError):  # op log must survive unpairing
+        db.delete(Instance, {"id": inst})

@@ -1,0 +1,103 @@
+"""Tests for the migrator, config, and event bus (reference has cfg(test)
+suites for migrator core/src/util/migrator.rs and mpscrr)."""
+
+import json
+import threading
+
+import pytest
+
+from spacedrive_tpu.config import BackendFeature, ConfigManager, NodeConfig
+from spacedrive_tpu.events import CoreEvent, EventBus
+from spacedrive_tpu.utils.migrator import MigratorError, VersionedConfig, migration
+
+
+class _V3Config(VersionedConfig):
+    VERSION = 3
+
+    @classmethod
+    def defaults(cls):
+        return {"name": "fresh", "added_in_v3": True}
+
+    @migration(1, 2)
+    def _m12(data):
+        data["renamed"] = data.pop("old_name", None)
+        return data
+
+    @migration(2, 3)
+    def _m23(data):
+        data["added_in_v3"] = True
+        return data
+
+
+def test_migrator_fresh_file(tmp_path):
+    cfg = _V3Config.load_and_migrate(tmp_path / "c.json")
+    assert cfg["version"] == 3
+    assert cfg["name"] == "fresh"
+    # persisted
+    on_disk = json.loads((tmp_path / "c.json").read_text())
+    assert on_disk["version"] == 3
+
+
+def test_migrator_upgrades_sequentially(tmp_path):
+    path = tmp_path / "c.json"
+    path.write_text(json.dumps({"version": 1, "old_name": "legacy"}))
+    cfg = _V3Config.load_and_migrate(path)
+    assert cfg["version"] == 3
+    assert cfg["renamed"] == "legacy"
+    assert cfg["added_in_v3"] is True
+
+
+def test_migrator_rejects_future_version(tmp_path):
+    path = tmp_path / "c.json"
+    path.write_text(json.dumps({"version": 99}))
+    with pytest.raises(MigratorError):
+        _V3Config.load_and_migrate(path)
+
+
+def test_node_config_roundtrip_and_flags(tmp_data_dir):
+    cfg = NodeConfig.load(tmp_data_dir)
+    node_id = cfg["id"]
+    mgr = ConfigManager(cfg)
+    assert mgr.toggle_feature(BackendFeature.FILES_OVER_P2P) is True
+    assert mgr.has_feature(BackendFeature.FILES_OVER_P2P)
+    assert mgr.toggle_feature(BackendFeature.FILES_OVER_P2P) is False
+
+    # reload keeps identity
+    cfg2 = NodeConfig.load(tmp_data_dir)
+    assert cfg2["id"] == node_id
+    with pytest.raises(ValueError):
+        mgr.toggle_feature("nope")
+
+
+def test_event_bus_broadcast_and_lossy():
+    bus = EventBus(capacity=4)
+    sub = bus.subscribe()
+    bus.emit_kind("job_progress", {"n": 1})
+    assert sub.get(timeout=1).payload == {"n": 1}
+
+    small = bus.subscribe(capacity=2)
+    for i in range(5):
+        bus.emit_kind("tick", i)
+    # oldest dropped, newest kept
+    got = [small.get(timeout=1).payload for _ in range(2)]
+    assert got == [3, 4]
+    sub.close()
+    small.close()
+    bus.emit_kind("after_close")  # no crash on closed subs
+
+
+def test_event_bus_threaded_producers():
+    bus = EventBus()
+    sub = bus.subscribe()
+    threads = [
+        threading.Thread(target=lambda: [bus.emit(CoreEvent("k", i)) for i in range(50)])
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    seen = 0
+    while sub.get(timeout=0.1) is not None:
+        seen += 1
+    assert seen == 200
