@@ -1,0 +1,122 @@
+"""Content-addressable-storage id (cas_id) generation.
+
+Byte-exact port of the reference's sampling scheme (core/src/object/cas.rs:23-62):
+
+    cas_id = hex(BLAKE3(size_le_8 ‖ samples))[:16]
+
+where samples are the whole file when ``size <= 100KiB``, else:
+
+    header  = bytes[0      : 8KiB]
+    sample_i = bytes[8KiB + i*seek_jump : +10KiB]   for i in 0..3,
+               seek_jump = (size - 16KiB) // 4
+    footer  = bytes[size-8KiB : size]
+
+(consts cas.rs:10-15; loop trace :42-51 — four samples at offsets
+``8KiB + i*seek_jump``, then the footer.)
+
+For files > 100KiB the hashed message is therefore a FIXED 57,352 bytes
+(8 + 8192 + 4*10240 + 8192) — a static shape, which is exactly what the
+batched TPU kernel wants. This module provides the host-side gather stage
+(shared by every backend) and the scalar CPU path; the batched TPU path
+lives in ops/blake3_jax.py behind the same sample layout.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from pathlib import Path
+from typing import BinaryIO
+
+from .blake3_ref import blake3
+
+SAMPLE_COUNT = 4
+SAMPLE_SIZE = 1024 * 10
+HEADER_OR_FOOTER_SIZE = 1024 * 8
+MINIMUM_FILE_SIZE = 1024 * 100
+
+# cas.rs:18-21 static asserts
+assert HEADER_OR_FOOTER_SIZE * 2 + SAMPLE_COUNT * SAMPLE_SIZE < MINIMUM_FILE_SIZE
+assert SAMPLE_SIZE > HEADER_OR_FOOTER_SIZE
+
+#: total hashed message length for the sampled (large-file) path
+SAMPLED_MESSAGE_LEN = 8 + 2 * HEADER_OR_FOOTER_SIZE + SAMPLE_COUNT * SAMPLE_SIZE  # 57352
+#: max hashed message length for the whole-file (small) path
+SMALL_MESSAGE_MAX_LEN = 8 + MINIMUM_FILE_SIZE  # 102408
+
+
+def sample_offsets(size: int) -> list[tuple[int, int]]:
+    """(offset, length) reads for a file of ``size`` bytes (> MINIMUM_FILE_SIZE),
+    in hash order: header, 4 strided samples, footer."""
+    seek_jump = (size - HEADER_OR_FOOTER_SIZE * 2) // SAMPLE_COUNT
+    reads = [(0, HEADER_OR_FOOTER_SIZE)]
+    reads += [
+        (HEADER_OR_FOOTER_SIZE + i * seek_jump, SAMPLE_SIZE) for i in range(SAMPLE_COUNT)
+    ]
+    reads.append((size - HEADER_OR_FOOTER_SIZE, HEADER_OR_FOOTER_SIZE))
+    return reads
+
+
+def cas_message_from_file(fh: BinaryIO, size: int) -> bytes:
+    """The exact byte string the reference feeds its hasher."""
+    parts = [struct.pack("<Q", size)]
+    if size <= MINIMUM_FILE_SIZE:
+        fh.seek(0)
+        data = fh.read(size)
+        if len(data) != size:
+            raise EOFError(f"file shrank while hashing: got {len(data)}, want {size}")
+        parts.append(data)
+    else:
+        for offset, length in sample_offsets(size):
+            fh.seek(offset)
+            chunk = fh.read(length)
+            if len(chunk) != length:  # read_exact semantics (cas.rs:36,43,56)
+                raise EOFError(f"short read at {offset}: got {len(chunk)}, want {length}")
+            parts.append(chunk)
+    return b"".join(parts)
+
+
+def generate_cas_id(path: str | Path, size: int | None = None) -> str:
+    """Scalar CPU path, identical output to the reference's generate_cas_id."""
+    path = Path(path)
+    if size is None:
+        size = path.stat().st_size
+    with open(path, "rb", buffering=0) as fh:
+        message = cas_message_from_file(fh, size)
+    return blake3(message).hex()[:16]
+
+
+def generate_cas_id_from_bytes(data: bytes, size: int | None = None) -> str:
+    """cas_id for an in-memory file image (ephemeral/non-indexed browsing path).
+
+    Like the file path, a ``size`` that exceeds the available bytes raises
+    EOFError (read_exact semantics) rather than silently hashing short samples.
+    """
+    size = len(data) if size is None else size
+    if size > len(data):
+        raise EOFError(f"buffer shorter than declared size: {len(data)} < {size}")
+    parts = [struct.pack("<Q", size)]
+    if size <= MINIMUM_FILE_SIZE:
+        parts.append(data[:size])
+    else:
+        for offset, length in sample_offsets(size):
+            parts.append(data[offset : offset + length])
+    return blake3(b"".join(parts)).hex()[:16]
+
+
+def read_sampled_batch(paths: list[str | Path], sizes: list[int]) -> list[bytes | Exception]:
+    """Gather stage for the batched backends: one message per file, hash order.
+
+    Per-file errors (deleted/shrunk files mid-scan) are returned in place as
+    the Exception instance rather than aborting the batch — callers route them
+    into JobRunErrors (the reference accumulates per-step errors instead of
+    failing the job, job/mod.rs:834-841).
+    """
+    out: list[bytes | Exception] = []
+    for path, size in zip(paths, sizes):
+        try:
+            with open(path, "rb", buffering=0) as fh:
+                out.append(cas_message_from_file(fh, size))
+        except (OSError, EOFError) as e:
+            out.append(e)
+    return out

@@ -1,0 +1,127 @@
+"""BLAKE3 + cas_id golden tests.
+
+The TPU kernel must byte-match the reference's cas.rs outputs (SURVEY.md §4
+takeaway 4); these tests pin the CPU oracle first. Official test vectors from
+the public BLAKE3 spec repo (inputs are bytes ``i % 251``).
+"""
+
+import random
+import struct
+
+import pytest
+
+from spacedrive_tpu.objects.blake3_ref import blake3, blake3_hex, blake3_recursive
+from spacedrive_tpu.objects.cas import (
+    HEADER_OR_FOOTER_SIZE,
+    MINIMUM_FILE_SIZE,
+    SAMPLE_COUNT,
+    SAMPLE_SIZE,
+    SAMPLED_MESSAGE_LEN,
+    generate_cas_id,
+    generate_cas_id_from_bytes,
+    sample_offsets,
+)
+
+OFFICIAL_VECTORS = {
+    0: "af1349b9f5f9a1a6a0404dea36dcc9499bcb25c9adc112b7cc9a93cae41f3262",
+    1: "2d3adedff11b61f14c886e35afa036736dcd87a74d27b5c1510225d0f592e213",
+}
+
+
+def _vector_input(n: int) -> bytes:
+    return bytes(i % 251 for i in range(n))
+
+
+@pytest.mark.parametrize("n,digest", sorted(OFFICIAL_VECTORS.items()))
+def test_official_vectors(n, digest):
+    assert blake3_hex(_vector_input(n)) == digest
+
+
+@pytest.mark.parametrize(
+    "n",
+    [1, 2, 63, 64, 65, 127, 128, 1023, 1024, 1025, 2047, 2048, 2049,
+     3 * 1024, 3 * 1024 + 1, 4096, 5 * 1024 - 1, 8 * 1024, 57352, 102408],
+)
+def test_constructions_agree(n):
+    """Incremental chunk-stack vs recursive divide-and-conquer must agree on
+    every block/chunk/tree boundary (includes both cas message lengths)."""
+    rng = random.Random(n)
+    data = rng.randbytes(n)
+    assert blake3(data) == blake3_recursive(data)
+
+
+def test_extended_output():
+    out64 = blake3(b"", out_len=64)
+    assert out64[:32] == blake3(b"")
+    assert len(out64) == 64
+
+
+def test_sample_offsets_match_reference_trace():
+    """Trace of cas.rs:30-58 for a 1MiB file: header @0, samples at
+    8KiB + i*seek_jump, footer at size-8KiB."""
+    size = 1024 * 1024
+    jump = (size - 2 * HEADER_OR_FOOTER_SIZE) // SAMPLE_COUNT
+    reads = sample_offsets(size)
+    assert reads[0] == (0, HEADER_OR_FOOTER_SIZE)
+    for i in range(SAMPLE_COUNT):
+        assert reads[1 + i] == (HEADER_OR_FOOTER_SIZE + i * jump, SAMPLE_SIZE)
+    assert reads[-1] == (size - HEADER_OR_FOOTER_SIZE, HEADER_OR_FOOTER_SIZE)
+    # all reads in-bounds (read_exact must never hit EOF for size > 100KiB)
+    for off, ln in reads:
+        assert 0 <= off and off + ln <= size
+    assert sum(ln for _, ln in reads) + 8 == SAMPLED_MESSAGE_LEN
+
+
+@pytest.mark.parametrize("size", [MINIMUM_FILE_SIZE + 1, 120 * 1024, 1024 * 1024])
+def test_sampled_reads_in_bounds_near_boundary(size):
+    for off, ln in sample_offsets(size):
+        assert 0 <= off and off + ln <= size
+
+
+def test_cas_id_small_file(tmp_path):
+    data = b"hello spacedrive" * 100  # 1600 bytes, whole-file path
+    p = tmp_path / "small.bin"
+    p.write_bytes(data)
+    cas = generate_cas_id(p)
+    # definition: blake3(size_le ‖ data)[:16]
+    expected = blake3(struct.pack("<Q", len(data)) + data).hex()[:16]
+    assert cas == expected
+    assert len(cas) == 16
+    assert cas == generate_cas_id_from_bytes(data)
+
+
+def test_cas_id_large_file_sampled(tmp_path):
+    rng = random.Random(1)
+    data = rng.randbytes(300 * 1024)
+    p = tmp_path / "large.bin"
+    p.write_bytes(data)
+    cas = generate_cas_id(p)
+    assert cas == generate_cas_id_from_bytes(data)
+    # sampling means a middle byte OUTSIDE any sample window doesn't change it
+    reads = sample_offsets(len(data))
+    covered = set()
+    for off, ln in reads:
+        covered.update(range(off, off + ln))
+    untouched = next(i for i in range(len(data)) if i not in covered)
+    mutated = bytearray(data)
+    mutated[untouched] ^= 0xFF
+    assert generate_cas_id_from_bytes(bytes(mutated)) == cas
+    # ...but a byte inside the header does
+    mutated2 = bytearray(data)
+    mutated2[0] ^= 0xFF
+    assert generate_cas_id_from_bytes(bytes(mutated2)) != cas
+
+
+def test_cas_id_size_seeds_hash(tmp_path):
+    """Two files with identical sampled windows but different sizes differ
+    (size is hashed first, cas.rs:25)."""
+    a = generate_cas_id_from_bytes(b"\0" * 200_000)
+    b = generate_cas_id_from_bytes(b"\0" * 200_001)
+    assert a != b
+
+
+def test_cas_id_shrunk_file_raises(tmp_path):
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"x" * 1000)
+    with pytest.raises(EOFError):
+        generate_cas_id(p, size=2000)  # stat lied / file truncated mid-scan

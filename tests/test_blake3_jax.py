@@ -1,0 +1,66 @@
+"""TPU/JAX BLAKE3 kernel parity vs the pure-Python oracle.
+
+Runs on the CPU backend with the virtual-device conftest; the same jitted
+code path runs on real TPU (bench.py / __graft_entry__). Two compiled shapes
+only (57-chunk sampled path, 101-chunk small-file bucket) to bound compile
+time.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.objects.blake3_ref import blake3
+from spacedrive_tpu.objects.cas import SAMPLED_MESSAGE_LEN, generate_cas_id_from_bytes
+from spacedrive_tpu.ops import blake3_jax
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(11)
+
+
+def test_sampled_length_parity(rng):
+    """The large-file hot path: every message exactly 57,352 bytes."""
+    msgs = [rng.randbytes(SAMPLED_MESSAGE_LEN) for _ in range(8)]
+    got = blake3_jax.blake3_batch_hex(msgs)
+    assert got == [blake3(m).hex() for m in msgs]
+
+
+def test_varlen_parity_all_boundaries(rng):
+    """Small-file bucket: single/multi block, single/multi chunk, exact
+    boundaries, the 101-chunk cas maximum, and a zero-length lane."""
+    lens = [0, 1, 8, 63, 64, 65, 127, 128, 1023, 1024, 1025, 2047, 2048, 2049,
+            3 * 1024, 4096, 5000, 65 * 1024, 102408]
+    msgs = [rng.randbytes(n) for n in lens]
+    got = blake3_jax.blake3_batch_hex(msgs, max_chunks=101)
+    want = [blake3(m).hex() for m in msgs]
+    assert got == want
+
+
+def test_cas_ids_match_cpu_path(rng):
+    """cas_id = digest[:16] — TPU batch must agree with objects/cas.py."""
+    from spacedrive_tpu.objects import cas
+
+    datas = [rng.randbytes(n) for n in (500, 1024 * 50, 102400)]
+    msgs = []
+    for d in datas:
+        import struct
+
+        msgs.append(struct.pack("<Q", len(d)) + d)  # small-file message form
+    got = [h[:16] for h in blake3_jax.blake3_batch_hex(msgs, max_chunks=101)]
+    want = [cas.generate_cas_id_from_bytes(d) for d in datas]
+    assert got == want
+
+
+def test_pack_messages_layout():
+    msgs = [b"\x01\x02\x03\x04" + b"\x00" * 60, b"\xff" * 8]
+    words, lengths = blake3_jax.pack_messages(msgs, 1)
+    assert words.shape == (16, 16, 1, 2)
+    assert list(lengths) == [64, 8]
+    # little-endian word assembly: first word of msg0 = 0x04030201
+    assert words[0, 0, 0, 0] == 0x04030201
+    assert words[0, 0, 0, 1] == 0xFFFFFFFF
+    with pytest.raises(ValueError):
+        blake3_jax.pack_messages([b"x" * 2000], 1)
