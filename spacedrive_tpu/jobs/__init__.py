@@ -1,0 +1,20 @@
+"""Stateful job engine: init/steps/finalize with checkpoint/resume."""
+
+from .error import (
+    EarlyFinish,
+    JobAlreadyRunning,
+    JobCanceled,
+    JobError,
+    JobPaused,
+)
+from .job import JOB_REGISTRY, DynJob, JobState, StatefulJob, StepResult, merge_metadata
+from .manager import MAX_WORKERS, Jobs
+from .report import JobReport, JobStatus
+from .worker import Worker, WorkerCommand, WorkerContext
+
+__all__ = [
+    "EarlyFinish", "JobAlreadyRunning", "JobCanceled", "JobError", "JobPaused",
+    "JOB_REGISTRY", "DynJob", "JobState", "StatefulJob", "StepResult",
+    "merge_metadata", "MAX_WORKERS", "Jobs", "JobReport", "JobStatus",
+    "Worker", "WorkerCommand", "WorkerContext",
+]

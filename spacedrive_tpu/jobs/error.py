@@ -1,0 +1,30 @@
+"""Job errors (core/src/job/error.rs)."""
+
+from __future__ import annotations
+
+
+class JobError(Exception):
+    """Fatal job failure → status Failed."""
+
+
+class JobPaused(Exception):  # JobError::Paused(state, signal)
+    """Raised by the command check to unwind the run loop; carries the
+    serialized checkpoint."""
+
+    def __init__(self, state_blob: bytes, from_shutdown: bool = False) -> None:
+        super().__init__("job paused")
+        self.state_blob = state_blob
+        self.from_shutdown = from_shutdown
+
+
+class JobCanceled(Exception):  # JobError::Canceled
+    pass
+
+
+class EarlyFinish(Exception):  # JobError::EarlyFinish — clean no-op completion
+    def __init__(self, reason: str = "nothing to do") -> None:
+        super().__init__(reason)
+
+
+class JobAlreadyRunning(JobError):
+    """Dedup rejection: same job hash running or queued (manager.rs:109-114)."""

@@ -1,0 +1,220 @@
+"""The StatefulJob protocol and its type-erased runner.
+
+Semantics ported from the reference (not its tokio mechanics): a job is
+``init()`` → a list of serializable steps → ``execute_step()`` per step →
+``finalize()`` (StatefulJob trait, core/src/job/mod.rs:68-110). Between steps
+the runner polls its command channel; Pause/Shutdown serialize the full
+``JobState{init, data, steps, step_number, run_metadata}`` into the report
+(job/mod.rs:679-781) so a later ``new_from_report`` resumes at the exact step
+(job/mod.rs:215-233). Steps may append more steps (the indexer's Walk steps);
+per-step errors accumulate into CompletedWithErrors instead of aborting
+(job/mod.rs:834-841); EarlyFinish is a clean skip (error.rs).
+
+State is JSON — every job's ``init_args``/``data``/steps must be plain
+JSON-serializable values, which keeps checkpoints portable and debuggable.
+
+TPU note: a "step" is the checkpoint quantum. Batched jobs (IS_BATCHED) size
+steps to one device batch, so a killed hashing run resumes at the last
+completed batch and device work quiesces at step granularity on Pause —
+the property §5.4 of SURVEY.md calls out.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+from typing import TYPE_CHECKING, Any, Callable, ClassVar
+
+from .error import EarlyFinish, JobCanceled, JobError, JobPaused
+from .report import JobReport, JobStatus
+
+if TYPE_CHECKING:
+    from .worker import WorkerContext
+
+logger = logging.getLogger(__name__)
+
+JOB_REGISTRY: dict[str, type["StatefulJob"]] = {}
+
+
+class StepResult:
+    """What one execute_step returns."""
+
+    __slots__ = ("more_steps", "metadata", "errors")
+
+    def __init__(self, more_steps: list[Any] | None = None,
+                 metadata: dict[str, Any] | None = None,
+                 errors: list[str] | None = None) -> None:
+        self.more_steps = more_steps or []
+        self.metadata = metadata or {}
+        self.errors = errors or []
+
+
+class StatefulJob:
+    """Subclass with NAME, init(), execute_step(); register for cold resume.
+
+    ``init_args`` identify the job (dedup hash, job/mod.rs:84-90); ``data`` is
+    shared working state produced by init; steps are the serializable work
+    units.
+    """
+
+    NAME: ClassVar[str] = ""
+    IS_BATCHED: ClassVar[bool] = False
+
+    def __init__(self, init_args: dict[str, Any]) -> None:
+        self.init_args = init_args
+
+    # -- identity -----------------------------------------------------------
+    def hash(self) -> str:
+        """Dedup identity: name + canonical init args (job/mod.rs:84-90)."""
+        blob = json.dumps({"name": self.NAME, "args": self.init_args}, sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+    # -- lifecycle (override) ----------------------------------------------
+    def init(self, ctx: "WorkerContext") -> tuple[dict[str, Any], list[Any], dict[str, Any]]:
+        """Returns (data, steps, initial run_metadata). Raise EarlyFinish to
+        complete with nothing to do."""
+        raise NotImplementedError
+
+    def execute_step(self, ctx: "WorkerContext", data: dict[str, Any],
+                     step: Any, step_number: int) -> StepResult:
+        raise NotImplementedError
+
+    def finalize(self, ctx: "WorkerContext", data: dict[str, Any],
+                 run_metadata: dict[str, Any]) -> dict[str, Any] | None:
+        """Returns final metadata for the report."""
+        return run_metadata or None
+
+    # registration for name→type dispatch at cold resume (manager.rs:376-401)
+    def __init_subclass__(cls, **kw: Any) -> None:
+        super().__init_subclass__(**kw)
+        if cls.NAME:
+            JOB_REGISTRY[cls.NAME] = cls
+
+
+def merge_metadata(acc: dict[str, Any], update: dict[str, Any]) -> None:
+    """RunMetadata::update semantics: numeric values accumulate, lists extend,
+    everything else overwrites."""
+    for key, value in update.items():
+        old = acc.get(key)
+        if isinstance(old, (int, float)) and isinstance(value, (int, float)) and not isinstance(old, bool):
+            acc[key] = old + value
+        elif isinstance(old, list) and isinstance(value, list):
+            acc[key] = old + value
+        else:
+            acc[key] = value
+
+
+class JobState:
+    """The checkpointable whole of a running job (job/mod.rs:247-288)."""
+
+    def __init__(self, init_args: dict[str, Any], data: dict[str, Any] | None,
+                 steps: list[Any], step_number: int, run_metadata: dict[str, Any]) -> None:
+        self.init_args = init_args
+        self.data = data
+        self.steps = steps
+        self.step_number = step_number
+        self.run_metadata = run_metadata
+
+    def serialize(self) -> bytes:
+        return json.dumps({
+            "init_args": self.init_args,
+            "data": self.data,
+            "steps": self.steps,
+            "step_number": self.step_number,
+            "run_metadata": self.run_metadata,
+        }).encode()
+
+    @classmethod
+    def deserialize(cls, blob: bytes) -> "JobState":
+        d = json.loads(blob.decode())
+        return cls(d["init_args"], d["data"], d["steps"], d["step_number"], d["run_metadata"])
+
+
+class DynJob:
+    """Type-erased runner for one job + its queued-next chain
+    (Job<SJob>/DynJob, job/mod.rs:113-245)."""
+
+    def __init__(self, job: StatefulJob, report: JobReport,
+                 state: JobState | None = None,
+                 next_jobs: list["DynJob"] | None = None) -> None:
+        self.job = job
+        self.report = report
+        self.state = state or JobState(job.init_args, None, [], 0, {})
+        self.next_jobs = next_jobs or []
+
+    @property
+    def id(self) -> str:
+        return self.report.id
+
+    def hash(self) -> str:
+        return self.job.hash()
+
+    @classmethod
+    def new_from_report(cls, report: JobReport) -> "DynJob":
+        """Revive a job from its persisted report + checkpoint
+        (job/mod.rs:215-233 + manager.rs:376-401 dispatch)."""
+        job_type = JOB_REGISTRY.get(report.name)
+        if job_type is None:
+            raise JobError(f"unknown job name for resume: {report.name!r}")
+        if report.data:
+            state = JobState.deserialize(report.data)
+        else:
+            state = None
+        job = job_type(state.init_args if state else {})
+        return cls(job, report, state)
+
+    # -- the run loop -------------------------------------------------------
+    def run(self, ctx: "WorkerContext") -> tuple[dict[str, Any] | None, list[str]]:
+        """Drive init/steps/finalize, checking commands between steps.
+
+        Returns (metadata, errors). Raises JobPaused (with serialized state),
+        JobCanceled, or JobError on fatal failure.
+        """
+        state = self.state
+        errors: list[str] = list(filter(None, (self.report.errors_text or "").split("\n\n")))
+
+        if state.data is None:  # fresh run (not a resume)
+            t0 = time.perf_counter()
+            try:
+                data, steps, meta = self.job.init(ctx)
+            except EarlyFinish as e:
+                logger.info("job %s early finish: %s", self.job.NAME, e)
+                return self.job.finalize(ctx, {}, {}), errors
+            state.data = data
+            state.steps = list(steps)
+            state.run_metadata = dict(meta)
+            state.step_number = 0
+            ctx.progress(task_count=len(state.steps),
+                         message=f"{self.job.NAME}: {len(state.steps)} steps")
+            logger.debug("job %s init phase took %.3fs", self.job.NAME, time.perf_counter() - t0)
+            ctx.check_commands(self)  # a pause during init checkpoints cleanly
+
+        while state.step_number < len(state.steps):
+            ctx.check_commands(self)
+            step = state.steps[state.step_number]
+            t0 = time.perf_counter()
+            try:
+                result = self.job.execute_step(ctx, state.data, step, state.step_number)
+            except EarlyFinish:
+                break
+            # a raised exception is fatal (reference: a step Err fails the job);
+            # per-item soft errors come back in StepResult.errors and accumulate
+            # into CompletedWithErrors (job/mod.rs:834-841)
+            if result.more_steps:
+                state.steps.extend(result.more_steps)
+                ctx.progress(task_count=len(state.steps))
+            if result.metadata:
+                merge_metadata(state.run_metadata, result.metadata)
+            errors.extend(result.errors)
+            state.step_number += 1
+            ctx.progress(completed_task_count=state.step_number)
+            logger.debug("job %s step %d finished in %.3fs",
+                         self.job.NAME, state.step_number - 1, time.perf_counter() - t0)
+
+        metadata = self.job.finalize(ctx, state.data or {}, state.run_metadata)
+        return metadata, errors
+
+    def serialize_state(self) -> bytes:
+        return self.state.serialize()

@@ -1,0 +1,216 @@
+"""Jobs manager: ingest/dispatch queue with single-writer discipline.
+
+Equivalent of core/src/job/manager.rs. MAX_WORKERS stays 1 for the same reason
+as the reference ("db is single threaded, nerd", manager.rs:31-32): the library
+DB has one writer, and the parallelism that matters — batched hashing — happens
+*inside* a step on the TPU, not across jobs. Dedup by job hash (:109-114),
+queue overflow persisted as Queued reports (:162-177), chained-job completion
+(:180-205), and cold resume of Paused/Running/Queued reports at startup
+(:269-319).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import TYPE_CHECKING
+
+from ..models import JobRow
+from .error import JobAlreadyRunning
+from .job import DynJob, StatefulJob
+from .report import JobReport, JobStatus
+from .worker import Worker, WorkerCommand
+
+if TYPE_CHECKING:
+    from ..library import Library
+
+logger = logging.getLogger(__name__)
+
+MAX_WORKERS = 1
+
+
+class Jobs:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._running: dict[str, Worker] = {}  # job id -> worker
+        self._queue: deque[tuple["Library", DynJob]] = deque()
+        self._shutting_down = False
+        self._idle = threading.Event()
+        self._idle.set()
+
+    # -- public API ---------------------------------------------------------
+    def spawn(self, library: "Library", jobs: list[StatefulJob],
+              action: str | None = None) -> str:
+        """Build a chained pipeline (JobBuilder::queue_next, job/mod.rs:194-212)
+        and ingest its head. Returns the head job report id."""
+        if not jobs:
+            raise ValueError("spawn requires at least one job")
+        dyn_jobs: list[DynJob] = []
+        parent_id = None
+        for i, job in enumerate(jobs):
+            act = f"{action}-{i}" if action and i else action
+            report = JobReport.new(job.NAME, action=act, parent_id=parent_id)
+            dyn = DynJob(job, report)
+            # persist init args up front so any later cold resume can rebuild
+            # the job even if it never ran (children of a crashed head)
+            report.data = dyn.serialize_state()
+            dyn_jobs.append(dyn)
+            if i == 0:
+                parent_id = report.id
+        head = dyn_jobs[0]
+        head.next_jobs = dyn_jobs[1:]
+        for dyn in dyn_jobs[1:]:
+            dyn.report.status = JobStatus.QUEUED
+            dyn.report.upsert(library.db)
+        self.ingest(library, head)
+        return head.id
+
+    def ingest(self, library: "Library", dyn_job: DynJob) -> None:
+        with self._lock:
+            if self._shutting_down:
+                raise JobAlreadyRunning("job system is shutting down")
+            new_hash = dyn_job.hash()
+            for worker in self._running.values():
+                if worker.dyn_job.hash() == new_hash:
+                    raise JobAlreadyRunning(
+                        f"job {dyn_job.job.NAME} already running (hash {new_hash[:8]})")
+            for _, queued in self._queue:
+                if queued.hash() == new_hash:
+                    raise JobAlreadyRunning(
+                        f"job {dyn_job.job.NAME} already queued (hash {new_hash[:8]})")
+            if len(self._running) < MAX_WORKERS:
+                self._dispatch(library, dyn_job)
+            else:
+                dyn_job.report.status = JobStatus.QUEUED
+                dyn_job.report.upsert(library.db)
+                self._queue.append((library, dyn_job))
+                logger.debug("job %s queued (%d in queue)",
+                             dyn_job.job.NAME, len(self._queue))
+
+    def complete(self, library: "Library", worker: Worker,
+                 next_job: DynJob | None) -> None:
+        """Called by the worker thread as it exits; dispatches the chained next
+        job or pops the queue (manager.rs:180-205)."""
+        with self._lock:
+            self._running.pop(worker.report.id, None)
+            if not self._shutting_down:
+                if next_job is not None:
+                    try:
+                        self.ingest(library, next_job)
+                    except JobAlreadyRunning as e:
+                        logger.warning("chained job dropped: %s", e)
+                # refill any remaining capacity from the queue (the chained job
+                # may have been dropped by dedup, or may itself have queued)
+                while self._queue and len(self._running) < MAX_WORKERS:
+                    lib, queued = self._queue.popleft()
+                    self._dispatch(lib, queued)
+            if not self._running:
+                self._idle.set()
+
+    def _dispatch(self, library: "Library", dyn_job: DynJob) -> None:
+        worker = Worker(self, library, dyn_job)
+        self._running[dyn_job.id] = worker
+        self._idle.clear()
+        logger.info("dispatching job %s (%s)", dyn_job.job.NAME, dyn_job.id[:8])
+        worker.start()
+
+    # -- control ------------------------------------------------------------
+    def pause(self, job_id: str) -> bool:
+        with self._lock:
+            worker = self._running.get(job_id)
+        if worker is None:
+            return False
+        worker.send_command(WorkerCommand.PAUSE)
+        return True
+
+    def cancel(self, job_id: str) -> bool:
+        with self._lock:
+            worker = self._running.get(job_id)
+            if worker is None:  # maybe queued
+                for i, (lib, queued) in enumerate(self._queue):
+                    if queued.id == job_id:
+                        del self._queue[i]
+                        queued.report.status = JobStatus.CANCELED
+                        queued.report.upsert(lib.db)
+                        return True
+                return False
+        worker.send_command(WorkerCommand.CANCEL)
+        return True
+
+    def resume(self, library: "Library", job_id: str) -> bool:
+        """Revive a Paused report from its checkpoint."""
+        row = library.db.find_one(JobRow, {"id": job_id})
+        if row is None or row["status"] != JobStatus.PAUSED:
+            return False
+        dyn_job = DynJob.new_from_report(JobReport.from_row(row))
+        dyn_job.next_jobs = self._load_children(library, job_id)
+        self.ingest(library, dyn_job)
+        return True
+
+    def is_active(self) -> bool:
+        with self._lock:
+            return bool(self._running or self._queue)
+
+    def wait_idle(self, timeout: float | None = None) -> bool:
+        """Test/shell helper: block until no jobs are running or queued."""
+        while True:
+            if not self._idle.wait(timeout):
+                return False
+            with self._lock:
+                if not self._running and not self._queue:
+                    return True
+                if self._queue and len(self._running) < MAX_WORKERS:
+                    lib, queued = self._queue.popleft()
+                    self._dispatch(lib, queued)
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """Graceful: every running job checkpoints (WorkerCommand::Shutdown →
+        serialized state, manager.rs:56-62); queued jobs stay Queued in DB."""
+        with self._lock:
+            self._shutting_down = True
+            workers = list(self._running.values())
+            for lib, queued in self._queue:
+                queued.report.status = JobStatus.QUEUED
+                queued.report.upsert(lib.db)
+            self._queue.clear()
+        for worker in workers:
+            worker.send_command(WorkerCommand.SHUTDOWN)
+        for worker in workers:
+            worker.join(timeout)
+
+    # -- cold resume (manager.rs:269-319) -----------------------------------
+    def cold_resume(self, library: "Library") -> int:
+        """At library load: revive Paused/Running (crashed) jobs from their
+        checkpoints and re-queue Queued ones; undeserializable → Canceled."""
+        revived = 0
+        rows = library.db.query(
+            "SELECT * FROM job WHERE status IN (?, ?, ?) AND parent_id IS NULL ORDER BY date_created",
+            [JobStatus.PAUSED, JobStatus.RUNNING, JobStatus.QUEUED],
+        )
+        for raw in rows:
+            row = JobRow.decode_row(raw)
+            report = JobReport.from_row(row)
+            try:
+                dyn_job = DynJob.new_from_report(report)
+                dyn_job.next_jobs = self._load_children(library, report.id)
+                self.ingest(library, dyn_job)
+                revived += 1
+            except Exception as e:
+                logger.warning("cold resume failed for %s (%s): %s; marking Canceled",
+                               report.name, report.id[:8], e)
+                report.status = JobStatus.CANCELED
+                report.upsert(library.db)
+        return revived
+
+    def _load_children(self, library: "Library", parent_id: str) -> list[DynJob]:
+        children = []
+        for raw in library.db.find(JobRow, {"parent_id": parent_id},
+                                   order_by="date_created"):
+            report = JobReport.from_row(raw)
+            if report.status in (JobStatus.PAUSED, JobStatus.QUEUED):
+                try:
+                    children.append(DynJob.new_from_report(report))
+                except Exception as e:
+                    logger.warning("dropping unresumable child %s: %s", report.id[:8], e)
+        return children

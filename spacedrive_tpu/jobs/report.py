@@ -1,0 +1,111 @@
+"""Job reports: the DB-backed record of every job run.
+
+Equivalent of the reference's JobReport (core/src/job/report.rs:41-62) and
+JobStatus enum; persisted in the ``job`` table (schema.prisma:407-436) with
+the serialized checkpoint state in ``data`` and chained-pipeline parentage in
+``parent_id``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as dt
+import json
+import uuid
+from typing import Any
+
+from ..models import Database, JobRow, utc_now
+
+
+class JobStatus:
+    QUEUED = 0
+    RUNNING = 1
+    COMPLETED = 2
+    CANCELED = 3
+    FAILED = 4
+    PAUSED = 5
+    COMPLETED_WITH_ERRORS = 6
+
+    FINISHED = (COMPLETED, CANCELED, FAILED, COMPLETED_WITH_ERRORS)
+
+    NAMES = {
+        0: "Queued", 1: "Running", 2: "Completed", 3: "Canceled",
+        4: "Failed", 5: "Paused", 6: "CompletedWithErrors",
+    }
+
+
+@dataclasses.dataclass
+class JobReport:
+    id: str
+    name: str
+    status: int = JobStatus.QUEUED
+    action: str | None = None
+    errors_text: str | None = None
+    data: bytes | None = None  # serialized JobState checkpoint
+    metadata: dict[str, Any] | None = None
+    parent_id: str | None = None
+    task_count: int = 0
+    completed_task_count: int = 0
+    date_estimated_completion: dt.datetime | None = None
+    date_created: dt.datetime | None = None
+    date_started: dt.datetime | None = None
+    date_completed: dt.datetime | None = None
+    message: str = ""  # live progress message (not persisted)
+
+    @classmethod
+    def new(cls, name: str, action: str | None = None, parent_id: str | None = None) -> "JobReport":
+        return cls(id=str(uuid.uuid4()), name=name, action=action,
+                   parent_id=parent_id, date_created=utc_now())
+
+    # -- persistence --------------------------------------------------------
+    def create(self, db: Database) -> None:
+        db.insert(JobRow, self._row())
+
+    def update(self, db: Database) -> None:
+        row = self._row()
+        row.pop("id")
+        db.update(JobRow, {"id": self.id}, row)
+
+    def upsert(self, db: Database) -> None:
+        if db.find_one(JobRow, {"id": self.id}) is None:
+            self.create(db)
+        else:
+            self.update(db)
+
+    def _row(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "name": self.name,
+            "action": self.action,
+            "status": self.status,
+            "errors_text": self.errors_text,
+            "data": self.data,
+            "metadata": self.metadata,
+            "parent_id": self.parent_id,
+            "task_count": self.task_count,
+            "completed_task_count": self.completed_task_count,
+            "date_estimated_completion": self.date_estimated_completion,
+            "date_created": self.date_created,
+            "date_started": self.date_started,
+            "date_completed": self.date_completed,
+        }
+
+    @classmethod
+    def from_row(cls, row: dict[str, Any]) -> "JobReport":
+        fields = {f.name for f in dataclasses.fields(cls)} - {"message"}
+        return cls(**{k: v for k, v in row.items() if k in fields})
+
+    def progress_payload(self) -> dict[str, Any]:
+        """The jobs.progress subscription payload (worker.rs:29-35)."""
+        return {
+            "id": self.id,
+            "name": self.name,
+            "status": self.status,
+            "task_count": self.task_count,
+            "completed_task_count": self.completed_task_count,
+            "message": self.message,
+            "estimated_completion": (
+                self.date_estimated_completion.isoformat()
+                if self.date_estimated_completion else None
+            ),
+        }

@@ -1,0 +1,172 @@
+"""Worker: one thread per running job.
+
+Equivalent of core/src/job/worker.rs — owns the command channel, publishes
+timed progress events as ``CoreEvent::JobProgress``, persists report
+transitions, and computes ETA from step cadence.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import logging
+import queue
+import threading
+import time
+from typing import TYPE_CHECKING, Any
+
+from ..models import utc_now
+from .error import JobCanceled, JobPaused
+from .job import DynJob
+from .report import JobReport, JobStatus
+
+if TYPE_CHECKING:
+    from ..library import Library
+    from .manager import Jobs
+
+logger = logging.getLogger(__name__)
+
+PROGRESS_THROTTLE_S = 0.05
+
+
+class WorkerCommand:
+    PAUSE = "pause"
+    CANCEL = "cancel"
+    SHUTDOWN = "shutdown"
+
+
+class WorkerContext:
+    """Passed to job code: progress reporting + command polling + library
+    access (WorkerContext, worker.rs:53-88)."""
+
+    def __init__(self, worker: "Worker") -> None:
+        self._worker = worker
+        self.library = worker.library
+        self.node = worker.library.node if worker.library else None
+
+    def progress(self, completed_task_count: int | None = None,
+                 task_count: int | None = None, message: str | None = None) -> None:
+        self._worker.update_progress(completed_task_count, task_count, message)
+
+    def check_commands(self, dyn_job: DynJob) -> None:
+        """Between-steps poll; raises JobPaused/JobCanceled to unwind."""
+        cmd = self._worker.poll_command()
+        if cmd is None:
+            return
+        if cmd == WorkerCommand.CANCEL:
+            raise JobCanceled()
+        if cmd in (WorkerCommand.PAUSE, WorkerCommand.SHUTDOWN):
+            raise JobPaused(dyn_job.serialize_state(),
+                            from_shutdown=cmd == WorkerCommand.SHUTDOWN)
+
+
+class Worker:
+    def __init__(self, manager: "Jobs", library: "Library", dyn_job: DynJob) -> None:
+        self.manager = manager
+        self.library = library
+        self.dyn_job = dyn_job
+        self.report = dyn_job.report
+        self._commands: queue.Queue[str] = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self._started_at = 0.0
+        self._last_progress_emit = 0.0
+
+    # -- control ------------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._do_work, name=f"job-{self.report.name}-{self.report.id[:8]}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def send_command(self, command: str) -> None:
+        self._commands.put(command)
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def poll_command(self) -> str | None:
+        try:
+            return self._commands.get_nowait()
+        except queue.Empty:
+            return None
+
+    # -- progress -----------------------------------------------------------
+    def update_progress(self, completed: int | None, total: int | None,
+                        message: str | None) -> None:
+        r = self.report
+        if completed is not None:
+            r.completed_task_count = completed
+        if total is not None:
+            r.task_count = total
+        if message is not None:
+            r.message = message
+        # ETA from cadence so far (worker.rs estimated_completion)
+        if r.completed_task_count and r.task_count:
+            elapsed = time.monotonic() - self._started_at
+            remaining = elapsed / r.completed_task_count * (
+                r.task_count - r.completed_task_count
+            )
+            r.date_estimated_completion = utc_now() + dt.timedelta(seconds=remaining)
+        now = time.monotonic()
+        if now - self._last_progress_emit >= PROGRESS_THROTTLE_S:
+            self._last_progress_emit = now
+            self._emit_progress()
+
+    def _emit_progress(self) -> None:
+        self.library.emit("job_progress", self.report.progress_payload())
+
+    # -- the work loop ------------------------------------------------------
+    def _do_work(self) -> None:
+        r = self.report
+        r.status = JobStatus.RUNNING
+        r.date_started = utc_now()
+        r.upsert(self.library.db)
+        self._started_at = time.monotonic()
+        ctx = WorkerContext(self)
+        run_time = 0.0
+        next_job: DynJob | None = None
+        try:
+            metadata, errors = self.dyn_job.run(ctx)
+            run_time = time.monotonic() - self._started_at
+            r.metadata = metadata
+            if errors:
+                r.status = JobStatus.COMPLETED_WITH_ERRORS
+                r.errors_text = "\n\n".join(errors)
+            else:
+                r.status = JobStatus.COMPLETED
+            r.date_completed = utc_now()
+            next_job = self.dyn_job.next_jobs.pop(0) if self.dyn_job.next_jobs else None
+            if next_job is not None:
+                next_job.next_jobs = self.dyn_job.next_jobs
+        except JobPaused as p:
+            r.status = JobStatus.PAUSED
+            r.data = p.state_blob
+            self._pause_children(p.state_blob)
+        except JobCanceled:
+            r.status = JobStatus.CANCELED
+            r.date_completed = utc_now()
+            self._cancel_children()
+        except Exception as e:
+            logger.exception("job %s failed", r.name)
+            r.status = JobStatus.FAILED
+            r.errors_text = repr(e)
+            r.date_completed = utc_now()
+            self._cancel_children()
+        finally:
+            r.upsert(self.library.db)
+            self._emit_progress()
+            logger.info("job %s -> %s (total run time %.3fs)",
+                        r.name, JobStatus.NAMES[r.status], run_time)
+            self.manager.complete(self.library, self, next_job)
+
+    def _pause_children(self, _blob: bytes) -> None:
+        """Persist queued-next chain as Paused reports (job/mod.rs:917-951)."""
+        for child in self.dyn_job.next_jobs:
+            child.report.status = JobStatus.PAUSED
+            child.report.upsert(self.library.db)
+
+    def _cancel_children(self) -> None:
+        for child in self.dyn_job.next_jobs:
+            child.report.status = JobStatus.CANCELED
+            child.report.upsert(self.library.db)

@@ -1,0 +1,206 @@
+"""Library and Libraries manager.
+
+A Library (core/src/library/library.rs:39-61) is one synced database: its own
+SQLite file, sync manager, instance identity, and config sidecar. The Libraries
+manager (library/manager/mod.rs:51-61) loads ``libraries/*.sdlibrary`` configs
+plus sibling ``.db`` files at startup, creates/edits/deletes libraries, and
+broadcasts load/edit/delete events that the location watchers, job cold-resume
+and networked-library machinery subscribe to.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import logging
+import threading
+import uuid
+from pathlib import Path
+from typing import TYPE_CHECKING, Any, Callable
+
+from .config import Platform
+from .models import ALL_MODELS, Database, Instance, utc_now
+from .utils.migrator import VersionedConfig
+
+if TYPE_CHECKING:
+    from .node import Node
+
+logger = logging.getLogger(__name__)
+
+
+class LibraryConfig(VersionedConfig):
+    """The versioned ``<uuid>.sdlibrary`` sidecar (library/config.rs)."""
+
+    VERSION = 1
+
+    @classmethod
+    def defaults(cls) -> dict[str, Any]:
+        return {"name": "", "description": "", "instance_id": 0}
+
+
+def validate_library_name(name: str) -> str:
+    """LibraryName newtype validation (library/name.rs)."""
+    name = name.strip()
+    if not name:
+        raise ValueError("library name cannot be empty")
+    return name
+
+
+class Library:
+    def __init__(self, lib_id: str, config: LibraryConfig, db: Database,
+                 node: "Node | None" = None) -> None:
+        self.id = lib_id
+        self.config = config
+        self.db = db
+        self.node = node
+        self._lock = threading.RLock()
+        self.instance_id: int = config.get("instance_id", 0)
+        self.sync = None  # attached by sync.Manager (sync layer)
+
+    @property
+    def name(self) -> str:
+        return self.config.get("name", "")
+
+    def emit(self, kind: str, payload: Any = None) -> None:
+        if self.node is not None:
+            self.node.events.emit_kind(kind, payload, library_id=self.id)
+
+    def instance(self) -> dict[str, Any] | None:
+        return self.db.find_one(Instance, {"id": self.instance_id})
+
+    def close(self) -> None:
+        self.db.close()
+
+
+class LibraryManagerEvent:
+    LOAD = "load"
+    EDIT = "edit"
+    DELETE = "delete"
+    INSTANCES_MODIFIED = "instances_modified"
+
+
+class Libraries:
+    """Loads and owns every library under ``<data_dir>/libraries``."""
+
+    def __init__(self, data_dir: str | Path, node: "Node | None" = None) -> None:
+        self.dir = Path(data_dir) / "libraries"
+        self.node = node
+        self._lock = threading.RLock()
+        self._libraries: dict[str, Library] = {}
+        self._subscribers: list[Callable[[str, Library], None]] = []
+
+    # -- events -------------------------------------------------------------
+    def subscribe(self, fn: Callable[[str, Library], None]) -> None:
+        """Register for (event, library) callbacks; replays Load for already-
+        loaded libraries (the mpscrr ack-subscription pattern, manager:42-48)."""
+        with self._lock:
+            self._subscribers.append(fn)
+            current = list(self._libraries.values())
+        for lib in current:
+            fn(LibraryManagerEvent.LOAD, lib)
+
+    def _emit(self, event: str, library: Library) -> None:
+        with self._lock:
+            subs = list(self._subscribers)
+        for fn in subs:
+            try:
+                fn(event, library)
+            except Exception:
+                logger.exception("library event subscriber failed (%s)", event)
+
+    # -- lifecycle ----------------------------------------------------------
+    def init(self) -> None:
+        """Load all .sdlibrary configs; corrupt ones are skipped with a warning
+        (manager/mod.rs:95-120)."""
+        self.dir.mkdir(parents=True, exist_ok=True)
+        for cfg_path in sorted(self.dir.glob("*.sdlibrary")):
+            lib_id = cfg_path.stem
+            try:
+                self._load(lib_id)
+            except Exception:
+                logger.exception("skipping corrupt library %s", lib_id)
+
+    def _load(self, lib_id: str) -> Library:
+        config = LibraryConfig.load_and_migrate(self.dir / f"{lib_id}.sdlibrary")
+        db = Database(self.dir / f"{lib_id}.db", ALL_MODELS)
+        library = Library(lib_id, config, db, self.node)
+        self._attach_services(library)
+        with self._lock:
+            self._libraries[lib_id] = library
+        self._emit(LibraryManagerEvent.LOAD, library)
+        return library
+
+    def _attach_services(self, library: Library) -> None:
+        from .sync.manager import SyncManager  # cycle-free local import
+
+        library.sync = SyncManager(library)
+
+    def create(self, name: str, description: str = "",
+               lib_id: str | None = None,
+               instance_pub_id: str | None = None) -> Library:
+        """Create a library + its own Instance row (create_with_uuid is the
+        pairing path, library/manager create_with_uuid)."""
+        name = validate_library_name(name)
+        lib_id = lib_id or str(uuid.uuid4())
+        if lib_id in self._libraries:
+            raise ValueError(f"library {lib_id} already exists")
+        self.dir.mkdir(parents=True, exist_ok=True)
+        config = LibraryConfig.load_and_migrate(self.dir / f"{lib_id}.sdlibrary")
+        config["name"] = name
+        config["description"] = description
+        db = Database(self.dir / f"{lib_id}.db", ALL_MODELS)
+        node_cfg = self.node.config.get() if self.node else {}
+        instance_id = db.insert(Instance, {
+            "pub_id": instance_pub_id or str(uuid.uuid4()),
+            "identity": node_cfg.get("keypair_seed", "")[:16] or "local",
+            "node_id": node_cfg.get("id", str(uuid.uuid4())),
+            "node_name": node_cfg.get("name", "node"),
+            "node_platform": node_cfg.get("platform", Platform.current()),
+            "last_seen": utc_now(),
+            "date_created": utc_now(),
+        })
+        config["instance_id"] = instance_id
+        config.save()
+        library = Library(lib_id, config, db, self.node)
+        self._attach_services(library)
+        with self._lock:
+            self._libraries[lib_id] = library
+        self._emit(LibraryManagerEvent.LOAD, library)
+        return library
+
+    def edit(self, lib_id: str, name: str | None = None,
+             description: str | None = None) -> Library:
+        library = self.get(lib_id)
+        if name is not None:
+            library.config["name"] = validate_library_name(name)
+        if description is not None:
+            library.config["description"] = description
+        library.config.save()
+        self._emit(LibraryManagerEvent.EDIT, library)
+        return library
+
+    def delete(self, lib_id: str) -> None:
+        library = self.get(lib_id)
+        self._emit(LibraryManagerEvent.DELETE, library)
+        with self._lock:
+            self._libraries.pop(lib_id, None)
+        library.close()
+        (self.dir / f"{lib_id}.sdlibrary").unlink(missing_ok=True)
+        (self.dir / f"{lib_id}.db").unlink(missing_ok=True)
+
+    # -- access -------------------------------------------------------------
+    def get(self, lib_id: str) -> Library:
+        with self._lock:
+            if lib_id not in self._libraries:
+                raise KeyError(f"library {lib_id} not loaded")
+            return self._libraries[lib_id]
+
+    def list(self) -> list[Library]:
+        with self._lock:
+            return list(self._libraries.values())
+
+    def close(self) -> None:
+        with self._lock:
+            libs = list(self._libraries.values())
+            self._libraries.clear()
+        for lib in libs:
+            lib.close()

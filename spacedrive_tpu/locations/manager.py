@@ -1,0 +1,94 @@
+"""Locations actor: watcher lifecycle + online-locations set.
+
+Mirrors core/src/location/manager/mod.rs — tracks which locations are online
+and owns per-location filesystem watchers (inotify on Linux; the per-OS
+EventHandler seam of watcher/mod.rs:32-66 is kept for parity). The watcher is
+attached lazily in the watcher milestone; the actor API is stable now so the
+Node boot order matches the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import TYPE_CHECKING
+
+from ..library import LibraryManagerEvent
+
+if TYPE_CHECKING:
+    from ..library import Library
+    from ..node import Node
+
+logger = logging.getLogger(__name__)
+
+
+class LocationsActor:
+    def __init__(self, node: "Node") -> None:
+        self.node = node
+        self._lock = threading.Lock()
+        self._online: set[tuple[str, int]] = set()  # (library_id, location_id)
+        self._watchers: dict[tuple[str, int], object] = {}
+        node.libraries.subscribe(self._on_library_event)
+
+    def _on_library_event(self, event: str, library: "Library") -> None:
+        from ..models import Location
+
+        if event == LibraryManagerEvent.LOAD:
+            for row in library.db.find(Location):
+                self.add(library, row["id"])
+        elif event == LibraryManagerEvent.DELETE:
+            with self._lock:
+                for key in [k for k in self._online if k[0] == library.id]:
+                    self._online.discard(key)
+                    self._stop_watcher(key)
+
+    def add(self, library: "Library", location_id: int) -> None:
+        key = (library.id, location_id)
+        with self._lock:
+            self._online.add(key)
+        self._start_watcher(library, location_id)
+
+    def remove(self, library: "Library", location_id: int) -> None:
+        key = (library.id, location_id)
+        with self._lock:
+            self._online.discard(key)
+            self._stop_watcher(key)
+
+    def is_online(self, library_id: str, location_id: int) -> bool:
+        with self._lock:
+            return (library_id, location_id) in self._online
+
+    def online_ids(self, library_id: str) -> list[int]:
+        with self._lock:
+            return sorted(loc for lib, loc in self._online if lib == library_id)
+
+    # watcher seam (locations/watcher.py milestone)
+    def _start_watcher(self, library: "Library", location_id: int) -> None:
+        try:
+            from .watcher import LocationWatcher
+        except ImportError:
+            return
+        key = (library.id, location_id)
+        with self._lock:
+            if key in self._watchers:
+                return
+            try:
+                self._watchers[key] = LocationWatcher(library, location_id)
+            except Exception as e:
+                logger.warning("watcher for location %s failed to start: %s",
+                               location_id, e)
+
+    def _stop_watcher(self, key: tuple[str, int]) -> None:
+        watcher = self._watchers.pop(key, None)
+        if watcher is not None:
+            try:
+                watcher.stop()  # type: ignore[attr-defined]
+            except Exception:
+                logger.exception("watcher stop failed")
+
+    def stop(self) -> None:
+        with self._lock:
+            keys = list(self._watchers)
+        for key in keys:
+            with self._lock:
+                self._stop_watcher(key)

@@ -1,0 +1,84 @@
+"""Node: the core runtime every shell embeds.
+
+Equivalent of the reference's ``Node::new`` (core/src/lib.rs:77-135): construct
+config, event bus, managers; then start them in dependency order — locations
+actor → libraries init → job cold-resume → p2p (the reference warns the
+ordering is deadlock-critical, lib.rs:126; here the same order keeps watchers
+and resumed jobs from racing library load).
+
+TPU-native addition: the node probes its accelerator inventory at boot and
+records it in config (advertised to peers for remote-hasher routing).
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any
+
+from .config import ConfigManager, NodeConfig
+from .events import EventBus
+from .jobs import Jobs
+from .library import Libraries
+
+logger = logging.getLogger(__name__)
+
+
+def _probe_accelerator() -> dict[str, Any]:
+    """Record device kind/count without forcing JAX init failure to be fatal."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "kind": devices[0].platform if devices else None,
+            "devices": len(devices),
+            "mesh": [len(devices)],
+        }
+    except Exception as e:  # no accelerator is fine; CPU hasher still works
+        logger.info("no accelerator available: %s", e)
+        return {"kind": None, "devices": 0, "mesh": []}
+
+
+class Node:
+    def __init__(self, data_dir: str | Path, probe_accelerator: bool = True) -> None:
+        self.data_dir = Path(data_dir)
+        self.data_dir.mkdir(parents=True, exist_ok=True)
+        self.config = ConfigManager(NodeConfig.load(self.data_dir))
+        self.events = EventBus()
+        self.jobs = Jobs()
+        self.libraries = Libraries(self.data_dir, node=self)
+        self.locations = None  # attached by locations layer
+        self.p2p = None  # attached by p2p layer
+
+        if probe_accelerator:
+            self.config.write(accelerator=_probe_accelerator())
+
+        # ordering-critical start sequence (lib.rs:126-130)
+        self._start_locations()
+        self.libraries.init()
+        for library in self.libraries.list():
+            revived = self.jobs.cold_resume(library)
+            if revived:
+                logger.info("cold-resumed %d jobs for library %s", revived, library.id[:8])
+        self._start_p2p()
+
+    def _start_locations(self) -> None:
+        from .locations.manager import LocationsActor
+
+        self.locations = LocationsActor(self)
+
+    def _start_p2p(self) -> None:
+        pass  # p2p layer milestone
+
+    # -- events (lib.rs:203-229) -------------------------------------------
+    def emit(self, kind: str, payload: Any = None, library_id: str | None = None) -> None:
+        self.events.emit_kind(kind, payload, library_id)
+
+    def shutdown(self) -> None:
+        """Graceful: checkpoint all jobs, stop watchers, close DBs
+        (Node::shutdown, lib.rs:196)."""
+        self.jobs.shutdown()
+        if self.locations is not None:
+            self.locations.stop()
+        self.libraries.close()

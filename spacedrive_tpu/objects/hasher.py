@@ -1,0 +1,134 @@
+"""Hasher backends: the seam that makes identity hashing pluggable.
+
+The reference hard-codes scalar CPU BLAKE3 inside FileMetadata::new
+(file_identifier/mod.rs:80-88). Here the cas_id computation is a backend
+behind the per-location ``hasher`` config ("cpu" | "tpu", BASELINE.json's
+`hasher = "tpu"` flag) so the identifier job, dedup and sync stay
+hasher-agnostic.
+
+The TPU backend batches sampled messages into shape buckets:
+- the fixed 57,352-byte sampled bucket (every file > 100KiB) — the hot path,
+  one compiled kernel shape;
+- a handful of small-file chunk-capacity buckets (1/4/16/32/64/101 chunks) to
+  bound zero-padding waste while keeping the compiled-shape count constant.
+
+Per-file IO errors come back as Exception entries; callers route them into
+job errors instead of aborting the batch.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Callable, Protocol
+
+from .cas import SAMPLED_MESSAGE_LEN, generate_cas_id, read_sampled_batch
+
+logger = logging.getLogger(__name__)
+
+#: chunk capacities for small-file buckets (1 chunk = 1024 B); 101 covers the
+#: largest whole-file message (100KiB + 8B size prefix)
+SMALL_BUCKETS = (1, 4, 16, 32, 64, 101)
+SAMPLED_CHUNKS = (SAMPLED_MESSAGE_LEN + 1023) // 1024  # 57
+
+
+class HasherBackend(Protocol):
+    name: str
+
+    def hash_batch(self, paths: list[str | Path],
+                   sizes: list[int]) -> list[str | Exception]: ...
+
+
+class CpuHasher:
+    """Scalar reference path; byte-exact oracle (objects/cas.py). The native
+    C++ helper slots in here when present (native/)."""
+
+    name = "cpu"
+
+    def __init__(self) -> None:
+        self._fast = _load_native_hasher()
+
+    def hash_batch(self, paths: list[str | Path], sizes: list[int]) -> list[str | Exception]:
+        if self._fast is not None:
+            return self._fast(paths, sizes)
+        out: list[str | Exception] = []
+        for path, size in zip(paths, sizes):
+            try:
+                out.append(generate_cas_id(path, size))
+            except (OSError, EOFError) as e:
+                out.append(e)
+        return out
+
+
+class TpuHasher:
+    """Batched JAX/TPU path: gather samples → bucket by shape → device hash."""
+
+    name = "tpu"
+
+    def hash_batch(self, paths: list[str | Path], sizes: list[int]) -> list[str | Exception]:
+        import numpy as np
+
+        from ..ops.blake3_jax import blake3_batch_hex
+
+        messages = read_sampled_batch(paths, sizes)
+        out: list[str | Exception] = [None] * len(messages)  # type: ignore[list-item]
+
+        buckets: dict[int, list[int]] = {}
+        for i, msg in enumerate(messages):
+            if isinstance(msg, Exception):
+                out[i] = msg
+                continue
+            n = len(msg)
+            if n == SAMPLED_MESSAGE_LEN:
+                cap = SAMPLED_CHUNKS
+            else:
+                chunks = max(1, (n + 1023) // 1024)
+                cap = next(b for b in SMALL_BUCKETS if b >= chunks)
+            buckets.setdefault(cap, []).append(i)
+
+        for cap, indices in sorted(buckets.items()):
+            hexes = blake3_batch_hex([messages[i] for i in indices], max_chunks=cap)
+            for i, h in zip(indices, hexes):
+                out[i] = h[:16]
+        return out
+
+
+_BACKENDS: dict[str, Callable[[], HasherBackend]] = {
+    "cpu": CpuHasher,
+    "tpu": TpuHasher,
+}
+
+_instances: dict[str, HasherBackend] = {}
+
+
+def get_hasher(name: str | None) -> HasherBackend:
+    """Resolve a backend by location config; unknown/absent → tpu if JAX has a
+    device, else cpu."""
+    if name not in _BACKENDS:
+        name = "tpu" if _tpu_available() else "cpu"
+    if name not in _instances:
+        _instances[name] = _BACKENDS[name]()
+    return _instances[name]
+
+
+def register_backend(name: str, factory: Callable[[], HasherBackend]) -> None:
+    _BACKENDS[name] = factory
+
+
+def _tpu_available() -> bool:
+    try:
+        import jax
+
+        return len(jax.devices()) > 0
+    except Exception:
+        return False
+
+
+def _load_native_hasher():
+    """ctypes binding to the C++ blake3 helper (native/); None until built."""
+    try:
+        from ..native import cas_native
+
+        return cas_native.hash_batch
+    except Exception:
+        return None

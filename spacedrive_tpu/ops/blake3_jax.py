@@ -222,11 +222,28 @@ def digests_to_hex(digest_words: np.ndarray) -> list[str]:
     return [b[i * 32 : (i + 1) * 32].hex() for i in range(words.shape[1])]
 
 
+#: batch-size tiers: every call pads its lane count up to a tier so XLA
+#: compiles a handful of (chunks, batch) shapes total, never per-call shapes
+BATCH_TIERS = (8, 64, 512, 1024, 2048, 4096)
+
+
+def _pad_to_tier(n: int) -> int:
+    for t in BATCH_TIERS:
+        if t >= n:
+            return t
+    return -(-n // BATCH_TIERS[-1]) * BATCH_TIERS[-1]
+
+
 def blake3_batch_hex(messages: list[bytes], max_chunks: int | None = None) -> list[str]:
-    """Convenience one-shot: pack → device hash → hex digests."""
+    """Convenience one-shot: pack → device hash → hex digests. Pads the batch
+    to a size tier (empty-message lanes) to bound compiled-shape count."""
     if not messages:
         return []
     if max_chunks is None:
-        max_chunks = max(1, max((len(m) + CHUNK_LEN - 1) // CHUNK_LEN for m in messages))
-    words, lengths = pack_messages(messages, max_chunks)
-    return digests_to_hex(np.asarray(blake3_batch(jnp.asarray(words), jnp.asarray(lengths))))
+        need = max(1, max((len(m) + CHUNK_LEN - 1) // CHUNK_LEN for m in messages))
+        max_chunks = 1 << (need - 1).bit_length()  # tier to a power of two
+    B = len(messages)
+    padded = messages + [b""] * (_pad_to_tier(B) - B)
+    words, lengths = pack_messages(padded, max_chunks)
+    out = digests_to_hex(np.asarray(blake3_batch(jnp.asarray(words), jnp.asarray(lengths))))
+    return out[:B]

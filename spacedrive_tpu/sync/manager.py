@@ -1,0 +1,19 @@
+"""Sync manager stub — fleshed out by the sync layer milestone.
+
+Interface shape follows core/crates/sync/src/manager.rs: domain writes go
+through ``write_ops`` so CRDT operations are logged atomically with the data
+mutation when message emission is on.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ..library import Library
+
+
+class SyncManager:
+    def __init__(self, library: "Library") -> None:
+        self.library = library
+        self.emit_messages = False  # BackendFeature.SYNC_EMIT_MESSAGES gates this

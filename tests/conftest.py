@@ -15,6 +15,18 @@ if "xla_force_host_platform_device_count" not in flags:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # persistent XLA compilation cache keeps repeat suite runs fast
+    try:
+        import jax
+    except ImportError:
+        return  # jax-free envs still run the non-kernel suites
+
+    cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 @pytest.fixture()
 def tmp_data_dir(tmp_path):
     d = tmp_path / "sd_data"

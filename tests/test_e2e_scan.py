@@ -1,0 +1,206 @@
+"""End-to-end slice (SURVEY.md §7): Node boot → library → location →
+indexer → file_identifier (tpu hasher) → media processor → query results.
+
+Fixture tree follows the reference walker tests (walk.rs:670-700): a project
+tree with .git / node_modules / hidden files that rules must filter, plus
+photos with duplicates for dedup.
+"""
+
+import random
+import time
+from pathlib import Path
+
+import pytest
+
+from spacedrive_tpu.jobs import JobStatus
+from spacedrive_tpu.locations import create_location, delete_location, scan_location
+from spacedrive_tpu.models import FilePath, JobRow, Location, MediaData, Object
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.objects.cas import generate_cas_id
+from spacedrive_tpu.objects.kind import ObjectKind
+
+
+@pytest.fixture()
+def fixture_tree(tmp_path):
+    """Realistic tree: code project + photos + dups + rule-rejected noise."""
+    root = tmp_path / "tree"
+    rng = random.Random(5)
+    (root / "project" / ".git").mkdir(parents=True)
+    (root / "project" / ".git" / "HEAD").write_text("ref: refs/heads/main")
+    (root / "project" / "node_modules" / "dep").mkdir(parents=True)
+    (root / "project" / "node_modules" / "dep" / "index.js").write_text("x")
+    (root / "project" / "src").mkdir()
+    (root / "project" / "src" / "main.rs").write_text("fn main() {}")
+    (root / "project" / "README.md").write_text("# readme")
+    (root / "project" / ".hidden_config").write_text("secret")
+    (root / "photos").mkdir()
+    big = rng.randbytes(300_000)  # sampled-path file
+    (root / "photos" / "big_photo.raw").write_bytes(big)
+    (root / "photos" / "big_photo_copy.raw").write_bytes(big)  # duplicate
+    (root / "photos" / "small.txt").write_text("tiny contents")
+    (root / "photos" / "empty.dat").write_bytes(b"")
+    try:
+        from PIL import Image
+
+        img = Image.new("RGB", (800, 600), (200, 30, 90))
+        img.save(root / "photos" / "pic.png")
+    except ImportError:
+        pass
+    return root
+
+
+@pytest.fixture()
+def node(tmp_data_dir):
+    n = Node(tmp_data_dir, probe_accelerator=False)
+    yield n
+    n.shutdown()
+
+
+def _wait_scan(node, timeout=90.0):
+    assert node.jobs.wait_idle(timeout), "scan did not finish"
+
+
+@pytest.mark.parametrize("hasher", ["cpu", "tpu"])
+def test_full_scan_pipeline(node, fixture_tree, hasher):
+    lib = node.libraries.create(f"e2e-{hasher}")
+    loc = create_location(lib, fixture_tree, hasher=hasher)
+    scan_location(lib, loc["id"])
+    _wait_scan(node)
+
+    db = lib.db
+    paths = {r["materialized_path"] + (f"{r['name']}.{r['extension']}"
+             if r["extension"] and not r["is_dir"] else r["name"])
+             for r in db.find(FilePath, {"location_id": loc["id"]})}
+
+    # rules filtered the noise
+    assert not any(".git" in p or "node_modules" in p or ".hidden" in p for p in paths)
+    # the real files are there
+    for expect in ("/project/src/main.rs", "/project/README.md",
+                   "/photos/big_photo.raw", "/photos/big_photo_copy.raw",
+                   "/photos/small.txt", "/photos/empty.dat"):
+        assert expect in paths, f"missing {expect} in {paths}"
+
+    # every scan job completed
+    for row in db.find(JobRow):
+        assert row["status"] == JobStatus.COMPLETED, (row["name"], row["errors_text"])
+
+    # cas_ids byte-match the scalar oracle
+    big_rows = [db.find_one(FilePath, {"location_id": loc["id"], "name": name,
+                                       "extension": "raw"})
+                for name in ("big_photo", "big_photo_copy")]
+    oracle = generate_cas_id(fixture_tree / "photos" / "big_photo.raw")
+    assert big_rows[0]["cas_id"] == oracle
+    # duplicate files share cas AND object (dedup)
+    assert big_rows[0]["cas_id"] == big_rows[1]["cas_id"]
+    assert big_rows[0]["object_id"] == big_rows[1]["object_id"]
+
+    # kinds resolved from extensions
+    rs = db.find_one(FilePath, {"location_id": loc["id"], "extension": "rs"})
+    obj = db.find_one(Object, {"id": rs["object_id"]})
+    assert obj["kind"] == ObjectKind.CODE
+
+    # empty file: no cas_id but still an object (reference mod.rs:84-88)
+    empty = db.find_one(FilePath, {"location_id": loc["id"], "name": "empty"})
+    assert empty["cas_id"] is None
+    assert empty["object_id"] is not None
+
+    # unique objects: two raw copies collapsed to one object
+    n_files = len([r for r in db.find(FilePath, {"location_id": loc["id"]})
+                   if not r["is_dir"]])
+    assert db.count(Object) == n_files - 1
+
+    delete_location(lib, loc["id"])
+    assert db.count(FilePath, {"location_id": loc["id"]}) == 0
+
+
+def test_cpu_tpu_hashers_agree(node, fixture_tree):
+    """BASELINE config 1 vs 2: identical cas_id outputs across backends."""
+    results = {}
+    for hasher in ("cpu", "tpu"):
+        lib = node.libraries.create(f"parity-{hasher}")
+        loc = create_location(lib, fixture_tree, hasher=hasher)
+        scan_location(lib, loc["id"])
+        _wait_scan(node)
+        results[hasher] = {
+            r["name"] + "." + (r["extension"] or ""): r["cas_id"]
+            for r in lib.db.find(FilePath, {"location_id": loc["id"]})
+            if not r["is_dir"]
+        }
+    assert results["cpu"] == results["tpu"]
+    assert any(v for v in results["cpu"].values())
+
+
+def test_media_processor_generates_thumbnails(node, fixture_tree):
+    pytest.importorskip("PIL")
+    lib = node.libraries.create("media")
+    loc = create_location(lib, fixture_tree, hasher="cpu")
+    scan_location(lib, loc["id"])
+    _wait_scan(node)
+
+    pic = lib.db.find_one(FilePath, {"location_id": loc["id"], "extension": "png"})
+    assert pic is not None and pic["cas_id"]
+    from spacedrive_tpu.objects.media.thumbnail import thumbnail_path
+
+    thumb = thumbnail_path(node.data_dir, pic["cas_id"])
+    assert thumb.exists(), "webp thumbnail missing"
+    assert thumb.read_bytes()[:4] == b"RIFF"  # webp container
+    media = lib.db.find_one(MediaData, {"object_id": pic["object_id"]})
+    assert media is not None
+    assert media["dimensions"] == {"width": 800, "height": 600}
+
+
+def test_rescan_is_incremental_and_detects_changes(node, fixture_tree):
+    lib = node.libraries.create("rescan")
+    loc = create_location(lib, fixture_tree, hasher="cpu")
+    scan_location(lib, loc["id"])
+    _wait_scan(node)
+    db = lib.db
+    before = {r["id"]: r["cas_id"] for r in db.find(FilePath, {"location_id": loc["id"]})}
+
+    # touch nothing → rescan changes nothing
+    scan_location(lib, loc["id"])
+    _wait_scan(node)
+    after = {r["id"]: r["cas_id"] for r in db.find(FilePath, {"location_id": loc["id"]})}
+    assert before == after
+
+    # modify + add + remove
+    time.sleep(0.01)
+    (fixture_tree / "photos" / "small.txt").write_text("changed contents!")
+    (fixture_tree / "photos" / "new_file.txt").write_text("brand new")
+    (fixture_tree / "project" / "README.md").unlink()
+    scan_location(lib, loc["id"])
+    _wait_scan(node)
+
+    small = db.find_one(FilePath, {"location_id": loc["id"], "name": "small"})
+    assert small["cas_id"] is not None
+    assert small["cas_id"] != [v for k, v in before.items() if k == small["id"]][0]
+    assert db.find_one(FilePath, {"location_id": loc["id"], "name": "new_file"}) is not None
+    assert db.find_one(FilePath, {"location_id": loc["id"], "name": "README",
+                                  "extension": "md"}) is None
+
+
+def test_rename_keeps_identity(node, fixture_tree):
+    """A renamed file keeps its row, cas_id and object link (walker matches by
+    inode/device); reviewer-found regression."""
+    lib = node.libraries.create("rename")
+    loc = create_location(lib, fixture_tree, hasher="cpu")
+    scan_location(lib, loc["id"])
+    _wait_scan(node)
+    db = lib.db
+    before = db.find_one(FilePath, {"location_id": loc["id"], "name": "small",
+                                    "extension": "txt"})
+    assert before["cas_id"]
+
+    (fixture_tree / "photos" / "small.txt").rename(fixture_tree / "photos" / "renamed.txt")
+    scan_location(lib, loc["id"])
+    _wait_scan(node)
+
+    gone = db.find_one(FilePath, {"location_id": loc["id"], "name": "small",
+                                  "extension": "txt"})
+    renamed = db.find_one(FilePath, {"location_id": loc["id"], "name": "renamed",
+                                     "extension": "txt"})
+    assert gone is None
+    assert renamed is not None
+    assert renamed["id"] == before["id"]  # same row survived
+    assert renamed["cas_id"] == before["cas_id"]  # identity kept, no re-hash
+    assert renamed["object_id"] == before["object_id"]

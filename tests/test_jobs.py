@@ -1,0 +1,207 @@
+"""Job engine tests: lifecycle, checkpoint/pause/resume, chaining, dedup,
+cold resume — the semantics SURVEY.md §2.2/§5.4 require byte-for-byte.
+
+Uses a slow toy job so pause can land mid-run deterministically.
+"""
+
+import time
+
+import pytest
+
+from spacedrive_tpu.jobs import (
+    EarlyFinish,
+    JobAlreadyRunning,
+    JobStatus,
+    Jobs,
+    StatefulJob,
+    StepResult,
+)
+from spacedrive_tpu.library import Libraries
+from spacedrive_tpu.models import JobRow
+
+EXECUTED: list[tuple[str, int]] = []
+
+
+class ToyJob(StatefulJob):
+    NAME = "toy"
+
+    def init(self, ctx):
+        n = self.init_args.get("steps", 3)
+        if n == 0:
+            raise EarlyFinish("nothing to do")
+        return {"tag": self.init_args.get("tag", "t")}, list(range(n)), {"inited": 1}
+
+    def execute_step(self, ctx, data, step, step_number):
+        EXECUTED.append((data["tag"], step))
+        delay = self.init_args.get("delay", 0)
+        if delay:
+            time.sleep(delay)
+        if self.init_args.get("fail_on") == step:  # soft per-item error
+            return StepResult(metadata={"done": 1}, errors=[f"boom at {step}"])
+        if self.init_args.get("fatal_on") == step:  # fatal step exception
+            raise RuntimeError("fatal")
+        return StepResult(metadata={"done": 1})
+
+
+class FatalInitJob(StatefulJob):
+    NAME = "fatal_init"
+
+    def init(self, ctx):
+        raise RuntimeError("init exploded")
+
+
+@pytest.fixture()
+def library(tmp_path):
+    libs = Libraries(tmp_path, node=None)
+    lib = libs.create("test-lib")
+    yield lib
+    libs.close()
+
+
+@pytest.fixture(autouse=True)
+def _clear_executed():
+    EXECUTED.clear()
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def report_of(library, job_id):
+    return library.db.find_one(JobRow, {"id": job_id})
+
+
+def test_job_completes_and_merges_metadata(library):
+    jobs = Jobs()
+    jid = jobs.spawn(library, [ToyJob({"steps": 4, "tag": "a"})])
+    assert jobs.wait_idle(5)
+    row = report_of(library, jid)
+    assert row["status"] == JobStatus.COMPLETED
+    assert row["metadata"]["done"] == 4  # numeric metadata accumulates
+    assert row["completed_task_count"] == 4
+    assert [s for _, s in EXECUTED] == [0, 1, 2, 3]
+
+
+def test_early_finish_completes_clean(library):
+    jobs = Jobs()
+    jid = jobs.spawn(library, [ToyJob({"steps": 0})])
+    assert jobs.wait_idle(5)
+    assert report_of(library, jid)["status"] == JobStatus.COMPLETED
+
+
+def test_step_error_accumulates_to_completed_with_errors(library):
+    jobs = Jobs()
+    jid = jobs.spawn(library, [ToyJob({"steps": 3, "fail_on": 1})])
+    assert jobs.wait_idle(5)
+    row = report_of(library, jid)
+    assert row["status"] == JobStatus.COMPLETED_WITH_ERRORS
+    assert "boom at 1" in row["errors_text"]
+    assert [s for _, s in EXECUTED] == [0, 1, 2]  # did not abort
+
+
+def test_init_failure_is_failed(library):
+    jobs = Jobs()
+    jid = jobs.spawn(library, [FatalInitJob({})])
+    assert jobs.wait_idle(5)
+    assert report_of(library, jid)["status"] == JobStatus.FAILED
+
+
+def test_dedup_rejects_same_hash(library):
+    jobs = Jobs()
+    jobs.spawn(library, [ToyJob({"steps": 50, "delay": 0.05, "tag": "d"})])
+    with pytest.raises(JobAlreadyRunning):
+        jobs.spawn(library, [ToyJob({"steps": 50, "delay": 0.05, "tag": "d"})])
+    # different args → different hash → queued fine
+    jobs.spawn(library, [ToyJob({"steps": 1, "tag": "other"})])
+    jobs.shutdown()
+
+
+def test_pause_checkpoints_and_resume_continues(library):
+    jobs = Jobs()
+    jid = jobs.spawn(library, [ToyJob({"steps": 40, "delay": 0.03, "tag": "p"})])
+    assert wait_for(lambda: len(EXECUTED) >= 3)
+    assert jobs.pause(jid)
+    assert wait_for(lambda: (report_of(library, jid) or {}).get("status") == JobStatus.PAUSED)
+    done_at_pause = len(EXECUTED)
+    assert done_at_pause < 40
+    row = report_of(library, jid)
+    assert row["data"] is not None  # serialized checkpoint present
+
+    assert jobs.resume(library, jid)
+    assert jobs.wait_idle(15)
+    assert report_of(library, jid)["status"] == JobStatus.COMPLETED
+    # every step ran exactly once across pause/resume
+    steps_run = [s for _, s in EXECUTED]
+    assert sorted(steps_run) == list(range(40))
+    assert len(steps_run) == 40
+
+
+def test_cancel(library):
+    jobs = Jobs()
+    jid = jobs.spawn(library, [ToyJob({"steps": 100, "delay": 0.03, "tag": "c"})])
+    assert wait_for(lambda: len(EXECUTED) >= 2)
+    assert jobs.cancel(jid)
+    assert jobs.wait_idle(5)
+    assert report_of(library, jid)["status"] == JobStatus.CANCELED
+    assert len(EXECUTED) < 100
+
+
+def test_chaining_runs_in_order_and_failure_cancels_children(library):
+    jobs = Jobs()
+    head = jobs.spawn(library, [ToyJob({"steps": 2, "tag": "one"}),
+                                ToyJob({"steps": 2, "tag": "two"})])
+    assert jobs.wait_idle(10)
+    tags = [t for t, _ in EXECUTED]
+    assert tags == ["one", "one", "two", "two"]
+    children = library.db.find(JobRow, {"parent_id": head})
+    assert len(children) == 1
+    assert children[0]["status"] == JobStatus.COMPLETED
+
+    EXECUTED.clear()
+    head2 = jobs.spawn(library, [ToyJob({"steps": 2, "fatal_on": 0, "tag": "bad"}),
+                                 ToyJob({"steps": 2, "tag": "never"})])
+    assert jobs.wait_idle(10)
+    assert report_of(library, head2)["status"] == JobStatus.FAILED
+    child = library.db.find(JobRow, {"parent_id": head2})[0]
+    assert child["status"] == JobStatus.CANCELED
+    assert all(t != "never" for t, _ in EXECUTED)
+
+
+def test_shutdown_checkpoints_then_cold_resume_finishes(library):
+    jobs = Jobs()
+    jid = jobs.spawn(library, [ToyJob({"steps": 30, "delay": 0.03, "tag": "s"}),
+                               ToyJob({"steps": 2, "tag": "s2"})])
+    assert wait_for(lambda: len(EXECUTED) >= 2)
+    jobs.shutdown()
+    row = report_of(library, jid)
+    assert row["status"] == JobStatus.PAUSED
+    done_before = len([1 for t, _ in EXECUTED if t == "s"])
+    assert done_before < 30
+
+    # new manager = new process; cold resume revives from checkpoints
+    jobs2 = Jobs()
+    revived = jobs2.cold_resume(library)
+    assert revived == 1
+    assert jobs2.wait_idle(20)
+    assert report_of(library, jid)["status"] == JobStatus.COMPLETED
+    steps_s = sorted(s for t, s in EXECUTED if t == "s")
+    assert steps_s == list(range(30))  # no step re-ran
+    # chained child ran after resume too
+    assert [s for t, s in EXECUTED if t == "s2"] == [0, 1]
+
+
+def test_cold_resume_cancels_unknown_job(library):
+    from spacedrive_tpu.jobs import JobReport
+
+    report = JobReport.new("does_not_exist")
+    report.status = JobStatus.PAUSED
+    report.data = b'{"bad": "state"}'
+    report.create(library.db)
+    jobs = Jobs()
+    assert jobs.cold_resume(library) == 0
+    assert report_of(library, report.id)["status"] == JobStatus.CANCELED
