@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Headline benchmark: file_identifier cas_id throughput, TPU vs native CPU.
+
+Measures the north-star hot path (SURVEY.md §6 / BASELINE.json): batched
+sampled-BLAKE3 cas_id hashing of a synthetic file corpus, end to end from
+file IO through digest hex — the work one `file_identifier` job performs per
+step (reference core/src/object/file_identifier/mod.rs:107-134, cas.rs:23-62).
+
+Baseline = the native C++ BLAKE3 batch hasher on all host cores (the honest
+stand-in for the reference's SIMD blake3 crate under join_all concurrency).
+Candidate = the JAX BLAKE3 kernel (single chip, or data-sharded mesh when
+multiple devices are visible). Outputs are asserted identical before timing
+counts.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+N_FILES = int(os.environ.get("SD_BENCH_FILES", "2048"))
+FILE_SIZE = int(os.environ.get("SD_BENCH_FILE_SIZE", str(192 * 1024)))  # sampled path
+REPEATS = int(os.environ.get("SD_BENCH_REPEATS", "3"))
+
+
+def make_corpus(root: Path, n: int, size: int) -> tuple[list[str], list[int]]:
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    paths, sizes = [], []
+    # one shared random pool, sliced at varying offsets: cheap to generate,
+    # still unique bytes per file (offset stride) so cas_ids differ
+    pool = rng.integers(0, 256, size + n, dtype=np.uint8).tobytes()
+    for i in range(n):
+        p = root / f"{i:06d}.bin"
+        with open(p, "wb") as f:
+            f.write(pool[i : i + size])
+        paths.append(str(p))
+        sizes.append(size)
+    return paths, sizes
+
+
+def time_best(fn, repeats: int):
+    best, out = float("inf"), None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> int:
+    from spacedrive_tpu.objects.hasher import CpuHasher, TpuHasher
+
+    tmp = tempfile.TemporaryDirectory(prefix="sd_bench_")
+    paths, sizes = make_corpus(Path(tmp.name), N_FILES, FILE_SIZE)
+
+    cpu = CpuHasher()
+    if cpu._fast is None:
+        print("warning: native hasher unavailable, baseline is pure Python",
+              file=sys.stderr)
+    cpu_t, cpu_ids = time_best(lambda: cpu.hash_batch(paths, sizes), REPEATS)
+    cpu_fps = N_FILES / cpu_t
+
+    tpu_fps = None
+    try:
+        import jax
+
+        devices = jax.devices()
+        if len(devices) > 1:
+            from spacedrive_tpu.objects.hasher import ShardedHasher
+
+            tpu = ShardedHasher()
+        else:
+            tpu = TpuHasher()
+        tpu.hash_batch(paths, sizes)  # warmup: compile + caches
+        tpu_t, tpu_ids = time_best(lambda: tpu.hash_batch(paths, sizes), REPEATS)
+        mismatches = sum(1 for a, b in zip(cpu_ids, tpu_ids) if a != b)
+        if mismatches:
+            print(f"FATAL: {mismatches}/{N_FILES} cas_id mismatches", file=sys.stderr)
+            return 1
+        tpu_fps = N_FILES / tpu_t
+        platform = devices[0].platform
+        n_dev = len(devices)
+    except Exception as e:  # no usable accelerator: report CPU-only
+        print(f"warning: device path failed ({type(e).__name__}: {e})", file=sys.stderr)
+
+    if tpu_fps is not None:
+        record = {
+            "metric": f"file_identifier_files_per_sec[{platform}x{n_dev},"
+                      f"{N_FILES}x{FILE_SIZE >> 10}KiB]",
+            "value": round(tpu_fps, 1),
+            "unit": "files/sec",
+            "vs_baseline": round(tpu_fps / cpu_fps, 3),
+        }
+    else:
+        record = {
+            "metric": f"file_identifier_files_per_sec[cpu-native,"
+                      f"{N_FILES}x{FILE_SIZE >> 10}KiB]",
+            "value": round(cpu_fps, 1),
+            "unit": "files/sec",
+            "vs_baseline": 1.0,
+        }
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,10 +11,14 @@ class JobPaused(Exception):  # JobError::Paused(state, signal)
     """Raised by the command check to unwind the run loop; carries the
     serialized checkpoint."""
 
-    def __init__(self, state_blob: bytes, from_shutdown: bool = False) -> None:
+    def __init__(self, state_blob: bytes, from_shutdown: bool = False,
+                 errors: list[str] | None = None) -> None:
         super().__init__("job paused")
         self.state_blob = state_blob
         self.from_shutdown = from_shutdown
+        # soft step errors accumulated before the pause; persisted so a
+        # resumed run still ends CompletedWithErrors (job/mod.rs:834-841)
+        self.errors = errors or []
 
 
 class JobCanceled(Exception):  # JobError::Canceled

@@ -174,6 +174,9 @@ class DynJob:
         """
         state = self.state
         errors: list[str] = list(filter(None, (self.report.errors_text or "").split("\n\n")))
+        # expose to the pause path: JobPaused must carry these so they survive
+        # the checkpoint (a resume re-reads them from report.errors_text)
+        self._soft_errors = errors
 
         if state.data is None:  # fresh run (not a resume)
             t0 = time.perf_counter()

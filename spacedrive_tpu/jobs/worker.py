@@ -56,7 +56,8 @@ class WorkerContext:
             raise JobCanceled()
         if cmd in (WorkerCommand.PAUSE, WorkerCommand.SHUTDOWN):
             raise JobPaused(dyn_job.serialize_state(),
-                            from_shutdown=cmd == WorkerCommand.SHUTDOWN)
+                            from_shutdown=cmd == WorkerCommand.SHUTDOWN,
+                            errors=getattr(dyn_job, "_soft_errors", []))
 
 
 class Worker:
@@ -142,6 +143,8 @@ class Worker:
         except JobPaused as p:
             r.status = JobStatus.PAUSED
             r.data = p.state_blob
+            if p.errors:
+                r.errors_text = "\n\n".join(p.errors)
             self._pause_children(p.state_blob)
         except JobCanceled:
             r.status = JobStatus.CANCELED

@@ -113,12 +113,16 @@ class CompiledRules:
     """All rules for one location, compiled once per walk."""
 
     def __init__(self, specs: list[IndexerRuleSpec]) -> None:
-        accept, reject = [], []
+        accept, reject, reject_abs = [], [], []
         self.accept_children: list[set[str]] = []
         self.reject_children: list[set[str]] = []
         for spec in specs:
             accept += spec.rules.get(RuleKind.ACCEPT_FILES_BY_GLOB, [])
-            reject += spec.rules.get(RuleKind.REJECT_FILES_BY_GLOB, [])
+            for g in spec.rules.get(RuleKind.REJECT_FILES_BY_GLOB, []):
+                # globs anchored at "/" target absolute OS paths (the seeded
+                # /proc, /sys... guards) — entries are walked as
+                # location-relative, so these match the absolute path instead
+                (reject_abs if g.startswith("/") else reject).append(g)
             if RuleKind.ACCEPT_IF_CHILDREN_DIRECTORIES_ARE_PRESENT in spec.rules:
                 self.accept_children.append(
                     set(spec.rules[RuleKind.ACCEPT_IF_CHILDREN_DIRECTORIES_ARE_PRESENT]))
@@ -127,10 +131,14 @@ class CompiledRules:
                     set(spec.rules[RuleKind.REJECT_IF_CHILDREN_DIRECTORIES_ARE_PRESENT]))
         self._accept = compile_globs(accept) if accept else None
         self._reject = compile_globs(reject)
+        self._reject_abs = compile_globs(reject_abs) if reject_abs else None
 
-    def allows_path(self, rel_path: str, is_dir: bool) -> bool:
-        """Glob acceptance for one entry (path relative to location root)."""
+    def allows_path(self, rel_path: str, is_dir: bool, abs_path: str = "") -> bool:
+        """Glob acceptance for one entry (path relative to location root;
+        ``abs_path`` additionally screens the absolute-anchored rejects)."""
         if self._reject.fullmatch(rel_path):
+            return False
+        if self._reject_abs is not None and abs_path and self._reject_abs.fullmatch(abs_path):
             return False
         if self._accept is not None and not is_dir and not self._accept.fullmatch(rel_path):
             return False
@@ -160,6 +168,7 @@ NO_OS_PROTECTED = IndexerRuleSpec(
     default=True,
     rules={RuleKind.REJECT_FILES_BY_GLOB: [
         "**/.DS_Store", "**/Thumbs.db", "**/desktop.ini",
+        # leading "/" = absolute-path rejects (see CompiledRules.allows_path)
         "/proc/**", "/sys/**", "/dev/**", "/run/**", "/boot/**",
         "**/System Volume Information/**", "**/$RECYCLE.BIN/**",
         "**/lost+found/**", "**/.Trash-*/**",

@@ -52,6 +52,18 @@ DbFetcher = Callable[[str], list[dict[str, Any]]]
 (the ``file_paths_db_fetcher_fn!`` seam, walk.rs)."""
 
 
+def resolve_sub_path(root: Path, sub_path: str) -> Path:
+    """Join + containment check: a sub_path may not escape the location root
+    (the reference validates sub-paths via ensure_sub_path_is_in_location
+    before walking). Raises ValueError with a clear message otherwise."""
+    if not sub_path:
+        return root
+    start = (root / sub_path).resolve()
+    if start != root.resolve() and root.resolve() not in start.parents:
+        raise ValueError(f"sub_path {sub_path!r} escapes location root {root}")
+    return start
+
+
 def walk(
     location_id: int,
     location_path: str | Path,
@@ -66,7 +78,7 @@ def walk(
     into the in-walk queue once ``limit`` entries have been produced, returning
     the remainder as ``to_walk`` continuation dirs (indexer_job.rs:183-198)."""
     root = Path(location_path)
-    start = root / sub_path if sub_path else root
+    start = resolve_sub_path(root, sub_path)
     result = WalkResult([], [], [], [], [])
 
     if include_root and not sub_path:
@@ -111,7 +123,7 @@ def walk(
                 if entry.is_symlink():
                     seen_names.add(entry.name)  # present on disk, just skipped
                     continue  # reference skips symlinks in the indexer walk
-                if not rules.allows_path(rel_path, is_dir):
+                if not rules.allows_path(rel_path, is_dir, abs_path=entry.path):
                     continue
                 if is_dir and not rules.allows_dir_by_children(Path(entry.path)):
                     continue

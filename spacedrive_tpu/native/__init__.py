@@ -1,0 +1,44 @@
+"""Native (C++) runtime components, built on demand with the system g++.
+
+The reference's equivalents are Rust crates with SIMD/FFI cores (blake3 crate,
+sd-crypto, sd-ffmpeg). Here each component is a small C++ translation unit
+compiled to a shared library at first import and loaded with ctypes — no
+pybind11 dependency. Build artifacts land in ``native/_build`` (gitignored);
+a failed toolchain leaves the pure-Python path in charge.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+_DIR = Path(__file__).parent
+_BUILD = _DIR / "_build"
+
+
+def build_shared(name: str, sources: list[str], extra_flags: list[str] | None = None) -> Path:
+    """Compile ``sources`` (relative to native/) into ``_build/lib<name>.so``,
+    rebuilding only when a source is newer than the artifact. Concurrent
+    builders race benignly: each compiles to a temp file then renames."""
+    out = _BUILD / f"lib{name}.so"
+    srcs = [_DIR / s for s in sources]
+    if out.exists() and all(out.stat().st_mtime >= s.stat().st_mtime for s in srcs):
+        return out
+    _BUILD.mkdir(exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD)
+    os.close(fd)
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        *(extra_flags or []),
+        *map(str, srcs), "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return out

@@ -1,0 +1,259 @@
+// Native cas_id hasher: clean-room BLAKE3 (from the public spec) + the
+// reference's sampling scheme (core/src/object/cas.rs:10-62) behind a C ABI.
+//
+// Role: CPU fast path / baseline for the TPU kernel (ops/blake3_jax.py) — the
+// analogue of the reference's SIMD `blake3` crate. Scalar but -O3
+// auto-vectorized; batch API fans files across a thread pool the way the
+// reference's join_all fans futures (file_identifier/mod.rs:107-134).
+//
+// Build: g++ -O3 -shared -fPIC (see native/__init__.py). No deps.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+const uint32_t IV[8] = {0x6A09E667u, 0xBB67AE85u, 0x3C6EF372u, 0xA54FF53Au,
+                        0x510E527Fu, 0x9B05688Cu, 0x1F83D9ABu, 0x5BE0CD19u};
+const int MSG_PERM[16] = {2, 6, 3, 10, 7, 0, 4, 13, 1, 11, 12, 5, 9, 14, 15, 8};
+
+enum Flags : uint32_t {
+  CHUNK_START = 1 << 0,
+  CHUNK_END = 1 << 1,
+  PARENT = 1 << 2,
+  ROOT = 1 << 3,
+};
+
+constexpr size_t CHUNK_LEN = 1024;
+constexpr size_t BLOCK_LEN = 64;
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+inline void g(uint32_t s[16], int a, int b, int c, int d, uint32_t mx, uint32_t my) {
+  s[a] = s[a] + s[b] + mx;
+  s[d] = rotr(s[d] ^ s[a], 16);
+  s[c] = s[c] + s[d];
+  s[b] = rotr(s[b] ^ s[c], 12);
+  s[a] = s[a] + s[b] + my;
+  s[d] = rotr(s[d] ^ s[a], 8);
+  s[c] = s[c] + s[d];
+  s[b] = rotr(s[b] ^ s[c], 7);
+}
+
+void compress(const uint32_t cv[8], const uint32_t block[16], uint64_t counter,
+              uint32_t block_len, uint32_t flags, uint32_t out[8]) {
+  uint32_t s[16] = {
+      cv[0], cv[1], cv[2], cv[3], cv[4], cv[5], cv[6], cv[7],
+      IV[0], IV[1], IV[2], IV[3],
+      static_cast<uint32_t>(counter), static_cast<uint32_t>(counter >> 32),
+      block_len, flags,
+  };
+  uint32_t m[16];
+  std::memcpy(m, block, sizeof(m));
+  for (int r = 0; r < 7; r++) {
+    g(s, 0, 4, 8, 12, m[0], m[1]);
+    g(s, 1, 5, 9, 13, m[2], m[3]);
+    g(s, 2, 6, 10, 14, m[4], m[5]);
+    g(s, 3, 7, 11, 15, m[6], m[7]);
+    g(s, 0, 5, 10, 15, m[8], m[9]);
+    g(s, 1, 6, 11, 12, m[10], m[11]);
+    g(s, 2, 7, 8, 13, m[12], m[13]);
+    g(s, 3, 4, 9, 14, m[14], m[15]);
+    if (r < 6) {
+      uint32_t t[16];
+      for (int i = 0; i < 16; i++) t[i] = m[MSG_PERM[i]];
+      std::memcpy(m, t, sizeof(m));
+    }
+  }
+  for (int i = 0; i < 8; i++) out[i] = s[i] ^ s[i + 8];
+}
+
+// A finished-but-unfinalized tree node: its CV chains upward without ROOT;
+// the root node recompresses with ROOT to emit the digest.
+struct Node {
+  uint32_t cv[8];
+  uint32_t block[16];
+  uint64_t counter;
+  uint32_t block_len;
+  uint32_t flags;
+};
+
+inline void load_block(const uint8_t* p, size_t n, uint32_t out[16]) {
+  uint8_t buf[BLOCK_LEN] = {0};
+  std::memcpy(buf, p, n);
+  for (int i = 0; i < 16; i++) {
+    out[i] = static_cast<uint32_t>(buf[4 * i]) |
+             static_cast<uint32_t>(buf[4 * i + 1]) << 8 |
+             static_cast<uint32_t>(buf[4 * i + 2]) << 16 |
+             static_cast<uint32_t>(buf[4 * i + 3]) << 24;
+  }
+}
+
+Node chunk_node(const uint8_t* data, size_t len, uint64_t counter) {
+  Node n;
+  std::memcpy(n.cv, IV, sizeof(IV));
+  n.counter = counter;
+  size_t nblocks = len == 0 ? 1 : (len + BLOCK_LEN - 1) / BLOCK_LEN;
+  for (size_t j = 0; j + 1 < nblocks; j++) {
+    uint32_t block[16];
+    load_block(data + j * BLOCK_LEN, BLOCK_LEN, block);
+    uint32_t flags = j == 0 ? CHUNK_START : 0;
+    uint32_t out[8];
+    compress(n.cv, block, counter, BLOCK_LEN, flags, out);
+    std::memcpy(n.cv, out, sizeof(out));
+  }
+  size_t last_off = (nblocks - 1) * BLOCK_LEN;
+  size_t last_len = len - last_off;
+  load_block(data + last_off, last_len, n.block);
+  n.block_len = static_cast<uint32_t>(last_len);
+  n.flags = CHUNK_END | (nblocks == 1 ? CHUNK_START : 0);
+  return n;
+}
+
+inline void chain(const Node& n, uint32_t out_cv[8]) {
+  compress(n.cv, n.block, n.counter, n.block_len, n.flags, out_cv);
+}
+
+Node parent_node(const uint32_t l[8], const uint32_t r[8]) {
+  Node n;
+  std::memcpy(n.cv, IV, sizeof(IV));
+  std::memcpy(n.block, l, 32);
+  std::memcpy(n.block + 8, r, 32);
+  n.counter = 0;
+  n.block_len = BLOCK_LEN;
+  n.flags = PARENT;
+  return n;
+}
+
+// left subtree takes the largest power-of-two chunk count < total
+size_t left_chunks(size_t n_chunks) {
+  size_t p = 1;
+  while (p * 2 < n_chunks) p *= 2;
+  return p;
+}
+
+Node tree(const uint8_t* data, size_t len, uint64_t counter) {
+  if (len <= CHUNK_LEN) return chunk_node(data, len, counter);
+  size_t n_chunks = (len + CHUNK_LEN - 1) / CHUNK_LEN;
+  size_t lc = left_chunks(n_chunks);
+  size_t llen = lc * CHUNK_LEN;
+  Node l = tree(data, llen, counter);
+  Node r = tree(data + llen, len - llen, counter + lc);
+  uint32_t lcv[8], rcv[8];
+  chain(l, lcv);
+  chain(r, rcv);
+  return parent_node(lcv, rcv);
+}
+
+void blake3_digest(const uint8_t* data, size_t len, uint8_t out[32]) {
+  Node root = tree(data, len, 0);
+  uint32_t words[8];
+  compress(root.cv, root.block, 0, root.block_len, root.flags | ROOT, words);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = static_cast<uint8_t>(words[i]);
+    out[4 * i + 1] = static_cast<uint8_t>(words[i] >> 8);
+    out[4 * i + 2] = static_cast<uint8_t>(words[i] >> 16);
+    out[4 * i + 3] = static_cast<uint8_t>(words[i] >> 24);
+  }
+}
+
+// ---- cas sampling (reference consts cas.rs:10-15) ----
+constexpr uint64_t SAMPLE_COUNT = 4;
+constexpr uint64_t SAMPLE_SIZE = 1024 * 10;
+constexpr uint64_t HEADER_OR_FOOTER = 1024 * 8;
+constexpr uint64_t MINIMUM_FILE_SIZE = 1024 * 100;
+
+const char HEX[] = "0123456789abcdef";
+
+// Returns 0 on success; writes 16 lowercase hex chars + NUL into out17.
+int cas_id_for_fd(int fd, uint64_t size, char out17[17]) {
+  std::vector<uint8_t> msg;
+  msg.reserve(8 + (size <= MINIMUM_FILE_SIZE
+                       ? size
+                       : 2 * HEADER_OR_FOOTER + SAMPLE_COUNT * SAMPLE_SIZE));
+  for (int i = 0; i < 8; i++) msg.push_back(static_cast<uint8_t>(size >> (8 * i)));
+
+  auto read_exact = [&](uint64_t off, uint64_t len) -> bool {
+    size_t base = msg.size();
+    msg.resize(base + len);
+    uint64_t got = 0;
+    while (got < len) {
+      ssize_t r = pread(fd, msg.data() + base + got, len - got, off + got);
+      if (r <= 0) return false;
+      got += static_cast<uint64_t>(r);
+    }
+    return true;
+  };
+
+  if (size <= MINIMUM_FILE_SIZE) {
+    if (size > 0 && !read_exact(0, size)) return 1;
+  } else {
+    uint64_t seek_jump = (size - HEADER_OR_FOOTER * 2) / SAMPLE_COUNT;
+    if (!read_exact(0, HEADER_OR_FOOTER)) return 1;
+    for (uint64_t i = 0; i < SAMPLE_COUNT; i++) {
+      if (!read_exact(HEADER_OR_FOOTER + i * seek_jump, SAMPLE_SIZE)) return 1;
+    }
+    if (!read_exact(size - HEADER_OR_FOOTER, HEADER_OR_FOOTER)) return 1;
+  }
+
+  uint8_t digest[32];
+  blake3_digest(msg.data(), msg.size(), digest);
+  for (int i = 0; i < 8; i++) {
+    out17[2 * i] = HEX[digest[i] >> 4];
+    out17[2 * i + 1] = HEX[digest[i] & 0xF];
+  }
+  out17[16] = '\0';
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Full 32-byte BLAKE3 of a buffer → 64 hex chars + NUL.
+void sd_blake3_hex(const uint8_t* data, uint64_t len, char out65[65]) {
+  uint8_t digest[32];
+  blake3_digest(data, len, digest);
+  for (int i = 0; i < 32; i++) {
+    out65[2 * i] = HEX[digest[i] >> 4];
+    out65[2 * i + 1] = HEX[digest[i] & 0xF];
+  }
+  out65[64] = '\0';
+}
+
+// Batch cas_id over files. out = n rows of 17 bytes (16 hex + NUL); a row
+// whose first byte is NUL means that file errored (caller raises per-file).
+void sd_cas_hash_batch(const char* const* paths, const uint64_t* sizes,
+                       int32_t n, int32_t n_threads, char* out) {
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int32_t> next(0);
+  auto worker = [&]() {
+    for (;;) {
+      int32_t i = next.fetch_add(1);
+      if (i >= n) break;
+      char* row = out + static_cast<size_t>(i) * 17;
+      row[0] = '\0';
+      int fd = open(paths[i], O_RDONLY);
+      if (fd < 0) continue;
+      cas_id_for_fd(fd, sizes[i], row);
+      close(fd);
+    }
+  };
+  if (n_threads == 1 || n == 1) {
+    worker();
+    return;
+  }
+  std::vector<std::thread> threads;
+  int32_t spawn = std::min<int32_t>(n_threads, n);
+  threads.reserve(spawn);
+  for (int32_t t = 0; t < spawn; t++) threads.emplace_back(worker);
+  for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,61 @@
+"""ctypes binding for the C++ cas_id hasher (blake3_cas.cc).
+
+Drop-in for the pure-Python scalar path: ``hash_batch(paths, sizes)`` returns
+16-hex cas_ids with per-file OSError entries for unreadable/shrunk files —
+same error routing as objects/cas.py::read_sampled_batch.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+from . import build_shared
+
+_lib = ctypes.CDLL(str(build_shared("sdcas", ["blake3_cas.cc"])))
+
+_lib.sd_cas_hash_batch.argtypes = [
+    ctypes.POINTER(ctypes.c_char_p),
+    ctypes.POINTER(ctypes.c_uint64),
+    ctypes.c_int32,
+    ctypes.c_int32,
+    ctypes.c_char_p,
+]
+_lib.sd_cas_hash_batch.restype = None
+
+_lib.sd_blake3_hex.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+_lib.sd_blake3_hex.restype = None
+
+
+def blake3_hex(data: bytes) -> str:
+    """Full 64-hex BLAKE3 digest (used by the validator's integrity checksum)."""
+    out = ctypes.create_string_buffer(65)
+    _lib.sd_blake3_hex(data, len(data), out)
+    return out.value.decode()
+
+
+def hash_batch(paths: list[str | Path], sizes: list[int],
+               n_threads: int | None = None) -> list[str | Exception]:
+    n = len(paths)
+    if n == 0:
+        return []
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, n)
+    c_paths = (ctypes.c_char_p * n)(*[os.fsencode(str(p)) for p in paths])
+    c_sizes = (ctypes.c_uint64 * n)(*[int(s) for s in sizes])
+    out = ctypes.create_string_buffer(n * 17)
+    _lib.sd_cas_hash_batch(
+        ctypes.cast(c_paths, ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.cast(c_sizes, ctypes.POINTER(ctypes.c_uint64)),
+        n, n_threads, out,
+    )
+    results: list[str | Exception] = []
+    raw = out.raw
+    for i in range(n):
+        row = raw[i * 17 : i * 17 + 16]
+        if row[0] == 0:
+            results.append(OSError(f"native cas hash failed for {paths[i]}"))
+        else:
+            results.append(row.decode())
+    return results

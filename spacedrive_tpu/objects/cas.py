@@ -86,12 +86,10 @@ def generate_cas_id(path: str | Path, size: int | None = None) -> str:
     return blake3(message).hex()[:16]
 
 
-def generate_cas_id_from_bytes(data: bytes, size: int | None = None) -> str:
-    """cas_id for an in-memory file image (ephemeral/non-indexed browsing path).
-
-    Like the file path, a ``size`` that exceeds the available bytes raises
-    EOFError (read_exact semantics) rather than silently hashing short samples.
-    """
+def cas_message_from_bytes(data: bytes, size: int | None = None) -> bytes:
+    """Hashed message for an in-memory file image (same layout as
+    :func:`cas_message_from_file`). A ``size`` exceeding the available bytes
+    raises EOFError (read_exact semantics), never hashes short samples."""
     size = len(data) if size is None else size
     if size > len(data):
         raise EOFError(f"buffer shorter than declared size: {len(data)} < {size}")
@@ -101,7 +99,12 @@ def generate_cas_id_from_bytes(data: bytes, size: int | None = None) -> str:
     else:
         for offset, length in sample_offsets(size):
             parts.append(data[offset : offset + length])
-    return blake3(b"".join(parts)).hex()[:16]
+    return b"".join(parts)
+
+
+def generate_cas_id_from_bytes(data: bytes, size: int | None = None) -> str:
+    """cas_id for an in-memory file image (ephemeral/non-indexed browsing path)."""
+    return blake3(cas_message_from_bytes(data, size)).hex()[:16]
 
 
 def read_sampled_batch(paths: list[str | Path], sizes: list[int]) -> list[bytes | Exception]:

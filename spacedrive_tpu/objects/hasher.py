@@ -87,25 +87,60 @@ class TpuHasher:
             buckets.setdefault(cap, []).append(i)
 
         for cap, indices in sorted(buckets.items()):
-            hexes = blake3_batch_hex([messages[i] for i in indices], max_chunks=cap)
+            hexes = self._hash_bucket([messages[i] for i in indices], cap)
             for i, h in zip(indices, hexes):
                 out[i] = h[:16]
         return out
+
+    def _hash_bucket(self, msgs: list[bytes], cap: int) -> list[str]:
+        from ..ops.blake3_jax import blake3_batch_hex
+
+        return blake3_batch_hex(msgs, max_chunks=cap)
+
+
+class ShardedHasher(TpuHasher):
+    """Multi-device variant: batch axis sharded over a data-parallel mesh
+    (parallel/mesh.py). Same bucketing; each bucket's lane count additionally
+    pads to a multiple of the mesh's data-axis size."""
+
+    name = "tpu-sharded"
+
+    def __init__(self) -> None:
+        from ..parallel.mesh import make_mesh
+
+        self._mesh = make_mesh()
+
+    def _hash_bucket(self, msgs: list[bytes], cap: int) -> list[str]:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops.blake3_jax import _pad_to_tier, digests_to_hex, pack_messages
+        from ..parallel.mesh import pad_batch_for_mesh, sharded_hasher
+
+        B = len(msgs)
+        target = pad_batch_for_mesh(_pad_to_tier(B), self._mesh)
+        words, lengths = pack_messages(msgs + [b""] * (target - B), cap)
+        fn = sharded_hasher(self._mesh)
+        out = digests_to_hex(np.asarray(fn(jnp.asarray(words), jnp.asarray(lengths))))
+        return out[:B]
 
 
 _BACKENDS: dict[str, Callable[[], HasherBackend]] = {
     "cpu": CpuHasher,
     "tpu": TpuHasher,
+    "tpu-sharded": ShardedHasher,
 }
 
 _instances: dict[str, HasherBackend] = {}
 
 
 def get_hasher(name: str | None) -> HasherBackend:
-    """Resolve a backend by location config; unknown/absent → tpu if JAX has a
-    device, else cpu."""
+    """Resolve a backend by location config; unknown/absent → tpu if JAX sees
+    an accelerator, else the native cpu path."""
     if name not in _BACKENDS:
-        name = "tpu" if _tpu_available() else "cpu"
+        if name is not None:
+            logger.warning("unknown hasher backend %r, falling back to default", name)
+        name = "tpu" if _accelerator_available() else "cpu"
     if name not in _instances:
         _instances[name] = _BACKENDS[name]()
     return _instances[name]
@@ -115,11 +150,13 @@ def register_backend(name: str, factory: Callable[[], HasherBackend]) -> None:
     _BACKENDS[name] = factory
 
 
-def _tpu_available() -> bool:
+def _accelerator_available() -> bool:
+    """True only for a real accelerator — jax.devices() is never empty (it
+    falls back to CPU), so count checks are vacuous; inspect the platform."""
     try:
         import jax
 
-        return len(jax.devices()) > 0
+        return any(d.platform not in ("cpu",) for d in jax.devices())
     except Exception:
         return False
 

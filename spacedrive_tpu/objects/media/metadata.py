@@ -26,7 +26,6 @@ def extract_media_data(path: str, extension: str) -> dict[str, Any] | None:
         return None
     try:
         from PIL import Image
-        from PIL.ExifTags import GPS
 
         with Image.open(path) as img:
             out: dict[str, Any] = {"dimensions": {"width": img.width, "height": img.height}}

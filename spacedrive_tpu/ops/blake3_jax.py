@@ -66,23 +66,39 @@ def _g(s, a, b, c, d, mx, my):
     s[b] = _rotr(s[b] ^ s[c], 7)
 
 
+_PERM = np.array(MSG_PERMUTATION)
+
+
 def compress(cv, m, counter, block_len, flags):
     """One BLAKE3 compression, broadcast over any lane shape.
 
-    ``cv``: list of 8 arrays; ``m``: list of 16 arrays; ``counter``/
-    ``block_len``/``flags``: arrays broadcastable to the lane shape (counter
-    high word is 0 — the cas domain never exceeds 2^32 chunks). Returns the
-    first 8 output words (chaining value / digest head)."""
+    ``cv``: list of 8 arrays; ``m``: list of 16 arrays or a stacked
+    ``(16, ...)`` array; ``counter``/``block_len``/``flags``: arrays
+    broadcastable to the lane shape (counter high word is 0 — the cas domain
+    never exceeds 2^32 chunks). Returns the first 8 output words (chaining
+    value / digest head).
+
+    The 7 rounds run as a ``lax.scan`` with the message permutation as a
+    static gather — NOT unrolled: rounds are serial anyway so unrolling buys
+    no parallelism, and a ~450-op unrolled body sent XLA:CPU's
+    post-layout simplification fixed-point into multi-minute compiles.
+    """
     zero = jnp.zeros(jnp.broadcast_shapes(cv[0].shape, block_len.shape, flags.shape), _u32)
-    s = [
+    s0 = (
         cv[0] + zero, cv[1] + zero, cv[2] + zero, cv[3] + zero,
         cv[4] + zero, cv[5] + zero, cv[6] + zero, cv[7] + zero,
         zero + _u32(IV[0]), zero + _u32(IV[1]), zero + _u32(IV[2]), zero + _u32(IV[3]),
         counter.astype(_u32) + zero, zero,
         block_len.astype(_u32) + zero, flags.astype(_u32) + zero,
-    ]
-    m = list(m)
-    for r in range(7):
+    )
+    if isinstance(m, (list, tuple)):
+        m = jnp.stack([mw + jnp.zeros_like(zero) for mw in m])
+    else:
+        m = m + jnp.zeros_like(zero)[None]
+
+    def round_body(carry, _):
+        s, m = carry
+        s = list(s)
         _g(s, 0, 4, 8, 12, m[0], m[1])
         _g(s, 1, 5, 9, 13, m[2], m[3])
         _g(s, 2, 6, 10, 14, m[4], m[5])
@@ -91,8 +107,10 @@ def compress(cv, m, counter, block_len, flags):
         _g(s, 1, 6, 11, 12, m[10], m[11])
         _g(s, 2, 7, 8, 13, m[12], m[13])
         _g(s, 3, 4, 9, 14, m[14], m[15])
-        if r < 6:
-            m = [m[i] for i in MSG_PERMUTATION]
+        # permuting after the final round too is harmless: m is discarded
+        return (tuple(s), m[_PERM]), None
+
+    (s, _), _ = lax.scan(round_body, (s0, m), None, length=7)
     return [s[i] ^ s[i + 8] for i in range(8)]
 
 
@@ -134,33 +152,50 @@ def blake3_batch(words: jax.Array, lengths: jax.Array) -> jax.Array:
     # ---- single-chunk lanes: rerun chunk 0 with ROOT on each lane's final block
     single_root = _single_chunk_root(words[:, :, 0, :], lengths)  # (8, B)
 
-    # ---- phase 2: log-depth merkle merge (adjacent pairing == BLAKE3 tree)
-    nodes = cvs  # list of 8 arrays (C, B)
-    remaining = n_chunks  # (B,) nodes left per lane
-    root8 = [jnp.zeros((B,), _u32) for _ in range(8)]
-    width = C
-    while width > 1:
-        half = width // 2
-        left = [n[0 : 2 * half : 2] for n in nodes]  # (half, B)
-        right = [n[1 : 2 * half : 2] for n in nodes]
+    # ---- phase 2: log-depth merkle merge (adjacent pairing == BLAKE3 tree).
+    # One fixed-shape lax.scan over levels — NOT an unrolled width-shrinking
+    # loop, which would instantiate a distinct ~450-op compress per level and
+    # blow up XLA compile time. Active nodes stay packed in the array prefix;
+    # lanes whose remaining count runs out promote their left node (the odd
+    # tail of BLAKE3's left-heavy tree); slots past the prefix carry garbage
+    # that the masks never read.
+    if C > 1:
+        Cp = 1 << (C - 1).bit_length()  # pad chunk axis to a power of two
+        nodes = jnp.stack([
+            jnp.pad(cv, ((0, Cp - C), (0, 0))) if Cp != C else cv for cv in cvs
+        ])  # (8, Cp, B)
+        half = Cp // 2
         pair_idx = jnp.arange(half, dtype=jnp.int32)[:, None]  # (half, 1)
-        has_right = (2 * pair_idx + 1) < remaining[None, :]  # (half, B)
-        is_root_pair = (pair_idx == 0) & (remaining[None, :] == 2)
-        flags = jnp.where(is_root_pair, _u32(PARENT | ROOT), _u32(PARENT))
         zero = jnp.zeros((half, B), _u32)
-        parent = compress(_iv_lanes((half, B)), left + right, zero,
-                          zero + _u32(BLOCK_LEN), flags)
-        merged = [jnp.where(has_right, parent[w], left[w]) for w in range(8)]
-        for w in range(8):
-            root8[w] = jnp.where(is_root_pair[0], parent[w][0], root8[w])
-        if width % 2 == 1:  # odd tail promotes unchanged
-            merged = [jnp.concatenate([mw, n[width - 1 : width]], axis=0)
-                      for mw, n in zip(merged, nodes)]
-        nodes = merged
-        remaining = (remaining + 1) // 2
-        width = half + (width % 2)
 
-    digest = [jnp.where(n_chunks == 1, single_root[w], root8[w]) for w in range(8)]
+        def level(carry, _):
+            nodes, remaining, root8 = carry
+            left = nodes[:, 0 : 2 * half : 2]  # (8, half, B)
+            right = nodes[:, 1 : 2 * half : 2]
+            has_right = (2 * pair_idx + 1) < remaining[None, :]  # (half, B)
+            is_root_pair = (pair_idx == 0) & (remaining[None, :] == 2)
+            flags = jnp.where(is_root_pair, _u32(PARENT | ROOT), _u32(PARENT))
+            parent = compress(
+                _iv_lanes((half, B)),
+                [left[w] for w in range(8)] + [right[w] for w in range(8)],
+                zero, zero + _u32(BLOCK_LEN), flags,
+            )
+            merged = jnp.stack(
+                [jnp.where(has_right, parent[w], left[w, :, :]) for w in range(8)]
+            )
+            root8 = jnp.stack(
+                [jnp.where(is_root_pair[0], parent[w][0], root8[w]) for w in range(8)]
+            )
+            nodes = jnp.concatenate(
+                [merged, jnp.zeros((8, Cp - half, B), _u32)], axis=1
+            )
+            return (nodes, (remaining + 1) // 2, root8), None
+
+        carry0 = (nodes, n_chunks, jnp.zeros((8, B), _u32))
+        (_, _, root8), _ = lax.scan(level, carry0, None, length=Cp.bit_length() - 1)
+        digest = [jnp.where(n_chunks == 1, single_root[w], root8[w]) for w in range(8)]
+    else:
+        digest = single_root
     return jnp.stack(digest)
 
 

@@ -7,10 +7,20 @@ bench.py and the driver's __graft_entry__ checks.
 
 import os
 
+# The driver environment preloads the real-TPU PJRT plugin before this file
+# runs (PYTHONPATH sitecustomize), so plain env vars are too late: update the
+# live jax config instead. The suite always runs on the 8-device virtual CPU
+# mesh; the real-TPU path is exercised by bench.py and the driver's
+# __graft_entry__ checks. NOTE: this host has ONE cpu core — never run pytest
+# concurrently with other heavy processes or everything crawls.
+import sys
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "jax" in sys.modules:
+    sys.modules["jax"].config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
