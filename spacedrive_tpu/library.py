@@ -67,6 +67,18 @@ class Library:
     def instance(self) -> dict[str, Any] | None:
         return self.db.find_one(Instance, {"id": self.instance_id})
 
+    def add_remote_instance(self, instance_row: dict[str, Any]) -> int:
+        """Register a paired peer instance (the responder inserts the
+        originator's Instance and vice versa; pairing proto + the reference
+        sync test's hand-pairing, core/crates/sync/tests/lib.rs:66-99)."""
+        row = {k: v for k, v in instance_row.items() if k != "id"}
+        row.setdefault("last_seen", utc_now())
+        row.setdefault("date_created", utc_now())
+        existing = self.db.find_one(Instance, {"pub_id": row["pub_id"]})
+        if existing is not None:
+            return existing["id"]
+        return self.db.insert(Instance, row)
+
     def close(self) -> None:
         self.db.close()
 
@@ -130,9 +142,13 @@ class Libraries:
         return library
 
     def _attach_services(self, library: Library) -> None:
+        from .config import BackendFeature
         from .sync.manager import SyncManager  # cycle-free local import
 
         library.sync = SyncManager(library)
+        if self.node is not None:
+            features = self.node.config.get().get("features", [])
+            library.sync.emit_messages = BackendFeature.SYNC_EMIT_MESSAGES in features
 
     def create(self, name: str, description: str = "",
                lib_id: str | None = None,

@@ -70,10 +70,15 @@ def create_location(library: "Library", path: str | Path, name: str | None = Non
                       {"location_id": location_id, "indexer_rule_id": rule["id"]},
                       or_ignore=True)
     _write_metadata(path, library.id, location_id)
+    row = db.find_one(Location, {"id": location_id})
+    sync = getattr(library, "sync", None)
+    if sync is not None and getattr(sync, "emit_messages", False):
+        sync.shared_create_many(Location, [row])
+        sync.created()
     if library.node is not None and library.node.locations is not None:
         library.node.locations.add(library, location_id)
     library.emit("invalidate_query", {"key": "locations.list"})
-    return db.find_one(Location, {"id": location_id})
+    return row
 
 
 def delete_location(library: "Library", location_id: int) -> None:

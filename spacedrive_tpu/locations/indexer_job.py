@@ -108,36 +108,64 @@ class IndexerJob(StatefulJob):
         db = ctx.library.db
         kind = step["kind"]
         t0 = time.perf_counter()
+        sync = getattr(ctx.library, "sync", None)
+        emit = sync is not None and getattr(sync, "emit_messages", False)
         if kind == "save":
-            # or_ignore: a watcher may have raced us (unique indexes hold)
-            db.insert_many(FilePath, step["rows"], or_ignore=True)
-            sync = getattr(ctx.library, "sync", None)
-            if sync is not None and getattr(sync, "emit_messages", False):
-                sync.shared_create_many(FilePath, step["rows"])
+            with db.transaction():
+                # or_ignore: a watcher may have raced us (unique indexes hold)
+                db.insert_many(FilePath, step["rows"], or_ignore=True)
+                if emit:
+                    sync.shared_create_many(FilePath, step["rows"])
+            if emit:
+                sync.created()
             return StepResult(metadata={"db_write_time": time.perf_counter() - t0,
                                         "saved_rows": len(step["rows"])})
         if kind == "update":
-            for row in step["rows"]:
-                values = {
-                    # renames carry the new identity fields; updates by row id
-                    "materialized_path": row["materialized_path"],
-                    "name": row["name"], "extension": row["extension"],
-                    "size_in_bytes": row["size_in_bytes"],
-                    "inode": row["inode"], "device": row["device"],
-                    "date_modified": row["date_modified"],
-                    "hidden": row["hidden"],
-                }
-                if row.get("content_changed", True):
-                    # content changed: clear identity so re-identify runs;
-                    # a pure rename keeps its cas_id/object link
-                    values["cas_id"] = None
-                    values["object_id"] = None
-                db.update(FilePath, {"id": row["row_id"]}, values)
+            ops = []
+            with db.transaction():
+                for row in step["rows"]:
+                    values = {
+                        # renames carry the new identity fields; updates by row id
+                        "materialized_path": row["materialized_path"],
+                        "name": row["name"], "extension": row["extension"],
+                        "size_in_bytes": row["size_in_bytes"],
+                        "inode": row["inode"], "device": row["device"],
+                        "date_modified": row["date_modified"],
+                        "hidden": row["hidden"],
+                    }
+                    if row.get("content_changed", True):
+                        # content changed: clear identity so re-identify runs;
+                        # a pure rename keeps its cas_id/object link
+                        values["cas_id"] = None
+                        values["object_id"] = None
+                    db.update(FilePath, {"id": row["row_id"]}, values)
+                    if emit and row.get("pub_id"):
+                        for field in ("materialized_path", "name", "extension",
+                                      "size_in_bytes", "date_modified", "cas_id"):
+                            if field in values:
+                                v = values[field]
+                                ops.append(sync.shared_update(
+                                    FilePath, row["pub_id"], field,
+                                    v.isoformat() if hasattr(v, "isoformat") else v))
+                if ops:
+                    sync.log_ops(ops)
+            if ops:
+                sync.created()
             return StepResult(metadata={"db_write_time": time.perf_counter() - t0,
                                         "updated_rows": len(step["rows"])})
         if kind == "remove":
-            for fp_id in step["ids"]:
-                db.delete(FilePath, {"id": fp_id})
+            ops = []
+            with db.transaction():
+                for fp_id in step["ids"]:
+                    if emit:
+                        row = db.find_one(FilePath, {"id": fp_id})
+                        if row is not None:
+                            ops.append(sync.shared_delete(FilePath, row["pub_id"]))
+                    db.delete(FilePath, {"id": fp_id})
+                if ops:
+                    sync.log_ops(ops)
+            if ops:
+                sync.created()
             return StepResult(metadata={"db_write_time": time.perf_counter() - t0})
         if kind == "walk":
             location = self._location(ctx)

@@ -1,5 +1,5 @@
 """Declarative SQLite model layer (replaces prisma-client-rust + sync-generator)."""
 
-from .base import Database, Field, Model, Relation, Shared, utc_now
+from .base import MODEL_REGISTRY, Database, Field, Model, Relation, Shared, utc_now
 from .schema import *  # noqa: F401,F403
 from .schema import ALL_MODELS, SYNCED_MODELS  # noqa: F401

@@ -23,8 +23,14 @@ from typing import Any
 
 from ..jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
 from ..models import FilePath, Location, Object, utc_now
+from ..sync.crdt import ref
 from .hasher import get_hasher
 from .kind import kind_from_extension
+
+
+def ref_obj(pub_id: str):
+    """object FK crossing the sync wire as a pub_id reference (crdt.py)."""
+    return ref(Object.TABLE, pub_id)
 
 logger = logging.getLogger(__name__)
 
@@ -102,13 +108,14 @@ class FileIdentifierJob(StatefulJob):
 
         sync = getattr(ctx.library, "sync", None)
         emit = sync is not None and getattr(sync, "emit_messages", False)
+        ops = []  # CRDT ops logged atomically with the writes (write_ops semantics)
 
         with db.transaction():
             # 1. write cas_ids
             for row, cas in identified:
                 db.update(FilePath, {"id": row["id"]}, {"cas_id": cas})
                 if emit:
-                    sync.shared_update(FilePath, row["pub_id"], "cas_id", cas)
+                    ops.append(sync.shared_update(FilePath, row["pub_id"], "cas_id", cas))
 
             # 2. link to existing objects owning these cas_ids
             cas_ids = sorted({cas for _, cas in identified})
@@ -129,7 +136,8 @@ class FileIdentifierJob(StatefulJob):
                     oid, opub = existing[cas]
                     db.update(FilePath, {"id": row["id"]}, {"object_id": oid})
                     if emit:
-                        sync.shared_update(FilePath, row["pub_id"], "object_id", opub)
+                        ops.append(sync.shared_update(
+                            FilePath, row["pub_id"], "object_id", ref_obj(opub)))
                     linked += 1
                 else:
                     need_object.setdefault(cas, []).append(row)
@@ -137,14 +145,24 @@ class FileIdentifierJob(StatefulJob):
             # 3. create one object per unique new cas_id (+ one per empty file)
             created = 0
             for cas, members in need_object.items():
-                oid = self._create_object(ctx, members[0], emit)
+                oid, opub = self._create_object(ctx, members[0], emit, ops)
                 created += 1
                 for row in members:
                     db.update(FilePath, {"id": row["id"]}, {"object_id": oid})
+                    if emit:
+                        ops.append(sync.shared_update(
+                            FilePath, row["pub_id"], "object_id", ref_obj(opub)))
             for row in empty:
-                oid = self._create_object(ctx, row, emit)
+                oid, opub = self._create_object(ctx, row, emit, ops)
                 created += 1
                 db.update(FilePath, {"id": row["id"]}, {"object_id": oid})
+                if emit:
+                    ops.append(sync.shared_update(
+                        FilePath, row["pub_id"], "object_id", ref_obj(opub)))
+            if emit and ops:
+                sync.log_ops(ops)
+        if emit and ops:
+            sync.created()
 
         ctx.progress(message=f"identified {len(identified)} files "
                              f"({created} new objects, {linked} linked)")
@@ -153,20 +171,23 @@ class FileIdentifierJob(StatefulJob):
                                     "hash_time": hash_time},
                           errors=errors)
 
-    def _create_object(self, ctx: WorkerContext, row: dict, emit: bool) -> int:
+    def _create_object(self, ctx: WorkerContext, row: dict, emit: bool,
+                       ops: list | None = None) -> int:
         db = ctx.library.db
         pub_id = str(uuid.uuid4())
+        kind = kind_from_extension(row.get("extension"), bool(row.get("is_dir")))
         oid = db.insert(Object, {
             "pub_id": pub_id,
-            "kind": kind_from_extension(row.get("extension"), bool(row.get("is_dir"))),
+            "kind": kind,
             "date_created": row.get("date_created") or utc_now(),
         })
         sync = getattr(ctx.library, "sync", None)
-        if emit and sync is not None:
-            sync.shared_create(Object, pub_id, {
-                "kind": kind_from_extension(row.get("extension"), bool(row.get("is_dir"))),
-            })
-        return oid
+        if emit and sync is not None and ops is not None:
+            ops.append(sync.shared_create(Object, pub_id, {
+                "kind": kind,
+                "date_created": utc_now().isoformat(),
+            }))
+        return oid, pub_id
 
     def finalize(self, ctx: WorkerContext, data: dict, run_metadata: dict):
         ctx.library.emit("invalidate_query", {"key": "search.paths"})

@@ -1,0 +1,21 @@
+"""CRDT sync layer (SURVEY.md §2.6).
+
+HLC-ordered per-field last-write-wins replication of library state, matching
+the reference's sd-sync design: op factories + atomic op-log emission
+(manager.py), annotation-driven application (apply.py), and the pull-based
+ingest actor with stale-op rejection (ingest.py). Networking attaches at the
+Transport seam — the two-instance integration test (tests/test_sync.py) wires
+it to direct calls exactly like the reference's fake-transport test
+(core/crates/sync/tests/lib.rs:102-217).
+"""
+
+from .crdt import CREATE, DELETE, UPDATE_PREFIX, CRDTOperation, RelationOp, SharedOp, ref
+from .hlc import HLC, ntp64
+from .ingest import Actor, Ingester
+from .manager import SyncManager, SyncMessage
+
+__all__ = [
+    "CREATE", "DELETE", "UPDATE_PREFIX", "CRDTOperation", "RelationOp",
+    "SharedOp", "ref", "HLC", "ntp64", "Actor", "Ingester", "SyncManager",
+    "SyncMessage",
+]

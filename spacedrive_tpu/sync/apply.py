@@ -1,0 +1,114 @@
+"""Op application — the ModelSyncData equivalent, driven by annotations.
+
+The reference generates per-model apply code at build time (sd-sync-generator,
+crates/sync-generator/src/sync_data.rs: ``ModelSyncData::from_op(...).exec``).
+Here the model layer's ``SYNC`` annotations (models/schema.py) carry the same
+information, so one generic applier covers every synced model — no codegen.
+
+FK fields arrive as ``ref(table, pub_id)`` markers (crdt.py) and resolve to
+local integer ids; a ref whose target row doesn't exist yet resolves to None
+for nullable fields (it back-fills when the target's Create op applies and a
+later Update rewrites the field) and raises for required ones.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from ..models import MODEL_REGISTRY
+from ..models.base import Database, Model, Relation, Shared
+from .crdt import CREATE, DELETE, UPDATE_PREFIX, RelationOp, SharedOp, is_ref
+
+logger = logging.getLogger(__name__)
+
+
+class ApplyError(Exception):
+    pass
+
+
+def model_for(table: str) -> type[Model]:
+    try:
+        return MODEL_REGISTRY[table]
+    except KeyError:
+        raise ApplyError(f"unknown synced model {table!r}") from None
+
+
+def resolve_value(db: Database, value: Any) -> Any:
+    if not is_ref(value):
+        return value
+    table, pub_id = value["__ref__"]
+    target = model_for(table)
+    sync = target.SYNC
+    key = sync.id if isinstance(sync, Shared) else "pub_id"
+    row = db.find_one(target, {key: pub_id})
+    return row["id"] if row else None
+
+
+def apply_shared(db: Database, op: SharedOp) -> None:
+    model = model_for(op.model)
+    sync = model.SYNC
+    if not isinstance(sync, Shared):
+        raise ApplyError(f"{op.model} is not a Shared model")
+    where = {sync.id: op.record_id}
+
+    if op.kind == CREATE:
+        fields = {k: resolve_value(db, v) for k, v in (op.data or {}).items()}
+        existing = db.find_one(model, where)
+        if existing is None:
+            db.insert(model, {**where, **fields})
+        elif fields:
+            db.update(model, where, fields)
+    elif op.kind == DELETE:
+        db.delete(model, where)
+    elif op.kind.startswith(UPDATE_PREFIX):
+        field = op.kind[len(UPDATE_PREFIX):]
+        if field not in model.FIELDS:
+            raise ApplyError(f"{op.model} has no field {field!r}")
+        value = resolve_value(db, op.data)
+        if db.find_one(model, where) is None:
+            # update for a record we never saw: materialize it (the reference
+            # applies ops idempotently; order across instances isn't guaranteed)
+            db.insert(model, {**where, field: value})
+        else:
+            db.update(model, where, {field: value})
+    else:
+        raise ApplyError(f"unknown shared op kind {op.kind!r}")
+
+
+def apply_relation(db: Database, op: RelationOp) -> None:
+    model = model_for(op.relation)
+    sync = model.SYNC
+    if not isinstance(sync, Relation):
+        raise ApplyError(f"{op.relation} is not a Relation model")
+
+    item_model = model_for(sync.item)
+    group_model = model_for(sync.group)
+    item = db.find_one(item_model, {_shared_key(item_model): op.item_id})
+    group = db.find_one(group_model, {_shared_key(group_model): op.group_id})
+    if item is None or group is None:
+        # link precedes its endpoints; the reference drops these too (the
+        # endpoint's own Create op re-links via a later relation op replay)
+        logger.warning("relation %s op %s: missing endpoint (item=%s group=%s)",
+                       op.relation, op.kind, op.item_id, op.group_id)
+        return
+    where = {f"{sync.item}_id": item["id"], f"{sync.group}_id": group["id"]}
+
+    if op.kind == CREATE:
+        fields = {k: resolve_value(db, v) for k, v in (op.data or {}).items()}
+        if db.find_one(model, where) is None:
+            db.insert(model, {**where, **fields})
+        elif fields:
+            db.update(model, where, fields)
+    elif op.kind == DELETE:
+        db.delete(model, where)
+    elif op.kind.startswith(UPDATE_PREFIX):
+        field = op.kind[len(UPDATE_PREFIX):]
+        db.upsert(model, where, {field: resolve_value(db, op.data)},
+                  {field: resolve_value(db, op.data)})
+    else:
+        raise ApplyError(f"unknown relation op kind {op.kind!r}")
+
+
+def _shared_key(model: type[Model]) -> str:
+    return model.SYNC.id if isinstance(model.SYNC, Shared) else "pub_id"

@@ -1,0 +1,86 @@
+"""CRDT operation types + wire format.
+
+Same algebra as the reference (crates/sync/src/crdt.rs:25-131): per-record
+shared ops (Create / per-field Update with last-write-wins / Delete) and
+many-many relation ops, each stamped with (instance pub_id, HLC timestamp,
+op uuid). The wire format is plain JSON-safe dicts — no codegen; the model
+layer's ``SYNC`` annotations (models/schema.py) drive application.
+
+Foreign keys never cross the wire as local integer ids: factories emit
+``ref(model, pub_id)`` markers that the applier resolves against the local
+database (the reference reaches the same end via per-model SyncId types
+emitted by sd-sync-generator, crates/sync-generator/src/lib.rs:22-36).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from typing import Any
+
+# op kinds as stored in the op-log `kind` column
+CREATE = "c"
+DELETE = "d"
+UPDATE_PREFIX = "u:"  # "u:<field>"
+
+
+def ref(table: str, pub_id: Any) -> dict[str, Any]:
+    """FK value marker: resolved to the local row id at apply time."""
+    return {"__ref__": [table, pub_id]}
+
+
+def is_ref(value: Any) -> bool:
+    return isinstance(value, dict) and "__ref__" in value
+
+
+@dataclasses.dataclass
+class SharedOp:
+    """Record-level op on a ``SYNC = Shared(id=...)`` model."""
+
+    model: str               # table name
+    record_id: Any           # the Shared.id field value (usually pub_id)
+    kind: str                # CREATE | DELETE | "u:<field>"
+    data: Any                # CREATE: {field: value}; UPDATE: value; DELETE: None
+
+
+@dataclasses.dataclass
+class RelationOp:
+    """Link-table op on a ``SYNC = Relation(item, group)`` model."""
+
+    relation: str            # link table name
+    item_id: Any             # item-side pub_id
+    group_id: Any            # group-side pub_id
+    kind: str                # CREATE | DELETE | "u:<field>"
+    data: Any
+
+
+@dataclasses.dataclass
+class CRDTOperation:
+    instance: str            # origin instance pub_id
+    timestamp: int           # HLC NTP64
+    id: str                  # op uuid
+    typ: SharedOp | RelationOp
+
+    def to_wire(self) -> dict[str, Any]:
+        t = self.typ
+        body = dataclasses.asdict(t)
+        body["_t"] = "shared" if isinstance(t, SharedOp) else "relation"
+        return {"instance": self.instance, "timestamp": self.timestamp,
+                "id": self.id, "typ": body}
+
+    @classmethod
+    def from_wire(cls, wire: dict[str, Any]) -> "CRDTOperation":
+        body = dict(wire["typ"])
+        kind = body.pop("_t")
+        typ: SharedOp | RelationOp
+        if kind == "shared":
+            typ = SharedOp(**body)
+        else:
+            typ = RelationOp(**body)
+        return cls(instance=wire["instance"], timestamp=wire["timestamp"],
+                   id=wire["id"], typ=typ)
+
+
+def new_op(instance: str, timestamp: int, typ: SharedOp | RelationOp) -> CRDTOperation:
+    return CRDTOperation(instance=instance, timestamp=timestamp,
+                         id=str(uuid.uuid4()), typ=typ)

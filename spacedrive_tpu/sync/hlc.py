@@ -1,0 +1,46 @@
+"""Hybrid logical clock — the uhlc-equivalent ordering primitive.
+
+The reference orders CRDT ops by a uhlc NTP64 timestamp (core/crates/sync/src/
+manager.rs:44, crdt.rs:25-131). Same shape here: a 64-bit timestamp whose high
+32 bits are unix seconds and low 32 bits are fraction, made strictly monotonic
+per library by bumping past the last seen value (local or remote). Fits SQLite
+INTEGER (i64) until year 2106.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+def ntp64(unix_seconds: float) -> int:
+    sec = int(unix_seconds)
+    frac = int((unix_seconds - sec) * (1 << 32))
+    return (sec << 32) | (frac & 0xFFFFFFFF)
+
+
+def to_unix(ts: int) -> float:
+    return (ts >> 32) + (ts & 0xFFFFFFFF) / (1 << 32)
+
+
+class HLC:
+    """Monotonic hybrid clock; thread-safe (domain writers + ingest thread)."""
+
+    def __init__(self, last: int = 0) -> None:
+        self._last = last
+        self._lock = threading.Lock()
+
+    def now(self) -> int:
+        with self._lock:
+            self._last = max(ntp64(time.time()), self._last + 1)
+            return self._last
+
+    def update(self, remote_ts: int) -> None:
+        """Witness a remote timestamp (ingest.rs HLC update on receive)."""
+        with self._lock:
+            self._last = max(self._last, remote_ts)
+
+    @property
+    def last(self) -> int:
+        with self._lock:
+            return self._last

@@ -1,0 +1,151 @@
+"""Sync ingest: receive → stale-check → apply → re-log → persist clock.
+
+Mirrors core/crates/sync/src/ingest.rs:
+
+- state machine WaitingForNotification → RetrievingMessages → Ingesting
+  (:30-88): a notification triggers pull rounds against a transport callback
+  until ``has_more`` is false;
+- ``receive_crdt_operation`` (:114-186): update the HLC, drop ops older than
+  the newest stored op for the same (model, record, field) target
+  ("compare_message" :188-233), apply via the annotation-driven applier,
+  re-log the op (transitive propagation + future stale checks), persist the
+  origin instance's clock in ``instance.timestamp`` (:136-159).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import TYPE_CHECKING, Any, Callable
+
+from ..models import Instance, RelationOperationRow, SharedOperationRow
+from .apply import ApplyError, apply_relation, apply_shared
+from .crdt import CREATE, DELETE, UPDATE_PREFIX, CRDTOperation, RelationOp, SharedOp
+from .manager import SyncMessage
+
+if TYPE_CHECKING:
+    from ..library import Library
+
+logger = logging.getLogger(__name__)
+
+#: transport: clocks, count -> (wire_ops, has_more). Wired to a direct call in
+#: tests and to the p2p sync session (GetOpsArgs over the wire) in production.
+Transport = Callable[[dict[str, int], int], tuple[list[dict[str, Any]], bool]]
+
+BATCH = 100  # GetOpsArgs.count used by the reference's integration test
+
+
+class Ingester:
+    """Synchronous core (usable inline); Actor wraps it in a thread."""
+
+    def __init__(self, library: "Library") -> None:
+        self.library = library
+
+    # -- stale check (compare_message, ingest.rs:188-233) -------------------
+    def _is_stale(self, op: CRDTOperation) -> bool:
+        db = self.library.db
+        t = op.typ
+        if isinstance(t, SharedOp):
+            rows = db.find(SharedOperationRow,
+                           {"model": t.model, "record_id": str(t.record_id)},
+                           order_by="timestamp DESC")
+        else:
+            rows = db.find(RelationOperationRow,
+                           {"relation": t.relation, "item_id": str(t.item_id),
+                            "group_id": str(t.group_id)},
+                           order_by="timestamp DESC")
+        for row in rows:
+            if row["id"] == op.id:  # already ingested (duplicate delivery)
+                return True
+            if row["timestamp"] < op.timestamp:
+                break  # nothing newer can conflict
+            if self._conflicts(op.typ.kind, row["kind"]):
+                return True
+        return False
+
+    @staticmethod
+    def _conflicts(incoming: str, stored: str) -> bool:
+        """Does a stored op at >= timestamp shadow the incoming one?
+        Per-field LWW: updates conflict only with the same field or a delete;
+        creates/deletes conflict with any same-record op."""
+        if incoming.startswith(UPDATE_PREFIX):
+            return stored == incoming or stored == DELETE
+        return True  # CREATE / DELETE are record-level
+
+    # -- application --------------------------------------------------------
+    def receive(self, wire_ops: list[dict[str, Any]]) -> int:
+        """Apply a batch; returns number of ops actually applied."""
+        db = self.library.db
+        sync = self.library.sync
+        applied = 0
+        seen_clocks: dict[str, int] = {}
+        with db.transaction():
+            for wire in wire_ops:
+                op = CRDTOperation.from_wire(wire)
+                sync.clock.update(op.timestamp)
+                if op.instance == sync.instance_pub_id:
+                    continue  # our own op reflected back
+                seen_clocks[op.instance] = max(seen_clocks.get(op.instance, 0),
+                                               op.timestamp)
+                if self._is_stale(op):
+                    continue
+                try:
+                    if isinstance(op.typ, SharedOp):
+                        apply_shared(db, op.typ)
+                    else:
+                        apply_relation(db, op.typ)
+                except ApplyError as e:
+                    logger.error("sync apply failed for op %s: %s", op.id, e)
+                    continue
+                sync.log_ops([op])  # re-log under the ORIGIN instance
+                applied += 1
+            # persist per-origin clocks (ingest.rs:136-159)
+            for pub_id, ts in seen_clocks.items():
+                row = db.find_one(Instance, {"pub_id": pub_id})
+                if row is not None and (row["timestamp"] or 0) < ts:
+                    db.update(Instance, {"pub_id": pub_id}, {"timestamp": ts})
+        if applied:
+            sync._broadcast(SyncMessage.INGESTED)
+        return applied
+
+
+class Actor:
+    """Threaded pull loop: ``notify()`` wakes it; it pulls batches from the
+    transport until has_more is false, then waits again."""
+
+    def __init__(self, library: "Library", transport: Transport,
+                 batch: int = BATCH) -> None:
+        self.ingester = Ingester(library)
+        self.library = library
+        self.transport = transport
+        self.batch = batch
+        self._wake: queue.Queue[object | None] = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"sync-ingest-{library.id[:8]}")
+        self._stopped = False
+        self._thread.start()
+
+    def notify(self) -> None:
+        self._wake.put(object())
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._wake.put(None)
+        self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while True:
+            item = self._wake.get()
+            if item is None or self._stopped:
+                return
+            try:
+                while True:
+                    clocks = self.library.sync.timestamps()
+                    ops, has_more = self.transport(clocks, self.batch)
+                    if ops:
+                        self.ingester.receive(ops)
+                    if not has_more:
+                        break
+            except Exception:
+                logger.exception("sync ingest round failed")

@@ -1,19 +1,248 @@
-"""Sync manager stub — fleshed out by the sync layer milestone.
+"""Sync manager: atomic op emission + ordered op serving.
 
-Interface shape follows core/crates/sync/src/manager.rs: domain writes go
-through ``write_ops`` so CRDT operations are logged atomically with the data
-mutation when message emission is on.
+Follows core/crates/sync/src/manager.rs semantics:
+
+- ``write_ops(ops, fn)`` — run the domain mutation and append the CRDT ops to
+  the op-log in ONE SQLite transaction (manager.rs:62-99), then broadcast
+  ``SyncMessage.CREATED``. When ``emit_messages`` is off the mutation runs
+  bare (no log rows) — same flag-gating as the reference's
+  ``emit_messages_flag``.
+- ``get_ops(clocks, count)`` — merged shared+relation fetch, timestamp-
+  ordered, newer than the caller's per-instance HLC clocks (manager.rs:130-199).
+- factories (``shared_create`` etc.) — the OperationFactory equivalent
+  (crates/sync/src/factory.rs), stamping (instance pub_id, HLC now, uuid).
 """
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING
+import logging
+import threading
+from typing import TYPE_CHECKING, Any, Callable
+
+from ..models import Instance, RelationOperationRow, SharedOperationRow
+from .crdt import (CREATE, DELETE, UPDATE_PREFIX, CRDTOperation, RelationOp,
+                   SharedOp, new_op)
+from .hlc import HLC
 
 if TYPE_CHECKING:
     from ..library import Library
+
+logger = logging.getLogger(__name__)
+
+
+class SyncMessage:
+    CREATED = "created"     # this instance logged new ops
+    INGESTED = "ingested"   # remote ops were applied here
 
 
 class SyncManager:
     def __init__(self, library: "Library") -> None:
         self.library = library
         self.emit_messages = False  # BackendFeature.SYNC_EMIT_MESSAGES gates this
+        self.clock = HLC(self._stored_clock_floor())
+        self._subscribers: list[Callable[[str], None]] = []
+        self._lock = threading.Lock()
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def instance_pub_id(self) -> str:
+        row = self.library.instance()
+        if row is None:
+            raise RuntimeError("library has no instance row")
+        return row["pub_id"]
+
+    def _instance_db_id(self, pub_id: str) -> int:
+        row = self.library.db.find_one(Instance, {"pub_id": pub_id})
+        if row is None:
+            raise RuntimeError(f"unknown instance {pub_id}")
+        return row["id"]
+
+    def _stored_clock_floor(self) -> int:
+        """Resume the HLC past everything already logged (restart safety)."""
+        try:
+            row = self.library.db.query(
+                "SELECT max(m) AS m FROM (SELECT max(timestamp) m FROM shared_operation "
+                "UNION ALL SELECT max(timestamp) m FROM relation_operation)")
+            return row[0]["m"] or 0
+        except Exception:
+            return 0
+
+    # -- subscriptions ------------------------------------------------------
+    def subscribe(self, fn: Callable[[str], None]) -> None:
+        """fn(SyncMessage.*) — NLM push-notify + UI sync.newMessage feed."""
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def _broadcast(self, message: str) -> None:
+        with self._lock:
+            subs = list(self._subscribers)
+        for fn in subs:
+            try:
+                fn(message)
+            except Exception:
+                logger.exception("sync subscriber failed")
+        self.library.emit("sync.newMessage", {"kind": message})
+
+    # -- op factories (factory.rs) -----------------------------------------
+    @staticmethod
+    def _table(model: Any) -> str:
+        return getattr(model, "TABLE", model)
+
+    def shared_create(self, model: Any, record_id: Any,
+                      fields: dict[str, Any] | None = None) -> CRDTOperation:
+        return new_op(self.instance_pub_id, self.clock.now(),
+                      SharedOp(self._table(model), record_id, CREATE, fields or {}))
+
+    def shared_update(self, model: Any, record_id: Any, field: str,
+                      value: Any) -> CRDTOperation:
+        return new_op(self.instance_pub_id, self.clock.now(),
+                      SharedOp(self._table(model), record_id, UPDATE_PREFIX + field, value))
+
+    def shared_delete(self, model: Any, record_id: Any) -> CRDTOperation:
+        return new_op(self.instance_pub_id, self.clock.now(),
+                      SharedOp(self._table(model), record_id, DELETE, None))
+
+    def relation_create(self, relation: Any, item_id: Any, group_id: Any,
+                        fields: dict[str, Any] | None = None) -> CRDTOperation:
+        return new_op(self.instance_pub_id, self.clock.now(),
+                      RelationOp(self._table(relation), item_id, group_id,
+                                 CREATE, fields or {}))
+
+    def relation_update(self, relation: Any, item_id: Any, group_id: Any,
+                        field: str, value: Any) -> CRDTOperation:
+        return new_op(self.instance_pub_id, self.clock.now(),
+                      RelationOp(self._table(relation), item_id, group_id,
+                                 UPDATE_PREFIX + field, value))
+
+    def relation_delete(self, relation: Any, item_id: Any, group_id: Any) -> CRDTOperation:
+        return new_op(self.instance_pub_id, self.clock.now(),
+                      RelationOp(self._table(relation), item_id, group_id, DELETE, None))
+
+    def created(self) -> None:
+        """Post-commit notification hook for call sites that logged ops inside
+        their own transaction (the broadcast must happen after commit)."""
+        self._broadcast(SyncMessage.CREATED)
+
+    def shared_create_many(self, model: Any, rows: list[dict[str, Any]],
+                           log: bool = True) -> list[CRDTOperation]:
+        """Bulk create-ops from model rows (the indexer save path). Local
+        integer FKs to synced models are rewritten as ``ref`` markers via the
+        target's sync id; local-only fields (id, SYNC_SKIP) are dropped;
+        datetimes become ISO strings (wire is JSON-safe)."""
+        import datetime as _dt
+
+        from ..models import MODEL_REGISTRY
+        from .crdt import ref
+
+        spec = model.SYNC
+        db = self.library.db
+        ref_cache: dict[tuple[str, Any], Any] = {}
+        skip = set(getattr(model, "SYNC_SKIP", ())) | {"id", spec.id}
+        ops: list[CRDTOperation] = []
+        for row in rows:
+            fields: dict[str, Any] = {}
+            for name, f in model.FIELDS.items():
+                if name in skip or name not in row or row[name] is None:
+                    continue
+                v = row[name]
+                if f.references:
+                    table = f.references.split(".")[0]
+                    target = MODEL_REGISTRY.get(table)
+                    # FK crosses the wire as the target's sync id / pub_id
+                    # (even @local models like instance have replicated
+                    # pub_ids via pairing); targets without one are dropped
+                    tkey = (target.SYNC.id if target is not None and target.SYNC
+                            else "pub_id" if target is not None and "pub_id" in target.FIELDS
+                            else None)
+                    if target is None or tkey is None:
+                        continue
+                    key = (table, v)
+                    if key not in ref_cache:
+                        trow = db.find_one(target, {"id": v})
+                        ref_cache[key] = trow[tkey] if trow else None
+                    if ref_cache[key] is None:
+                        continue
+                    v = ref(table, ref_cache[key])
+                if isinstance(v, _dt.datetime):
+                    v = v.isoformat()
+                fields[name] = v
+            ops.append(self.shared_create(model, row[spec.id], fields))
+        if log:
+            self.log_ops(ops)
+        return ops
+
+    # -- write path ---------------------------------------------------------
+    def write_ops(self, ops: list[CRDTOperation],
+                  fn: Callable[[Any], Any] | None = None) -> Any:
+        """Atomically run ``fn(db)`` and append ``ops`` to the op-log; no-op
+        logging (mutation only) when emit_messages is off."""
+        db = self.library.db
+        result = None
+        with db.transaction():
+            if fn is not None:
+                result = fn(db)
+            if self.emit_messages and ops:
+                self.log_ops(ops)
+        if self.emit_messages and ops:
+            self._broadcast(SyncMessage.CREATED)
+        return result
+
+    def log_ops(self, ops: list[CRDTOperation]) -> None:
+        db = self.library.db
+        for op in ops:
+            inst = self._instance_db_id(op.instance)
+            t = op.typ
+            if isinstance(t, SharedOp):
+                db.insert(SharedOperationRow, {
+                    "id": op.id, "timestamp": op.timestamp, "model": t.model,
+                    "record_id": str(t.record_id), "kind": t.kind,
+                    "data": t.data, "instance_id": inst,
+                }, or_ignore=True)
+            else:
+                db.insert(RelationOperationRow, {
+                    "id": op.id, "timestamp": op.timestamp, "relation": t.relation,
+                    "item_id": str(t.item_id), "group_id": str(t.group_id),
+                    "kind": t.kind, "data": t.data, "instance_id": inst,
+                }, or_ignore=True)
+
+    # -- read path ----------------------------------------------------------
+    def timestamps(self) -> dict[str, int]:
+        """Per-origin-instance applied-clock map (GetOpsArgs.clocks). For our
+        own instance: everything we logged; for peers: instance.timestamp as
+        persisted by ingest (ingest.rs:136-159)."""
+        out: dict[str, int] = {}
+        for row in self.library.db.find(Instance):
+            if row["id"] == self.library.instance_id:
+                out[row["pub_id"]] = self.clock.last
+            else:
+                out[row["pub_id"]] = row["timestamp"] or 0
+        return out
+
+    def get_ops(self, clocks: dict[str, int] | None = None,
+                count: int = 100) -> tuple[list[dict[str, Any]], bool]:
+        """Ops strictly newer (per origin instance) than ``clocks``, merged
+        across both log tables in timestamp order. Returns (wire_ops,
+        has_more)."""
+        clocks = clocks or {}
+        db = self.library.db
+        inst_pub: dict[int, str] = {r["id"]: r["pub_id"] for r in db.find(Instance)}
+        ops: list[CRDTOperation] = []
+
+        def newer(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
+            return [r for r in rows
+                    if r["timestamp"] > clocks.get(inst_pub.get(r["instance_id"], ""), 0)]
+
+        for r in newer(db.find(SharedOperationRow, order_by="timestamp")):
+            ops.append(CRDTOperation(
+                instance=inst_pub[r["instance_id"]], timestamp=r["timestamp"],
+                id=r["id"],
+                typ=SharedOp(r["model"], r["record_id"], r["kind"], r["data"])))
+        for r in newer(db.find(RelationOperationRow, order_by="timestamp")):
+            ops.append(CRDTOperation(
+                instance=inst_pub[r["instance_id"]], timestamp=r["timestamp"],
+                id=r["id"],
+                typ=RelationOp(r["relation"], r["item_id"], r["group_id"],
+                               r["kind"], r["data"])))
+        ops.sort(key=lambda o: (o.timestamp, o.id))
+        has_more = len(ops) > count
+        return [o.to_wire() for o in ops[:count]], has_more
