@@ -15,6 +15,7 @@
 #include <vector>
 
 #include <fcntl.h>
+#include <sys/mman.h>
 #include <unistd.h>
 
 namespace {
@@ -225,6 +226,32 @@ void sd_blake3_hex(const uint8_t* data, uint64_t len, char out65[65]) {
     out65[2 * i + 1] = HEX[digest[i] & 0xF];
   }
   out65[64] = '\0';
+}
+
+// Full-file BLAKE3 (the validator's integrity_checksum — distinct from the
+// sampled cas_id, reference core/src/object/validation/hash.rs:24). mmap'd so
+// multi-GB files hash without buffering. Returns 0 on success.
+int sd_blake3_file_hex(const char* path, char out65[65]) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return 1;
+  off_t size = lseek(fd, 0, SEEK_END);
+  if (size < 0) { close(fd); return 1; }
+  const uint8_t* data = nullptr;
+  if (size > 0) {
+    void* p = mmap(nullptr, static_cast<size_t>(size), PROT_READ, MAP_PRIVATE, fd, 0);
+    if (p == MAP_FAILED) { close(fd); return 1; }
+    data = static_cast<const uint8_t*>(p);
+  }
+  uint8_t digest[32];
+  blake3_digest(data, static_cast<size_t>(size), digest);
+  if (data) munmap(const_cast<uint8_t*>(data), static_cast<size_t>(size));
+  close(fd);
+  for (int i = 0; i < 32; i++) {
+    out65[2 * i] = HEX[digest[i] >> 4];
+    out65[2 * i + 1] = HEX[digest[i] & 0xF];
+  }
+  out65[64] = '\0';
+  return 0;
 }
 
 // Batch cas_id over files. out = n rows of 17 bytes (16 hex + NUL); a row

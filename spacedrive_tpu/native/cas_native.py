@@ -27,11 +27,23 @@ _lib.sd_cas_hash_batch.restype = None
 _lib.sd_blake3_hex.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
 _lib.sd_blake3_hex.restype = None
 
+_lib.sd_blake3_file_hex.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+_lib.sd_blake3_file_hex.restype = ctypes.c_int
+
 
 def blake3_hex(data: bytes) -> str:
     """Full 64-hex BLAKE3 digest (used by the validator's integrity checksum)."""
     out = ctypes.create_string_buffer(65)
     _lib.sd_blake3_hex(data, len(data), out)
+    return out.value.decode()
+
+
+def blake3_file_hex(path: str | Path) -> str:
+    """Full-file BLAKE3 via mmap (validator integrity checksums)."""
+    out = ctypes.create_string_buffer(65)
+    rc = _lib.sd_blake3_file_hex(os.fsencode(str(path)), out)
+    if rc != 0:
+        raise OSError(f"blake3 file hash failed for {path}")
     return out.value.decode()
 
 

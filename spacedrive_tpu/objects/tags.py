@@ -1,0 +1,119 @@
+"""Tags + seeded categories.
+
+Parity with core/src/object/tag/{mod,seed}.rs and library/cat.rs: tag CRUD,
+object assignment (the many-many TagOnObject link), and the seeded category
+list the overview screen groups by. All mutations emit CRDT ops when sync is
+on (tags are the canonical Shared + Relation sync models).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import TYPE_CHECKING, Any
+
+from ..models import Object, Tag, TagOnObject, utc_now
+
+if TYPE_CHECKING:
+    from ..library import Library
+
+#: library/cat.rs categories (overview grouping; ObjectKind-driven)
+CATEGORIES = [
+    "Recents", "Favorites", "Photos", "Videos", "Movies", "Music",
+    "Documents", "Downloads", "Encrypted", "Projects", "Applications",
+    "Archives", "Databases", "Games", "Books", "Contacts", "Trash",
+]
+
+
+def _emit(library: "Library", ops: list) -> None:
+    sync = getattr(library, "sync", None)
+    if sync is not None and getattr(sync, "emit_messages", False) and ops:
+        sync.log_ops(ops)
+        sync.created()
+
+
+def _ops(library: "Library"):
+    sync = getattr(library, "sync", None)
+    if sync is not None and getattr(sync, "emit_messages", False):
+        return sync
+    return None
+
+
+def create_tag(library: "Library", name: str, color: str | None = None) -> dict[str, Any]:
+    pub_id = str(uuid.uuid4())
+    row = {"pub_id": pub_id, "name": name, "color": color,
+           "date_created": utc_now(), "date_modified": utc_now()}
+    library.db.insert(Tag, row)
+    sync = _ops(library)
+    if sync:
+        _emit(library, [sync.shared_create(Tag, pub_id, {
+            "name": name, "color": color,
+            "date_created": row["date_created"].isoformat()})])
+    library.emit("invalidate_query", {"key": "tags.list"})
+    return library.db.find_one(Tag, {"pub_id": pub_id})
+
+
+def update_tag(library: "Library", tag_id: int, name: str | None = None,
+               color: str | None = None) -> None:
+    values: dict[str, Any] = {"date_modified": utc_now()}
+    if name is not None:
+        values["name"] = name
+    if color is not None:
+        values["color"] = color
+    library.db.update(Tag, {"id": tag_id}, values)
+    row = library.db.find_one(Tag, {"id": tag_id})
+    sync = _ops(library)
+    if sync and row:
+        _emit(library, [sync.shared_update(Tag, row["pub_id"], k,
+                                           v.isoformat() if hasattr(v, "isoformat") else v)
+                        for k, v in values.items()])
+    library.emit("invalidate_query", {"key": "tags.list"})
+
+
+def delete_tag(library: "Library", tag_id: int) -> None:
+    row = library.db.find_one(Tag, {"id": tag_id})
+    if row is None:
+        return
+    library.db.delete(TagOnObject, {"tag_id": tag_id})
+    library.db.delete(Tag, {"id": tag_id})
+    sync = _ops(library)
+    if sync:
+        _emit(library, [sync.shared_delete(Tag, row["pub_id"])])
+    library.emit("invalidate_query", {"key": "tags.list"})
+
+
+def assign_tag(library: "Library", tag_id: int, object_ids: list[int],
+               unassign: bool = False) -> None:
+    """tags.assign: link/unlink a tag on objects (api/tags.rs assign)."""
+    db = library.db
+    tag = db.find_one(Tag, {"id": tag_id})
+    if tag is None:
+        raise ValueError(f"tag {tag_id} not found")
+    sync = _ops(library)
+    ops = []
+    for oid in object_ids:
+        obj = db.find_one(Object, {"id": oid})
+        if obj is None:
+            continue
+        if unassign:
+            db.delete(TagOnObject, {"tag_id": tag_id, "object_id": oid})
+            if sync:
+                ops.append(sync.relation_delete(TagOnObject, tag["pub_id"], obj["pub_id"]))
+        else:
+            db.insert(TagOnObject, {"tag_id": tag_id, "object_id": oid,
+                                    "date_created": utc_now()}, or_ignore=True)
+            if sync:
+                ops.append(sync.relation_create(TagOnObject, tag["pub_id"], obj["pub_id"]))
+    _emit(library, ops)
+    library.emit("invalidate_query", {"key": "tags.getForObject"})
+
+
+def tags_for_object(library: "Library", object_id: int) -> list[dict[str, Any]]:
+    return [Tag.decode_row(r) for r in library.db.query(
+        "SELECT t.* FROM tag t JOIN tag_on_object j ON j.tag_id = t.id "
+        "WHERE j.object_id = ? ORDER BY t.name", [object_id])]
+
+
+def objects_for_tag(library: "Library", tag_id: int) -> list[dict[str, Any]]:
+    return [Object.decode_row(r) for r in library.db.query(
+        "SELECT o.* FROM object o JOIN tag_on_object j ON j.object_id = o.id "
+        "WHERE j.tag_id = ? ORDER BY o.id", [tag_id])]
