@@ -1,0 +1,61 @@
+"""Invalidation-key registry + mount-time validation.
+
+The reference's `invalidate_query!` macro records every invocation in a
+global and validates each recorded key (and argument type) against the router
+when `api::mount` runs in debug builds (api/utils/invalidate.rs:24-117) — a
+compile-adjacent guarantee that the frontend's cache invalidation never
+references a procedure that doesn't exist. Python has no macro collection
+step, so the registry is explicit: domain code calls ``invalidate_query``
+(or is listed in USED_KEYS if it emits the raw event), and ``validate``
+cross-checks the union against the mounted router's query keys at startup.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Any
+
+logger = logging.getLogger(__name__)
+
+if TYPE_CHECKING:
+    from ..library import Library
+    from .router import Router
+
+#: keys emitted via raw ``library.emit("invalidate_query", ...)`` calls in
+#: domain code (grep-audited); new call sites must be added here or use
+#: invalidate_query() below, which records automatically.
+USED_KEYS: set[str] = {
+    "search.paths",
+    "search.objects",
+    "locations.list",
+    "tags.list",
+    "tags.getForObject",
+    "preferences.get",
+    "jobs.reports",
+    "notifications.get",
+    "libraries.list",
+}
+
+_RUNTIME_KEYS: set[str] = set()
+
+
+def invalidate_query(library: "Library", key: str, arg: Any = None) -> None:
+    """Emit an invalidation event; records the key for mount validation."""
+    _RUNTIME_KEYS.add(key)
+    library.emit("invalidate_query", {"key": key, "arg": arg})
+
+
+class InvalidationError(Exception):
+    pass
+
+
+def validate(router: "Router") -> None:
+    """InvalidRequests::validate — every declared/used invalidation key must
+    name a registered QUERY procedure."""
+    from .router import QUERY
+
+    queries = {p.key for p in router.procedures.values() if p.kind == QUERY}
+    bad = sorted(k for k in (USED_KEYS | _RUNTIME_KEYS) if k not in queries)
+    if bad:
+        raise InvalidationError(
+            f"invalidation keys with no matching query procedure: {bad}")

@@ -1,0 +1,143 @@
+"""Typed procedure router — the rspc equivalent.
+
+Parity with core/src/api/mod.rs: a Node-scoped router of queries, mutations
+and subscriptions, merged from per-domain sub-router modules (17 in the
+reference, ~150 procedures); library-scoped procedures resolve their Library
+from a LibraryArgs envelope via middleware (api/utils/library.rs:50); and
+mount() validates every invalidation key domain code emits against the
+registered queries — the reference's load-bearing `InvalidRequests::validate`
+trick (api/utils/invalidate.rs:82-117) that keeps the frontend cache-
+invalidation contract honest.
+
+Transports (HTTP/WebSocket server shell, in-process tests, FFI) call
+``resolve``/``subscribe`` with plain JSON-safe values; ``schema()`` exports
+the procedure inventory the way the reference's bindings-codegen test does
+(api/mod.rs:205-212).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import logging
+from typing import TYPE_CHECKING, Any, Callable
+
+if TYPE_CHECKING:
+    from ..events import Subscription
+    from ..library import Library
+    from ..node import Node
+
+logger = logging.getLogger(__name__)
+
+QUERY = "query"
+MUTATION = "mutation"
+SUBSCRIPTION = "subscription"
+
+
+class ApiError(Exception):
+    def __init__(self, message: str, code: int = 400) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+@dataclasses.dataclass
+class Procedure:
+    key: str
+    kind: str            # query | mutation | subscription
+    scope: str           # node | library
+    fn: Callable
+    doc: str = ""
+
+
+class Router:
+    def __init__(self, node: "Node") -> None:
+        self.node = node
+        self.procedures: dict[str, Procedure] = {}
+
+    # -- registration -------------------------------------------------------
+    def _register(self, key: str, kind: str, scope: str, fn: Callable) -> Callable:
+        if key in self.procedures:
+            raise ValueError(f"duplicate procedure key {key!r}")
+        self.procedures[key] = Procedure(key, kind, scope, fn,
+                                         inspect.getdoc(fn) or "")
+        return fn
+
+    def query(self, key: str, scope: str = "node"):
+        return lambda fn: self._register(key, QUERY, scope, fn)
+
+    def mutation(self, key: str, scope: str = "node"):
+        return lambda fn: self._register(key, MUTATION, scope, fn)
+
+    def subscription(self, key: str, scope: str = "node"):
+        return lambda fn: self._register(key, SUBSCRIPTION, scope, fn)
+
+    # library-scoped sugar
+    def library_query(self, key: str):
+        return self.query(key, scope="library")
+
+    def library_mutation(self, key: str):
+        return self.mutation(key, scope="library")
+
+    def library_subscription(self, key: str):
+        return self.subscription(key, scope="library")
+
+    # -- resolution ---------------------------------------------------------
+    def _proc(self, key: str) -> Procedure:
+        proc = self.procedures.get(key)
+        if proc is None:
+            raise ApiError(f"unknown procedure {key!r}", code=404)
+        return proc
+
+    def _library(self, library_id: str | None) -> "Library":
+        if not library_id:
+            raise ApiError("library_id required for library-scoped procedure")
+        try:
+            return self.node.libraries.get(library_id)
+        except KeyError:
+            raise ApiError(f"library {library_id!r} not loaded", code=404) from None
+
+    def resolve(self, key: str, arg: Any = None, library_id: str | None = None) -> Any:
+        """Execute a query or mutation. Library-scoped procedures receive
+        (node, library, arg); node-scoped (node, arg)."""
+        proc = self._proc(key)
+        if proc.kind == SUBSCRIPTION:
+            raise ApiError(f"{key} is a subscription; use subscribe()")
+        if proc.scope == "library":
+            return proc.fn(self.node, self._library(library_id), arg)
+        return proc.fn(self.node, arg)
+
+    def subscribe(self, key: str, arg: Any = None,
+                  library_id: str | None = None) -> "Subscription":
+        proc = self._proc(key)
+        if proc.kind != SUBSCRIPTION:
+            raise ApiError(f"{key} is not a subscription")
+        if proc.scope == "library":
+            return proc.fn(self.node, self._library(library_id), arg)
+        return proc.fn(self.node, arg)
+
+    # -- schema export (bindings-codegen analogue) -------------------------
+    def schema(self) -> dict[str, Any]:
+        return {
+            "version": 1,
+            "procedures": [
+                {"key": p.key, "kind": p.kind, "scope": p.scope, "doc": p.doc}
+                for p in sorted(self.procedures.values(), key=lambda p: p.key)
+            ],
+        }
+
+
+def mount(node: "Node") -> Router:
+    """Build the full router (api::mount, mod.rs:102-203) and validate the
+    invalidation-key contract."""
+    from . import invalidate
+    from .routers import (backups, categories, files, jobs, libraries,
+                          locations, nodes, notifications, p2p, preferences,
+                          root, search, sync, tags, volumes)
+
+    router = Router(node)
+    for module in (root, libraries, locations, search, files, jobs, tags,
+                   volumes, nodes, notifications, preferences, backups,
+                   categories, sync, p2p):
+        module.mount(router)
+    invalidate.validate(router)
+    return router

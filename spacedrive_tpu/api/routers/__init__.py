@@ -1,0 +1,2 @@
+"""Per-domain sub-routers, merged by api.router.mount (the 17-router layout
+of core/src/api/mod.rs:102-203)."""

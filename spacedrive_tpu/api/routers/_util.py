@@ -1,0 +1,21 @@
+"""Shared helpers for router modules."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def filtered_subscription(node, kinds: set[str], library_id: str | None = None,
+                          predicate: Callable[[Any], bool] | None = None):
+    """Event-bus subscription annotated with a filter; transports apply
+    ``sub.filter(event)`` before forwarding (reference subscriptions stream
+    only their own CoreEvent variants)."""
+    sub = node.events.subscribe()
+    def _filter(ev) -> bool:
+        if kinds and ev.kind not in kinds:
+            return False
+        if library_id is not None and getattr(ev, "library_id", None) not in (None, library_id):
+            return False
+        return predicate(ev) if predicate else True
+    sub.filter = _filter
+    return sub

@@ -1,0 +1,21 @@
+"""categories.list (api/categories.rs): seeded overview categories with
+object counts per kind."""
+
+from __future__ import annotations
+
+from ...objects.tags import CATEGORIES
+
+
+def mount(router) -> None:
+    @router.library_query("categories.list")
+    def list_categories(node, library, _arg):
+        counts = {r["kind"]: r["n"] for r in library.db.query(
+            "SELECT kind, COUNT(*) n FROM object GROUP BY kind")}
+        from ...objects.kind import CATEGORY_KINDS
+
+        out = []
+        for name in CATEGORIES:
+            kinds = CATEGORY_KINDS.get(name, ())
+            out.append({"category": name,
+                        "count": sum(counts.get(k, 0) for k in kinds)})
+        return out

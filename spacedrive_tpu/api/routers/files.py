@@ -1,0 +1,171 @@
+"""files.* procedures (api/files.rs): object/file getters + mutations +
+fs-job launchers (copy/cut/delete/erase/duplicate/rename/createDirectory)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from ...models import FilePath, MediaData, Object, utc_now
+from ...objects.fs import (FileCopierJob, FileCutterJob, FileDeleterJob,
+                           FileEraserJob, create_directory, create_file,
+                           file_path_abs, find_available_name)
+from ...objects.media.metadata import extract_media_data
+from ..invalidate import invalidate_query
+from ..router import ApiError
+
+
+def _object_of(library, object_id: int) -> dict:
+    row = library.db.find_one(Object, {"id": object_id})
+    if row is None:
+        raise ApiError(f"object {object_id} not found", code=404)
+    return row
+
+
+def mount(router) -> None:
+    @router.library_query("files.get")
+    def get(node, library, arg):
+        """Object + its file_paths by object id or file_path id."""
+        db = library.db
+        if arg.get("file_path_id") is not None:
+            fp = db.find_one(FilePath, {"id": arg["file_path_id"]})
+            if fp is None:
+                raise ApiError("file_path not found", code=404)
+            obj = db.find_one(Object, {"id": fp["object_id"]}) if fp["object_id"] else None
+        else:
+            obj = _object_of(library, arg["object_id"])
+            fp = None
+        paths = db.find(FilePath, {"object_id": obj["id"]}) if obj else ([fp] if fp else [])
+        return {"object": obj, "file_paths": paths}
+
+    @router.library_query("files.getPath")
+    def get_path(node, library, file_path_id: int):
+        _row, path = file_path_abs(library.db, file_path_id)
+        return str(path)
+
+    @router.library_query("files.getMediaData")
+    def get_media_data(node, library, object_id: int):
+        return library.db.find_one(MediaData, {"object_id": object_id})
+
+    @router.query("files.getEphemeralMediaData")
+    def get_ephemeral_media_data(node, path: str):
+        ext = Path(path).suffix.lstrip(".").lower()
+        return extract_media_data(path, ext)
+
+    @router.library_mutation("files.setNote")
+    def set_note(node, library, arg):
+        obj = _object_of(library, arg["object_id"])
+        library.db.update(Object, {"id": obj["id"]}, {"note": arg.get("note")})
+        _sync_update(library, obj, "note", arg.get("note"))
+        invalidate_query(library, "search.paths")
+        return None
+
+    @router.library_mutation("files.setFavorite")
+    def set_favorite(node, library, arg):
+        obj = _object_of(library, arg["object_id"])
+        library.db.update(Object, {"id": obj["id"]},
+                          {"favorite": bool(arg.get("favorite"))})
+        _sync_update(library, obj, "favorite", bool(arg.get("favorite")))
+        invalidate_query(library, "search.paths")
+        return None
+
+    @router.library_mutation("files.updateAccessTime")
+    def update_access_time(node, library, object_id: int):
+        library.db.update(Object, {"id": object_id},
+                          {"date_accessed": utc_now()})
+        return None
+
+    @router.library_mutation("files.removeAccessTime")
+    def remove_access_time(node, library, object_id: int):
+        library.db.update(Object, {"id": object_id}, {"date_accessed": None})
+        return None
+
+    @router.library_mutation("files.renameFile")
+    def rename_file(node, library, arg):
+        """Disk rename + row update (api/files.rs renameFile)."""
+        db = library.db
+        row, path = file_path_abs(db, arg["file_path_id"])
+        new_name = arg["new_name"]
+        if "/" in new_name or new_name in (".", ".."):
+            raise ApiError(f"invalid name {new_name!r}")
+        target = path.with_name(new_name)
+        if target.exists():
+            raise ApiError(f"target exists: {target.name}", code=409)
+        path.rename(target)
+        stem, dot, ext = new_name.rpartition(".")
+        if row["is_dir"] or not dot or not stem:
+            stem, ext = new_name, ""
+        db.update(FilePath, {"id": row["id"]},
+                  {"name": stem, "extension": ext.lower()})
+        invalidate_query(library, "search.paths")
+        return None
+
+    @router.library_mutation("files.createDirectory")
+    def create_dir(node, library, arg):
+        from ...objects.fs import location_path_of
+
+        root = location_path_of(library.db, arg["location_id"])
+        parent = root / arg.get("sub_path", "").strip("/")
+        made = create_directory(parent, arg.get("name", "New Folder"))
+        from ...locations import light_scan_location
+
+        light_scan_location(library, arg["location_id"],
+                            arg.get("sub_path", "").strip("/"))
+        invalidate_query(library, "search.paths")
+        return str(made)
+
+    @router.library_mutation("files.createFile")
+    def create_file_(node, library, arg):
+        from ...objects.fs import location_path_of
+
+        root = location_path_of(library.db, arg["location_id"])
+        parent = root / arg.get("sub_path", "").strip("/")
+        made = create_file(parent, arg.get("name", "New File"))
+        from ...locations import light_scan_location
+
+        light_scan_location(library, arg["location_id"],
+                            arg.get("sub_path", "").strip("/"))
+        invalidate_query(library, "search.paths")
+        return str(made)
+
+    # -- job launchers ------------------------------------------------------
+    @router.library_mutation("files.copyFiles")
+    def copy_files(node, library, arg):
+        return node.jobs.spawn(library, [FileCopierJob({
+            "sources": arg["sources"],
+            "target_location_id": arg["target_location_id"],
+            "target_dir": arg.get("target_dir", "")})])
+
+    @router.library_mutation("files.cutFiles")
+    def cut_files(node, library, arg):
+        return node.jobs.spawn(library, [FileCutterJob({
+            "sources": arg["sources"],
+            "target_location_id": arg["target_location_id"],
+            "target_dir": arg.get("target_dir", "")})])
+
+    @router.library_mutation("files.duplicateFiles")
+    def duplicate_files(node, library, arg):
+        """Copy into the source's own directory (collision-safe naming)."""
+        db = library.db
+        jobs = []
+        for fp_id in arg["sources"]:
+            row, _path = file_path_abs(db, fp_id)
+            jobs.append(FileCopierJob({
+                "sources": [fp_id],
+                "target_location_id": row["location_id"],
+                "target_dir": (row["materialized_path"] or "/").strip("/")}))
+        return node.jobs.spawn(library, jobs)
+
+    @router.library_mutation("files.deleteFiles")
+    def delete_files(node, library, arg):
+        return node.jobs.spawn(library, [FileDeleterJob({"sources": arg["sources"]})])
+
+    @router.library_mutation("files.eraseFiles")
+    def erase_files(node, library, arg):
+        return node.jobs.spawn(library, [FileEraserJob({
+            "sources": arg["sources"], "passes": arg.get("passes", 2)})])
+
+
+def _sync_update(library, obj: dict, field: str, value) -> None:
+    sync = getattr(library, "sync", None)
+    if sync is not None and getattr(sync, "emit_messages", False):
+        sync.write_ops([sync.shared_update(Object, obj["pub_id"], field, value)])

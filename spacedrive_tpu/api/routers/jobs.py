@@ -1,0 +1,94 @@
+"""jobs.* procedures (api/jobs.rs): reports (grouped with children),
+isActive, clear, clearAll, pause, resume, cancel, job launchers, progress +
+newThumbnail subscriptions."""
+
+from __future__ import annotations
+
+from ...jobs import JobStatus
+from ...models import JobRow
+from ...objects.validator import ObjectValidatorJob
+from ..invalidate import invalidate_query
+from ._util import filtered_subscription
+
+_ACTIVE = {JobStatus.QUEUED, JobStatus.RUNNING, JobStatus.PAUSED}
+
+
+def mount(router) -> None:
+    @router.library_query("jobs.reports")
+    def reports(node, library, _arg):
+        """All job reports, children grouped under their chain head
+        (api/jobs.rs:67)."""
+        rows = library.db.find(JobRow, order_by="date_created DESC")
+        by_parent: dict[str | None, list] = {}
+        for r in rows:
+            r.pop("data", None)  # serialized state stays internal
+            by_parent.setdefault(r["parent_id"], []).append(r)
+        out = []
+        for head in by_parent.get(None, []):
+            head["children"] = by_parent.get(head["id"], [])
+            out.append(head)
+        return out
+
+    @router.query("jobs.isActive")
+    def is_active(node, _arg):
+        return node.jobs.is_active()
+
+    @router.library_mutation("jobs.clear")
+    def clear(node, library, job_id: str):
+        library.db.delete(JobRow, {"id": job_id})
+        invalidate_query(library, "jobs.reports")
+        return None
+
+    @router.library_mutation("jobs.clearAll")
+    def clear_all(node, library, _arg):
+        """Remove every non-active report (api clearAll)."""
+        for row in library.db.find(JobRow):
+            if row["status"] not in _ACTIVE:
+                library.db.delete(JobRow, {"id": row["id"]})
+        invalidate_query(library, "jobs.reports")
+        return None
+
+    @router.mutation("jobs.pause")
+    def pause(node, job_id: str):
+        return node.jobs.pause(job_id)
+
+    @router.library_mutation("jobs.resume")
+    def resume(node, library, job_id: str):
+        return node.jobs.resume(library, job_id)
+
+    @router.mutation("jobs.cancel")
+    def cancel(node, job_id: str):
+        return node.jobs.cancel(job_id)
+
+    @router.library_mutation("jobs.objectValidator")
+    def object_validator(node, library, arg):
+        return node.jobs.spawn(library, [ObjectValidatorJob({
+            "location_id": arg["location_id"],
+            "sub_path": arg.get("sub_path"),
+            "revalidate": arg.get("revalidate", False)})])
+
+    @router.library_mutation("jobs.identifyUniqueFiles")
+    def identify_unique_files(node, library, arg):
+        from ...objects.file_identifier import FileIdentifierJob
+
+        return node.jobs.spawn(library, [FileIdentifierJob({
+            "location_id": arg["location_id"],
+            "sub_path": arg.get("sub_path")})])
+
+    @router.library_mutation("jobs.generateThumbsForLocation")
+    def generate_thumbs(node, library, arg):
+        from ...objects.media.processor import MediaProcessorJob
+
+        return node.jobs.spawn(library, [MediaProcessorJob({
+            "location_id": arg["location_id"],
+            "sub_path": arg.get("sub_path"),
+            "regenerate": arg.get("regenerate", False)})])
+
+    @router.library_subscription("jobs.progress")
+    def progress(node, library, _arg):
+        """JobProgress events for this library (api/jobs.rs:33)."""
+        return filtered_subscription(node, {"job_progress"}, library.id)
+
+    @router.library_subscription("jobs.newThumbnail")
+    def new_thumbnail(node, library, _arg):
+        return filtered_subscription(node, {"new_thumbnail"}, library.id)

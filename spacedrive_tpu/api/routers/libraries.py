@@ -1,0 +1,39 @@
+"""library.* procedures (api/libraries.rs): list, statistics, create, edit,
+delete."""
+
+from __future__ import annotations
+
+from ...statistics import update_statistics
+
+
+def mount(router) -> None:
+    @router.query("libraries.list")
+    def list_libraries(node, _arg):
+        return [{"id": lib.id, "name": lib.name,
+                 "description": lib.config.get("description", ""),
+                 "instance_id": lib.instance_id,
+                 "instance_pub_id": (lib.instance() or {}).get("pub_id")}
+                for lib in node.libraries.list()]
+
+    @router.library_query("libraries.statistics")
+    def statistics(node, library, _arg):
+        """Recomputed on query (api/libraries.rs:47)."""
+        row = dict(update_statistics(library))
+        row.pop("date_captured", None)
+        return row
+
+    @router.mutation("libraries.create")
+    def create(node, arg):
+        lib = node.libraries.create(arg["name"], arg.get("description", ""))
+        return {"id": lib.id, "name": lib.name}
+
+    @router.mutation("libraries.edit")
+    def edit(node, arg):
+        node.libraries.edit(arg["id"], name=arg.get("name"),
+                            description=arg.get("description"))
+        return None
+
+    @router.mutation("libraries.delete")
+    def delete(node, library_id: str):
+        node.libraries.delete(library_id)
+        return None

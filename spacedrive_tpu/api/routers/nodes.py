@@ -1,0 +1,20 @@
+"""nodes.* procedures (api/nodes.rs): edit, listLocations."""
+
+from __future__ import annotations
+
+from ...models import Location
+
+
+def mount(router) -> None:
+    @router.mutation("nodes.edit")
+    def edit(node, arg):
+        updates = {}
+        if arg.get("name"):
+            updates["name"] = arg["name"]
+        if updates:
+            node.config.write(**updates)
+        return None
+
+    @router.library_query("nodes.listLocations")
+    def list_locations(node, library, _arg):
+        return library.db.find(Location, order_by="name")

@@ -1,0 +1,59 @@
+"""p2p.* procedures (api/p2p.rs). The networking layer wires real handlers;
+until a peer mesh is up these surface the node's own state and validate
+the procedure contract."""
+
+from __future__ import annotations
+
+from ..router import ApiError
+from ._util import filtered_subscription
+
+
+def mount(router) -> None:
+    @router.subscription("p2p.events")
+    def events(node, _arg):
+        return filtered_subscription(node, {"p2p"})
+
+    @router.query("p2p.nlmState")
+    def nlm_state(node, _arg):
+        p2p = getattr(node, "p2p", None)
+        if p2p is None:
+            return {}
+        return p2p.nlm_state()
+
+    @router.mutation("p2p.spacedrop")
+    def spacedrop(node, arg):
+        p2p = getattr(node, "p2p", None)
+        if p2p is None:
+            raise ApiError("p2p is not running", code=503)
+        return p2p.spacedrop(arg["peer_id"], arg["paths"])
+
+    @router.mutation("p2p.acceptSpacedrop")
+    def accept_spacedrop(node, arg):
+        p2p = getattr(node, "p2p", None)
+        if p2p is None:
+            raise ApiError("p2p is not running", code=503)
+        p2p.accept_spacedrop(arg["id"], arg.get("target_dir"))
+        return None
+
+    @router.mutation("p2p.cancelSpacedrop")
+    def cancel_spacedrop(node, arg):
+        p2p = getattr(node, "p2p", None)
+        if p2p is None:
+            raise ApiError("p2p is not running", code=503)
+        p2p.cancel_spacedrop(arg["id"])
+        return None
+
+    @router.mutation("p2p.pair")
+    def pair(node, arg):
+        p2p = getattr(node, "p2p", None)
+        if p2p is None:
+            raise ApiError("p2p is not running", code=503)
+        return p2p.pair(arg["peer_id"], arg["library_id"])
+
+    @router.mutation("p2p.pairingResponse")
+    def pairing_response(node, arg):
+        p2p = getattr(node, "p2p", None)
+        if p2p is None:
+            raise ApiError("p2p is not running", code=503)
+        p2p.pairing_response(arg["pairing_id"], arg["decision"])
+        return None

@@ -1,0 +1,37 @@
+"""Root procedures (api/mod.rs:105-167): buildInfo, nodeState,
+toggleFeatureFlag."""
+
+from __future__ import annotations
+
+from ... import __version__
+
+
+def mount(router) -> None:
+    @router.query("buildInfo")
+    def build_info(node, _arg):
+        """Version + commit of the running core."""
+        return {"version": __version__, "commit": "dev"}
+
+    @router.query("nodeState")
+    def node_state(node, _arg):
+        """Node config + data dir + connected device inventory."""
+        cfg = node.config.get()
+        return {
+            "id": cfg["id"], "name": cfg["name"],
+            "data_path": str(node.data_dir),
+            "p2p_port": cfg.get("p2p_port"),
+            "features": cfg.get("features", []),
+            "accelerator": cfg.get("accelerator"),
+        }
+
+    @router.mutation("toggleFeatureFlag")
+    def toggle_feature_flag(node, feature: str):
+        """Flip a BackendFeature; returns the new enabled state."""
+        enabled = node.config.toggle_feature(feature)
+        from ...config import BackendFeature
+
+        if feature == BackendFeature.SYNC_EMIT_MESSAGES:
+            for library in node.libraries.list():
+                library.sync.emit_messages = enabled
+        node.emit("feature_flags", node.config.get().get("features", []))
+        return enabled

@@ -1,0 +1,157 @@
+"""search.* procedures (api/search.rs): paths, pathsCount, objects,
+objectsCount, ephemeralPaths — filterable, ordered, cursor-paginated.
+
+Filter/ordering surface follows the reference's search args (:42-70 ordering
+enums, :191-259 cursor types): locationId, search (name substring),
+extensions, kinds, tags, favorite, hidden, dateRange; orderBy name|
+sizeInBytes|dateCreated|dateModified + direction; cursor = last row id.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...locations.non_indexed import walk_ephemeral
+from ...models import FilePath, Object
+
+_PATH_ORDERS = {"name", "size_in_bytes", "date_created", "date_modified"}
+
+
+def _path_filters(arg: dict[str, Any]) -> tuple[str, list[Any]]:
+    where, params = ["1=1"], []
+    if arg.get("location_id") is not None:
+        where.append("fp.location_id = ?")
+        params.append(arg["location_id"])
+    if arg.get("search"):
+        where.append("fp.name LIKE ?")
+        params.append(f"%{arg['search']}%")
+    if arg.get("extensions"):
+        marks = ",".join("?" for _ in arg["extensions"])
+        where.append(f"fp.extension IN ({marks})")
+        params.extend(e.lstrip(".").lower() for e in arg["extensions"])
+    if arg.get("kinds"):
+        marks = ",".join("?" for _ in arg["kinds"])
+        where.append(f"o.kind IN ({marks})")
+        params.extend(arg["kinds"])
+    if arg.get("tags"):
+        marks = ",".join("?" for _ in arg["tags"])
+        where.append(f"fp.object_id IN (SELECT object_id FROM tag_on_object "
+                     f"WHERE tag_id IN ({marks}))")
+        params.extend(arg["tags"])
+    if arg.get("favorite") is not None:
+        where.append("o.favorite = ?")
+        params.append(int(arg["favorite"]))
+    if not arg.get("include_hidden"):
+        where.append("(fp.hidden IS NULL OR fp.hidden = 0)")
+    if arg.get("materialized_path"):
+        where.append("fp.materialized_path = ?")
+        params.append(arg["materialized_path"])
+    return " AND ".join(where), params
+
+
+#: NULL-safe order expressions (keyset cursors need total order)
+_COALESCED = {
+    "name": "COALESCE(fp.name, '')",
+    "size_in_bytes": "COALESCE(fp.size_in_bytes, -1)",
+    "date_created": "COALESCE(fp.date_created, '')",
+    "date_modified": "COALESCE(fp.date_modified, '')",
+}
+
+
+def _order_parts(arg: dict[str, Any]) -> tuple[str, str, bool]:
+    field = arg.get("order_by") or "name"
+    if field not in _PATH_ORDERS:
+        field = "name"
+    desc = bool(arg.get("order_desc"))
+    expr = _COALESCED[field]
+    return expr, f"{expr} {'DESC' if desc else 'ASC'}, fp.id ASC", desc
+
+
+def _cursor_sql(expr: str, desc: bool) -> str:
+    """Keyset condition over (order value, id) — a bare id cursor would be
+    incoherent under non-id orderings (cursor types, api/search.rs:191-259)."""
+    cmp = "<" if desc else ">"
+    return f"({expr} {cmp} ? OR ({expr} = ? AND fp.id > ?))"
+
+
+def mount(router) -> None:
+    @router.library_query("search.paths")
+    def paths(node, library, arg):
+        """Cursor-paginated file_path search with object join."""
+        arg = arg or {}
+        where, params = _path_filters(arg)
+        take = min(int(arg.get("take", 100)), 500)
+        expr, order_sql, desc = _order_parts(arg)
+        cursor = arg.get("cursor")
+        cursor_sql = ""
+        if cursor is not None:
+            value, last_id = cursor
+            cursor_sql = f"AND {_cursor_sql(expr, desc)}"
+            params = params + [value, value, last_id]
+        rows = library.db.query(
+            f"SELECT fp.*, o.pub_id AS object_pub_id, o.kind AS object_kind, "
+            f"o.favorite AS favorite, o.note AS note, {expr} AS _order_val "
+            f"FROM file_path fp LEFT JOIN object o ON fp.object_id = o.id "
+            f"WHERE {where} {cursor_sql} ORDER BY {order_sql} LIMIT ?",
+            params + [take + 1])
+        items = []
+        for r in rows[:take]:
+            d = dict(FilePath.decode_row(r) | {
+                "object_pub_id": r["object_pub_id"], "object_kind": r["object_kind"],
+                "favorite": bool(r["favorite"]), "note": r["note"],
+            })
+            d.pop("_order_val", None)
+            items.append(d)
+        next_cursor = None
+        if len(rows) > take and items:
+            next_cursor = [rows[take - 1]["_order_val"], items[-1]["id"]]
+        return {"items": items, "cursor": next_cursor}
+
+    @router.library_query("search.pathsCount")
+    def paths_count(node, library, arg):
+        where, params = _path_filters(arg or {})
+        return library.db.query(
+            f"SELECT COUNT(*) n FROM file_path fp "
+            f"LEFT JOIN object o ON fp.object_id = o.id WHERE {where}",
+            params)[0]["n"]
+
+    @router.library_query("search.objects")
+    def objects(node, library, arg):
+        arg = arg or {}
+        where, params = ["1=1"], []
+        if arg.get("kinds"):
+            marks = ",".join("?" for _ in arg["kinds"])
+            where.append(f"o.kind IN ({marks})")
+            params.extend(arg["kinds"])
+        if arg.get("favorite") is not None:
+            where.append("o.favorite = ?")
+            params.append(int(arg["favorite"]))
+        if arg.get("tags"):
+            marks = ",".join("?" for _ in arg["tags"])
+            where.append(f"o.id IN (SELECT object_id FROM tag_on_object "
+                         f"WHERE tag_id IN ({marks}))")
+            params.extend(arg["tags"])
+        take = min(int(arg.get("take", 100)), 500)
+        cursor_sql = ""
+        if arg.get("cursor") is not None:
+            cursor_sql = "AND o.id > ?"
+            params.append(arg["cursor"])
+        rows = library.db.query(
+            f"SELECT o.* FROM object o WHERE {' AND '.join(where)} {cursor_sql} "
+            f"ORDER BY o.id LIMIT ?", params + [take + 1])
+        items = [Object.decode_row(r) for r in rows[:take]]
+        return {"items": items,
+                "cursor": items[-1]["id"] if len(rows) > take else None}
+
+    @router.library_query("search.objectsCount")
+    def objects_count(node, library, arg):
+        return library.db.query("SELECT COUNT(*) n FROM object")[0]["n"]
+
+    @router.query("search.ephemeralPaths")
+    def ephemeral_paths(node, arg):
+        """Non-indexed directory listing (api/search.rs:328 /
+        location/non_indexed.rs)."""
+        arg = arg or {}
+        return walk_ephemeral(arg["path"],
+                              include_hidden=bool(arg.get("include_hidden")),
+                              with_cas_ids=bool(arg.get("with_cas_ids")))

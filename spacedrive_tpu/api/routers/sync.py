@@ -1,0 +1,19 @@
+"""sync.* procedures (api/sync.rs): messages + newMessage subscription."""
+
+from __future__ import annotations
+
+from ._util import filtered_subscription
+
+
+def mount(router) -> None:
+    @router.library_query("sync.messages")
+    def messages(node, library, arg):
+        """Raw op-log feed for the sync debug page."""
+        arg = arg or {}
+        ops, has_more = library.sync.get_ops(arg.get("clocks"),
+                                             int(arg.get("count", 100)))
+        return {"ops": ops, "has_more": has_more}
+
+    @router.library_subscription("sync.newMessage")
+    def new_message(node, library, _arg):
+        return filtered_subscription(node, {"sync.newMessage"}, library.id)

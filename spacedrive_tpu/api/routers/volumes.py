@@ -1,0 +1,11 @@
+"""volumes.list (api/volumes.rs), backed by /proc/mounts enumeration."""
+
+from __future__ import annotations
+
+from ...volumes import get_volumes
+
+
+def mount(router) -> None:
+    @router.query("volumes.list")
+    def list_volumes(node, _arg):
+        return get_volumes()

@@ -1,0 +1,102 @@
+"""Library backups: tar.gz snapshots of config + DB with a magic header.
+
+Parity with core/src/api/backups.rs:32-108: a backup file = fixed-size magic
+header (magic bytes, backup id, timestamp, library id, library name) followed
+by a tar.gz of the `.sdlibrary` config and `.db` database. Restore unloads
+the library, untars over the originals, and reloads.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import tarfile
+import time
+import uuid
+from pathlib import Path
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from .node import Node
+
+MAGIC = b"SDTPUBAK"  # 8 bytes
+HEADER_LEN = 256
+
+
+def _header(backup_id: str, library_id: str, library_name: str) -> bytes:
+    meta = json.dumps({
+        "id": backup_id, "timestamp": int(time.time() * 1000),
+        "library_id": library_id, "library_name": library_name[:80],
+    }).encode()
+    if len(meta) > HEADER_LEN - 12:
+        meta = meta[: HEADER_LEN - 12]
+    return MAGIC + struct.pack("<I", len(meta)) + meta.ljust(HEADER_LEN - 12, b"\0")
+
+
+def read_header(path: str | Path) -> dict[str, Any]:
+    with open(path, "rb") as fh:
+        head = fh.read(HEADER_LEN)
+    if len(head) < HEADER_LEN or not head.startswith(MAGIC):
+        raise ValueError(f"not a backup file: {path}")
+    (meta_len,) = struct.unpack_from("<I", head, 8)
+    return json.loads(head[12 : 12 + meta_len])
+
+
+def backups_dir(node: "Node") -> Path:
+    d = node.data_dir / "backups"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def list_backups(node: "Node") -> list[dict[str, Any]]:
+    out = []
+    for path in sorted(backups_dir(node).glob("*.bkp")):
+        try:
+            out.append({**read_header(path), "path": str(path)})
+        except (ValueError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def do_backup(node: "Node", library_id: str) -> str:
+    library = node.libraries.get(library_id)
+    backup_id = str(uuid.uuid4())
+    target = backups_dir(node) / f"{backup_id}.bkp"
+    cfg_path = node.libraries.dir / f"{library_id}.sdlibrary"
+    db_path = node.libraries.dir / f"{library_id}.db"
+    library.db.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        tar.add(cfg_path, arcname=f"{library_id}.sdlibrary")
+        tar.add(db_path, arcname=f"{library_id}.db")
+    with open(target, "wb") as fh:
+        fh.write(_header(backup_id, library_id, library.name))
+        fh.write(buf.getvalue())
+    return backup_id
+
+
+def do_restore(node: "Node", backup_path: str | Path) -> str:
+    header = read_header(backup_path)
+    library_id = header["library_id"]
+    # unload if loaded (restore semantics: backups.rs restore)
+    try:
+        library = node.libraries.get(library_id)
+        library.close()
+        node.libraries._libraries.pop(library_id, None)
+    except KeyError:
+        pass
+    with open(backup_path, "rb") as fh:
+        fh.seek(HEADER_LEN)
+        with tarfile.open(fileobj=io.BytesIO(fh.read()), mode="r:gz") as tar:
+            members = [m for m in tar.getmembers()
+                       if m.name in (f"{library_id}.sdlibrary", f"{library_id}.db")]
+            tar.extractall(node.libraries.dir, members=members, filter="data")
+    node.libraries._load(library_id)
+    return library_id
+
+
+def delete_backup(node: "Node", backup_id: str) -> None:
+    path = backups_dir(node) / f"{backup_id}.bkp"
+    if path.exists():
+        path.unlink()

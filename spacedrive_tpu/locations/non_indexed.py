@@ -1,0 +1,69 @@
+"""Ephemeral (non-indexed) directory browsing.
+
+Parity with core/src/location/non_indexed.rs:27-36: list any path outside a
+location without touching the database — entries get kinds from the extension
+registry, the seeded system rules filter noise (same rules the indexer
+seeds), and image entries can produce on-the-fly thumbnails keyed by an
+ephemeral cas_id (generate_cas_id over the real file).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any
+
+from ..objects.cas import generate_cas_id
+from ..objects.kind import ObjectKind, kind_from_extension
+from .rules import SYSTEM_RULES, CompiledRules, IndexerRuleSpec
+
+
+def _default_rules(include_hidden: bool) -> CompiledRules:
+    specs: list[IndexerRuleSpec] = [s for s in SYSTEM_RULES if s.default]
+    if include_hidden:
+        specs = [s for s in specs if s.name != "No Hidden"]
+    return CompiledRules(specs)
+
+
+def walk_ephemeral(path: str | Path, include_hidden: bool = False,
+                   with_cas_ids: bool = False) -> dict[str, Any]:
+    """One-directory listing → {entries, errors}; no DB writes."""
+    root = Path(path)
+    if not root.is_dir():
+        raise NotADirectoryError(str(root))
+    rules = _default_rules(include_hidden)
+    entries: list[dict[str, Any]] = []
+    errors: list[str] = []
+    try:
+        listing = sorted(os.scandir(root), key=lambda e: e.name)
+    except OSError as e:
+        return {"entries": [], "errors": [f"scandir {root}: {e}"]}
+    for entry in listing:
+        try:
+            if entry.is_symlink():
+                continue
+            is_dir = entry.is_dir(follow_symlinks=False)
+            if not rules.allows_path(entry.name, is_dir, abs_path=entry.path):
+                continue
+            st = entry.stat(follow_symlinks=False)
+            name, dot, ext = entry.name.rpartition(".")
+            if is_dir or not dot or not name:
+                name, ext = entry.name, ""
+            kind = ObjectKind.FOLDER if is_dir else kind_from_extension(ext.lower(), False)
+            row: dict[str, Any] = {
+                "name": name, "extension": ext.lower() if not is_dir else "",
+                "kind": kind, "is_dir": is_dir,
+                "size_in_bytes": 0 if is_dir else st.st_size,
+                "date_modified": st.st_mtime, "date_created": st.st_ctime,
+                "hidden": entry.name.startswith("."),
+                "path": entry.path,
+            }
+            if with_cas_ids and not is_dir and st.st_size > 0:
+                try:
+                    row["cas_id"] = generate_cas_id(entry.path, st.st_size)
+                except (OSError, EOFError) as e:
+                    errors.append(f"cas {entry.name}: {e}")
+            entries.append(row)
+        except OSError as e:
+            errors.append(f"stat {entry.name}: {e}")
+    return {"entries": entries, "errors": errors}

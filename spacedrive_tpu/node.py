@@ -63,6 +63,12 @@ class Node:
                 logger.info("cold-resumed %d jobs for library %s", revived, library.id[:8])
         self._start_p2p()
 
+        # api::mount last — validates the invalidation-key contract
+        # (api/mod.rs:102, invalidate.rs:82)
+        from .api.router import mount as api_mount
+
+        self.router = api_mount(self)
+
     def _start_locations(self) -> None:
         from .locations.manager import LocationsActor
 

@@ -103,3 +103,19 @@ def kind_from_extension(extension: str | None, is_dir: bool = False) -> int:
     if not extension:
         return ObjectKind.UNKNOWN
     return EXTENSION_TO_KIND.get(extension.lower().lstrip("."), ObjectKind.UNKNOWN)
+
+
+#: overview-category → ObjectKinds grouping (library/cat.rs:77 semantics)
+CATEGORY_KINDS: dict[str, tuple[int, ...]] = {
+    "Photos": (ObjectKind.IMAGE,),
+    "Videos": (ObjectKind.VIDEO,),
+    "Movies": (ObjectKind.VIDEO,),
+    "Music": (ObjectKind.AUDIO,),
+    "Documents": (ObjectKind.DOCUMENT, ObjectKind.TEXT),
+    "Encrypted": (ObjectKind.ENCRYPTED,),
+    "Projects": (ObjectKind.CODE,),
+    "Applications": (ObjectKind.EXECUTABLE, ObjectKind.WIDGET),
+    "Archives": (ObjectKind.ARCHIVE,),
+    "Databases": (ObjectKind.DATABASE,),
+    "Books": (ObjectKind.BOOK,),
+}

@@ -1,0 +1,67 @@
+"""Library statistics (the `library.statistics` procedure's backing store).
+
+Parity with the reference's Statistics model (schema.prisma:99) + the
+update-on-query pattern of api/libraries.rs:47: counts come from the library
+DB, capacity from the volume the data dir lives on. Byte counters are stored
+as TEXT to match the reference's schema (u64-in-string workaround) even
+though SQLite INTEGER would hold them.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import TYPE_CHECKING, Any
+
+from .models import Statistics, utc_now
+from .volumes import volume_for_path
+
+if TYPE_CHECKING:
+    from .library import Library
+
+
+def update_statistics(library: "Library") -> dict[str, Any]:
+    db = library.db
+    total_objects = db.query("SELECT COUNT(*) n FROM object")[0]["n"]
+    totals = db.query(
+        "SELECT COALESCE(SUM(size_in_bytes),0) s FROM file_path WHERE is_dir=0")[0]["s"]
+    unique = db.query(
+        "SELECT COALESCE(SUM(sz),0) s FROM (SELECT MIN(size_in_bytes) sz "
+        "FROM file_path WHERE cas_id IS NOT NULL GROUP BY cas_id)")[0]["s"]
+    try:
+        db_size = os.path.getsize(db.path)
+    except OSError:
+        db_size = 0
+    vol = volume_for_path(os.path.dirname(str(db.path))) or {}
+    row = {
+        "date_captured": utc_now(),
+        "total_object_count": total_objects,
+        "library_db_size": str(db_size),
+        "total_bytes_used": str(totals),
+        "total_unique_bytes": str(unique),
+        "total_bytes_capacity": str(vol.get("total_capacity", 0)),
+        "total_bytes_free": str(vol.get("available_capacity", 0)),
+        "preview_media_bytes": str(_thumb_dir_size(library)),
+    }
+    existing = db.find(Statistics, limit=1)
+    if existing:
+        db.update(Statistics, {"id": existing[0]["id"]}, row)
+        row["id"] = existing[0]["id"]
+    else:
+        row["id"] = db.insert(Statistics, row)
+    return row
+
+
+def _thumb_dir_size(library: "Library") -> int:
+    node = library.node
+    if node is None:
+        return 0
+    thumb_dir = node.data_dir / "thumbnails"
+    total = 0
+    if thumb_dir.is_dir():
+        for dirpath, _dirs, files in os.walk(thumb_dir):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, f))
+                except OSError:
+                    pass
+    return total

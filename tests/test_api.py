@@ -1,0 +1,250 @@
+"""API router: procedure resolution, library middleware, invalidation
+contract, subscriptions, schema export (the bindings-codegen analogue —
+running this suite regenerates schema/api.json like the reference's
+test_and_export_rspc_bindings, api/mod.rs:205-212)."""
+
+import json
+import random
+from pathlib import Path
+
+import pytest
+
+from spacedrive_tpu.api.invalidate import InvalidationError, invalidate_query
+from spacedrive_tpu.api.router import ApiError, mount
+from spacedrive_tpu.locations import create_location, scan_location
+from spacedrive_tpu.models import FilePath, Object
+from spacedrive_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_data_dir):
+    n = Node(tmp_data_dir, probe_accelerator=False)
+    yield n
+    n.shutdown()
+
+
+@pytest.fixture()
+def indexed(node, tmp_path):
+    tree = tmp_path / "tree"
+    (tree / "sub").mkdir(parents=True)
+    rng = random.Random(11)
+    (tree / "report.pdf").write_bytes(rng.randbytes(2000))
+    (tree / "song.mp3").write_bytes(rng.randbytes(3000))
+    (tree / "sub" / "photo.png").write_bytes(rng.randbytes(1500))
+    lib = node.libraries.create("api-test")
+    loc = create_location(lib, str(tree), hasher="cpu")
+    scan_location(lib, loc["id"])
+    assert node.jobs.wait_idle(90)
+    return node, lib, loc, tree
+
+
+def test_router_mounts_with_validated_invalidations(node):
+    assert len(node.router.procedures) >= 80
+    schema = node.router.schema()
+    out = Path(__file__).parent.parent / "schema" / "api.json"
+    out.parent.mkdir(exist_ok=True)
+    out.write_text(json.dumps(schema, indent=1))
+    assert {p["key"] for p in schema["procedures"]} >= {
+        "buildInfo", "nodeState", "search.paths", "libraries.list",
+        "locations.create", "jobs.reports", "tags.assign", "volumes.list",
+        "backups.getAll", "sync.messages", "p2p.nlmState"}
+
+
+def test_invalidation_validation_rejects_unknown_key(node, tmp_path):
+    lib = node.libraries.create("bad-keys")
+    invalidate_query(lib, "not.aProcedure")
+    with pytest.raises(InvalidationError):
+        mount(node)
+    from spacedrive_tpu.api import invalidate
+
+    invalidate._RUNTIME_KEYS.discard("not.aProcedure")
+
+
+def test_node_scoped_procedures(node):
+    info = node.router.resolve("buildInfo")
+    assert "version" in info
+    state = node.router.resolve("nodeState")
+    assert state["data_path"] == str(node.data_dir)
+    assert node.router.resolve("volumes.list")
+    assert node.router.resolve("jobs.isActive") is False
+    with pytest.raises(ApiError):
+        node.router.resolve("no.suchThing")
+
+
+def test_feature_flag_toggle_propagates_to_sync(node):
+    lib = node.libraries.create("flags")
+    assert lib.sync.emit_messages is False
+    assert node.router.resolve("toggleFeatureFlag", "syncEmitMessages") is True
+    assert lib.sync.emit_messages is True
+    assert node.router.resolve("toggleFeatureFlag", "syncEmitMessages") is False
+    assert lib.sync.emit_messages is False
+
+
+def test_library_scoped_requires_library_id(node):
+    node.libraries.create("lib-scoped")
+    with pytest.raises(ApiError):
+        node.router.resolve("search.paths", {})
+    with pytest.raises(ApiError):
+        node.router.resolve("search.paths", {}, library_id="nope")
+
+
+def test_search_paths_filters_and_pagination(indexed):
+    node, lib, loc, tree = indexed
+    r = node.router.resolve("search.paths", {}, library_id=lib.id)
+    names = {i["name"] for i in r["items"]}
+    assert {"report", "song", "photo"} <= names
+
+    r = node.router.resolve("search.paths", {"search": "song"}, library_id=lib.id)
+    assert [i["name"] for i in r["items"] if not i["is_dir"]] == ["song"]
+
+    r = node.router.resolve("search.paths", {"extensions": ["png"]}, library_id=lib.id)
+    assert {i["name"] for i in r["items"]} == {"photo"}
+
+    # audio kind filter (kind 6)
+    r = node.router.resolve("search.paths", {"kinds": [6]}, library_id=lib.id)
+    assert {i["name"] for i in r["items"]} == {"song"}
+
+    # pagination: take=1 pages through everything without overlap
+    seen, cursor = [], None
+    for _ in range(20):
+        page = node.router.resolve("search.paths", {"take": 1, "cursor": cursor},
+                                   library_id=lib.id)
+        seen += [i["id"] for i in page["items"]]
+        cursor = page["cursor"]
+        if cursor is None:
+            break
+    assert len(seen) == len(set(seen))
+    total = node.router.resolve("search.pathsCount", {}, library_id=lib.id)
+    assert len(seen) == total
+
+    counts = node.router.resolve("search.objectsCount", {}, library_id=lib.id)
+    assert counts == lib.db.count(Object)
+
+
+def test_search_ephemeral(node, tmp_path):
+    (tmp_path / "loose.txt").write_text("hi")
+    r = node.router.resolve("search.ephemeralPaths", {"path": str(tmp_path)})
+    assert any(e["name"] == "loose" for e in r["entries"])
+
+
+def test_files_procedures(indexed):
+    node, lib, loc, tree = indexed
+    fp = lib.db.find_one(FilePath, {"name": "report"})
+    got = node.router.resolve("files.get", {"file_path_id": fp["id"]},
+                              library_id=lib.id)
+    assert got["object"]["id"] == fp["object_id"]
+    path = node.router.resolve("files.getPath", fp["id"], library_id=lib.id)
+    assert path.endswith("report.pdf")
+
+    node.router.resolve("files.setFavorite",
+                        {"object_id": fp["object_id"], "favorite": True},
+                        library_id=lib.id)
+    node.router.resolve("files.setNote",
+                        {"object_id": fp["object_id"], "note": "important"},
+                        library_id=lib.id)
+    obj = lib.db.find_one(Object, {"id": fp["object_id"]})
+    assert obj["favorite"] and obj["note"] == "important"
+
+    node.router.resolve("files.renameFile",
+                        {"file_path_id": fp["id"], "new_name": "renamed.pdf"},
+                        library_id=lib.id)
+    assert (tree / "renamed.pdf").exists() and not (tree / "report.pdf").exists()
+
+    made = node.router.resolve("files.createDirectory",
+                               {"location_id": loc["id"], "name": "made"},
+                               library_id=lib.id)
+    assert Path(made).is_dir()
+    assert lib.db.find_one(FilePath, {"name": "made"}) is not None
+
+
+def test_jobs_reports_and_launchers(indexed):
+    node, lib, loc, tree = indexed
+    reports = node.router.resolve("jobs.reports", None, library_id=lib.id)
+    assert reports, "scan should have produced reports"
+    head = reports[0]
+    assert "children" in head and "data" not in head
+
+    node.router.resolve("jobs.objectValidator", {"location_id": loc["id"]},
+                        library_id=lib.id)
+    assert node.jobs.wait_idle(60)
+    fp = lib.db.find_one(FilePath, {"name": "song"})
+    assert fp["integrity_checksum"]
+
+    node.router.resolve("jobs.clearAll", None, library_id=lib.id)
+    assert node.router.resolve("jobs.reports", None, library_id=lib.id) == []
+
+
+def test_tags_via_api(indexed):
+    node, lib, loc, tree = indexed
+    tag = node.router.resolve("tags.create", {"name": "t1", "color": "#123456"},
+                              library_id=lib.id)
+    oid = lib.db.find(Object, limit=1)[0]["id"]
+    node.router.resolve("tags.assign", {"tag_id": tag["id"], "object_ids": [oid]},
+                        library_id=lib.id)
+    got = node.router.resolve("tags.getForObject", oid, library_id=lib.id)
+    assert [t["name"] for t in got] == ["t1"]
+    both = node.router.resolve("tags.getWithObjects", tag["id"], library_id=lib.id)
+    assert len(both["objects"]) == 1
+
+
+def test_statistics_and_categories(indexed):
+    node, lib, loc, tree = indexed
+    stats = node.router.resolve("libraries.statistics", None, library_id=lib.id)
+    assert stats["total_object_count"] == lib.db.count(Object)
+    cats = node.router.resolve("categories.list", None, library_id=lib.id)
+    by_name = {c["category"]: c["count"] for c in cats}
+    assert by_name["Music"] >= 1 and by_name["Photos"] >= 1
+
+
+def test_preferences_roundtrip(node):
+    lib = node.libraries.create("prefs")
+    node.router.resolve("preferences.update",
+                        {"explorer": {"view": "grid", "size": 3}},
+                        library_id=lib.id)
+    got = node.router.resolve("preferences.get", None, library_id=lib.id)
+    assert got == {"explorer": {"view": "grid", "size": 3}}
+    node.router.resolve("preferences.update", {"explorer": {"size": None}},
+                        library_id=lib.id)
+    got = node.router.resolve("preferences.get", None, library_id=lib.id)
+    assert got == {"explorer": {"view": "grid"}}
+
+
+def test_notifications_flow(node):
+    made = node.router.resolve("notifications.test")
+    got = node.router.resolve("notifications.get")
+    assert any(n["id"] == made["id"] and n["source"] == "node" for n in got)
+    node.router.resolve("notifications.dismiss",
+                        {"source": "node", "id": made["id"]})
+    got = node.router.resolve("notifications.get")
+    assert not any(n["id"] == made["id"] and n["source"] == "node" for n in got)
+
+
+def test_subscription_receives_events(node):
+    lib = node.libraries.create("subs")
+    sub = node.router.subscribe("notifications.listen")
+    node.router.resolve("notifications.test")
+    ev = sub.get(timeout=5)
+    while ev is not None and not sub.filter(ev):
+        ev = sub.get(timeout=5)
+    assert ev is not None and ev.kind == "notification"
+    sub.close()
+
+
+def test_backup_and_restore(indexed):
+    node, lib, loc, tree = indexed
+    n_paths = lib.db.count(FilePath)
+    backup_id = node.router.resolve("backups.backup", lib.id)
+    all_b = node.router.resolve("backups.getAll")
+    assert any(b["id"] == backup_id for b in all_b["backups"])
+
+    # damage the library, then restore
+    lib.db.execute("DELETE FROM file_path")
+    assert lib.db.count(FilePath) == 0
+    path = next(b["path"] for b in all_b["backups"] if b["id"] == backup_id)
+    node.router.resolve("backups.restore", path)
+    restored = node.libraries.get(lib.id)
+    assert restored.db.count(FilePath) == n_paths
+
+    node.router.resolve("backups.delete", backup_id)
+    assert not any(b["id"] == backup_id
+                   for b in node.router.resolve("backups.getAll")["backups"])
