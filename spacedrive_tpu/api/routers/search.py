@@ -155,3 +155,15 @@ def mount(router) -> None:
         return walk_ephemeral(arg["path"],
                               include_hidden=bool(arg.get("include_hidden")),
                               with_cas_ids=bool(arg.get("with_cas_ids")))
+
+    @router.library_query("search.nearDuplicates")
+    def near_duplicates(node, library, arg):
+        """TPU MinHash similarity groups (beyond the reference's exact-cas_id
+        dedup; ops/minhash.py)."""
+        from ...objects.dedup import find_near_duplicates
+
+        arg = arg or {}
+        return find_near_duplicates(library,
+                                    location_id=arg.get("location_id"),
+                                    threshold=float(arg.get("threshold", 0.8)),
+                                    limit=int(arg.get("limit", 8192)))

@@ -254,6 +254,76 @@ int sd_blake3_file_hex(const char* path, char out65[65]) {
   return 0;
 }
 
+// Gather stage for the TPU path: read each file's cas sample message
+// (size_le8 ‖ samples, cas.rs layout) straight into row i of a zero-padded
+// (n, row_stride) byte matrix — the host side of the batched device hash,
+// fused with IO so Python never copies per-file. lengths[i] gets the true
+// message byte count; err-rows get length 0 (caller routes per-file errors).
+void sd_cas_gather_batch(const char* const* paths, const uint64_t* sizes,
+                         int32_t n, int32_t n_threads, uint8_t* out,
+                         int64_t row_stride, int32_t* lengths) {
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int32_t> next(0);
+  auto worker = [&]() {
+    for (;;) {
+      int32_t i = next.fetch_add(1);
+      if (i >= n) break;
+      uint8_t* row = out + static_cast<int64_t>(i) * row_stride;
+      lengths[i] = 0;
+      uint64_t size = sizes[i];
+      uint64_t msg_len = 8 + (size <= MINIMUM_FILE_SIZE
+                                  ? size
+                                  : 2 * HEADER_OR_FOOTER + SAMPLE_COUNT * SAMPLE_SIZE);
+      if (static_cast<int64_t>(msg_len) > row_stride) continue;
+      int fd = open(paths[i], O_RDONLY);
+      if (fd < 0) continue;
+      for (int b = 0; b < 8; b++) row[b] = static_cast<uint8_t>(size >> (8 * b));
+      uint8_t* dst = row + 8;
+      auto read_exact = [&](uint64_t off, uint64_t len) -> bool {
+        uint64_t got = 0;
+        while (got < len) {
+          ssize_t r = pread(fd, dst + got, len - got, off + got);
+          if (r <= 0) return false;
+          got += static_cast<uint64_t>(r);
+        }
+        dst += len;
+        return true;
+      };
+      bool ok = true;
+      if (size <= MINIMUM_FILE_SIZE) {
+        ok = size == 0 || read_exact(0, size);
+      } else {
+        uint64_t seek_jump = (size - HEADER_OR_FOOTER * 2) / SAMPLE_COUNT;
+        ok = read_exact(0, HEADER_OR_FOOTER);
+        for (uint64_t s = 0; ok && s < SAMPLE_COUNT; s++) {
+          ok = read_exact(HEADER_OR_FOOTER + s * seek_jump, SAMPLE_SIZE);
+        }
+        ok = ok && read_exact(size - HEADER_OR_FOOTER, HEADER_OR_FOOTER);
+      }
+      close(fd);
+      if (ok) {
+        // zero to the 64-byte block boundary: the device kernel compresses
+        // whole blocks and relies on zero padding within the final one
+        // (beyond that, per-lane block/chunk masks ignore the row tail)
+        uint64_t pad = (64 - (msg_len & 63)) & 63;
+        if (pad && static_cast<int64_t>(msg_len + pad) <= row_stride) {
+          std::memset(row + msg_len, 0, pad);
+        }
+        lengths[i] = static_cast<int32_t>(msg_len);
+      }
+    }
+  };
+  if (n_threads == 1 || n == 1) {
+    worker();
+    return;
+  }
+  std::vector<std::thread> threads;
+  int32_t spawn = std::min<int32_t>(n_threads, n);
+  threads.reserve(spawn);
+  for (int32_t t = 0; t < spawn; t++) threads.emplace_back(worker);
+  for (auto& th : threads) th.join();
+}
+
 // Batch cas_id over files. out = n rows of 17 bytes (16 hex + NUL); a row
 // whose first byte is NUL means that file errored (caller raises per-file).
 void sd_cas_hash_batch(const char* const* paths, const uint64_t* sizes,

@@ -38,6 +38,43 @@ def blake3_hex(data: bytes) -> str:
     return out.value.decode()
 
 
+_lib.sd_cas_gather_batch.argtypes = [
+    ctypes.POINTER(ctypes.c_char_p),
+    ctypes.POINTER(ctypes.c_uint64),
+    ctypes.c_int32,
+    ctypes.c_int32,
+    ctypes.c_void_p,
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int32),
+]
+_lib.sd_cas_gather_batch.restype = None
+
+
+def gather_batch(paths: list[str | Path], sizes: list[int], out, lengths,
+                 n_threads: int | None = None) -> None:
+    """Fill rows of ``out`` (np.uint8, shape (>=n, row_stride), C-contiguous)
+    with cas sample messages and ``lengths`` (np.int32, (>=n,)) with true
+    message byte counts (0 = per-file IO error). The fused IO+pack host stage
+    of the TPU hash pipeline."""
+    n = len(paths)
+    if n == 0:
+        return
+    assert out.dtype.itemsize == 1 and out.flags["C_CONTIGUOUS"]
+    assert lengths.dtype.itemsize == 4 and lengths.flags["C_CONTIGUOUS"]
+    if n_threads is None:
+        n_threads = min(max(os.cpu_count() or 1, 2), n)
+    c_paths = (ctypes.c_char_p * n)(*[os.fsencode(str(p)) for p in paths])
+    c_sizes = (ctypes.c_uint64 * n)(*[int(s) for s in sizes])
+    _lib.sd_cas_gather_batch(
+        ctypes.cast(c_paths, ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.cast(c_sizes, ctypes.POINTER(ctypes.c_uint64)),
+        n, n_threads,
+        out.ctypes.data_as(ctypes.c_void_p),
+        out.strides[0],
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+
+
 def blake3_file_hex(path: str | Path) -> str:
     """Full-file BLAKE3 via mmap (validator integrity checksums)."""
     out = ctypes.create_string_buffer(65)

@@ -1,0 +1,111 @@
+"""Near-duplicate detection over indexed files (the MinHash op as a feature).
+
+The reference collapses only EXACT duplicates (same cas_id → one Object).
+This module finds *near* duplicates — edited photos, re-encoded media,
+truncated copies — by running the TPU MinHash pipeline (ops/minhash.py) over
+a location's sampled content: native gather reads each file's cas sample
+rows (the same bytes the identifier hashed), the device computes signatures,
+and the all-pairs sweep returns similarity groups.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Any
+
+import numpy as np
+
+from ..models import FilePath
+from .cas import MINIMUM_FILE_SIZE, SAMPLED_MESSAGE_LEN
+
+if TYPE_CHECKING:
+    from ..library import Library
+
+logger = logging.getLogger(__name__)
+
+SAMPLED_STRIDE = ((SAMPLED_MESSAGE_LEN + 1023) // 1024) * 1024  # 58368
+
+
+def find_near_duplicates(library: "Library", location_id: int | None = None,
+                         threshold: float = 0.8,
+                         limit: int = 8192) -> dict[str, Any]:
+    """Similarity groups among sampled-size files. Returns
+    {groups: [[file_path rows...]], scanned, errors}."""
+    import jax
+
+    from ..ops.minhash import (K, minhash_rows, pad_for_blocks,
+                               similar_pairs_count)
+
+    db = library.db
+    where = "is_dir = 0 AND size_in_bytes > ?"
+    params: list[Any] = [MINIMUM_FILE_SIZE]
+    if location_id is not None:
+        where += " AND location_id = ?"
+        params.append(location_id)
+    rows_db = [FilePath.decode_row(r) for r in db.query(
+        f"SELECT * FROM file_path WHERE {where} ORDER BY id LIMIT ?",
+        params + [limit])]
+    if len(rows_db) < 2:
+        return {"groups": [], "scanned": len(rows_db), "errors": []}
+
+    from .fs import location_path_of
+
+    paths, sizes, errors = [], [], []
+    roots: dict[int, Any] = {}
+    for r in rows_db:
+        loc = r["location_id"]
+        if loc not in roots:
+            roots[loc] = location_path_of(db, loc)
+        rel = (r["materialized_path"] or "/").lstrip("/")
+        name = r["name"] + (f".{r['extension']}" if r["extension"] else "")
+        paths.append(str(roots[loc] / rel / name))
+        sizes.append(r["size_in_bytes"])
+
+    # gather sampled rows (native if available, python fallback)
+    n = len(paths)
+    buf = np.zeros((n, SAMPLED_STRIDE), np.uint8)
+    lengths = np.zeros(n, np.int32)
+    try:
+        from ..native import cas_native
+
+        cas_native.gather_batch(paths, sizes, buf, lengths)
+    except Exception:
+        from .cas import read_sampled_batch
+
+        msgs = read_sampled_batch(paths, sizes)
+        for i, m in enumerate(msgs):
+            if isinstance(m, Exception):
+                errors.append(f"{paths[i]}: {m}")
+                continue
+            buf[i, : len(m)] = np.frombuffer(m, np.uint8)
+            lengths[i] = len(m)
+    errors += [paths[i] for i in range(n) if lengths[i] == 0]
+
+    sigs = np.asarray(minhash_rows(
+        jax.device_put(buf.view(np.uint32).reshape(n, SAMPLED_STRIDE // 4)),
+        jax.device_put(lengths)))
+    sigs_p, valid = pad_for_blocks(sigs)
+    valid[:n] &= lengths > 0
+
+    thr_k = max(1, int(threshold * K))
+    _total, dup = similar_pairs_count(jax.device_put(sigs_p),
+                                      jax.device_put(valid), thr_k)
+    dup = np.asarray(dup)[:n]
+
+    # group on host: union by best-match (pairwise check only against flagged
+    # rows keeps this O(n_dup * n))
+    groups: dict[int, list[int]] = {}
+    assigned: dict[int, int] = {}
+    flagged = [i for i in range(n) if dup[i]]
+    for i in flagged:
+        eq = (sigs[i][None, :] == sigs[:i]).sum(axis=1)
+        j = int(np.argmax(eq))
+        if eq[j] >= thr_k:
+            root = assigned.get(j, j)
+            groups.setdefault(root, [root] if root not in assigned else []).append(i)
+            assigned[i] = root
+    out_groups = []
+    for root, members in groups.items():
+        ids = sorted({root, *members})
+        out_groups.append([rows_db[i] for i in ids])
+    return {"groups": out_groups, "scanned": n, "errors": errors}

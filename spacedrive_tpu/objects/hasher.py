@@ -60,48 +60,195 @@ class CpuHasher:
         return out
 
 
+#: files per device sub-batch in the pipelined sampled path
+PIPELINE_BATCH = 2048
+
+
 class TpuHasher:
-    """Batched JAX/TPU path: gather samples → bucket by shape → device hash."""
+    """Batched JAX/TPU path.
+
+    Large (sampled) files take the fused pipeline: the native C++ gather
+    reads each file's sample message straight into a row of the device-layout
+    byte matrix (no per-file Python work), the (block,word,chunk,batch)
+    permutation happens on device, and sub-batches are double-buffered so the
+    next gather overlaps the previous batch's transfer+compute (async jax
+    dispatch). Small files go through the bucketed whole-file path.
+    """
 
     name = "tpu"
 
     def hash_batch(self, paths: list[str | Path], sizes: list[int]) -> list[str | Exception]:
+        from .cas import MINIMUM_FILE_SIZE
+
+        out: list[str | Exception] = [None] * len(paths)  # type: ignore[list-item]
+        sampled = [i for i, s in enumerate(sizes) if s > MINIMUM_FILE_SIZE]
+        small = [i for i, s in enumerate(sizes) if s <= MINIMUM_FILE_SIZE]
+        if sampled:
+            self._hash_sampled(paths, sizes, sampled, out)
+        if small:
+            self._hash_small(paths, sizes, small, out)
+        return out
+
+    # -- sampled (fixed-shape) pipeline ------------------------------------
+    def _hash_sampled(self, paths, sizes, indices: list[int], out: list) -> None:
+        try:
+            from ..native import cas_native
+        except Exception:
+            self._hash_python(paths, sizes, indices, out)
+            return
+
+        import jax.numpy as jnp
         import numpy as np
 
-        from ..ops.blake3_jax import blake3_batch_hex
+        from ..ops.blake3_jax import (_pad_to_tier, blake3_batch_rows,
+                                      digests_to_hex)
 
-        messages = read_sampled_batch(paths, sizes)
-        out: list[str | Exception] = [None] * len(messages)  # type: ignore[list-item]
+        stride = SAMPLED_CHUNKS * 1024
+        pending = None  # (device result, lengths, batch indices)
 
+        def collect(item):
+            dev, lengths, idxs = item
+            hexes = digests_to_hex(np.asarray(dev))
+            for j, i in enumerate(idxs):
+                if lengths[j] == 0:
+                    out[i] = OSError(f"cas gather failed for {paths[i]}")
+                else:
+                    out[i] = hexes[j][:16]
+
+        for start in range(0, len(indices), PIPELINE_BATCH):
+            idxs = indices[start : start + PIPELINE_BATCH]
+            tier = self._pad_lanes(_pad_to_tier(len(idxs)))
+            rows = np.zeros((tier, stride), np.uint8)
+            lengths = np.zeros(tier, np.int32)
+            cas_native.gather_batch([paths[i] for i in idxs],
+                                    [sizes[i] for i in idxs], rows, lengths)
+            dev = self._device_hash_rows(
+                rows.view(np.uint32).reshape(tier, stride // 4), lengths)
+            if pending is not None:
+                collect(pending)
+            pending = (dev, lengths, idxs)
+        if pending is not None:
+            collect(pending)
+
+    # -- small files (variable size, bucketed) -----------------------------
+    def _hash_small(self, paths, sizes, indices: list[int], out: list) -> None:
+        messages = read_sampled_batch([paths[i] for i in indices],
+                                      [sizes[i] for i in indices])
         buckets: dict[int, list[int]] = {}
-        for i, msg in enumerate(messages):
+        for j, msg in enumerate(messages):
             if isinstance(msg, Exception):
-                out[i] = msg
+                out[indices[j]] = msg
                 continue
-            n = len(msg)
-            if n == SAMPLED_MESSAGE_LEN:
-                cap = SAMPLED_CHUNKS
-            else:
-                chunks = max(1, (n + 1023) // 1024)
-                cap = next(b for b in SMALL_BUCKETS if b >= chunks)
-            buckets.setdefault(cap, []).append(i)
+            chunks = max(1, (len(msg) + 1023) // 1024)
+            cap = next(b for b in SMALL_BUCKETS if b >= chunks)
+            buckets.setdefault(cap, []).append(j)
+        for cap, js in sorted(buckets.items()):
+            hexes = self._hash_bucket([messages[j] for j in js], cap)
+            for j, h in zip(js, hexes):
+                out[indices[j]] = h[:16]
 
-        for cap, indices in sorted(buckets.items()):
-            hexes = self._hash_bucket([messages[i] for i in indices], cap)
-            for i, h in zip(indices, hexes):
-                out[i] = h[:16]
-        return out
+    def _hash_python(self, paths, sizes, indices: list[int], out: list) -> None:
+        """No native toolchain: pure-Python gather into the bucketed kernel."""
+        messages = read_sampled_batch([paths[i] for i in indices],
+                                      [sizes[i] for i in indices])
+        ok = [j for j, m in enumerate(messages) if not isinstance(m, Exception)]
+        for j, m in enumerate(messages):
+            if isinstance(m, Exception):
+                out[indices[j]] = m
+        hexes = self._hash_bucket([messages[j] for j in ok], SAMPLED_CHUNKS)
+        for j, h in zip(ok, hexes):
+            out[indices[j]] = h[:16]
 
     def _hash_bucket(self, msgs: list[bytes], cap: int) -> list[str]:
         from ..ops.blake3_jax import blake3_batch_hex
 
         return blake3_batch_hex(msgs, max_chunks=cap)
 
+    # hooks the sharded variant overrides
+    def _pad_lanes(self, n: int) -> int:
+        return n
+
+    def _device_hash_rows(self, rows32, lengths):
+        import jax.numpy as jnp
+
+        from ..ops.blake3_jax import blake3_batch_rows
+
+        return blake3_batch_rows(jnp.asarray(rows32), jnp.asarray(lengths))
+
+
+class HybridHasher:
+    """Heterogeneous executor: native CPU threads and the TPU pipeline pull
+    chunks from one work queue until it drains (work-stealing, so the split
+    adapts to whichever engine is faster on this host). The reference has a
+    single engine (CPU join_all); on a TPU host both engines are throughput
+    and the host core is the contended resource — stealing balances it."""
+
+    name = "hybrid"
+
+    CHUNK = 1024
+
+    def __init__(self) -> None:
+        self._tpu = TpuHasher()
+        self._cpu = CpuHasher()
+
+    def hash_batch(self, paths: list[str | Path], sizes: list[int]) -> list[str | Exception]:
+        import queue as _q
+        import threading
+
+        from .cas import MINIMUM_FILE_SIZE
+
+        n = len(paths)
+        out: list[str | Exception] = [None] * n  # type: ignore[list-item]
+        sampled = [i for i, s in enumerate(sizes) if s > MINIMUM_FILE_SIZE]
+        small = [i for i, s in enumerate(sizes) if s <= MINIMUM_FILE_SIZE]
+        if small:  # small files: native CPU batch (IO-bound, not worth device)
+            res = self._cpu.hash_batch([paths[i] for i in small],
+                                       [sizes[i] for i in small])
+            for i, r in zip(small, res):
+                out[i] = r
+
+        if not sampled:
+            return out
+        if self._cpu._fast is None:  # no native lib: nothing to race
+            self._tpu._hash_sampled(paths, sizes, sampled, out)
+            return out
+
+        work: _q.Queue[list[int]] = _q.Queue()
+        for start in range(0, len(sampled), self.CHUNK):
+            work.put(sampled[start : start + self.CHUNK])
+
+        def cpu_worker():
+            while True:
+                try:
+                    idxs = work.get_nowait()
+                except _q.Empty:
+                    return
+                res = self._cpu.hash_batch([paths[i] for i in idxs],
+                                           [sizes[i] for i in idxs])
+                for i, r in zip(idxs, res):
+                    out[i] = r
+
+        def tpu_worker():
+            while True:
+                try:
+                    idxs = work.get_nowait()
+                except _q.Empty:
+                    return
+                self._tpu._hash_sampled(paths, sizes, idxs, out)
+
+        threads = [threading.Thread(target=cpu_worker, daemon=True),
+                   threading.Thread(target=tpu_worker, daemon=True)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
 
 class ShardedHasher(TpuHasher):
     """Multi-device variant: batch axis sharded over a data-parallel mesh
-    (parallel/mesh.py). Same bucketing; each bucket's lane count additionally
-    pads to a multiple of the mesh's data-axis size."""
+    (parallel/mesh.py) for both the row pipeline and the small-file buckets;
+    lane counts pad to a multiple of the mesh's data-axis size."""
 
     name = "tpu-sharded"
 
@@ -109,6 +256,19 @@ class ShardedHasher(TpuHasher):
         from ..parallel.mesh import make_mesh
 
         self._mesh = make_mesh()
+
+    def _pad_lanes(self, n: int) -> int:
+        from ..parallel.mesh import pad_batch_for_mesh
+
+        return pad_batch_for_mesh(n, self._mesh)
+
+    def _device_hash_rows(self, rows32, lengths):
+        import jax.numpy as jnp
+
+        from ..parallel.mesh import sharded_row_hasher
+
+        return sharded_row_hasher(self._mesh)(jnp.asarray(rows32),
+                                              jnp.asarray(lengths))
 
     def _hash_bucket(self, msgs: list[bytes], cap: int) -> list[str]:
         import jax.numpy as jnp
@@ -129,6 +289,7 @@ _BACKENDS: dict[str, Callable[[], HasherBackend]] = {
     "cpu": CpuHasher,
     "tpu": TpuHasher,
     "tpu-sharded": ShardedHasher,
+    "hybrid": HybridHasher,
 }
 
 _instances: dict[str, HasherBackend] = {}

@@ -227,6 +227,19 @@ def _single_chunk_root(words0: jax.Array, lengths: jax.Array) -> list[jax.Array]
     return digest
 
 
+@jax.jit
+def blake3_batch_rows(rows: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Row-major entry: ``rows`` is (B, C*256) uint32 — each row one message
+    in natural byte order (the layout the native gather writes). The
+    (block, word, chunk, batch) permutation the scan wants happens ON DEVICE,
+    where a 120MB transpose is ~free, instead of in a host numpy transpose
+    that used to dominate the pipeline profile."""
+    B, W = rows.shape
+    C = W // (BLOCKS_PER_CHUNK * 16)
+    words = rows.reshape(B, C, BLOCKS_PER_CHUNK, 16).transpose(2, 3, 1, 0)
+    return blake3_batch(words, lengths)
+
+
 # --------------------------------------------------------------------------
 # host packing
 # --------------------------------------------------------------------------

@@ -79,6 +79,22 @@ def sharded_hasher(mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=None)
+def sharded_row_hasher(mesh: Mesh):
+    """Row-major entry (the native gather's layout) with the batch axis
+    sharded on ``data``; the device-side permutation runs shard-local."""
+    from ..ops.blake3_jax import blake3_batch_rows
+
+    return jax.jit(
+        blake3_batch_rows,
+        in_shardings=(
+            _sharding(mesh, DATA_AXIS, None),
+            _sharding(mesh, DATA_AXIS),
+        ),
+        out_shardings=_sharding(mesh, None, DATA_AXIS),
+    )
+
+
+@functools.lru_cache(maxsize=None)
 def identify_step(mesh: Mesh):
     """The framework's full device step: sharded hash + cross-chip dedup.
 

@@ -1,0 +1,97 @@
+"""MinHash dedup ops: estimator sanity, CPU/TPU agreement, end-to-end
+near-duplicate API over an indexed location."""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from spacedrive_tpu.locations import create_location, scan_location
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.ops import minhash as mh
+
+
+def _sigs(rows, lengths):
+    return np.asarray(mh.minhash_rows(jax.device_put(rows),
+                                      jax.device_put(lengths)))
+
+
+def test_signature_estimates_jaccard():
+    rng = np.random.default_rng(0)
+    w = 4096
+    a = rng.integers(0, 2**32, w, dtype=np.uint32)
+    for drift, lo, hi in [(0.0, 1.0, 1.0), (0.1, 0.55, 0.95), (0.5, 0.05, 0.55)]:
+        b = a.copy()
+        sel = rng.random(w) < drift
+        b[sel] = rng.integers(0, 2**32, int(sel.sum()), dtype=np.uint32)
+        rows = np.stack([a, b])
+        lengths = np.full(2, w * 4, np.int32)
+        s = _sigs(rows, lengths)
+        sim = (s[0] == s[1]).mean()
+        assert lo <= sim <= hi, f"drift {drift}: estimated {sim}"
+
+
+def test_signature_ignores_padding():
+    rng = np.random.default_rng(1)
+    w = 1024
+    data = rng.integers(0, 2**32, w, dtype=np.uint32)
+    short = np.concatenate([data[: w // 2], np.zeros(w // 2, np.uint32)])
+    rows = np.stack([short, short])
+    s = _sigs(rows, np.asarray([w * 2, w * 2], np.int32))
+    assert (s[0] == s[1]).all()
+    # garbage past the declared length must not change the signature
+    noisy = short.copy()
+    noisy[w // 2 :] = rng.integers(0, 2**32, w // 2, dtype=np.uint32)
+    s2 = _sigs(np.stack([short, noisy]), np.asarray([w * 2, w * 2], np.int32))
+    assert (s2[0] == s2[1]).all()
+
+
+def test_all_pairs_device_matches_cpu():
+    rng = np.random.default_rng(2)
+    n = 1024
+    base = rng.integers(0, 2**32, (n // 4, 512), dtype=np.uint32)
+    rows = np.repeat(base, 4, axis=0).copy()
+    for m in range(1, 4):
+        sel = rng.random((n // 4, 512)) < (m * 0.03)
+        rows[m::4][sel] = rng.integers(0, 2**32, int(sel.sum()), dtype=np.uint32)
+    sigs = _sigs(rows, np.full(n, 2048, np.int32))
+    sigs_p, valid = mh.pad_for_blocks(sigs)
+    thr = mh.K // 2
+    total_cpu, dup_cpu = mh.similar_pairs_count_cpu(sigs_p, valid, thr)
+    total_d, dup_d = mh.similar_pairs_count(jax.device_put(sigs_p),
+                                            jax.device_put(valid), thr)
+    assert int(np.asarray(total_d)) == total_cpu > 0
+    assert (np.asarray(dup_d) == dup_cpu).all()
+    assert dup_cpu[:4].tolist() == [False, True, True, True]
+
+
+def test_near_duplicates_api(tmp_path, tmp_data_dir):
+    tree = tmp_path / "photos"
+    tree.mkdir()
+    rng = random.Random(9)
+    original = bytearray(rng.randbytes(300_000))
+    (tree / "original.raw").write_bytes(original)
+    edited = bytearray(original)
+    for _ in range(30):  # light edit: ~1% of bytes
+        pos = rng.randrange(len(edited))
+        edited[pos] ^= 0xFF
+    (tree / "edited.raw").write_bytes(edited)
+    (tree / "unrelated.raw").write_bytes(rng.randbytes(300_000))
+
+    node = Node(tmp_data_dir, probe_accelerator=False)
+    try:
+        lib = node.libraries.create("dedup")
+        loc = create_location(lib, str(tree), hasher="cpu")
+        scan_location(lib, loc["id"])
+        assert node.jobs.wait_idle(90)
+        res = node.router.resolve("search.nearDuplicates",
+                                  {"location_id": loc["id"]},
+                                  library_id=lib.id)
+        assert res["scanned"] == 3
+        assert len(res["groups"]) == 1
+        names = {r["name"] for r in res["groups"][0]}
+        assert names == {"original", "edited"}
+    finally:
+        node.shutdown()
