@@ -94,8 +94,40 @@ def mount(router) -> None:
         stem, dot, ext = new_name.rpartition(".")
         if row["is_dir"] or not dot or not stem:
             stem, ext = new_name, ""
-        db.update(FilePath, {"id": row["id"]},
-                  {"name": stem, "extension": ext.lower()})
+        sync = getattr(library, "sync", None)
+        emit = sync is not None and getattr(sync, "emit_messages", False)
+        ops = []
+        with db.transaction():
+            db.update(FilePath, {"id": row["id"]},
+                      {"name": stem, "extension": ext.lower()})
+            if emit:
+                ops.append(sync.shared_update(FilePath, row["pub_id"], "name", stem))
+                ops.append(sync.shared_update(FilePath, row["pub_id"],
+                                              "extension", ext.lower()))
+            if row["is_dir"]:
+                # rewrite descendants' materialized_path prefix in the same
+                # transaction — later jobs resolve absolute paths from it.
+                # SQL prefix match keeps the transaction O(descendants), not
+                # O(location rows).
+                old_prefix = f"{row['materialized_path'] or '/'}{row['name']}/"
+                new_prefix = f"{row['materialized_path'] or '/'}{stem}/"
+                like = (old_prefix.replace("\\", "\\\\")
+                        .replace("%", "\\%").replace("_", "\\_")) + "%"
+                children = db.query(
+                    "SELECT id, pub_id, materialized_path FROM file_path "
+                    "WHERE location_id = ? AND materialized_path LIKE ? ESCAPE '\\'",
+                    (row["location_id"], like))
+                for child in children:
+                    new_mp = new_prefix + child["materialized_path"][len(old_prefix):]
+                    db.update(FilePath, {"id": child["id"]},
+                              {"materialized_path": new_mp})
+                    if emit:
+                        ops.append(sync.shared_update(
+                            FilePath, child["pub_id"], "materialized_path", new_mp))
+            if ops:
+                sync.log_ops(ops)
+        if ops:
+            sync.created()
         invalidate_query(library, "search.paths")
         return None
 

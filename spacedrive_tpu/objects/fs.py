@@ -166,8 +166,13 @@ class FileCutterJob(_FsJob):
 
     def execute_step(self, ctx: WorkerContext, data, step, step_number) -> StepResult:
         src, dst = Path(step["src"]), Path(step["dst"])
+        # cut.rs semantics: moving a file onto itself is a no-op, and an
+        # existing destination is WouldOverwrite — never rename-away.
+        if src == dst:
+            return StepResult(metadata={"moved": 0})
+        if dst.exists():
+            return StepResult(errors=[f"move {src}: would overwrite {dst}"])
         try:
-            dst = find_available_name(dst)
             try:
                 os.rename(src, dst)
             except OSError:

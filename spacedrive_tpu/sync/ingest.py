@@ -1,15 +1,30 @@
-"""Sync ingest: receive → stale-check → apply → re-log → persist clock.
+"""Sync ingest: receive → arbitrate against the op-log → apply → log → clock.
 
-Mirrors core/crates/sync/src/ingest.rs:
+Role of core/crates/sync/src/ingest.rs (state machine :30-88,
+receive_crdt_operation :114-186, per-instance clock persistence :136-159) —
+but with a stronger arbitration rule than the reference's ``compare_message``
+(:188-233). The reference drops a "stale" op without recording it; that loses
+shadow information and lets cross-kind races (create vs update vs delete)
+converge differently depending on arrival order. Here the op-log IS the CRDT
+state:
 
-- state machine WaitingForNotification → RetrievingMessages → Ingesting
-  (:30-88): a notification triggers pull rounds against a transport callback
-  until ``has_more`` is false;
-- ``receive_crdt_operation`` (:114-186): update the HLC, drop ops older than
-  the newest stored op for the same (model, record, field) target
-  ("compare_message" :188-233), apply via the annotation-driven applier,
-  re-log the op (transitive propagation + future stale checks), persist the
-  origin instance's clock in ``instance.timestamp`` (:136-159).
+- EVERY op is logged (even ones with no materialized effect), so shadow
+  information propagates transitively and future arbitration sees the full
+  history;
+- an op's *effect* is computed against the record's logged history with a
+  deterministic (timestamp, op-id) total order — equivalent to replaying the
+  record's ops in timestamp order, so every arrival order converges
+  (tests/test_sync.py::test_cross_kind_arrival_order_converges proves all
+  4! permutations agree):
+
+  * update u:f applies unless a later delete, same-field update, or a later
+    create that specifies f exists (per-field LWW);
+  * create applies unless a later create/delete exists; fields with later
+    updates are stripped, the rest merge into the row;
+  * delete with no later create/update removes the row; with later ops it
+    takes PARTIAL effect — fields last written before the delete are
+    cleared, the row survives (exactly the in-order outcome where the
+    delete removes the row and later updates re-materialize it).
 """
 
 from __future__ import annotations
@@ -20,7 +35,7 @@ import threading
 from typing import TYPE_CHECKING, Any, Callable
 
 from ..models import Instance, RelationOperationRow, SharedOperationRow
-from .apply import ApplyError, apply_relation, apply_shared
+from .apply import ApplyError, apply_relation, apply_shared, model_for
 from .crdt import CREATE, DELETE, UPDATE_PREFIX, CRDTOperation, RelationOp, SharedOp
 from .manager import SyncMessage
 
@@ -36,70 +51,194 @@ Transport = Callable[[dict[str, int], int], tuple[list[dict[str, Any]], bool]]
 BATCH = 100  # GetOpsArgs.count used by the reference's integration test
 
 
+def _update_field(kind: str) -> str | None:
+    return kind[len(UPDATE_PREFIX):] if kind.startswith(UPDATE_PREFIX) else None
+
+
 class Ingester:
     """Synchronous core (usable inline); Actor wraps it in a thread."""
 
     def __init__(self, library: "Library") -> None:
         self.library = library
 
-    # -- stale check (compare_message, ingest.rs:188-233) -------------------
-    def _is_stale(self, op: CRDTOperation) -> bool:
-        db = self.library.db
-        t = op.typ
-        if isinstance(t, SharedOp):
-            rows = db.find(SharedOperationRow,
-                           {"model": t.model, "record_id": str(t.record_id)},
-                           order_by="timestamp DESC")
-        else:
-            rows = db.find(RelationOperationRow,
-                           {"relation": t.relation, "item_id": str(t.item_id),
-                            "group_id": str(t.group_id)},
-                           order_by="timestamp DESC")
-        for row in rows:
-            if row["id"] == op.id:  # already ingested (duplicate delivery)
-                return True
-            if row["timestamp"] < op.timestamp:
-                break  # nothing newer can conflict
-            if self._conflicts(op.typ.kind, row["kind"]):
-                return True
-        return False
+    # -- history helpers -----------------------------------------------------
+    def _history(self, t: SharedOp) -> list[dict[str, Any]]:
+        return self.library.db.find(
+            SharedOperationRow, {"model": t.model, "record_id": str(t.record_id)})
 
     @staticmethod
-    def _conflicts(incoming: str, stored: str) -> bool:
-        """Does a stored op at >= timestamp shadow the incoming one?
-        Per-field LWW: updates conflict only with the same field or a delete;
-        creates/deletes conflict with any same-record op."""
-        if incoming.startswith(UPDATE_PREFIX):
-            return stored == incoming or stored == DELETE
-        return True  # CREATE / DELETE are record-level
+    def _later(rows: list[dict[str, Any]], op: CRDTOperation) -> list[dict[str, Any]]:
+        """Ops strictly after ``op`` in the (timestamp, id) total order —
+        the deterministic cross-instance tiebreak."""
+        key = (op.timestamp, op.id)
+        return [r for r in rows if (r["timestamp"], r["id"]) > key]
 
-    # -- application --------------------------------------------------------
+    def _already_logged(self, op: CRDTOperation) -> bool:
+        t = op.typ
+        row_model = SharedOperationRow if isinstance(t, SharedOp) else RelationOperationRow
+        return self.library.db.find_one(row_model, {"id": op.id}) is not None
+
+    # -- shared-op arbitration ----------------------------------------------
+    def _apply_shared_convergent(self, op: CRDTOperation) -> bool:
+        """Apply ``op``'s effect given the record's logged history; returns
+        whether anything was materialized."""
+        db = self.library.db
+        t: SharedOp = op.typ
+        history = self._history(t)
+        later = self._later(history, op)
+
+        field = _update_field(t.kind)
+        if field is not None:
+            for r in later:
+                if r["kind"] in (DELETE, t.kind):
+                    return False
+                if r["kind"] == CREATE and isinstance(r["data"], dict) \
+                        and field in r["data"]:
+                    return False
+            apply_shared(db, t)
+            return True
+
+        if t.kind == CREATE:
+            if any(r["kind"] in (CREATE, DELETE) for r in later):
+                return False
+            shadowed = {_update_field(r["kind"]) for r in later
+                        if r["kind"].startswith(UPDATE_PREFIX)}
+            data = {k: v for k, v in (t.data or {}).items() if k not in shadowed}
+            apply_shared(db, SharedOp(t.model, t.record_id, CREATE, data))
+            return True
+
+        if t.kind == DELETE:
+            if any(r["kind"] in (CREATE, DELETE) for r in later):
+                return False  # later create revives / later tombstone wins
+            survivors = [r for r in later
+                         if r["kind"].startswith(UPDATE_PREFIX)
+                         or r["kind"] == CREATE]
+            if not survivors:
+                apply_shared(db, t)
+                return True
+            # partial effect: the in-order outcome is "delete the row, then
+            # later updates re-materialize it" — so clear every field whose
+            # last write precedes the delete
+            key = (op.timestamp, op.id)
+            last: dict[str, tuple[int, str]] = {}
+            for r in history:
+                rkey = (r["timestamp"], r["id"])
+                f = _update_field(r["kind"])
+                if f is not None:
+                    if rkey > last.get(f, (0, "")):
+                        last[f] = rkey
+                elif r["kind"] == CREATE and isinstance(r["data"], dict):
+                    for cf in r["data"]:
+                        if rkey > last.get(cf, (0, "")):
+                            last[cf] = rkey
+            model = model_for(t.model)
+            sync_spec = model.SYNC
+            dead = {f: None for f, lk in last.items()
+                    if lk < key and f in model.FIELDS and f != sync_spec.id}
+            if dead:
+                db.update(model, {sync_spec.id: t.record_id}, dead)
+            return bool(dead)
+
+        raise ApplyError(f"unknown shared op kind {t.kind!r}")
+
+    # -- relation-op arbitration --------------------------------------------
+    def _apply_relation_convergent(self, op: CRDTOperation) -> bool:
+        """Relations are link rows (little data, no partial-delete
+        reconstruction needed): tombstone-aware kind matrix."""
+        db = self.library.db
+        t: RelationOp = op.typ
+        rows = db.find(RelationOperationRow,
+                       {"relation": t.relation, "item_id": str(t.item_id),
+                        "group_id": str(t.group_id)})
+        later = self._later(rows, op)
+        for r in later:
+            if r["kind"] == DELETE:
+                return False
+            if r["kind"] == CREATE and t.kind in (CREATE, DELETE):
+                return False
+            if r["kind"] == t.kind and t.kind.startswith(UPDATE_PREFIX):
+                return False
+        apply_relation(db, t)
+        return True
+
+    # -- plumbing ------------------------------------------------------------
+    def _ensure_instance(self, pub_id: str) -> None:
+        """Ops can arrive from an origin we have no instance row for yet
+        (transitive propagation ahead of pairing metadata). Create a minimal
+        row so logging and clock persistence have a home instead of
+        poisoning the batch."""
+        import datetime as _dt
+
+        db = self.library.db
+        if db.find_one(Instance, {"pub_id": pub_id}) is None:
+            now = _dt.datetime.now(_dt.timezone.utc)
+            db.insert(Instance, {
+                "pub_id": pub_id, "identity": "", "node_id": "",
+                "node_name": "(unknown)", "node_platform": 0,
+                "last_seen": now, "date_created": now, "timestamp": 0,
+            }, or_ignore=True)
+            logger.warning("sync ingest created placeholder instance %s", pub_id)
+
+    # -- application ---------------------------------------------------------
     def receive(self, wire_ops: list[dict[str, Any]]) -> int:
-        """Apply a batch; returns number of ops actually applied."""
+        """Ingest a batch; returns the number of ops with materialized
+        effect (shadowed ops are still logged)."""
         db = self.library.db
         sync = self.library.sync
         applied = 0
         seen_clocks: dict[str, int] = {}
+        # NOTE on the raw SAVEPOINTs: db.transaction() holds the connection
+        # RLock for the whole batch, so no other thread can interleave
+        # statements between a savepoint and its release/rollback.
         with db.transaction():
             for wire in wire_ops:
                 op = CRDTOperation.from_wire(wire)
                 sync.clock.update(op.timestamp)
                 if op.instance == sync.instance_pub_id:
                     continue  # our own op reflected back
+                if self._already_logged(op):
+                    # duplicate delivery — already durable, safe to advance
+                    seen_clocks[op.instance] = max(
+                        seen_clocks.get(op.instance, 0), op.timestamp)
+                    continue
+                # per-op savepoint: effect + log commit or roll back as a
+                # unit — an applied-but-unlogged op would be invisible to
+                # future arbitration and never propagate transitively
+                db.execute("SAVEPOINT ingest_op")
+                try:
+                    # the materialization may fail on its own (e.g. a field
+                    # this build doesn't know) — roll back just the effect
+                    # and still log the op, or it would never propagate
+                    # transitively through this node
+                    db.execute("SAVEPOINT ingest_effect")
+                    try:
+                        if isinstance(op.typ, SharedOp):
+                            effect = self._apply_shared_convergent(op)
+                        else:
+                            effect = self._apply_relation_convergent(op)
+                        db.execute("RELEASE ingest_effect")
+                    except ApplyError as e:
+                        db.execute("ROLLBACK TO ingest_effect")
+                        db.execute("RELEASE ingest_effect")
+                        logger.warning("sync op %s logged without effect: %s",
+                                       op.id, e)
+                        effect = False
+                    self._ensure_instance(op.instance)
+                    sync.log_ops([op])  # ALWAYS — the log is the CRDT state
+                except Exception:
+                    # a single poison op must not abort the whole batch and
+                    # leave the Actor re-pulling it forever; its clock floor
+                    # is NOT advanced, so it will be retried next round
+                    db.execute("ROLLBACK TO ingest_op")
+                    db.execute("RELEASE ingest_op")
+                    logger.exception("sync ingest skipped poison op %s", op.id)
+                    continue
+                db.execute("RELEASE ingest_op")
+                # advance the clock floor only once the op is durably logged
                 seen_clocks[op.instance] = max(seen_clocks.get(op.instance, 0),
                                                op.timestamp)
-                if self._is_stale(op):
-                    continue
-                try:
-                    if isinstance(op.typ, SharedOp):
-                        apply_shared(db, op.typ)
-                    else:
-                        apply_relation(db, op.typ)
-                except ApplyError as e:
-                    logger.error("sync apply failed for op %s: %s", op.id, e)
-                    continue
-                sync.log_ops([op])  # re-log under the ORIGIN instance
-                applied += 1
+                if effect:
+                    applied += 1
             # persist per-origin clocks (ingest.rs:136-159)
             for pub_id, ts in seen_clocks.items():
                 row = db.find_one(Instance, {"pub_id": pub_id})

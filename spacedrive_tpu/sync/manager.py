@@ -222,22 +222,41 @@ class SyncManager:
                 count: int = 100) -> tuple[list[dict[str, Any]], bool]:
         """Ops strictly newer (per origin instance) than ``clocks``, merged
         across both log tables in timestamp order. Returns (wire_ops,
-        has_more)."""
+        has_more).
+
+        The per-instance floor, ordering, and LIMIT run in SQL (each table
+        contributes at most count+1 rows per round), so a full sync is
+        O(count log count) per round instead of loading the whole op-log."""
         clocks = clocks or {}
         db = self.library.db
-        inst_pub: dict[int, str] = {r["id"]: r["pub_id"] for r in db.find(Instance)}
+        inst_rows = db.find(Instance)
+        inst_pub: dict[int, str] = {r["id"]: r["pub_id"] for r in inst_rows}
+
+        # timestamp > (per-instance clock floor, 0 for unknown instances)
+        case_parts: list[str] = []
+        case_params: list[Any] = []
+        for r in inst_rows:
+            floor = clocks.get(r["pub_id"], 0)
+            if floor:
+                case_parts.append("WHEN ? THEN ?")
+                case_params.extend([r["id"], floor])
+        floor_sql = (f"CASE instance_id {' '.join(case_parts)} ELSE 0 END"
+                     if case_parts else "0")
+
+        def fetch(model, table: str) -> list[dict[str, Any]]:
+            rows = db.query(
+                f"SELECT * FROM {table} WHERE timestamp > {floor_sql} "
+                f"ORDER BY timestamp, id LIMIT ?",
+                case_params + [count + 1])
+            return [model.decode_row(r) for r in rows]
+
         ops: list[CRDTOperation] = []
-
-        def newer(rows: list[dict[str, Any]]) -> list[dict[str, Any]]:
-            return [r for r in rows
-                    if r["timestamp"] > clocks.get(inst_pub.get(r["instance_id"], ""), 0)]
-
-        for r in newer(db.find(SharedOperationRow, order_by="timestamp")):
+        for r in fetch(SharedOperationRow, "shared_operation"):
             ops.append(CRDTOperation(
                 instance=inst_pub[r["instance_id"]], timestamp=r["timestamp"],
                 id=r["id"],
                 typ=SharedOp(r["model"], r["record_id"], r["kind"], r["data"])))
-        for r in newer(db.find(RelationOperationRow, order_by="timestamp")):
+        for r in fetch(RelationOperationRow, "relation_operation"):
             ops.append(CRDTOperation(
                 instance=inst_pub[r["instance_id"]], timestamp=r["timestamp"],
                 id=r["id"],

@@ -248,3 +248,27 @@ def test_backup_and_restore(indexed):
     node.router.resolve("backups.delete", backup_id)
     assert not any(b["id"] == backup_id
                    for b in node.router.resolve("backups.getAll")["backups"])
+
+
+def test_rename_directory_rewrites_descendants(indexed):
+    """Renaming a directory must rewrite descendants' materialized_path in the
+    same transaction and emit CRDT ops for the rename (ADVICE round 1)."""
+    node, lib, loc, tree = indexed
+    lib.sync.emit_messages = True
+    d = lib.db.find_one(FilePath, {"name": "sub", "is_dir": True})
+    node.router.resolve("files.renameFile",
+                        {"file_path_id": d["id"], "new_name": "moved"},
+                        library_id=lib.id)
+    assert (tree / "moved" / "photo.png").exists()
+    child = lib.db.find_one(FilePath, {"name": "photo"})
+    assert child["materialized_path"] == "/moved/"
+    # later jobs resolve the right absolute path from the updated rows
+    from spacedrive_tpu.objects.fs import file_path_abs
+    _row, abs_path = file_path_abs(lib.db, child["id"])
+    assert abs_path == tree / "moved" / "photo.png"
+    # sync ops emitted: name update for the dir + materialized_path for child
+    ops, _ = lib.sync.get_ops({}, 1000)
+    kinds = {(o["typ"].get("kind"), o["typ"].get("record_id")) for o in ops
+             if "kind" in o.get("typ", {})}
+    assert ("u:name", d["pub_id"]) in kinds
+    assert ("u:materialized_path", child["pub_id"]) in kinds

@@ -145,3 +145,38 @@ def test_tags_crud_and_assignment(env):
     delete_tag(lib, tag["id"])
     assert lib.db.find_one(Tag, {"id": tag["id"]}) is None
     assert lib.db.count(TagOnObject, {"tag_id": tag["id"]}) == 0
+
+
+# -- round-2 regressions (ADVICE.md cut.rs parity) ---------------------------
+
+
+def test_cutter_into_own_directory_is_noop(env):
+    """Cutting a file into its own directory must be a no-op, never a
+    rename-away to 'name (2)' (fs/cut.rs src==dst short-circuit)."""
+    node, lib, loc, tree = env
+    src = _fp(lib, "a")
+    before = (tree / "docs" / "a.txt").read_bytes()
+    node.jobs.spawn(lib, [FileCutterJob({
+        "sources": [src["id"]],
+        "target_location_id": loc["id"], "target_dir": "docs"})])
+    assert node.jobs.wait_idle(60)
+    assert (tree / "docs" / "a.txt").read_bytes() == before
+    assert not (tree / "docs" / "a (2).txt").exists()
+
+
+def test_cutter_would_overwrite_reports_error(env):
+    """An existing destination is a WouldOverwrite step error: destination
+    untouched, source kept, job completes with errors (fs/cut.rs)."""
+    node, lib, loc, tree = env
+    (tree / "dest" / "a.txt").write_bytes(b"existing")
+    src = _fp(lib, "a")
+    node.jobs.spawn(lib, [FileCutterJob({
+        "sources": [src["id"]],
+        "target_location_id": loc["id"], "target_dir": "dest"})])
+    assert node.jobs.wait_idle(60)
+    assert (tree / "dest" / "a.txt").read_bytes() == b"existing"
+    assert (tree / "docs" / "a.txt").exists()
+    report = lib.db.find(JobRow, {"name": "file_cutter"},
+                         order_by="date_created DESC", limit=1)[0]
+    assert report["status"] == 6  # CompletedWithErrors
+    assert "would overwrite" in (report["errors_text"] or "")

@@ -214,3 +214,144 @@ def test_ingest_actor_pull_loop(pair):
     # per-instance clock persisted (ingest.rs:136-159)
     inst = lib_b.db.find_one(Instance, {"pub_id": lib_a.sync.instance_pub_id})
     assert (inst["timestamp"] or 0) > 0
+
+
+# -- round-2 regressions (ADVICE.md) -----------------------------------------
+
+
+def test_create_after_relayed_update_not_dropped(pair):
+    """A Create arriving after a same-record field update of a NEWER timestamp
+    must still apply (stale-check is per-kind, reference ingest.rs:188-233);
+    dropping it would lose the record's other fields forever."""
+    lib_a, lib_b = pair
+    pub = "44444444-4444-4444-4444-444444444444"
+    create = lib_a.sync.shared_create(Tag, pub, {"name": "orig", "color": "#123456"})
+    update = lib_a.sync.shared_update(Tag, pub, "name", "renamed")
+    assert update.timestamp > create.timestamp
+    # update relayed first (materializes a partial row), create arrives second
+    assert Ingester(lib_b).receive([update.to_wire()]) == 1
+    assert Ingester(lib_b).receive([create.to_wire()]) == 1
+    row = lib_b.db.find_one(Tag, {"pub_id": pub})
+    assert row is not None
+    assert row["color"] == "#123456", "create's fields must merge in"
+    assert row["name"] == "renamed", "newer per-field update must win"
+
+
+def test_unknown_origin_instance_not_poison(pair):
+    """An op from an origin with no local instance row must not abort the
+    batch forever: a placeholder row is created and the rest applies."""
+    lib_a, lib_b = pair
+    ghost = "99999999-9999-9999-9999-999999999999"
+    op1 = lib_a.sync.shared_create(Tag, "t-ghost", {"name": "ghost"})
+    op1.instance = ghost  # simulate transitive propagation from unseen peer
+    op2 = lib_a.sync.shared_create(Tag, "t-after", {"name": "after"})
+    assert Ingester(lib_b).receive([op1.to_wire(), op2.to_wire()]) == 2
+    assert lib_b.db.find_one(Tag, {"pub_id": "t-ghost"}) is not None
+    assert lib_b.db.find_one(Tag, {"pub_id": "t-after"}) is not None
+    ghost_row = lib_b.db.find_one(Instance, {"pub_id": ghost})
+    assert ghost_row is not None and (ghost_row["timestamp"] or 0) > 0
+
+
+def test_get_ops_pagination_and_floor(pair):
+    """SQL-pushed get_ops: per-instance floors respected, batches ordered,
+    has_more loops terminate, full drain equals the op-log."""
+    lib_a, lib_b = pair
+    for i in range(25):
+        lib_a.sync.write_ops(
+            [lib_a.sync.shared_create(Tag, f"pg-{i:02d}", {"name": f"t{i}"})],
+            lambda db, p=f"pg-{i:02d}", j=i: db.insert(
+                Tag, {"pub_id": p, "name": f"t{j}"}))
+    seen, clocks, rounds = [], lib_b.sync.timestamps(), 0
+    while True:
+        ops, has_more = lib_a.sync.get_ops(clocks, 7)
+        assert len(ops) <= 7
+        ts_list = [o["timestamp"] for o in ops]
+        assert ts_list == sorted(ts_list)
+        seen += ops
+        rounds += 1
+        if not ops:
+            break
+        # advance the floor like ingest does
+        for o in ops:
+            clocks[o["instance"]] = max(clocks.get(o["instance"], 0), o["timestamp"])
+        if not has_more:
+            break
+    assert rounds >= 4
+    assert len(seen) == 25 and len({o["id"] for o in seen}) == 25
+
+
+def test_delete_tombstone_shadows_older_ops(pair):
+    """A stored newer DELETE shadows late-arriving older creates/updates —
+    deleted records must not resurrect via transitive propagation."""
+    lib_a, lib_b = pair
+    pub = "55555555-5555-5555-5555-555555555555"
+    create = lib_a.sync.shared_create(Tag, pub, {"name": "t"})
+    update = lib_a.sync.shared_update(Tag, pub, "name", "renamed")
+    delete = lib_a.sync.shared_delete(Tag, pub)
+    assert Ingester(lib_b).receive([update.to_wire()]) == 1
+    assert Ingester(lib_b).receive([delete.to_wire()]) == 1
+    assert lib_b.db.find_one(Tag, {"pub_id": pub}) is None
+    assert Ingester(lib_b).receive([create.to_wire()]) == 0
+    assert lib_b.db.find_one(Tag, {"pub_id": pub}) is None
+
+
+def test_newer_create_survives_stale_delete(pair):
+    """A record revived by a newer CREATE must not be killed by an older
+    DELETE tombstone arriving late."""
+    lib_a, lib_b = pair
+    pub = "66666666-6666-6666-6666-666666666666"
+    delete = lib_a.sync.shared_delete(Tag, pub)      # older timestamp
+    create = lib_a.sync.shared_create(Tag, pub, {"name": "revived"})
+    assert create.timestamp > delete.timestamp
+    assert Ingester(lib_b).receive([create.to_wire()]) == 1
+    assert Ingester(lib_b).receive([delete.to_wire()]) == 0
+    row = lib_b.db.find_one(Tag, {"pub_id": pub})
+    assert row is not None and row["name"] == "revived"
+
+
+def test_cross_kind_arrival_order_converges(pair):
+    """The shadow matrix must be symmetric: any arrival order of the same op
+    set converges to the in-timestamp-order state (CRDT requirement the
+    reference's exact-kind compare violates)."""
+    import itertools
+
+    lib_a, _ = pair
+    pub = "77777777-7777-7777-7777-777777777777"
+    ops = [
+        lib_a.sync.shared_create(Tag, pub, {"name": "v1", "color": "#111111"}),
+        lib_a.sync.shared_update(Tag, pub, "name", "v2"),
+        lib_a.sync.shared_delete(Tag, pub),
+        lib_a.sync.shared_update(Tag, pub, "color", "#222222"),
+    ]
+    # in-timestamp-order end state: delete kills row, then color update
+    # re-materializes a partial row with only color set
+    results = []
+    for perm in itertools.permutations(range(4)):
+        node = Node(Path(lib_a.db.path).parent.parent / f"perm{''.join(map(str, perm))}",
+                    probe_accelerator=False)
+        lib = node.libraries.create("perm")
+        lib.sync.emit_messages = True
+        lib.add_remote_instance(lib_a.instance())
+        ing = Ingester(lib)
+        for i in perm:
+            ing.receive([ops[i].to_wire()])
+        row = lib.db.find_one(Tag, {"pub_id": pub})
+        results.append((perm, None if row is None
+                        else (row["name"], row["color"])))
+        node.shutdown()
+    baseline = next(r for p, r in results if p == (0, 1, 2, 3))
+    for perm, r in results:
+        assert r == baseline, f"order {perm}: {r} != {baseline}"
+
+
+def test_update_after_delete_rematerializes_everywhere(pair):
+    """Reviewer scenario: u:name@10 stored, stale d@5 arrives late — the row
+    must survive on every node regardless of order."""
+    lib_a, lib_b = pair
+    pub = "88888888-8888-8888-8888-888888888888"
+    delete = lib_a.sync.shared_delete(Tag, pub)          # older
+    update = lib_a.sync.shared_update(Tag, pub, "name", "kept")
+    # order 1: update then delete
+    assert Ingester(lib_b).receive([update.to_wire()]) == 1
+    assert Ingester(lib_b).receive([delete.to_wire()]) == 0
+    assert lib_b.db.find_one(Tag, {"pub_id": pub})["name"] == "kept"
