@@ -1,11 +1,20 @@
-"""p2p.* procedures (api/p2p.rs). The networking layer wires real handlers;
-until a peer mesh is up these surface the node's own state and validate
-the procedure contract."""
+"""p2p.* procedures (api/p2p.rs): events subscription, NLM state, spacedrop
+send/accept/cancel, pairing originate/response — backed by the live
+P2PManager (spacedrive_tpu/p2p). A node booted with ``p2p_enabled: false``
+returns 503 from the mutations, matching a reference build without the
+p2p feature."""
 
 from __future__ import annotations
 
 from ..router import ApiError
 from ._util import filtered_subscription
+
+
+def _p2p(node):
+    p2p = getattr(node, "p2p", None)
+    if p2p is None:
+        raise ApiError("p2p is not running", code=503)
+    return p2p
 
 
 def mount(router) -> None:
@@ -16,44 +25,61 @@ def mount(router) -> None:
     @router.query("p2p.nlmState")
     def nlm_state(node, _arg):
         p2p = getattr(node, "p2p", None)
-        if p2p is None:
-            return {}
-        return p2p.nlm_state()
+        return {} if p2p is None else p2p.nlm_state()
+
+    @router.query("p2p.peers")
+    def peers(node, _arg):
+        """Discovered + connected peers with metadata (incl. accelerator
+        inventory — the TPU-native remote-hasher routing input)."""
+        p2p = getattr(node, "p2p", None)
+        return [] if p2p is None else p2p.peer_list()
+
+    @router.query("p2p.identity")
+    def identity(node, _arg):
+        """This node's RemoteIdentity + listen port (peer address card)."""
+        p2p = _p2p(node)
+        return {"identity": p2p.remote_identity.encode(), "port": p2p.port}
 
     @router.mutation("p2p.spacedrop")
     def spacedrop(node, arg):
-        p2p = getattr(node, "p2p", None)
-        if p2p is None:
-            raise ApiError("p2p is not running", code=503)
-        return p2p.spacedrop(arg["peer_id"], arg["paths"])
+        return _p2p(node).spacedrop(arg["peer_id"], arg["paths"])
 
     @router.mutation("p2p.acceptSpacedrop")
     def accept_spacedrop(node, arg):
-        p2p = getattr(node, "p2p", None)
-        if p2p is None:
-            raise ApiError("p2p is not running", code=503)
-        p2p.accept_spacedrop(arg["id"], arg.get("target_dir"))
+        """target_dir omitted/None declines the drop (api/p2p.rs: accept
+        with None file path is the decline signal)."""
+        try:
+            _p2p(node).accept_spacedrop(arg["id"], arg.get("target_dir"))
+        except KeyError as e:
+            raise ApiError(str(e), code=404) from e
         return None
 
     @router.mutation("p2p.cancelSpacedrop")
     def cancel_spacedrop(node, arg):
-        p2p = getattr(node, "p2p", None)
-        if p2p is None:
-            raise ApiError("p2p is not running", code=503)
-        p2p.cancel_spacedrop(arg["id"])
+        _p2p(node).cancel_spacedrop(arg["id"])
         return None
 
     @router.mutation("p2p.pair")
     def pair(node, arg):
-        p2p = getattr(node, "p2p", None)
-        if p2p is None:
-            raise ApiError("p2p is not running", code=503)
-        return p2p.pair(arg["peer_id"], arg["library_id"])
+        return _p2p(node).pair(arg["peer_id"])
 
     @router.mutation("p2p.pairingResponse")
     def pairing_response(node, arg):
-        p2p = getattr(node, "p2p", None)
-        if p2p is None:
-            raise ApiError("p2p is not running", code=503)
-        p2p.pairing_response(arg["pairing_id"], arg["decision"])
+        try:
+            _p2p(node).pairing_response(arg["pairing_id"], arg["decision"])
+        except KeyError as e:
+            raise ApiError(str(e), code=404) from e
         return None
+
+    @router.mutation("p2p.debugConnect")
+    def debug_connect(node, arg):
+        """Handshake a host:port directly (static-peer path; returns the
+        peer's identity). The test/ops analogue of mDNS discovery."""
+        p2p = _p2p(node)
+
+        async def _connect():
+            reader, writer, meta = await p2p.open_stream(arg["addr"])
+            writer.close()
+            return meta["identity"]
+
+        return p2p.run_coro(_connect(), timeout=30)

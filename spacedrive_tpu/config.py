@@ -60,7 +60,11 @@ class NodeConfig(VersionedConfig):
             # ed25519 seed, hex; public identity derived in p2p layer
             "keypair_seed": secrets.token_hex(32),
             "platform": Platform.current(),
-            "p2p_port": None,
+            "p2p_enabled": True,
+            "p2p_port": None,              # TCP listen port (None = ephemeral)
+            "p2p_discovery_port": None,    # UDP beacon port (None = no discovery)
+            "p2p_static_peers": [],        # ["host:port", ...] for filtered LANs
+            "p2p_auto_accept_library": None,  # headless auto-pair target
             "features": [],
             # TPU-native: accelerator inventory advertised to peers
             "accelerator": {"kind": None, "devices": 0, "mesh": []},

@@ -152,13 +152,25 @@ class Libraries:
 
     def create(self, name: str, description: str = "",
                lib_id: str | None = None,
-               instance_pub_id: str | None = None) -> Library:
+               instance_pub_id: str | None = None,
+               instance_identity: str | None = None) -> Library:
         """Create a library + its own Instance row (create_with_uuid is the
-        pairing path, library/manager create_with_uuid)."""
+        pairing path, library/manager create_with_uuid). The instance gets a
+        fresh ed25519 identity unless pairing supplies one (the
+        IdentityOrRemoteIdentity encoding, identity_or_remote_identity.rs:48)."""
         name = validate_library_name(name)
         lib_id = lib_id or str(uuid.uuid4())
         if lib_id in self._libraries:
             raise ValueError(f"library {lib_id} already exists")
+        from .p2p.identity import Identity as _Identity
+        from .p2p.identity import encode_identity as _enc
+
+        if instance_identity is None:
+            instance_identity = _enc(_Identity())
+        node_cfg_early = self.node.config.get() if self.node else {}
+        seed = node_cfg_early.get("keypair_seed")
+        node_remote_identity = (
+            _Identity.from_seed(seed).to_remote_identity().encode() if seed else None)
         self.dir.mkdir(parents=True, exist_ok=True)
         config = LibraryConfig.load_and_migrate(self.dir / f"{lib_id}.sdlibrary")
         config["name"] = name
@@ -167,7 +179,8 @@ class Libraries:
         node_cfg = self.node.config.get() if self.node else {}
         instance_id = db.insert(Instance, {
             "pub_id": instance_pub_id or str(uuid.uuid4()),
-            "identity": node_cfg.get("keypair_seed", "")[:16] or "local",
+            "identity": instance_identity,
+            "node_remote_identity": node_remote_identity,
             "node_id": node_cfg.get("id", str(uuid.uuid4())),
             "node_name": node_cfg.get("name", "node"),
             "node_platform": node_cfg.get("platform", Platform.current()),
@@ -193,6 +206,11 @@ class Libraries:
         library.config.save()
         self._emit(LibraryManagerEvent.EDIT, library)
         return library
+
+    def notify_instances_modified(self, library: Library) -> None:
+        """Pairing added/changed instance rows — rebroadcast so NLM and
+        watchers rebuild (LibraryManagerEvent::InstancesModified)."""
+        self._emit(LibraryManagerEvent.INSTANCES_MODIFIED, library)
 
     def delete(self, lib_id: str) -> None:
         library = self.get(lib_id)

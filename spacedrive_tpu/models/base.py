@@ -190,12 +190,30 @@ class Database:
         self._conn.execute("PRAGMA foreign_keys=ON")
         self._conn.execute("PRAGMA synchronous=NORMAL")
         self.migrate()
+        self._migrate_columns()
 
     def migrate(self) -> None:
         with self._lock:
             for model in self.models:
                 for stmt in model.ddl():
                     self._conn.execute(stmt)
+
+    def _migrate_columns(self) -> None:
+        """Additive schema evolution: columns declared on a model but missing
+        from an existing DB file are ALTER TABLE'd in (the micro analogue of
+        prisma migrate for the append-only schema changes this framework
+        makes; destructive changes go through backups/restore)."""
+        with self._lock:
+            for model in self.models:
+                have = {r["name"] for r in self._conn.execute(
+                    f"PRAGMA table_info({model.TABLE})")}
+                for name, field in model.FIELDS.items():
+                    if name in have:
+                        continue
+                    col = f'"{name}" {field.type}'
+                    if field.default is not None:
+                        col += f" DEFAULT {model.encode(name, field.default)!r}"
+                    self._conn.execute(f"ALTER TABLE {model.TABLE} ADD COLUMN {col}")
 
     def close(self) -> None:
         with self._lock:

@@ -96,6 +96,9 @@ class Instance(Model):
         "id": _pk(),
         "pub_id": _pub_id(),
         "identity": Field(_T, nullable=False),  # IdentityOrRemoteIdentity encoding
+        # owning NODE's RemoteIdentity (proven by the p2p handshake) — the
+        # authorization anchor for sync sessions + files-over-p2p
+        "node_remote_identity": Field(_T),
         "node_id": Field(_T, nullable=False),
         "node_name": Field(_T, nullable=False),
         "node_platform": Field(_I, nullable=False),

@@ -75,7 +75,22 @@ class Node:
         self.locations = LocationsActor(self)
 
     def _start_p2p(self) -> None:
-        pass  # p2p layer milestone
+        """Start the p2p control plane last in the boot sequence
+        (lib.rs:126-130). ``p2p_enabled: false`` in node config (or
+        SD_P2P_DISABLED=1) keeps a node offline."""
+        import os
+
+        cfg = self.config.get()
+        if not cfg.get("p2p_enabled", True) or os.environ.get("SD_P2P_DISABLED"):
+            return
+        try:
+            from .p2p.manager import P2PManager
+
+            self.p2p = P2PManager(self)
+            self.p2p.start()
+        except Exception:
+            logger.exception("p2p failed to start; node stays offline")
+            self.p2p = None
 
     # -- events (lib.rs:203-229) -------------------------------------------
     def emit(self, kind: str, payload: Any = None, library_id: str | None = None) -> None:
@@ -87,4 +102,6 @@ class Node:
         self.jobs.shutdown()
         if self.locations is not None:
             self.locations.stop()
+        if self.p2p is not None:
+            self.p2p.stop()
         self.libraries.close()

@@ -1,0 +1,24 @@
+"""p2p: the host-side distribution control plane.
+
+The reference's stack (crates/p2p + core/src/p2p — libp2p QUIC transport,
+mDNS discovery, ed25519 spacetunnel identities, pairing, NetworkedLibraries,
+spaceblock transfer) rebuilt on asyncio TCP streams with real
+challenge-response stream auth. The TPU *compute* plane (device mesh,
+collectives) lives in ``spacedrive_tpu.parallel``; this package is how nodes
+find each other, pair libraries, replicate CRDT ops, and move file bytes.
+"""
+
+from .discovery import DiscoveredPeer, Discovery
+from .identity import (Identity, RemoteIdentity, decode_identity,
+                       encode_identity, remote_identity_of)
+from .manager import P2PManager, Peer
+from .nlm import NetworkedLibraries
+from .pairing import PairingManager
+from .proto import Header, Range, SpaceblockRequest
+
+__all__ = [
+    "DiscoveredPeer", "Discovery", "Header", "Identity", "NetworkedLibraries",
+    "P2PManager", "PairingManager", "Peer", "Range", "RemoteIdentity",
+    "SpaceblockRequest", "decode_identity", "encode_identity",
+    "remote_identity_of",
+]

@@ -1,0 +1,122 @@
+"""ed25519 instance identities (spacetunnel).
+
+Parity with crates/p2p/src/spacetunnel/identity.rs:19 (Identity/RemoteIdentity
+keypairs) and core/src/p2p/identity_or_remote_identity.rs:48 (the tagged
+encoding stored in the ``instance.identity`` DB column). The reference's
+Tunnel e2e-encryption is a TODO stub (tunnel.rs:23,39); here the identities
+are used for real challenge-response stream authentication instead
+(manager.py handshake).
+
+Keys ride on ``cryptography``'s ed25519 (the environment's libsodium-class
+primitive); the wire/DB encoding is urlsafe base64 of the raw 32-byte seed or
+public key, tagged ``I:`` (we hold the private key) or ``R:`` (peer's public
+key only).
+"""
+
+from __future__ import annotations
+
+import base64
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey, Ed25519PublicKey)
+
+_RAW = serialization.Encoding.Raw
+_RAW_PUB = serialization.PublicFormat.Raw
+_RAW_PRIV = serialization.PrivateFormat.Raw
+_NOENC = serialization.NoEncryption()
+
+
+def _b64e(raw: bytes) -> str:
+    return base64.urlsafe_b64encode(raw).decode().rstrip("=")
+
+
+def _b64d(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+@dataclass(frozen=True)
+class RemoteIdentity:
+    """A peer's public key — the stable address of an instance/node."""
+
+    public_bytes: bytes  # 32 raw bytes
+
+    def __post_init__(self) -> None:
+        if len(self.public_bytes) != 32:
+            raise ValueError("ed25519 public key must be 32 bytes")
+
+    def verify(self, signature: bytes, message: bytes) -> bool:
+        key = Ed25519PublicKey.from_public_bytes(self.public_bytes)
+        try:
+            key.verify(signature, message)
+            return True
+        except InvalidSignature:
+            return False
+
+    def encode(self) -> str:
+        return _b64e(self.public_bytes)
+
+    @classmethod
+    def decode(cls, s: str) -> "RemoteIdentity":
+        return cls(_b64d(s))
+
+    def __str__(self) -> str:  # peer id in events / UI
+        return self.encode()
+
+
+class Identity:
+    """An ed25519 keypair we hold the private half of."""
+
+    def __init__(self, private: Ed25519PrivateKey | None = None) -> None:
+        self._key = private or Ed25519PrivateKey.generate()
+
+    @classmethod
+    def from_seed(cls, seed: bytes | str) -> "Identity":
+        if isinstance(seed, str):
+            seed = bytes.fromhex(seed) if len(seed) == 64 else _b64d(seed)
+        return cls(Ed25519PrivateKey.from_private_bytes(seed[:32]))
+
+    def seed(self) -> bytes:
+        return self._key.private_bytes(_RAW, _RAW_PRIV, _NOENC)
+
+    def sign(self, message: bytes) -> bytes:
+        return self._key.sign(message)
+
+    def to_remote_identity(self) -> RemoteIdentity:
+        return RemoteIdentity(self._key.public_key().public_bytes(_RAW, _RAW_PUB))
+
+    def encode(self) -> str:
+        return _b64e(self.seed())
+
+    @classmethod
+    def decode(cls, s: str) -> "Identity":
+        return cls.from_seed(_b64d(s))
+
+
+# -- instance.identity column encoding --------------------------------------
+# identity_or_remote_identity.rs:48 — one column stores either our private
+# identity (for the instance this node owns) or a peer's public identity.
+
+_I_TAG, _R_TAG = "I:", "R:"
+
+
+def encode_identity(value: Identity | RemoteIdentity) -> str:
+    if isinstance(value, Identity):
+        return _I_TAG + value.encode()
+    return _R_TAG + value.encode()
+
+
+def decode_identity(s: str) -> Identity | RemoteIdentity:
+    if s.startswith(_I_TAG):
+        return Identity.decode(s[len(_I_TAG):])
+    if s.startswith(_R_TAG):
+        return RemoteIdentity.decode(s[len(_R_TAG):])
+    raise ValueError(f"not an identity encoding: {s[:8]!r}")
+
+
+def remote_identity_of(s: str) -> RemoteIdentity:
+    """Public identity regardless of which side of the pair we hold."""
+    v = decode_identity(s)
+    return v.to_remote_identity() if isinstance(v, Identity) else v

@@ -1,0 +1,523 @@
+"""P2PManager: authenticated streams, peer registry, header dispatch.
+
+The architectural role of sd-p2p's ``Manager``/``ManagerStream``
+(crates/p2p/src/manager.rs:34,62-79 — libp2p QUIC event loop) fused with the
+core-side ``P2PManager`` event pump (core/src/p2p/p2p_manager.rs:88-260):
+
+- one dedicated asyncio thread per Node runs the TCP listener, discovery
+  beacons, and every session coroutine;
+- a *stream* is one TCP connection carrying one header-tagged exchange
+  (the reference opens a fresh QUIC substream per exchange — same shape);
+- the connect handshake doubles as mutual authentication (ed25519
+  challenge-response — stronger than the reference's TODO-stubbed Tunnel,
+  crates/p2p/src/spacetunnel/tunnel.rs:23) and metadata exchange (so static
+  ``host:port`` peers bootstrap without UDP discovery);
+- inbound headers dispatch to pairing / sync sessions / spacedrop /
+  file-serving, mirroring protocol.rs:13-27.
+
+The *compute* plane stays on the device mesh (parallel/mesh.py); this module
+is the host-side control plane the CRDT layer and file transfers ride on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import TYPE_CHECKING, Any
+
+from .discovery import Discovery, DiscoveredPeer
+from .identity import Identity, RemoteIdentity, remote_identity_of
+from .proto import (Header, H_FILE, H_PAIR, H_PING, H_SPACEDROP, H_SYNC,
+                    ProtocolError, Range, SpaceblockRequest, block_size_for,
+                    json_frame, read_block_msg, read_exact, read_json)
+from .spaceblock import receive_file, send_file
+
+if TYPE_CHECKING:
+    from ..node import Node
+
+logger = logging.getLogger(__name__)
+
+MAGIC = b"SDP2"
+SPACEDROP_TIMEOUT = 60.0  # p2p_manager.rs:42-43
+HANDSHAKE_TIMEOUT = 20.0
+
+
+class Peer:
+    def __init__(self, identity: str, host: str, port: int,
+                 metadata: dict[str, Any]) -> None:
+        self.identity = identity
+        self.host = host
+        self.port = port
+        self.metadata = metadata
+        self.connected = False
+
+    def to_wire(self) -> dict[str, Any]:
+        return {"identity": self.identity, "host": self.host, "port": self.port,
+                "connected": self.connected,
+                "name": self.metadata.get("name"),
+                "accelerator": self.metadata.get("accelerator")}
+
+
+class P2PManager:
+    def __init__(self, node: "Node") -> None:
+        from .nlm import NetworkedLibraries
+        from .pairing import PairingManager
+
+        self.node = node
+        cfg = node.config.get()
+        self.identity = Identity.from_seed(cfg["keypair_seed"])
+        self.remote_identity = self.identity.to_remote_identity()
+        self.peers: dict[str, Peer] = {}
+        self.port: int | None = None
+        self.discovery: Discovery | None = None
+        self.pairing = PairingManager(self)
+        self.nlm = NetworkedLibraries(self)
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._stop: asyncio.Event | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._ready = threading.Event()
+        self._start_error: BaseException | None = None
+        self._spacedrop_in: dict[str, dict[str, Any]] = {}
+        self._spacedrop_cancel: dict[str, asyncio.Event] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="p2p-manager")
+        self._thread.start()
+        if not self._ready.wait(15):
+            raise RuntimeError("p2p manager failed to start")
+        if self._start_error is not None:
+            # surface bring-up failures (port in use, …) so the node falls
+            # back to a clean offline state instead of a zombie manager
+            raise RuntimeError(f"p2p bring-up failed: {self._start_error}")
+        self.nlm.attach()
+
+    def _run(self) -> None:
+        try:
+            asyncio.run(self._main())
+        except Exception as e:
+            logger.exception("p2p event loop died")
+            self._start_error = e
+            self._ready.set()
+
+    async def _main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._stop = asyncio.Event()
+        cfg = self.node.config.get()
+        self._server = await asyncio.start_server(
+            self._on_connection, "0.0.0.0", cfg.get("p2p_port") or 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("p2p listening on :%d as %s", self.port,
+                    self.remote_identity.encode()[:12])
+
+        disc_port = cfg.get("p2p_discovery_port")
+        if disc_port:
+            self.discovery = Discovery(
+                int(disc_port), self.metadata,
+                on_peer=self._on_discovered, on_expired=self._on_expired)
+            await self.discovery.start()
+        static = cfg.get("p2p_static_peers") or []
+        pinger = asyncio.create_task(self._static_peer_loop(static)) if static else None
+
+        self._ready.set()
+        await self._stop.wait()
+        if pinger:
+            pinger.cancel()
+        if self.discovery:
+            await self.discovery.stop()
+        self._server.close()
+        await self._server.wait_closed()
+
+    def stop(self) -> None:
+        if self._loop is None or self._stop is None:
+            return
+        try:
+            self._loop.call_soon_threadsafe(self._stop.set)
+            self._thread.join(timeout=10)
+        except RuntimeError:
+            pass
+
+    # -- metadata / events ---------------------------------------------------
+    def metadata(self) -> dict[str, Any]:
+        """PeerMetadata equivalent (core/src/p2p/peer_metadata.rs) + the
+        TPU-native accelerator inventory for remote-hasher routing.
+
+        Briefly cached: this runs on the p2p event loop (handshakes, beacon
+        ticks) and scans every library's instance table — the cache keeps a
+        long executor-side DB transaction from stalling the loop."""
+        cached = getattr(self, "_metadata_cache", None)
+        if cached is not None and time.monotonic() - cached[1] < 2.0:
+            return cached[0]
+        cfg = self.node.config.get()
+        instances: dict[str, list[str]] = {}
+        for library in self.node.libraries.list():
+            idents = []
+            from ..models import Instance
+
+            for row in library.db.find(Instance):
+                try:
+                    idents.append(remote_identity_of(row["identity"]).encode())
+                except ValueError:
+                    continue  # pre-p2p placeholder identity
+            instances[library.id] = idents
+        meta = {"identity": self.remote_identity.encode(),
+                "node_id": cfg["id"], "name": cfg["name"],
+                "port": self.port, "operating_system": cfg["platform"],
+                "instances": instances,
+                "accelerator": cfg.get("accelerator", {})}
+        self._metadata_cache = (meta, time.monotonic())
+        return meta
+
+    def emit(self, event: dict[str, Any]) -> None:
+        self.node.emit("p2p", event)
+
+    def _on_discovered(self, dp: DiscoveredPeer, is_new: bool) -> None:
+        peer = self.peers.get(dp.identity)
+        if peer is None:
+            peer = Peer(dp.identity, dp.host, dp.port, dp.metadata)
+            self.peers[dp.identity] = peer
+        else:
+            peer.host, peer.port, peer.metadata = dp.host, dp.port, dp.metadata
+        if is_new:
+            self.emit({"type": "DiscoveredPeer", "peer": peer.to_wire()})
+        self.nlm.peer_seen(peer)
+
+    def _on_expired(self, dp: DiscoveredPeer) -> None:
+        peer = self.peers.pop(dp.identity, None)
+        if peer is not None:
+            self.emit({"type": "ExpiredPeer", "identity": dp.identity})
+            self.nlm.peer_lost(peer)
+
+    async def _static_peer_loop(self, static: list[str]) -> None:
+        """Learn identities/metadata of configured host:port peers by pinging
+        them; refresh periodically (mDNS replacement for filtered networks)."""
+        while True:
+            for entry in static:
+                try:
+                    host, port = entry.rsplit(":", 1)
+                    await self._ping((host, int(port)))
+                except Exception as e:
+                    logger.debug("static peer %s unreachable: %s", entry, e)
+            await asyncio.sleep(10)
+
+    async def _ping(self, addr: tuple[str, int]) -> None:
+        reader, writer, _meta = await self._open_stream_addr(addr)
+        try:
+            writer.write(Header.ping().to_bytes())
+            await writer.drain()
+        finally:
+            writer.close()
+
+    # -- handshake -----------------------------------------------------------
+    async def _handshake_out(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> dict[str, Any]:
+        nonce = secrets.token_bytes(32)
+        hello = {**self.metadata(), "nonce": nonce.hex()}
+        writer.write(MAGIC + json_frame(hello))
+        await writer.drain()
+        resp = await read_json(reader)
+        peer_ident = RemoteIdentity.decode(resp["identity"])
+        if not peer_ident.verify(bytes.fromhex(resp["sig"]), nonce):
+            raise ProtocolError("peer failed challenge")
+        writer.write(json_frame({"sig": self.identity.sign(
+            bytes.fromhex(resp["nonce"])).hex()}))
+        await writer.drain()
+        return resp
+
+    async def _handshake_in(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> dict[str, Any]:
+        if await read_exact(reader, 4) != MAGIC:
+            raise ProtocolError("bad magic")
+        hello = await read_json(reader)
+        peer_ident = RemoteIdentity.decode(hello["identity"])
+        nonce = secrets.token_bytes(32)
+        writer.write(json_frame({**self.metadata(), "nonce": nonce.hex(),
+                                 "sig": self.identity.sign(
+                                     bytes.fromhex(hello["nonce"])).hex()}))
+        await writer.drain()
+        fin = await read_json(reader)
+        if not peer_ident.verify(bytes.fromhex(fin["sig"]), nonce):
+            raise ProtocolError("peer failed challenge")
+        return hello
+
+    def _register_connected(self, meta: dict[str, Any], host: str) -> Peer:
+        ident = meta["identity"]
+        peer = self.peers.get(ident)
+        if peer is None:
+            peer = Peer(ident, host, int(meta.get("port") or 0), meta)
+            self.peers[ident] = peer
+        else:
+            peer.host, peer.metadata = host, meta
+            if meta.get("port"):
+                peer.port = int(meta["port"])
+        first = not peer.connected
+        peer.connected = True
+        if first:
+            self.emit({"type": "ConnectedPeer", "identity": ident})
+        self.nlm.peer_seen(peer)
+        return peer
+
+    # -- outgoing streams ----------------------------------------------------
+    def _resolve_addr(self, peer_id: str) -> tuple[str, int]:
+        peer = self.peers.get(peer_id)
+        if peer is not None:
+            return peer.host, peer.port
+        if ":" in peer_id:  # direct host:port addressing (static/test path)
+            host, port = peer_id.rsplit(":", 1)
+            return host, int(port)
+        raise KeyError(f"unknown peer {peer_id}")
+
+    async def _open_stream_addr(self, addr: tuple[str, int]):
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(*addr), HANDSHAKE_TIMEOUT)
+        try:
+            meta = await asyncio.wait_for(
+                self._handshake_out(reader, writer), HANDSHAKE_TIMEOUT)
+        except Exception:
+            writer.close()
+            raise
+        self._register_connected(meta, addr[0])
+        return reader, writer, meta
+
+    async def open_stream(self, peer_id: str):
+        """(reader, writer, peer_metadata) — authenticated unicast stream
+        (the analogue of ``Manager::stream(peer_id)``, manager.rs). A failed
+        connect demotes a known peer so dead static peers don't stay
+        Connected and stall every sync round."""
+        try:
+            return await self._open_stream_addr(self._resolve_addr(peer_id))
+        except (OSError, asyncio.TimeoutError, ProtocolError):
+            peer = self.peers.get(peer_id)
+            if peer is not None and peer.connected:
+                peer.connected = False
+                self.emit({"type": "DisconnectedPeer", "identity": peer.identity})
+                self.nlm.peer_lost(peer)
+            raise
+
+    # -- cross-thread helpers ------------------------------------------------
+    def run_coro(self, coro, timeout: float | None = None):
+        """Run a coroutine on the p2p loop from a sync caller (API thread)."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    def schedule(self, coro) -> None:
+        """Fire-and-forget a coroutine on the p2p loop."""
+        def _spawn() -> None:
+            task = self._loop.create_task(coro)
+            task.add_done_callback(self._log_task_error)
+
+        self._loop.call_soon_threadsafe(_spawn)
+
+    @staticmethod
+    def _log_task_error(task: asyncio.Task) -> None:
+        if not task.cancelled() and task.exception() is not None:
+            logger.error("p2p task failed", exc_info=task.exception())
+
+    # -- inbound dispatch ----------------------------------------------------
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        host = writer.get_extra_info("peername", ("?", 0))[0]
+        try:
+            meta = await asyncio.wait_for(
+                self._handshake_in(reader, writer), HANDSHAKE_TIMEOUT)
+            peer = self._register_connected(meta, host)
+            header = await Header.from_stream(reader)
+            if header.kind == H_PING:
+                pass  # handshake already refreshed metadata
+            elif header.kind == H_PAIR:
+                await self.pairing.responder(reader, writer, peer)
+            elif header.kind == H_SYNC:
+                await self.nlm.responder(reader, writer, header.payload, peer)
+            elif header.kind == H_SPACEDROP:
+                await self._spacedrop_receive(reader, writer, header.payload, peer)
+            elif header.kind == H_FILE:
+                await self._serve_file(reader, writer, header.payload, peer)
+            else:
+                logger.warning("unhandled header kind %s", header.kind)
+        except (ProtocolError, asyncio.TimeoutError, OSError) as e:
+            logger.debug("p2p connection from %s failed: %s", host, e)
+        except Exception:
+            logger.exception("p2p connection handler crashed")
+        finally:
+            writer.close()
+
+    # -- spacedrop -----------------------------------------------------------
+    def spacedrop(self, peer_id: str, paths: list[str]) -> list[str]:
+        """Offer files to a peer; returns drop ids (p2p_manager.rs spacedrop)."""
+        ids = []
+        for p in paths:
+            drop_id = str(uuid.uuid4())
+            ids.append(drop_id)
+            self.schedule(self._spacedrop_send(drop_id, peer_id, Path(p)))
+        return ids
+
+    async def _spacedrop_send(self, drop_id: str, peer_id: str, path: Path) -> None:
+        cancel = asyncio.Event()
+        self._spacedrop_cancel[drop_id] = cancel
+        try:
+            size = path.stat().st_size
+            req = SpaceblockRequest(name=path.name, size=size,
+                                    block_size=block_size_for(size))
+            reader, writer, _meta = await self.open_stream(peer_id)
+            try:
+                writer.write(Header.spacedrop(req).to_bytes())
+                await writer.drain()
+                decision = await asyncio.wait_for(read_exact(reader, 1),
+                                                  SPACEDROP_TIMEOUT)
+                if decision != b"\x01":
+                    self.emit({"type": "SpacedropRejected", "id": drop_id})
+                    return
+                sent = await send_file(
+                    writer, path, req,
+                    progress=lambda done, total: self.emit(
+                        {"type": "SpacedropProgress", "id": drop_id,
+                         "percent": int(done * 100 / max(1, total))}),
+                    cancelled=cancel)
+                await writer.drain()
+                self.emit({"type": "SpacedropDone", "id": drop_id, "bytes": sent})
+            finally:
+                writer.close()
+        except (OSError, asyncio.TimeoutError, ProtocolError) as e:
+            self.emit({"type": "SpacedropFailed", "id": drop_id, "error": str(e)})
+        finally:
+            self._spacedrop_cancel.pop(drop_id, None)
+
+    async def _spacedrop_receive(self, reader, writer,
+                                 req: SpaceblockRequest, peer: Peer) -> None:
+        drop_id = str(uuid.uuid4())
+        fut: asyncio.Future = self._loop.create_future()
+        self._spacedrop_in[drop_id] = {"future": fut, "req": req,
+                                       "peer": peer.identity}
+        self.emit({"type": "SpacedropRequest", "id": drop_id,
+                   "identity": peer.identity, "name": req.name,
+                   "size": req.size})
+        try:
+            target_dir = await asyncio.wait_for(fut, SPACEDROP_TIMEOUT)
+        except asyncio.TimeoutError:
+            target_dir = None
+        finally:
+            self._spacedrop_in.pop(drop_id, None)
+        if target_dir is None:
+            writer.write(b"\x00")
+            await writer.drain()
+            self.emit({"type": "SpacedropRejected", "id": drop_id})
+            return
+        writer.write(b"\x01")
+        await writer.drain()
+        from ..objects.fs import find_available_name
+
+        # the offered name is attacker-controlled: keep only the basename so
+        # "../../x" or an absolute path can never escape the chosen directory
+        safe_name = Path(req.name).name or "received.bin"
+        target = find_available_name(Path(target_dir) / safe_name)
+        cancel = asyncio.Event()
+        self._spacedrop_cancel[drop_id] = cancel
+        try:
+            ok = await receive_file(
+                reader, target, req,
+                progress=lambda done, total: self.emit(
+                    {"type": "SpacedropProgress", "id": drop_id,
+                     "percent": int(done * 100 / max(1, total))}),
+                cancelled=cancel)
+            self.emit({"type": "SpacedropDone" if ok else "SpacedropFailed",
+                       "id": drop_id, "path": str(target)})
+        finally:
+            self._spacedrop_cancel.pop(drop_id, None)
+
+    def accept_spacedrop(self, drop_id: str, target_dir: str | None) -> None:
+        entry = self._spacedrop_in.get(drop_id)
+        if entry is None:
+            raise KeyError(f"no pending spacedrop {drop_id}")
+        self._loop.call_soon_threadsafe(
+            lambda: entry["future"].done() or entry["future"].set_result(target_dir))
+
+    def cancel_spacedrop(self, drop_id: str) -> None:
+        entry = self._spacedrop_in.get(drop_id)
+        if entry is not None:
+            self._loop.call_soon_threadsafe(
+                lambda: entry["future"].done() or entry["future"].set_result(None))
+            return
+        cancel = self._spacedrop_cancel.get(drop_id)
+        if cancel is not None:
+            self._loop.call_soon_threadsafe(cancel.set)
+
+    # -- files over p2p ------------------------------------------------------
+    async def _serve_file(self, reader, writer, payload: dict, peer: Peer) -> None:
+        """Serve a ranged file read to an authenticated peer
+        (Header::File, p2p_manager.rs gated on files_over_p2p_flag)."""
+        from ..config import BackendFeature
+        from ..models import FilePath
+        from ..objects.fs import file_path_abs
+
+        if not self.node.config.has_feature(BackendFeature.FILES_OVER_P2P):
+            writer.write(json_frame({"ok": False, "error": "filesOverP2P disabled"}))
+            await writer.drain()
+            return
+        try:
+            library = self.node.libraries.get(payload["library_id"])
+            # only nodes paired into the library may read its files
+            if peer.identity not in self.nlm.member_nodes(library):
+                raise KeyError("not a member of this library")
+            row = library.db.find_one(FilePath, {"pub_id": payload["file_path_pub_id"]})
+            if row is None:
+                raise KeyError("file_path not found")
+            _row, path = file_path_abs(library.db, row["id"])
+            size = path.stat().st_size
+        except (KeyError, OSError) as e:
+            writer.write(json_frame({"ok": False, "error": str(e)}))
+            await writer.drain()
+            return
+        rng = Range.from_wire(payload.get("range"))
+        req = SpaceblockRequest(name=path.name, size=size,
+                                block_size=block_size_for(size), range=rng)
+        writer.write(json_frame({"ok": True, **req.to_wire()}))
+        await writer.drain()
+        await send_file(writer, path, req)
+        await writer.drain()
+
+    async def request_file(self, peer_id: str, library_id: str,
+                           file_path_pub_id: str, rng: Range,
+                           sink) -> int:
+        """Fetch a peer's file bytes into ``sink`` (a writable binary file
+        object). Used by custom_uri's remote path (custom_uri.rs:64-69)."""
+        reader, writer, _meta = await self.open_stream(peer_id)
+        try:
+            writer.write(Header.file(library_id, file_path_pub_id, rng).to_bytes())
+            await writer.drain()
+            head = await read_json(reader)
+            if not head.get("ok"):
+                raise ProtocolError(head.get("error", "file request refused"))
+            req = SpaceblockRequest.from_wire(head)
+            total = (req.size if req.range.end is None
+                     else min(req.range.end, req.size)) - req.range.start
+            got = 0
+            while got < total:
+                msg = await read_block_msg(reader)
+                if msg is None:
+                    raise ProtocolError("peer cancelled file transfer")
+                _offset, data = msg
+                sink.write(data)
+                got += len(data)
+            return got
+        finally:
+            writer.close()
+
+    # -- state for the API ---------------------------------------------------
+    def peer_list(self) -> list[dict[str, Any]]:
+        return [p.to_wire() for p in self.peers.values()]
+
+    def nlm_state(self) -> dict[str, Any]:
+        return self.nlm.state()
+
+    def pair(self, peer_id: str) -> int:
+        return self.pairing.originator(peer_id)
+
+    def pairing_response(self, pairing_id: int, decision: Any) -> None:
+        self.pairing.decision(pairing_id, decision)

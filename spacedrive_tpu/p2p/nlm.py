@@ -1,0 +1,214 @@
+"""NetworkedLibraries: per-library peer state + sync-over-wire sessions.
+
+Parity with core/src/p2p/sync/mod.rs:
+
+- tracks ``InstanceState::{Unavailable, Discovered, Connected}`` per library
+  instance, keyed by the instance's RemoteIdentity (:31-50), rebuilt from the
+  instance table on library load/edit/instances-modified events (:60-91);
+- on ``SyncMessage::Created`` from a library's sync manager, *originates* a
+  sync session to every connected peer: ``Header::Sync(library_id)`` +
+  ``NewOperations`` notify, then answers the responder's GetOperations pulls
+  from ``sync.get_ops`` (:257-343);
+- as *responder*, drives the ingest side: request batches with the library's
+  per-instance HLC clocks, feed them to the Ingester, loop while has_more
+  (:343-440). DB work runs in the default executor so the p2p loop never
+  blocks on SQLite.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import TYPE_CHECKING, Any
+
+from .identity import remote_identity_of
+from .proto import (SYNC_NEW_OPERATIONS, Header, main_request_done,
+                    main_request_get_operations, operations_frame, read_exact,
+                    read_json)
+
+if TYPE_CHECKING:
+    from ..library import Library
+    from .manager import P2PManager, Peer
+
+logger = logging.getLogger(__name__)
+
+OPS_PER_REQUEST = 1000  # sync/mod.rs responder OPS_PER_REQUEST
+
+UNAVAILABLE = "Unavailable"
+DISCOVERED = "Discovered"
+CONNECTED = "Connected"
+
+
+class NetworkedLibraries:
+    def __init__(self, manager: "P2PManager") -> None:
+        self.manager = manager
+        self.node = manager.node
+        # lib_id -> instance RemoteIdentity str -> {"state", "peer"}
+        self._libraries: dict[str, dict[str, dict[str, Any]]] = {}
+        self._hooked: set[str] = set()  # libraries whose sync we subscribed
+
+    def attach(self) -> None:
+        """Subscribe to library manager events (replays Load for loaded
+        libraries) — called once the p2p loop is up."""
+        from ..library import LibraryManagerEvent as E
+
+        def on_event(event: str, library) -> None:
+            if event == E.DELETE:
+                self._libraries.pop(library.id, None)
+                self._hooked.discard(library.id)
+                return
+            self._load_library(library)
+
+        self.node.libraries.subscribe(on_event)
+
+    # -- state maintenance ---------------------------------------------------
+    def _load_library(self, library: "Library") -> None:
+        """Rebuild this library's instance map from its instance table
+        (sync/mod.rs load_library)."""
+        from ..models import Instance
+
+        entry: dict[str, dict[str, Any]] = {}
+        own = {self.manager.remote_identity.encode()}
+        for row in library.db.find(Instance):
+            try:
+                ident = remote_identity_of(row["identity"]).encode()
+            except ValueError:
+                continue  # placeholder identity from pre-p2p pairing
+            if ident in own:
+                continue
+            entry[ident] = {"state": UNAVAILABLE, "peer": None}
+        self._libraries[library.id] = entry
+        if library.id not in self._hooked and library.sync is not None:
+            self._hooked.add(library.id)
+            from ..sync.manager import SyncMessage
+
+            library.sync.subscribe(
+                lambda msg, lib=library: self._on_sync_message(lib, msg))
+        # fold in what we already know about peers
+        for peer in self.manager.peers.values():
+            self.peer_seen(peer)
+
+    def _on_sync_message(self, library: "Library", msg: str) -> None:
+        from ..sync.manager import SyncMessage
+
+        if msg == SyncMessage.CREATED:
+            self.manager.schedule(self.originate(library))
+
+    def peer_seen(self, peer: "Peer") -> None:
+        """Update instance states from a peer's advertised per-library
+        instance identities; trigger a resync when a shared library's peer
+        first connects (p2p_manager.rs:190-205 PeerConnected resync)."""
+        state = CONNECTED if peer.connected else DISCOVERED
+        for lib_id, idents in (peer.metadata.get("instances") or {}).items():
+            lib_entry = self._libraries.get(lib_id)
+            if lib_entry is None:
+                continue
+            for ident in idents:
+                if ident == self.manager.remote_identity.encode():
+                    continue
+                cur = lib_entry.setdefault(ident, {"state": UNAVAILABLE, "peer": None})
+                newly_connected = state == CONNECTED and cur["state"] != CONNECTED
+                cur["state"] = state
+                cur["peer"] = peer.identity
+                if newly_connected:
+                    try:
+                        library = self.node.libraries.get(lib_id)
+                    except KeyError:
+                        continue
+                    self.manager.schedule(self.originate(library))
+
+    def peer_lost(self, peer: "Peer") -> None:
+        for lib_entry in self._libraries.values():
+            for ident, cur in lib_entry.items():
+                if cur["peer"] == peer.identity:
+                    cur["state"] = UNAVAILABLE
+                    cur["peer"] = None
+
+    def state(self) -> dict[str, Any]:
+        """nlmState procedure payload (LibraryData map, sync/mod.rs:38-43)."""
+        return {lib_id: {"instances": dict(entry)}
+                for lib_id, entry in self._libraries.items()}
+
+    # -- membership ----------------------------------------------------------
+    def member_nodes(self, library: "Library") -> set[str]:
+        """Node RemoteIdentities authorized for this library — the
+        handshake-proven identities recorded on its instance rows at
+        create/pairing time. The authorization anchor for sync sessions and
+        files-over-p2p (the reference leaves this to its TODO-stubbed Tunnel
+        auth; here it is enforced)."""
+        from ..models import Instance
+
+        return {r["node_remote_identity"] for r in library.db.find(Instance)
+                if r.get("node_remote_identity")}
+
+    # -- originator (push notify + serve pulls) ------------------------------
+    async def originate(self, library: "Library") -> None:
+        """Alert every connected MEMBER peer that this library has new ops;
+        each receiver then pulls from us over the same stream. One direction
+        only (sync/mod.rs:288 'REMEMBER: This only syncs one direction!')."""
+        members = self.member_nodes(library)
+        targets = {p.identity for p in self.manager.peers.values()
+                   if p.connected and p.identity in members}
+        for peer_id in targets:
+            try:
+                await self._originate_to(library, peer_id)
+            except Exception as e:
+                logger.debug("sync originate to %s failed: %s", peer_id[:12], e)
+
+    async def _originate_to(self, library: "Library", peer_id: str) -> None:
+        reader, writer, _meta = await self.manager.open_stream(peer_id)
+        try:
+            writer.write(Header.sync(library.id).to_bytes())
+            writer.write(SYNC_NEW_OPERATIONS)
+            await writer.drain()
+            loop = asyncio.get_running_loop()
+            while True:
+                req = await read_json(reader)
+                if req.get("req") != "get_ops":
+                    break  # done
+                ops, has_more = await loop.run_in_executor(
+                    None, library.sync.get_ops, req.get("clocks") or {},
+                    int(req.get("count") or OPS_PER_REQUEST))
+                writer.write(operations_frame(ops, has_more))
+                await writer.drain()
+        finally:
+            writer.close()
+
+    # -- responder (pull + ingest) -------------------------------------------
+    async def responder(self, reader, writer, library_id: str,
+                        peer: "Peer") -> None:
+        """Drive the ingest pull loop for an incoming Sync stream."""
+        try:
+            library = self.node.libraries.get(library_id)
+        except KeyError:
+            writer.write(main_request_done())
+            await writer.drain()
+            return
+        if peer.identity not in self.member_nodes(library):
+            logger.warning("rejected sync for %s from non-member %s",
+                           library_id[:8], peer.identity[:12])
+            writer.write(main_request_done())
+            await writer.drain()
+            return
+        notify = await read_exact(reader, 1)
+        if notify != SYNC_NEW_OPERATIONS:
+            logger.warning("unexpected sync message %r", notify)
+            return
+        from ..sync.ingest import Ingester
+
+        ingester = Ingester(library)
+        loop = asyncio.get_running_loop()
+        while True:
+            clocks = await loop.run_in_executor(None, library.sync.timestamps)
+            writer.write(main_request_get_operations(clocks, OPS_PER_REQUEST))
+            await writer.drain()
+            batch = await read_json(reader)
+            ops = batch.get("ops") or []
+            if ops:
+                await loop.run_in_executor(None, ingester.receive, ops)
+            if not batch.get("has_more"):
+                break
+        writer.write(main_request_done())
+        await writer.drain()
+        self.manager.emit({"type": "SyncIngested", "library_id": library_id,
+                           "from": peer.identity})

@@ -1,0 +1,215 @@
+"""Pairing: originator/responder handshake that mirrors a library to a peer.
+
+Parity with core/src/p2p/pairing/mod.rs:38-44,75-230 and pairing/proto.rs:
+
+- the originator mints a fresh per-library ed25519 instance identity +
+  pub_id, sends ``Header::Pair`` + a PairingRequest carrying its Instance
+  record, and waits;
+- the responder surfaces a UI decision (``p2p.pairingResponse``; headless
+  nodes can set the ``p2p_auto_accept_library`` config key), inserts the
+  originator's instance into the chosen library, and replies Accepted with
+  the library info plus every instance row it knows;
+- the originator then creates the mirrored library with the SAME uuid
+  (create_with_uuid path) holding its private identity, registers the other
+  instances, and both sides kick off sync sessions so the op-logs converge.
+
+PairingStatus progress events flow over the p2p event stream throughout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as dt
+import itertools
+import logging
+import uuid
+from typing import TYPE_CHECKING, Any
+
+from .identity import Identity, encode_identity, remote_identity_of
+from .proto import Header, json_frame, read_json
+
+if TYPE_CHECKING:
+    from .manager import P2PManager, Peer
+
+logger = logging.getLogger(__name__)
+
+DECISION_TIMEOUT = 60.0
+RESPONSE_TIMEOUT = 120.0
+
+
+def _instance_wire(row: dict[str, Any]) -> dict[str, Any]:
+    """Instance row → wire form. The identity column crosses as the PUBLIC
+    half only (identity_or_remote_identity.rs — private keys never leave)."""
+    ident = remote_identity_of(row["identity"])
+    iso = lambda v: v.isoformat() if isinstance(v, dt.datetime) else v
+    return {"pub_id": row["pub_id"], "identity": "R:" + ident.encode(),
+            "node_remote_identity": row.get("node_remote_identity"),
+            "node_id": row["node_id"], "node_name": row["node_name"],
+            "node_platform": row["node_platform"],
+            "last_seen": iso(row["last_seen"]),
+            "date_created": iso(row["date_created"])}
+
+
+class PairingManager:
+    def __init__(self, manager: "P2PManager") -> None:
+        self.manager = manager
+        self._ids = itertools.count(0)
+        self._pending: dict[int, asyncio.Future] = {}
+
+    def _emit(self, pairing_id: int, status: Any) -> None:
+        self.manager.emit({"type": "PairingProgress", "id": pairing_id,
+                           "status": status})
+
+    def decision(self, pairing_id: int, decision: Any) -> None:
+        """UI answer for a pending responder prompt: ``{"accept": library_id}``
+        or anything falsy to reject (PairingDecision)."""
+        fut = self._pending.pop(pairing_id, None)
+        if fut is None:
+            raise KeyError(f"no pending pairing {pairing_id}")
+        self.manager._loop.call_soon_threadsafe(
+            lambda: fut.done() or fut.set_result(decision))
+
+    # -- originator ----------------------------------------------------------
+    def originator(self, peer_id: str) -> int:
+        pairing_id = next(self._ids)
+        self._emit(pairing_id, "EstablishingConnection")
+        self.manager.schedule(self._originator(pairing_id, peer_id))
+        return pairing_id
+
+    async def _originator(self, pairing_id: int, peer_id: str) -> None:
+        node = self.manager.node
+        try:
+            reader, writer, _meta = await self.manager.open_stream(peer_id)
+        except (OSError, KeyError) as e:
+            self._emit(pairing_id, {"Error": f"connect failed: {e}"})
+            return
+        try:
+            writer.write(Header.pair().to_bytes())
+            # 1. mint this node's instance for the future mirrored library
+            identity = Identity()
+            instance_pub_id = str(uuid.uuid4())
+            cfg = node.config.get()
+            now = dt.datetime.now(dt.timezone.utc).isoformat()
+            self._emit(pairing_id, "PairingRequested")
+            writer.write(json_frame({"instance": {
+                "pub_id": instance_pub_id,
+                "identity": "R:" + identity.to_remote_identity().encode(),
+                "node_id": cfg["id"], "node_name": cfg["name"],
+                "node_platform": cfg["platform"],
+                "last_seen": now, "date_created": now}}))
+            await writer.drain()
+
+            # 2. responder's verdict
+            resp = await asyncio.wait_for(read_json(reader), RESPONSE_TIMEOUT)
+            if resp.get("decision") != "accepted":
+                self._emit(pairing_id, "PairingRejected")
+                return
+            library_id = resp["library_id"]
+            self._emit(pairing_id, {"PairingInProgress": {
+                "library_name": resp["library_name"],
+                "library_description": resp.get("library_description", "")}})
+            if any(lib.id == library_id for lib in node.libraries.list()):
+                self._emit(pairing_id, "LibraryAlreadyExists")
+                return
+
+            # 3. mirror the library (create_with_uuid, manager/mod.rs)
+            loop = asyncio.get_running_loop()
+            library = await loop.run_in_executor(
+                None, lambda: node.libraries.create(
+                    resp["library_name"],
+                    description=resp.get("library_description", ""),
+                    lib_id=library_id,
+                    instance_pub_id=instance_pub_id,
+                    instance_identity=encode_identity(identity)))
+            for inst in resp.get("instances", []):
+                if inst["pub_id"] == instance_pub_id:
+                    continue
+                await loop.run_in_executor(
+                    None, library.add_remote_instance, _parse_instance(inst))
+            node.libraries.notify_instances_modified(library)
+            self._emit(pairing_id, {"PairingComplete": library_id})
+
+            # 4. both sides resync; ours announces (empty) state so the
+            # responder learns our instance is live, and its originate pushes
+            # the real data back to us
+            await self.manager.nlm.originate(library)
+        except (OSError, asyncio.TimeoutError) as e:
+            self._emit(pairing_id, {"Error": str(e)})
+        finally:
+            writer.close()
+
+    # -- responder -----------------------------------------------------------
+    async def responder(self, reader, writer, peer: "Peer") -> None:
+        node = self.manager.node
+        req = await read_json(reader)
+        inst = req["instance"]
+        pairing_id = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[pairing_id] = fut
+        self.manager.emit({"type": "PairingRequest", "id": pairing_id,
+                           "identity": peer.identity,
+                           "name": inst.get("node_name", "?")})
+
+        auto = node.config.get().get("p2p_auto_accept_library")
+        if auto:
+            fut.set_result({"accept": auto})
+        try:
+            decision = await asyncio.wait_for(fut, DECISION_TIMEOUT)
+        except asyncio.TimeoutError:
+            decision = None
+        finally:
+            self._pending.pop(pairing_id, None)
+
+        library_id = (decision or {}).get("accept") if isinstance(decision, dict) else None
+        if not library_id:
+            writer.write(json_frame({"decision": "rejected"}))
+            await writer.drain()
+            self._emit(pairing_id, "PairingRejected")
+            return
+        try:
+            library = node.libraries.get(library_id)
+        except KeyError:
+            writer.write(json_frame({"decision": "rejected"}))
+            await writer.drain()
+            self._emit(pairing_id, {"Error": f"library {library_id} not loaded"})
+            return
+
+        loop = asyncio.get_running_loop()
+        row = _parse_instance(inst)
+        # the membership anchor is the HANDSHAKE-proven node identity, not
+        # anything the request claims
+        row["node_remote_identity"] = peer.identity
+        await loop.run_in_executor(None, library.add_remote_instance, row)
+        node.libraries.notify_instances_modified(library)
+
+        from ..models import Instance
+
+        rows = await loop.run_in_executor(None, library.db.find, Instance)
+        instances = []
+        for row in rows:
+            try:
+                instances.append(_instance_wire(row))
+            except ValueError:
+                continue  # placeholder identity (pre-p2p library)
+        writer.write(json_frame({
+            "decision": "accepted", "library_id": library.id,
+            "library_name": library.name,
+            "library_description": library.config.get("description", ""),
+            "instances": instances}))
+        await writer.drain()
+        self._emit(pairing_id, {"PairingComplete": library.id})
+        # push our data to the (new) peer as soon as it finishes mirroring
+        self.manager.schedule(self._originate_soon(library))
+
+    async def _originate_soon(self, library) -> None:
+        await asyncio.sleep(0.5)  # let the originator finish creating the mirror
+        await self.manager.nlm.originate(library)
+
+
+def _parse_instance(inst: dict[str, Any]) -> dict[str, Any]:
+    row = dict(inst)
+    for key in ("last_seen", "date_created"):
+        if isinstance(row.get(key), str):
+            row[key] = dt.datetime.fromisoformat(row[key])
+    row.setdefault("timestamp", 0)
+    return row

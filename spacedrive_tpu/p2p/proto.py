@@ -1,0 +1,229 @@
+"""Wire protocol: framing, headers, sync-session and spaceblock messages.
+
+Message surface mirrors the reference's:
+
+- ``Header`` discriminators follow core/src/p2p/protocol.rs:13-27
+  (0=Spacedrop, 1=Ping, 2=Pair, 3=Sync, 4=File, 5=Connected);
+- sync sessions speak ``SyncMessage::NewOperations`` (core/src/p2p/sync/
+  proto.rs), then a responder-driven ``MainRequest::GetOperations(GetOpsArgs)``
+  / ``Operations`` pull loop (core/src/p2p/sync/mod.rs:257-440);
+- spaceblock messages (Block/Cancelled) per crates/p2p/src/spaceblock/mod.rs.
+
+Encoding is deliberately simple and debuggable: a 1-byte discriminator where
+the reference has one, and u32-length-prefixed JSON frames for structured
+payloads (the CRDT ops are already JSON-shaped on our wire; rmp adds nothing
+on a LAN control plane). Block payloads are raw bytes after a fixed header —
+never JSON.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+MAX_FRAME = 64 << 20  # defensive bound for a control-plane frame
+
+
+class ProtocolError(Exception):
+    pass
+
+
+# -- framing -----------------------------------------------------------------
+
+async def read_exact(reader: asyncio.StreamReader, n: int) -> bytes:
+    try:
+        return await reader.readexactly(n)
+    except asyncio.IncompleteReadError as e:
+        raise ProtocolError(f"stream closed mid-read ({len(e.partial)}/{n})") from e
+
+
+async def read_frame(reader: asyncio.StreamReader) -> bytes:
+    n = int.from_bytes(await read_exact(reader, 4), "big")
+    if n > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {n}")
+    return await read_exact(reader, n)
+
+
+def frame(payload: bytes) -> bytes:
+    return len(payload).to_bytes(4, "big") + payload
+
+
+async def read_json(reader: asyncio.StreamReader) -> Any:
+    return json.loads((await read_frame(reader)).decode())
+
+
+def json_frame(obj: Any) -> bytes:
+    return frame(json.dumps(obj, separators=(",", ":")).encode())
+
+
+# -- spaceblock requests -----------------------------------------------------
+
+BLOCK_SIZES = tuple(1 << p for p in range(10, 28))  # 1KiB..128MiB
+
+
+def block_size_for(file_size: int) -> int:
+    """Power-of-two block size scaled to the transfer (block_size.rs:
+    from_size). Small files move in one block; big ones in 128KiB+ blocks
+    so progress events stay frequent without drowning in framing."""
+    for size in BLOCK_SIZES:
+        if file_size <= size * 256:
+            return size
+    return BLOCK_SIZES[-1]
+
+
+@dataclass(frozen=True)
+class Range:
+    """Full file or byte sub-range [start, end) (spaceblock sb_request Range)."""
+
+    start: int = 0
+    end: int | None = None  # None = to EOF
+
+    def to_wire(self) -> list:
+        return [self.start, self.end]
+
+    @classmethod
+    def from_wire(cls, v: Any) -> "Range":
+        if not v:
+            return cls()
+        return cls(int(v[0]), None if v[1] is None else int(v[1]))
+
+
+@dataclass(frozen=True)
+class SpaceblockRequest:
+    """Offer/request metadata preceding a block transfer
+    (spaceblock/sb_request.rs)."""
+
+    name: str
+    size: int
+    block_size: int
+    range: Range = field(default_factory=Range)
+
+    def to_wire(self) -> dict:
+        return {"name": self.name, "size": self.size,
+                "block_size": self.block_size, "range": self.range.to_wire()}
+
+    @classmethod
+    def from_wire(cls, v: dict) -> "SpaceblockRequest":
+        return cls(str(v["name"]), int(v["size"]), int(v["block_size"]),
+                   Range.from_wire(v.get("range")))
+
+
+# -- headers (protocol.rs:13-27) --------------------------------------------
+
+H_SPACEDROP = 0
+H_PING = 1
+H_PAIR = 2
+H_SYNC = 3
+H_FILE = 4
+H_CONNECTED = 5
+
+
+@dataclass(frozen=True)
+class Header:
+    kind: int
+    payload: Any = None  # kind-specific
+
+    # constructors ---------------------------------------------------------
+    @classmethod
+    def ping(cls) -> "Header":
+        return cls(H_PING)
+
+    @classmethod
+    def pair(cls) -> "Header":
+        return cls(H_PAIR)
+
+    @classmethod
+    def sync(cls, library_id: str) -> "Header":
+        return cls(H_SYNC, library_id)
+
+    @classmethod
+    def spacedrop(cls, req: SpaceblockRequest) -> "Header":
+        return cls(H_SPACEDROP, req)
+
+    @classmethod
+    def file(cls, library_id: str, file_path_pub_id: str, rng: Range) -> "Header":
+        return cls(H_FILE, {"library_id": library_id,
+                            "file_path_pub_id": file_path_pub_id,
+                            "range": rng.to_wire()})
+
+    @classmethod
+    def connected(cls, identities: list[str]) -> "Header":
+        return cls(H_CONNECTED, identities)
+
+    # wire -----------------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        b = bytes([self.kind])
+        if self.kind == H_PING:
+            return b
+        if self.kind == H_PAIR:
+            return b
+        if self.kind == H_SYNC:
+            return b + json_frame(self.payload)
+        if self.kind == H_SPACEDROP:
+            return b + json_frame(self.payload.to_wire())
+        if self.kind in (H_FILE, H_CONNECTED):
+            return b + json_frame(self.payload)
+        raise ProtocolError(f"unknown header kind {self.kind}")
+
+    @classmethod
+    async def from_stream(cls, reader: asyncio.StreamReader) -> "Header":
+        kind = (await read_exact(reader, 1))[0]
+        if kind in (H_PING, H_PAIR):
+            return cls(kind)
+        if kind == H_SYNC:
+            return cls(kind, str(await read_json(reader)))
+        if kind == H_SPACEDROP:
+            return cls(kind, SpaceblockRequest.from_wire(await read_json(reader)))
+        if kind in (H_FILE, H_CONNECTED):
+            return cls(kind, await read_json(reader))
+        raise ProtocolError(f"invalid header discriminator {kind}")
+
+
+# -- sync session messages ---------------------------------------------------
+
+SYNC_NEW_OPERATIONS = b"N"  # SyncMessage::NewOperations (sync/proto.rs)
+
+
+def main_request_get_operations(clocks: dict[str, int], count: int) -> bytes:
+    """Responder → originator: GetOpsArgs pull (sync/mod.rs responder loop)."""
+    return json_frame({"req": "get_ops", "clocks": clocks, "count": count})
+
+
+def main_request_done() -> bytes:
+    return json_frame({"req": "done"})
+
+
+def operations_frame(ops: list[dict], has_more: bool) -> bytes:
+    """Originator → responder: one batch of wire ops."""
+    return json_frame({"ops": ops, "has_more": has_more})
+
+
+# -- spaceblock stream messages ---------------------------------------------
+
+MSG_BLOCK = 0
+MSG_CANCELLED = 1
+
+
+def block_msg(offset: int, data: bytes) -> bytes:
+    return (bytes([MSG_BLOCK]) + offset.to_bytes(8, "big")
+            + len(data).to_bytes(4, "big") + data)
+
+
+def cancel_msg() -> bytes:
+    return bytes([MSG_CANCELLED])
+
+
+async def read_block_msg(reader: asyncio.StreamReader) -> tuple[int, bytes] | None:
+    """Returns (offset, data) or None for Cancelled."""
+    kind = (await read_exact(reader, 1))[0]
+    if kind == MSG_CANCELLED:
+        return None
+    if kind != MSG_BLOCK:
+        raise ProtocolError(f"invalid spaceblock discriminator {kind}")
+    offset = int.from_bytes(await read_exact(reader, 8), "big")
+    n = int.from_bytes(await read_exact(reader, 4), "big")
+    if n > MAX_FRAME:
+        raise ProtocolError(f"block too large: {n}")
+    return offset, await read_exact(reader, n)

