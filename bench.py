@@ -1,20 +1,26 @@
 #!/usr/bin/env python
 """Headline benchmark. Prints ONE JSON line {metric, value, unit, vs_baseline}.
 
-Default mode (``SD_BENCH_MODE=dedup``): MinHash near-duplicate detection —
-BASELINE.json config 4. Signatures for N objects (the ones the identify pass
-computes on-device for free, ops/minhash.py) are swept all-pairs on the TPU
-vs the identical blocked-numpy algorithm on CPU; pair sets must match
-exactly before timing counts. This is the TPU-native capability the
-reference lacks entirely (its dedup is exact-cas_id only).
+Default mode (``combined``): the dedup headline plus the north-star identify
+record in ``extra``.
+
+``SD_BENCH_MODE=dedup``: MinHash near-duplicate detection — BASELINE.json
+config 4. Signatures for N objects (the ones the identify pass computes
+on-device for free, ops/minhash.py) are swept all-pairs on the TPU vs the
+identical blocked-numpy algorithm on CPU; pair sets must match exactly
+before timing counts. This is the TPU-native capability the reference lacks
+entirely (its dedup is exact-cas_id only).
 
 ``SD_BENCH_MODE=identify``: the file_identifier cas_id path (north-star
-files/sec, BASELINE configs 1-3) — native C++ BLAKE3 on all host cores vs
-the JAX kernel pipeline. NOTE: on the tunneled single-chip harness this is
-wire-limited (~50 MB/s H2D for incompressible data, measured), which caps
-any device-side content hash at ~0.1x the 1-core native baseline; the same
-pipeline on a local-PCIe TPU host is transfer-free by comparison. The dedup
-metric above is the honest accelerator headline on this harness.
+files/sec, BASELINE configs 1-3) — the production HybridHasher vs the
+native-CPU baseline, identical cas_ids enforced. The hybrid probes both
+engines and routes adaptively: on this tunneled single-chip harness H2D is
+wire-limited (~50 MB/s for incompressible data) and device transfers
+collapse ~100x under concurrent CPU load (relay starvation on the single
+host core, measured 0.4s vs 39.7s per 128-file chunk), so sampled work
+routes to the native engine and the hybrid matches/beats the baseline; on
+a local-PCIe TPU host the same probe engages the device. The dedup metric
+is the honest accelerator headline on this harness.
 """
 
 from __future__ import annotations
@@ -26,7 +32,7 @@ import tempfile
 import time
 from pathlib import Path
 
-MODE = os.environ.get("SD_BENCH_MODE", "dedup")
+MODE = os.environ.get("SD_BENCH_MODE", "combined")
 REPEATS = int(os.environ.get("SD_BENCH_REPEATS", "3"))
 
 
@@ -92,9 +98,15 @@ def bench_dedup() -> dict:
 
 
 def bench_identify() -> dict:
+    """North-star config 1-3: file_identifier files/sec vs the native-CPU
+    baseline, using the production HybridHasher (adaptive engine routing).
+    On the tunneled 1-core harness the probe routes sampled work to the
+    native engine (device H2D is wire-limited and collapses further under
+    concurrent CPU load), so the hybrid matches the best engine available;
+    on a local-PCIe TPU host the same code engages the device."""
     import numpy as np
 
-    from spacedrive_tpu.objects.hasher import CpuHasher, TpuHasher
+    from spacedrive_tpu.objects.hasher import CpuHasher, HybridHasher
 
     n_files = int(os.environ.get("SD_BENCH_FILES", "2048"))
     file_size = int(os.environ.get("SD_BENCH_FILE_SIZE", str(192 * 1024)))
@@ -110,22 +122,30 @@ def bench_identify() -> dict:
 
     cpu = CpuHasher()
     cpu_t, cpu_ids = time_best(lambda: cpu.hash_batch(paths, sizes), REPEATS)
-    tpu = TpuHasher()
-    tpu.hash_batch(paths, sizes)  # warmup
-    tpu_t, tpu_ids = time_best(lambda: tpu.hash_batch(paths, sizes), REPEATS)
-    if cpu_ids != tpu_ids:
+    hy = HybridHasher()
+    hy.hash_batch(paths, sizes)  # warmup: compiles kernels + runs the probe
+    hy_t, hy_ids = time_best(lambda: hy.hash_batch(paths, sizes), REPEATS)
+    if cpu_ids != hy_ids:
         print("FATAL: cas_id mismatch", file=sys.stderr)
         sys.exit(1)
+    print(f"info: identify {n_files} files, cpu {cpu_t:.3f}s "
+          f"hybrid {hy_t:.3f}s", file=sys.stderr)
     return {
         "metric": f"file_identifier_files_per_sec[{n_files}x{file_size >> 10}KiB]",
-        "value": round(n_files / tpu_t, 1),
+        "value": round(n_files / hy_t, 1),
         "unit": "files/sec",
-        "vs_baseline": round(cpu_t / tpu_t, 3),
+        "vs_baseline": round(cpu_t / hy_t, 3),
     }
 
 
 def main() -> int:
-    record = bench_dedup() if MODE == "dedup" else bench_identify()
+    if MODE == "dedup":
+        record = bench_dedup()
+    elif MODE == "identify":
+        record = bench_identify()
+    else:  # combined (default): dedup headline + north-star identify record
+        record = bench_dedup()
+        record["extra"] = [bench_identify()]
     print(json.dumps(record))
     return 0
 

@@ -177,19 +177,61 @@ class TpuHasher:
 
 
 class HybridHasher:
-    """Heterogeneous executor: native CPU threads and the TPU pipeline pull
-    chunks from one work queue until it drains (work-stealing, so the split
-    adapts to whichever engine is faster on this host). The reference has a
-    single engine (CPU join_all); on a TPU host both engines are throughput
-    and the host core is the contended resource — stealing balances it."""
+    """Adaptive heterogeneous executor over the native-CPU and TPU engines.
+
+    On first use it probes each engine's solo throughput on real work (the
+    results are kept, not discarded). The device engine is engaged only when
+    its measured rate beats the CPU's — then sampled chunks are work-stolen
+    from one queue with a tail guard so the slower engine's last chunk never
+    dominates the makespan. When the device loses the probe (e.g. this
+    harness: tunneled H2D is wire-limited AND device transfers collapse
+    ~100x under concurrent CPU load because the relay starves for the single
+    host core — measured 0.4s/chunk solo vs 39.7s under load), ALL sampled
+    work routes to the native path, so hybrid throughput equals the best
+    available engine by construction instead of losing to contention.
+
+    The reference has a single engine (CPU join_all, file_identifier/
+    mod.rs:107-134); this seam is where a local-PCIe TPU host gets its
+    speedup without any config change."""
 
     name = "hybrid"
 
-    CHUNK = 1024
+    #: steal unit: small enough that the slower engine's last chunk can't
+    #: dominate the makespan, large enough to amortize a device dispatch
+    CHUNK = 128
+    #: files used for the one-time engine rate probe
+    PROBE = 64
 
     def __init__(self) -> None:
         self._tpu = TpuHasher()
         self._cpu = CpuHasher()
+        self._cpu_rate: float | None = None
+        self._device_rate: float | None = None
+
+    def _probe_rates(self, paths, sizes, sampled: list[int], out: list) -> list[int]:
+        """Measure both engines on leading slices of the real workload;
+        returns the still-unhashed indices."""
+        import time as _time
+
+        k = min(self.PROBE, len(sampled) // 2)
+        if k < 8:  # too little work to probe — native path is the safe bet
+            self._cpu_rate, self._device_rate = 1.0, 0.0
+            return sampled
+        cpu_part, dev_part, rest = sampled[:k], sampled[k:2 * k], sampled[2 * k:]
+        t0 = _time.perf_counter()
+        res = self._cpu.hash_batch([paths[i] for i in cpu_part],
+                                   [sizes[i] for i in cpu_part])
+        self._cpu_rate = k / max(1e-9, _time.perf_counter() - t0)
+        for i, r in zip(cpu_part, res):
+            out[i] = r
+        t0 = _time.perf_counter()
+        self._tpu._hash_sampled(paths, sizes, dev_part, out)
+        self._device_rate = k / max(1e-9, _time.perf_counter() - t0)
+        logger.info("hybrid probe: cpu %.0f files/s, device %.0f files/s — %s",
+                    self._cpu_rate, self._device_rate,
+                    "engaging device" if self._device_rate > self._cpu_rate
+                    else "routing to native CPU")
+        return rest
 
     def hash_batch(self, paths: list[str | Path], sizes: list[int]) -> list[str | Exception]:
         import queue as _q
@@ -213,6 +255,18 @@ class HybridHasher:
             self._tpu._hash_sampled(paths, sizes, sampled, out)
             return out
 
+        if self._cpu_rate is None:
+            sampled = self._probe_rates(paths, sizes, sampled, out)
+            if not sampled:
+                return out
+
+        if self._device_rate <= self._cpu_rate:
+            res = self._cpu.hash_batch([paths[i] for i in sampled],
+                                       [sizes[i] for i in sampled])
+            for i, r in zip(sampled, res):
+                out[i] = r
+            return out
+
         work: _q.Queue[list[int]] = _q.Queue()
         for start in range(0, len(sampled), self.CHUNK):
             work.put(sampled[start : start + self.CHUNK])
@@ -230,6 +284,10 @@ class HybridHasher:
 
         def tpu_worker():
             while True:
+                # tail guard: the slower engine never takes one of the last
+                # chunks — its chunk latency would become the makespan
+                if work.qsize() < 2:
+                    return
                 try:
                     idxs = work.get_nowait()
                 except _q.Empty:

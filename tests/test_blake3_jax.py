@@ -64,3 +64,34 @@ def test_pack_messages_layout():
     assert words[0, 0, 0, 1] == 0xFFFFFFFF
     with pytest.raises(ValueError):
         blake3_jax.pack_messages([b"x" * 2000], 1)
+
+
+def test_hybrid_hasher_adaptive_routing(tmp_path):
+    """HybridHasher: byte-exact results across the probe and both routing
+    outcomes; forcing the device-rate verdict either way must not change
+    correctness."""
+    import random
+
+    from spacedrive_tpu.objects.cas import generate_cas_id
+    from spacedrive_tpu.objects.hasher import HybridHasher
+
+    rng = random.Random(9)
+    paths, sizes = [], []
+    for i in range(40):
+        size = rng.choice([500, 50_000, 150_000, 200_000])
+        p = tmp_path / f"h{i}.bin"
+        p.write_bytes(rng.randbytes(size))
+        paths.append(str(p))
+        sizes.append(size)
+    expect = [generate_cas_id(p, s) for p, s in zip(paths, sizes)]
+
+    hy = HybridHasher()
+    got = hy.hash_batch(paths, sizes)  # runs the probe inline
+    assert got == expect
+    assert hy._cpu_rate is not None and hy._device_rate is not None
+
+    # force both verdicts and re-hash
+    hy._device_rate = 0.0
+    assert hy.hash_batch(paths, sizes) == expect
+    hy._device_rate = hy._cpu_rate * 10
+    assert hy.hash_batch(paths, sizes) == expect
