@@ -65,7 +65,10 @@ class FileIdentifierJob(StatefulJob):
         logger.info("Found %d orphan file paths", count)
         steps = [{"kind": "identify"} for _ in range(-(-count // BATCH_SIZE))]
         data = {"location_id": location_id, "location_path": location["path"],
-                "hasher": location.get("hasher") or "tpu", "cursor": 0,
+                # hybrid probes both engines and routes to the winner, so a
+                # production scan never takes a known-losing path on hosts
+                # where transfers are slow (the bench measures both regimes)
+                "hasher": location.get("hasher") or "hybrid", "cursor": 0,
                 "sub_path": self.init_args.get("sub_path")}
         return data, steps, {"total_orphan_paths": count, "created_objects": 0,
                              "linked_objects": 0, "hash_time": 0.0}

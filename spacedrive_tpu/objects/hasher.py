@@ -208,22 +208,29 @@ class HybridHasher:
         self._cpu_rate: float | None = None
         self._device_rate: float | None = None
 
-    def _probe_rates(self, paths, sizes, sampled: list[int], out: list) -> list[int]:
+    def _cpu_into(self, paths, sizes, idxs: list[int], out: list) -> None:
+        """Native-CPU hash ``idxs`` and scatter results into ``out``."""
+        res = self._cpu.hash_batch([paths[i] for i in idxs],
+                                   [sizes[i] for i in idxs])
+        for i, r in zip(idxs, res):
+            out[i] = r
+
+    def _probe_rates(self, paths, sizes, sampled: list[int],
+                     out: list) -> list[int] | None:
         """Measure both engines on leading slices of the real workload;
-        returns the still-unhashed indices."""
+        returns the still-unhashed indices — or None when the batch is too
+        small to measure anything (rates stay unset so a real batch
+        re-probes; the process-wide hasher must not pin itself to
+        placeholder rates off a tiny first batch)."""
         import time as _time
 
         k = min(self.PROBE, len(sampled) // 2)
-        if k < 8:  # too little work to probe — native path is the safe bet
-            self._cpu_rate, self._device_rate = 1.0, 0.0
-            return sampled
+        if k < 8:
+            return None
         cpu_part, dev_part, rest = sampled[:k], sampled[k:2 * k], sampled[2 * k:]
         t0 = _time.perf_counter()
-        res = self._cpu.hash_batch([paths[i] for i in cpu_part],
-                                   [sizes[i] for i in cpu_part])
+        self._cpu_into(paths, sizes, cpu_part, out)
         self._cpu_rate = k / max(1e-9, _time.perf_counter() - t0)
-        for i, r in zip(cpu_part, res):
-            out[i] = r
         t0 = _time.perf_counter()
         self._tpu._hash_sampled(paths, sizes, dev_part, out)
         self._device_rate = k / max(1e-9, _time.perf_counter() - t0)
@@ -244,10 +251,7 @@ class HybridHasher:
         sampled = [i for i, s in enumerate(sizes) if s > MINIMUM_FILE_SIZE]
         small = [i for i, s in enumerate(sizes) if s <= MINIMUM_FILE_SIZE]
         if small:  # small files: native CPU batch (IO-bound, not worth device)
-            res = self._cpu.hash_batch([paths[i] for i in small],
-                                       [sizes[i] for i in small])
-            for i, r in zip(small, res):
-                out[i] = r
+            self._cpu_into(paths, sizes, small, out)
 
         if not sampled:
             return out
@@ -256,38 +260,38 @@ class HybridHasher:
             return out
 
         if self._cpu_rate is None:
-            sampled = self._probe_rates(paths, sizes, sampled, out)
+            rest = self._probe_rates(paths, sizes, sampled, out)
+            if rest is None:  # too small to probe — CPU for THIS batch only
+                self._cpu_into(paths, sizes, sampled, out)
+                return out
+            sampled = rest
             if not sampled:
                 return out
 
         if self._device_rate <= self._cpu_rate:
-            res = self._cpu.hash_batch([paths[i] for i in sampled],
-                                       [sizes[i] for i in sampled])
-            for i, r in zip(sampled, res):
-                out[i] = r
+            self._cpu_into(paths, sizes, sampled, out)
             return out
 
         work: _q.Queue[list[int]] = _q.Queue()
         for start in range(0, len(sampled), self.CHUNK):
             work.put(sampled[start : start + self.CHUNK])
 
+        # this branch only runs when the device won the probe, so the CPU is
+        # the slower engine here — the tail guard (slower engine never takes
+        # one of the last chunks, or its chunk latency becomes the makespan)
+        # belongs on the CPU worker
         def cpu_worker():
             while True:
+                if work.qsize() < 2:
+                    return
                 try:
                     idxs = work.get_nowait()
                 except _q.Empty:
                     return
-                res = self._cpu.hash_batch([paths[i] for i in idxs],
-                                           [sizes[i] for i in idxs])
-                for i, r in zip(idxs, res):
-                    out[i] = r
+                self._cpu_into(paths, sizes, idxs, out)
 
         def tpu_worker():
             while True:
-                # tail guard: the slower engine never takes one of the last
-                # chunks — its chunk latency would become the makespan
-                if work.qsize() < 2:
-                    return
                 try:
                     idxs = work.get_nowait()
                 except _q.Empty:

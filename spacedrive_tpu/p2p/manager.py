@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import secrets
 import threading
 import time
 import uuid
@@ -35,6 +34,8 @@ from .identity import Identity, RemoteIdentity, remote_identity_of
 from .proto import (Header, H_FILE, H_PAIR, H_PING, H_SPACEDROP, H_SYNC,
                     ProtocolError, Range, SpaceblockRequest, block_size_for,
                     json_frame, read_block_msg, read_exact, read_json)
+from .secure import (SecureReader, SecureWriter, derive_session_keys,
+                     gen_ephemeral, transcript)
 from .spaceblock import receive_file, send_file
 
 if TYPE_CHECKING:
@@ -42,7 +43,7 @@ if TYPE_CHECKING:
 
 logger = logging.getLogger(__name__)
 
-MAGIC = b"SDP2"
+MAGIC = b"SDP3"  # bumped with the encrypted-AKE handshake (round 3)
 SPACEDROP_TIMEOUT = 60.0  # p2p_manager.rs:42-43
 HANDSHAKE_TIMEOUT = 20.0
 
@@ -216,36 +217,65 @@ class P2PManager:
             writer.close()
 
     # -- handshake -----------------------------------------------------------
+    # SIGMA-style authenticated key exchange; see secure.py's module
+    # docstring for the full protocol and its security argument. Every byte
+    # after the two ephemeral keys travels ChaCha20Poly1305-encrypted.
+
     async def _handshake_out(self, reader: asyncio.StreamReader,
-                             writer: asyncio.StreamWriter) -> dict[str, Any]:
-        nonce = secrets.token_bytes(32)
-        hello = {**self.metadata(), "nonce": nonce.hex()}
-        writer.write(MAGIC + json_frame(hello))
+                             writer: asyncio.StreamWriter,
+                             expected_identity: str | None = None):
+        eph, e_i = gen_ephemeral()
+        writer.write(MAGIC + e_i)
         await writer.drain()
-        resp = await read_json(reader)
-        peer_ident = RemoteIdentity.decode(resp["identity"])
-        if not peer_ident.verify(bytes.fromhex(resp["sig"]), nonce):
+        e_r = await read_exact(reader, 32)
+        k_i2r, k_r2i = derive_session_keys(eph, e_r, e_i, e_r)
+        sr, sw = SecureReader(reader, k_r2i), SecureWriter(writer, k_i2r)
+        auth = await read_json(sr)  # responder proves identity, nothing more
+        peer_ident = RemoteIdentity.decode(auth["identity"])
+        # pin: a discovery beacon may have planted this address for a known
+        # identity — if whoever answered is not that identity, bail before
+        # trusting anything it said
+        if expected_identity is not None and auth["identity"] != expected_identity:
+            raise ProtocolError("peer identity mismatch")
+        if not peer_ident.verify(bytes.fromhex(auth["sig"]),
+                                 transcript("resp", e_i, e_r, auth["identity"])):
             raise ProtocolError("peer failed challenge")
-        writer.write(json_frame({"sig": self.identity.sign(
-            bytes.fromhex(resp["nonce"])).hex()}))
-        await writer.drain()
-        return resp
+        my_ident = self.remote_identity.encode()
+        sw.write(json_frame({**self.metadata(), "sig": self.identity.sign(
+            transcript("init", e_i, e_r, my_ident, auth["identity"])).hex()}))
+        await sw.drain()
+        # responder metadata arrives only after it verified US — an
+        # anonymous prober can learn the responder's (public, beaconed)
+        # identity but not node names / library instance lists
+        meta = await read_json(sr)
+        return sr, sw, {**meta, "identity": auth["identity"]}
 
     async def _handshake_in(self, reader: asyncio.StreamReader,
-                            writer: asyncio.StreamWriter) -> dict[str, Any]:
+                            writer: asyncio.StreamWriter):
         if await read_exact(reader, 4) != MAGIC:
             raise ProtocolError("bad magic")
-        hello = await read_json(reader)
-        peer_ident = RemoteIdentity.decode(hello["identity"])
-        nonce = secrets.token_bytes(32)
-        writer.write(json_frame({**self.metadata(), "nonce": nonce.hex(),
-                                 "sig": self.identity.sign(
-                                     bytes.fromhex(hello["nonce"])).hex()}))
+        e_i = await read_exact(reader, 32)
+        eph, e_r = gen_ephemeral()
+        writer.write(e_r)
         await writer.drain()
-        fin = await read_json(reader)
-        if not peer_ident.verify(bytes.fromhex(fin["sig"]), nonce):
+        k_i2r, k_r2i = derive_session_keys(eph, e_i, e_i, e_r)
+        sr, sw = SecureReader(reader, k_i2r), SecureWriter(writer, k_r2i)
+        my_ident = self.remote_identity.encode()
+        # SIGMA-I ordering: prove identity first, disclose metadata only
+        # after the initiator's signature verifies — an anonymous prober
+        # must not harvest node names or per-library instance lists
+        sw.write(json_frame({"identity": my_ident, "sig": self.identity.sign(
+            transcript("resp", e_i, e_r, my_ident)).hex()}))
+        await sw.drain()
+        hello = await read_json(sr)
+        peer_ident = RemoteIdentity.decode(hello["identity"])
+        if not peer_ident.verify(bytes.fromhex(hello["sig"]),
+                                 transcript("init", e_i, e_r,
+                                            hello["identity"], my_ident)):
             raise ProtocolError("peer failed challenge")
-        return hello
+        sw.write(json_frame(self.metadata()))
+        await sw.drain()
+        return sr, sw, hello
 
     def _register_connected(self, meta: dict[str, Any], host: str) -> Peer:
         ident = meta["identity"]
@@ -274,25 +304,31 @@ class P2PManager:
             return host, int(port)
         raise KeyError(f"unknown peer {peer_id}")
 
-    async def _open_stream_addr(self, addr: tuple[str, int]):
+    async def _open_stream_addr(self, addr: tuple[str, int],
+                                expected_identity: str | None = None):
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(*addr), HANDSHAKE_TIMEOUT)
         try:
-            meta = await asyncio.wait_for(
-                self._handshake_out(reader, writer), HANDSHAKE_TIMEOUT)
+            sr, sw, meta = await asyncio.wait_for(
+                self._handshake_out(reader, writer, expected_identity),
+                HANDSHAKE_TIMEOUT)
         except Exception:
             writer.close()
             raise
         self._register_connected(meta, addr[0])
-        return reader, writer, meta
+        return sr, sw, meta
 
     async def open_stream(self, peer_id: str):
-        """(reader, writer, peer_metadata) — authenticated unicast stream
-        (the analogue of ``Manager::stream(peer_id)``, manager.rs). A failed
-        connect demotes a known peer so dead static peers don't stay
+        """(reader, writer, peer_metadata) — encrypted authenticated unicast
+        stream (the analogue of ``Manager::stream(peer_id)``, manager.rs). A
+        failed connect demotes a known peer so dead static peers don't stay
         Connected and stall every sync round."""
+        # a peer_id that is an identity (not host:port dialing) pins the
+        # handshake to that identity
+        expected = peer_id if peer_id in self.peers else None
         try:
-            return await self._open_stream_addr(self._resolve_addr(peer_id))
+            return await self._open_stream_addr(self._resolve_addr(peer_id),
+                                                expected)
         except (OSError, asyncio.TimeoutError, ProtocolError):
             peer = self.peers.get(peer_id)
             if peer is not None and peer.connected:
@@ -325,20 +361,20 @@ class P2PManager:
                              writer: asyncio.StreamWriter) -> None:
         host = writer.get_extra_info("peername", ("?", 0))[0]
         try:
-            meta = await asyncio.wait_for(
+            sr, sw, meta = await asyncio.wait_for(
                 self._handshake_in(reader, writer), HANDSHAKE_TIMEOUT)
             peer = self._register_connected(meta, host)
-            header = await Header.from_stream(reader)
+            header = await Header.from_stream(sr)
             if header.kind == H_PING:
                 pass  # handshake already refreshed metadata
             elif header.kind == H_PAIR:
-                await self.pairing.responder(reader, writer, peer)
+                await self.pairing.responder(sr, sw, peer)
             elif header.kind == H_SYNC:
-                await self.nlm.responder(reader, writer, header.payload, peer)
+                await self.nlm.responder(sr, sw, header.payload, peer)
             elif header.kind == H_SPACEDROP:
-                await self._spacedrop_receive(reader, writer, header.payload, peer)
+                await self._spacedrop_receive(sr, sw, header.payload, peer)
             elif header.kind == H_FILE:
-                await self._serve_file(reader, writer, header.payload, peer)
+                await self._serve_file(sr, sw, header.payload, peer)
             else:
                 logger.warning("unhandled header kind %s", header.kind)
         except (ProtocolError, asyncio.TimeoutError, OSError) as e:

@@ -70,15 +70,36 @@ class CRDTOperation:
 
     @classmethod
     def from_wire(cls, wire: dict[str, Any]) -> "CRDTOperation":
+        """Strict decode: ops arrive from remote peers, so every structural
+        assumption the ingest path relies on (string ids, int timestamp,
+        known tag, exact field set) is enforced here — a malformed op must
+        fail *at decode*, where ingest can skip it, not deep inside a DB
+        statement."""
+        if not isinstance(wire, dict) or not isinstance(wire.get("typ"), dict):
+            raise ValueError("op is not a tagged dict")
         body = dict(wire["typ"])
-        kind = body.pop("_t")
+        tag = body.pop("_t", None)
         typ: SharedOp | RelationOp
-        if kind == "shared":
+        if tag == "shared":
             typ = SharedOp(**body)
-        else:
+            if not isinstance(typ.model, str):
+                raise ValueError("shared op model must be a string")
+        elif tag == "relation":
             typ = RelationOp(**body)
-        return cls(instance=wire["instance"], timestamp=wire["timestamp"],
-                   id=wire["id"], typ=typ)
+            if not isinstance(typ.relation, str):
+                raise ValueError("relation op relation must be a string")
+        else:
+            raise ValueError(f"unknown op tag {tag!r}")
+        if not isinstance(typ.kind, str) or not (
+                typ.kind in (CREATE, DELETE) or typ.kind.startswith(UPDATE_PREFIX)):
+            raise ValueError(f"unknown op kind {typ.kind!r}")
+        op = cls(instance=wire["instance"], timestamp=wire["timestamp"],
+                 id=wire["id"], typ=typ)
+        if not isinstance(op.instance, str) or not isinstance(op.id, str) \
+                or not isinstance(op.timestamp, int) \
+                or isinstance(op.timestamp, bool):
+            raise ValueError("op envelope fields have wrong types")
+        return op
 
 
 def new_op(instance: str, timestamp: int, typ: SharedOp | RelationOp) -> CRDTOperation:

@@ -187,29 +187,79 @@ class Ingester:
         sync = self.library.sync
         applied = 0
         seen_clocks: dict[str, int] = {}
+        # Dropped-op floor policy, by failure class:
+        #
+        # - TRANSIENT failures (savepoint rollback: DB error while logging)
+        #   cap the instance's floor below the failed op for the rest of the
+        #   batch — ops are timestamp-ordered, so a later successful op from
+        #   the same instance would otherwise push the floor past it and it
+        #   would never be re-pulled (lost, breaking convergence).
+        # - PERMANENT garbage (decode/validation failure) is dropped with no
+        #   cap: it can never apply anywhere, and pinning the floor below an
+        #   immutable bad op in the origin's log would stall that peer link
+        #   forever once more than one window of ops accumulates behind it.
+        #   A beyond-drift timestamp sorts after all sane ops anyway, so it
+        #   rides the window tail without blocking floor advancement.
+        poison_cap: dict[str, int] = {}
+
+        def _advance(instance: str, ts: int) -> None:
+            cap = poison_cap.get(instance)
+            if cap is not None:
+                ts = min(ts, cap)
+            if ts > seen_clocks.get(instance, 0):
+                seen_clocks[instance] = ts
+
+        def _poison(instance: Any, ts: Any) -> None:
+            if not isinstance(instance, str) or not isinstance(ts, int):
+                return  # unattributable — no floor movement for it at all
+            cap = min(poison_cap.get(instance, ts - 1), ts - 1)
+            poison_cap[instance] = cap
+            if seen_clocks.get(instance, 0) > cap:
+                seen_clocks[instance] = cap
+
         # NOTE on the raw SAVEPOINTs: db.transaction() holds the connection
         # RLock for the whole batch, so no other thread can interleave
         # statements between a savepoint and its release/rollback.
         with db.transaction():
             for wire in wire_ops:
-                op = CRDTOperation.from_wire(wire)
-                sync.clock.update(op.timestamp)
+                # decode + clock witness inside the skip guard: one malformed
+                # wire op (bad '_t', wrong key set, absurd timestamp) from a
+                # buggy or malicious member must not abort the batch and
+                # wedge the sync session forever
+                try:
+                    op = CRDTOperation.from_wire(wire)
+                except Exception as e:
+                    logger.warning("sync ingest dropped malformed op: %s", e)
+                    continue
+                if not sync.clock.update(op.timestamp):
+                    # beyond the drift bound (uhlc parity): deferred, not
+                    # lost — a skewed-but-honest peer's ops sort after all
+                    # sane ops, so they ride the window tail without
+                    # blocking floor advancement and apply once wall time
+                    # catches up. debug level: this repeats every round for
+                    # the duration of the skew.
+                    logger.debug("sync ingest deferred op %s: timestamp %d "
+                                 "beyond drift bound", op.id, op.timestamp)
+                    continue
                 if op.instance == sync.instance_pub_id:
                     continue  # our own op reflected back
                 if self._already_logged(op):
                     # duplicate delivery — already durable, safe to advance
-                    seen_clocks[op.instance] = max(
-                        seen_clocks.get(op.instance, 0), op.timestamp)
+                    _advance(op.instance, op.timestamp)
                     continue
                 # per-op savepoint: effect + log commit or roll back as a
                 # unit — an applied-but-unlogged op would be invisible to
                 # future arbitration and never propagate transitively
                 db.execute("SAVEPOINT ingest_op")
                 try:
-                    # the materialization may fail on its own (e.g. a field
-                    # this build doesn't know) — roll back just the effect
-                    # and still log the op, or it would never propagate
-                    # transitively through this node
+                    # ANY materialization failure — known (ApplyError) or
+                    # not (bad data shapes deep in SQL) — is deterministic in
+                    # the op's content, so retrying can never succeed: roll
+                    # back just the effect and still log the op, or it would
+                    # neither propagate transitively nor let the floor
+                    # advance past it (a permanent wedge). Only failures in
+                    # the logging infrastructure itself (below) are treated
+                    # as transient.
                     db.execute("SAVEPOINT ingest_effect")
                     try:
                         if isinstance(op.typ, SharedOp):
@@ -217,26 +267,28 @@ class Ingester:
                         else:
                             effect = self._apply_relation_convergent(op)
                         db.execute("RELEASE ingest_effect")
-                    except ApplyError as e:
+                    except Exception as e:
                         db.execute("ROLLBACK TO ingest_effect")
                         db.execute("RELEASE ingest_effect")
-                        logger.warning("sync op %s logged without effect: %s",
-                                       op.id, e)
+                        log = (logger.warning if isinstance(e, ApplyError)
+                               else logger.exception)
+                        log("sync op %s logged without effect: %s", op.id, e)
                         effect = False
                     self._ensure_instance(op.instance)
                     sync.log_ops([op])  # ALWAYS — the log is the CRDT state
                 except Exception:
                     # a single poison op must not abort the whole batch and
                     # leave the Actor re-pulling it forever; its clock floor
-                    # is NOT advanced, so it will be retried next round
+                    # is NOT advanced (and is capped below the poison op for
+                    # the rest of the batch), so it will be retried next round
                     db.execute("ROLLBACK TO ingest_op")
                     db.execute("RELEASE ingest_op")
+                    _poison(op.instance, op.timestamp)
                     logger.exception("sync ingest skipped poison op %s", op.id)
                     continue
                 db.execute("RELEASE ingest_op")
                 # advance the clock floor only once the op is durably logged
-                seen_clocks[op.instance] = max(seen_clocks.get(op.instance, 0),
-                                               op.timestamp)
+                _advance(op.instance, op.timestamp)
                 if effect:
                     applied += 1
             # persist per-origin clocks (ingest.rs:136-159)
@@ -279,8 +331,19 @@ class Actor:
             if item is None or self._stopped:
                 return
             try:
+                own = self.library.sync.instance_pub_id
+                prev_floors: dict | None = None
                 while True:
                     clocks = self.library.sync.timestamps()
+                    # progress = some REMOTE floor advanced; the own-instance
+                    # entry is the live HLC and moves on every local write
+                    floors = {k: v for k, v in clocks.items() if k != own}
+                    if floors == prev_floors:
+                        # every op in the window was skipped — the transport
+                        # would replay the identical batch forever
+                        logger.warning("ingest made no progress; ending round")
+                        break
+                    prev_floors = floors
                     ops, has_more = self.transport(clocks, self.batch)
                     if ops:
                         self.ingester.receive(ops)

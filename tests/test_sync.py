@@ -55,9 +55,23 @@ def test_hlc_monotonic_and_update():
     clock = HLC()
     ts = [clock.now() for _ in range(100)]
     assert ts == sorted(set(ts)), "HLC must be strictly monotonic"
-    future = ntp64(time.time() + 3600)
-    clock.update(future)
-    assert clock.now() > future, "witnessing a remote ts must advance the clock"
+    near_future = ntp64(time.time() + 60)  # within the drift bound
+    assert clock.update(near_future)
+    assert clock.now() > near_future, "witnessing a remote ts must advance the clock"
+
+
+def test_hlc_rejects_poisonous_timestamps():
+    """uhlc-style drift bound: a peer claiming a timestamp near 2^63 (or a
+    non-int) must not poison the library clock (ADVICE r2)."""
+    clock = HLC()
+    base = clock.now()
+    # NTP64 packs unix seconds in the high 32 bits, so "near 2^63" means
+    # year-2038+ — far beyond any honest drift
+    for bad in ((1 << 63) - 1, ntp64(time.time() + 7200), -5, 0, "1e18",
+                None, 1.5, True):
+        assert clock.update(bad) is False
+    assert clock.last < ntp64(time.time() + 120), "clock was poisoned"
+    assert clock.now() > base
 
 
 # -- shared ops --------------------------------------------------------------
@@ -355,3 +369,108 @@ def test_update_after_delete_rematerializes_everywhere(pair):
     assert Ingester(lib_b).receive([update.to_wire()]) == 1
     assert Ingester(lib_b).receive([delete.to_wire()]) == 0
     assert lib_b.db.find_one(Tag, {"pub_id": pub})["name"] == "kept"
+
+
+# -- ingest hardening (round-3 ADVICE fixes) ---------------------------------
+
+
+def test_malformed_wire_op_skipped_not_wedging(pair):
+    """One malformed op (bad '_t', junk types) in a batch must be skipped —
+    not abort the batch, not kill the session, not poison the clock — while
+    every well-formed op in the same batch still lands."""
+    lib_a, lib_b = pair
+    pub = "aaaaaaa1-0000-0000-0000-000000000000"
+    good1 = lib_a.sync.shared_create(Tag, pub, {"name": "first"})
+    good2 = lib_a.sync.shared_update(Tag, pub, "name", "second")
+    batch = [
+        good1.to_wire(),
+        {"instance": lib_a.sync.instance_pub_id, "timestamp": "NaN",
+         "id": 7, "typ": {"_t": "mystery"}},          # junk envelope
+        {"not": "even close"},                          # junk shape
+        good2.to_wire(),
+    ]
+    ing = Ingester(lib_b)
+    assert ing.receive(batch) == 2  # both good ops applied
+    assert lib_b.db.find_one(Tag, {"pub_id": pub})["name"] == "second"
+    # no absurd clock movement
+    assert lib_b.sync.clock.last < ntp64(time.time() + 120)
+
+
+def test_transient_poison_op_caps_clock_floor(pair):
+    """A TRANSIENTLY failing op (DB error during logging) must keep that
+    instance's clock floor below itself even when a LATER op from the same
+    instance lands in the same batch — otherwise the dropped op is never
+    re-pulled and convergence breaks. Once the failure clears, a re-pull
+    must apply it."""
+    lib_a, lib_b = pair
+    pub = "aaaaaaa2-0000-0000-0000-000000000000"
+    before = lib_a.sync.shared_create(Tag, pub, {"name": "pre"})
+    poisoned = lib_a.sync.shared_update(Tag, pub, "color", "#123456")
+    after = lib_a.sync.shared_update(Tag, pub, "name", "post")
+    batch = [before.to_wire(), poisoned.to_wire(), after.to_wire()]
+
+    # simulate a transient DB failure logging exactly the poisoned op
+    real_log_ops = lib_b.sync.log_ops
+
+    def flaky_log_ops(ops):
+        if any(o.id == poisoned.id for o in ops):
+            raise RuntimeError("simulated transient DB failure")
+        return real_log_ops(ops)
+
+    lib_b.sync.log_ops = flaky_log_ops
+    ing = Ingester(lib_b)
+    try:
+        ing.receive(batch)
+    finally:
+        lib_b.sync.log_ops = real_log_ops
+    # both good ops applied...
+    assert lib_b.db.find_one(Tag, {"pub_id": pub})["name"] == "post"
+    # ...but the floor for lib_a's instance stays below the poisoned op, so
+    # it is still inside the next pull window
+    floor = lib_b.sync.timestamps()[lib_a.sync.instance_pub_id]
+    assert floor < poisoned.timestamp, \
+        f"floor {floor} advanced past transient poison {poisoned.timestamp}"
+    # next round (failure cleared): the poisoned op applies, good ops dedup
+    assert ing.receive(batch) == 1
+    row = lib_b.db.find_one(Tag, {"pub_id": pub})
+    assert row["name"] == "post" and row["color"] == "#123456"
+    assert lib_b.sync.timestamps()[lib_a.sync.instance_pub_id] >= after.timestamp
+
+
+def test_permanently_malformed_op_does_not_stall_link(pair):
+    """A structurally-garbage op (can never decode anywhere) must NOT pin
+    the floor below itself — that would stall the peer link forever once a
+    window of ops accumulates behind the immutable bad op."""
+    lib_a, lib_b = pair
+    pub = "aaaaaaa4-0000-0000-0000-000000000000"
+    before = lib_a.sync.shared_create(Tag, pub, {"name": "pre"})
+    garbage_ts = lib_a.sync.clock.now()
+    after = lib_a.sync.shared_update(Tag, pub, "name", "post")
+    batch = [
+        before.to_wire(),
+        {"instance": lib_a.sync.instance_pub_id, "timestamp": garbage_ts,
+         "id": "broken", "typ": {"_t": "shared", "model": 42,
+                                 "record_id": pub, "kind": "c", "data": {}}},
+        after.to_wire(),
+    ]
+    ing = Ingester(lib_b)
+    ing.receive(batch)
+    assert lib_b.db.find_one(Tag, {"pub_id": pub})["name"] == "post"
+    # floor advanced past the garbage — the link keeps making progress
+    floor = lib_b.sync.timestamps()[lib_a.sync.instance_pub_id]
+    assert floor >= after.timestamp
+
+
+def test_absurd_timestamp_rejected_in_ingest(pair):
+    """An op claiming a timestamp near 2^62 is dropped at the door; the
+    library clock and the instance floor never witness it."""
+    lib_a, lib_b = pair
+    bad = lib_a.sync.shared_create(Tag, "aaaaaaa3-0000-0000-0000-000000000000",
+                                   {"name": "evil"})
+    wire = bad.to_wire()
+    wire["timestamp"] = (1 << 63) - 7  # "year 2106", would overflow i64 soon
+    ing = Ingester(lib_b)
+    assert ing.receive([wire]) == 0
+    assert lib_b.db.find_one(Tag, {"name": "evil"}) is None
+    assert lib_b.sync.clock.last < ntp64(time.time() + 120)
+    assert lib_b.sync.timestamps()[lib_a.sync.instance_pub_id] < 1 << 62
