@@ -230,10 +230,20 @@ class HybridHasher:
         cpu_part, dev_part, rest = sampled[:k], sampled[k:2 * k], sampled[2 * k:]
         t0 = _time.perf_counter()
         self._cpu_into(paths, sizes, cpu_part, out)
-        self._cpu_rate = k / max(1e-9, _time.perf_counter() - t0)
+        cpu_rate = k / max(1e-9, _time.perf_counter() - t0)
         t0 = _time.perf_counter()
-        self._tpu._hash_sampled(paths, sizes, dev_part, out)
-        self._device_rate = k / max(1e-9, _time.perf_counter() - t0)
+        try:
+            self._tpu._hash_sampled(paths, sizes, dev_part, out)
+            device_rate = k / max(1e-9, _time.perf_counter() - t0)
+        except Exception:
+            # a dying device must not leave half-set rates (permanently
+            # broken comparisons) — score it dead and finish on CPU
+            logger.exception("hybrid probe: device engine failed; "
+                             "routing everything to native CPU")
+            self._cpu_into(paths, sizes, dev_part, out)
+            device_rate = 0.0
+        # set both rates atomically only once both probes concluded
+        self._cpu_rate, self._device_rate = cpu_rate, device_rate
         logger.info("hybrid probe: cpu %.0f files/s, device %.0f files/s — %s",
                     self._cpu_rate, self._device_rate,
                     "engaging device" if self._device_rate > self._cpu_rate
@@ -296,7 +306,14 @@ class HybridHasher:
                     idxs = work.get_nowait()
                 except _q.Empty:
                     return
-                self._tpu._hash_sampled(paths, sizes, idxs, out)
+                try:
+                    self._tpu._hash_sampled(paths, sizes, idxs, out)
+                except Exception:
+                    # device died mid-batch: return the chunk to the queue
+                    # and stop stealing — the drain below finishes natively
+                    logger.exception("hybrid device worker failed mid-batch")
+                    work.put(idxs)
+                    return
 
         threads = [threading.Thread(target=cpu_worker, daemon=True),
                    threading.Thread(target=tpu_worker, daemon=True)]
@@ -304,6 +321,15 @@ class HybridHasher:
             t.start()
         for t in threads:
             t.join()
+        # drain of last resort: anything still queued (device died, CPU
+        # stopped at the tail guard) is hashed natively so every index gets
+        # a result — the list[str | Exception] contract allows no Nones
+        while True:
+            try:
+                idxs = work.get_nowait()
+            except _q.Empty:
+                break
+            self._cpu_into(paths, sizes, idxs, out)
         return out
 
 

@@ -198,27 +198,22 @@ class NetworkedLibraries:
 
         ingester = Ingester(library)
         loop = asyncio.get_running_loop()
-        own = library.sync.instance_pub_id
-        prev_floors: dict | None = None
         while True:
             clocks = await loop.run_in_executor(None, library.sync.timestamps)
-            # progress = some REMOTE floor advanced; the own-instance entry
-            # is the live HLC and moves on every concurrent local write
-            floors = {k: v for k, v in clocks.items() if k != own}
-            if floors == prev_floors:
-                # every op in the window was skipped (malformed / transient
-                # poison) — the peer would hand us the identical window
-                # forever; stop the session instead of hot-looping on it
-                logger.warning("sync session with %s made no progress; "
-                               "ending round", peer.identity[:12])
-                break
-            prev_floors = floors
             writer.write(main_request_get_operations(clocks, OPS_PER_REQUEST))
             await writer.drain()
             batch = await read_json(reader)
             ops = batch.get("ops") or []
             if ops:
                 await loop.run_in_executor(None, ingester.receive, ops)
+                if not ingester.last_floor_advanced:
+                    # every op in the window was skipped (malformed /
+                    # transient poison) — the peer would hand us the
+                    # identical window forever; stop the session instead
+                    # of hot-looping on it
+                    logger.warning("sync session with %s made no progress; "
+                                   "ending round", peer.identity[:12])
+                    break
             if not batch.get("has_more"):
                 break
         writer.write(main_request_done())

@@ -55,7 +55,7 @@ from .proto import ProtocolError
 RECORD_MAX = 64 * 1024          # plaintext bytes per record
 _CIPHERTEXT_MAX = RECORD_MAX + 16  # + poly1305 tag
 
-AKE_LABEL = b"SDP2-AKE1"
+AKE_LABEL = b"SDP3-AKE1"  # versioned with manager.py's wire MAGIC (SDP3)
 
 
 def gen_ephemeral() -> tuple[X25519PrivateKey, bytes]:

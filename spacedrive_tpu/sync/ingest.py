@@ -60,6 +60,11 @@ class Ingester:
 
     def __init__(self, library: "Library") -> None:
         self.library = library
+        #: whether the last receive() advanced any instance's clock floor —
+        #: the single source of truth the pull loops use to detect a stuck
+        #: window (a batch whose every op is skipped would otherwise be
+        #: re-pulled identically forever)
+        self.last_floor_advanced = False
 
     # -- history helpers -----------------------------------------------------
     def _history(self, t: SharedOp) -> list[dict[str, Any]]:
@@ -292,10 +297,12 @@ class Ingester:
                 if effect:
                     applied += 1
             # persist per-origin clocks (ingest.rs:136-159)
+            self.last_floor_advanced = False
             for pub_id, ts in seen_clocks.items():
                 row = db.find_one(Instance, {"pub_id": pub_id})
                 if row is not None and (row["timestamp"] or 0) < ts:
                     db.update(Instance, {"pub_id": pub_id}, {"timestamp": ts})
+                    self.last_floor_advanced = True
         if applied:
             sync._broadcast(SyncMessage.INGESTED)
         return applied
@@ -331,22 +338,18 @@ class Actor:
             if item is None or self._stopped:
                 return
             try:
-                own = self.library.sync.instance_pub_id
-                prev_floors: dict | None = None
                 while True:
                     clocks = self.library.sync.timestamps()
-                    # progress = some REMOTE floor advanced; the own-instance
-                    # entry is the live HLC and moves on every local write
-                    floors = {k: v for k, v in clocks.items() if k != own}
-                    if floors == prev_floors:
-                        # every op in the window was skipped — the transport
-                        # would replay the identical batch forever
-                        logger.warning("ingest made no progress; ending round")
-                        break
-                    prev_floors = floors
                     ops, has_more = self.transport(clocks, self.batch)
                     if ops:
                         self.ingester.receive(ops)
+                        if not self.ingester.last_floor_advanced:
+                            # every op in the window was skipped — the
+                            # transport would replay the identical batch
+                            # forever
+                            logger.warning("ingest made no progress; "
+                                           "ending round")
+                            break
                     if not has_more:
                         break
             except Exception:
