@@ -62,12 +62,11 @@ class LocationsActor:
         with self._lock:
             return sorted(loc for lib, loc in self._online if lib == library_id)
 
-    # watcher seam (locations/watcher.py milestone)
     def _start_watcher(self, library: "Library", location_id: int) -> None:
-        try:
-            from .watcher import LocationWatcher
-        except ImportError:
+        if not getattr(self.node, "watch_locations", True):
             return
+        from .watcher import LocationWatcher
+
         key = (library.id, location_id)
         with self._lock:
             if key in self._watchers:
@@ -77,6 +76,11 @@ class LocationsActor:
             except Exception as e:
                 logger.warning("watcher for location %s failed to start: %s",
                                location_id, e)
+
+    def watcher_for(self, library_id: str, location_id: int):
+        """fs jobs use this to mute their own writes (IgnorePath channel)."""
+        with self._lock:
+            return self._watchers.get((library_id, location_id))
 
     def _stop_watcher(self, key: tuple[str, int]) -> None:
         watcher = self._watchers.pop(key, None)

@@ -1,0 +1,685 @@
+"""Location watcher: live filesystem changes → DB + sync ops.
+
+Mirrors core/src/location/manager/watcher/ — the per-OS backend seam of
+mod.rs:32-39 (Linux here is raw inotify via ctypes; anything else, or an
+inotify failure, falls back to a polling backend emitting the same normalized
+event stream), the Linux event-handler debounce semantics of linux.rs
+(100ms update debounce, rename-cookie matching, 1s dangling-rename eviction),
+and the DB application helpers of utils.rs (create_dir :76, create_file :134,
+update_file :338, rename :606 incl. descendant rewrite, remove :698).
+
+Events that survive debouncing are applied inline on the watcher thread: the
+Database is single-writer-locked, matching the reference's discipline of
+funnelling watcher mutations through the library DB actor.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import dataclasses
+import errno
+import logging
+import os
+import select
+import struct
+import sys
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import TYPE_CHECKING, Any, Callable
+
+from ..models import FilePath, Location, utc_now
+from .paths import FilePathMetadata, IsolatedFilePathData
+from .rules import CompiledRules, rules_for_location
+
+if TYPE_CHECKING:
+    from ..library import Library
+
+logger = logging.getLogger(__name__)
+
+ONE_SECOND = 1.0      # dangling-rename eviction (watcher/mod.rs:46)
+HUNDRED_MILLIS = 0.1  # update debounce window (watcher/mod.rs:47)
+
+
+# ---------------------------------------------------------------------------
+# Normalized events (what every backend emits)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RawEvent:
+    kind: str            # create | modify | moved_from | moved_to | delete | overflow
+    path: str            # absolute path
+    is_dir: bool = False
+    cookie: int = 0      # links moved_from/moved_to pairs (inotify cookie)
+
+
+# ---------------------------------------------------------------------------
+# inotify backend (Linux)
+# ---------------------------------------------------------------------------
+
+IN_ACCESS = 0x0001
+IN_MODIFY = 0x0002
+IN_ATTRIB = 0x0004
+IN_CLOSE_WRITE = 0x0008
+IN_MOVED_FROM = 0x0040
+IN_MOVED_TO = 0x0080
+IN_CREATE = 0x0100
+IN_DELETE = 0x0200
+IN_DELETE_SELF = 0x0400
+IN_MOVE_SELF = 0x0800
+IN_Q_OVERFLOW = 0x4000
+IN_ISDIR = 0x40000000
+IN_ONLYDIR = 0x01000000
+
+_WATCH_MASK = (IN_CREATE | IN_MODIFY | IN_ATTRIB | IN_CLOSE_WRITE
+               | IN_MOVED_FROM | IN_MOVED_TO | IN_DELETE | IN_DELETE_SELF)
+
+_EVENT_HDR = struct.Struct("iIII")  # wd, mask, cookie, len
+
+
+class InotifyBackend:
+    """Raw inotify via libc. inotify watches are per-directory, so the backend
+    mirrors the directory tree into a wd↔path map, growing it as directories
+    appear and pruning on IN_DELETE_SELF/IN_IGNORED."""
+
+    def __init__(self, root: str) -> None:
+        libc_name = ctypes.util.find_library("c") or "libc.so.6"
+        self._libc = ctypes.CDLL(libc_name, use_errno=True)
+        self._fd = self._libc.inotify_init1(os.O_NONBLOCK)
+        if self._fd < 0:
+            raise OSError(ctypes.get_errno(), "inotify_init1 failed")
+        self._wd_to_path: dict[int, str] = {}
+        self._path_to_wd: dict[str, int] = {}
+        self._buf = b""
+        self.root = root
+        self._add_watch_recursive(root)
+
+    def _add_watch(self, path: str) -> None:
+        wd = self._libc.inotify_add_watch(
+            self._fd, os.fsencode(path), _WATCH_MASK | IN_ONLYDIR)
+        if wd < 0:
+            err = ctypes.get_errno()
+            if err not in (errno.ENOENT, errno.ENOTDIR):
+                logger.warning("inotify_add_watch(%s): %s", path, os.strerror(err))
+            return
+        old = self._wd_to_path.get(wd)
+        if old is not None:
+            self._path_to_wd.pop(old, None)
+        self._wd_to_path[wd] = path
+        self._path_to_wd[path] = wd
+
+    def _add_watch_recursive(self, path: str) -> None:
+        self._add_watch(path)
+        try:
+            with os.scandir(path) as it:
+                for entry in it:
+                    if entry.is_dir(follow_symlinks=False):
+                        self._add_watch_recursive(entry.path)
+        except OSError:
+            pass
+
+    def note_dir_moved(self, from_path: str, to_path: str) -> None:
+        """inotify wds follow inodes across renames; rebase our path map."""
+        prefix = from_path.rstrip("/") + "/"
+        for wd, path in list(self._wd_to_path.items()):
+            if path == from_path or path.startswith(prefix):
+                new = to_path + path[len(from_path):]
+                self._path_to_wd.pop(path, None)
+                self._wd_to_path[wd] = new
+                self._path_to_wd[new] = wd
+
+    def watch_new_dir(self, path: str) -> None:
+        self._add_watch_recursive(path)
+
+    def read(self, timeout: float) -> list[RawEvent]:
+        try:
+            ready, _, _ = select.select([self._fd], [], [], timeout)
+        except OSError:
+            return []
+        if not ready:
+            return []
+        try:
+            self._buf += os.read(self._fd, 65536)
+        except BlockingIOError:
+            return []
+        except OSError:
+            return []
+        events: list[RawEvent] = []
+        buf = self._buf
+        offset = 0
+        while offset + _EVENT_HDR.size <= len(buf):
+            wd, mask, cookie, name_len = _EVENT_HDR.unpack_from(buf, offset)
+            if offset + _EVENT_HDR.size + name_len > len(buf):
+                break
+            name = buf[offset + _EVENT_HDR.size: offset + _EVENT_HDR.size
+                       + name_len].rstrip(b"\x00").decode(errors="surrogateescape")
+            offset += _EVENT_HDR.size + name_len
+            if mask & IN_Q_OVERFLOW:
+                events.append(RawEvent("overflow", self.root))
+                continue
+            dir_path = self._wd_to_path.get(wd)
+            if dir_path is None:
+                continue
+            if mask & IN_DELETE_SELF:
+                self._path_to_wd.pop(dir_path, None)
+                self._wd_to_path.pop(wd, None)
+                continue
+            path = os.path.join(dir_path, name) if name else dir_path
+            is_dir = bool(mask & IN_ISDIR)
+            if mask & IN_CREATE:
+                events.append(RawEvent("create", path, is_dir, cookie))
+            elif mask & (IN_CLOSE_WRITE | IN_MODIFY | IN_ATTRIB):
+                events.append(RawEvent("modify", path, is_dir, cookie))
+            elif mask & IN_MOVED_FROM:
+                events.append(RawEvent("moved_from", path, is_dir, cookie))
+            elif mask & IN_MOVED_TO:
+                events.append(RawEvent("moved_to", path, is_dir, cookie))
+            elif mask & IN_DELETE:
+                events.append(RawEvent("delete", path, is_dir, cookie))
+        self._buf = buf[offset:]
+        return events
+
+    def close(self) -> None:
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Polling backend (fallback; also the deterministic backend for tests)
+# ---------------------------------------------------------------------------
+
+class PollingBackend:
+    """Periodic scandir snapshot diff emitting the same normalized stream.
+    Renames are recovered by inode identity between the vanished and the
+    appeared sets (the same trick the walker's DB diffing uses)."""
+
+    def __init__(self, root: str, interval: float = 0.5) -> None:
+        self.root = root
+        self.interval = interval
+        self._snapshot = self._scan()
+        self._last = time.monotonic()
+        self._cookie = 0
+
+    def _scan(self) -> dict[str, tuple[bool, float, int, int]]:
+        snap: dict[str, tuple[bool, float, int, int]] = {}
+        stack = [self.root]
+        while stack:
+            d = stack.pop()
+            try:
+                with os.scandir(d) as it:
+                    entries = list(it)
+            except OSError:
+                continue
+            for entry in entries:
+                try:
+                    if entry.is_symlink():
+                        continue
+                    is_dir = entry.is_dir(follow_symlinks=False)
+                    st = entry.stat(follow_symlinks=False)
+                except OSError:
+                    continue
+                snap[entry.path] = (is_dir, st.st_mtime, st.st_size, st.st_ino)
+                if is_dir:
+                    stack.append(entry.path)
+        return snap
+
+    def note_dir_moved(self, from_path: str, to_path: str) -> None:
+        pass
+
+    def watch_new_dir(self, path: str) -> None:
+        pass
+
+    def read(self, timeout: float) -> list[RawEvent]:
+        now = time.monotonic()
+        wait = min(timeout, max(0.0, self.interval - (now - self._last)))
+        if wait > 0:
+            time.sleep(wait)
+        if time.monotonic() - self._last < self.interval:
+            return []
+        self._last = time.monotonic()
+        new = self._scan()
+        old = self._snapshot
+        self._snapshot = new
+        events: list[RawEvent] = []
+        gone = {p: v for p, v in old.items() if p not in new}
+        appeared = {p: v for p, v in new.items() if p not in old}
+        # pair renames by inode
+        gone_by_ino = {v[3]: p for p, v in gone.items()}
+        for path, (is_dir, _, _, ino) in sorted(appeared.items()):
+            src = gone_by_ino.pop(ino, None)
+            if src is not None and gone[src][0] == is_dir:
+                self._cookie += 1
+                events.append(RawEvent("moved_from", src, is_dir, self._cookie))
+                events.append(RawEvent("moved_to", path, is_dir, self._cookie))
+                del gone[src]
+            else:
+                events.append(RawEvent("create", path, is_dir))
+        for path, (is_dir, *_rest) in sorted(gone.items()):
+            events.append(RawEvent("delete", path, is_dir))
+        for path, (is_dir, mtime, size, ino) in new.items():
+            if path in old and not is_dir:
+                o = old[path]
+                if o[1] != mtime or o[2] != size:
+                    events.append(RawEvent("modify", path, is_dir))
+        return events
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# DB application helpers (watcher/utils.rs semantics)
+# ---------------------------------------------------------------------------
+
+def _emit(library: "Library") -> tuple[Any, bool]:
+    sync = getattr(library, "sync", None)
+    return sync, sync is not None and getattr(sync, "emit_messages", False)
+
+
+def _row_for(library: "Library", location_id: int,
+             iso: IsolatedFilePathData) -> dict[str, Any] | None:
+    return library.db.find_one(FilePath, {
+        "location_id": location_id,
+        "materialized_path": iso.materialized_path,
+        "name": iso.name, "extension": iso.extension,
+    })
+
+
+def apply_create(library: "Library", location: dict[str, Any],
+                 rel_path: str, is_dir: bool) -> bool:
+    """create_dir/create_file (utils.rs:76,134): insert the row + sync op.
+    Returns False when the path vanished before we could stat it."""
+    db = library.db
+    iso = IsolatedFilePathData.from_relative(location["id"], rel_path, is_dir)
+    abs_path = iso.absolute_path(location["path"])
+    try:
+        st = abs_path.stat()
+    except OSError:
+        return False
+    meta = FilePathMetadata.from_stat(abs_path, st)
+    existing = _row_for(library, location["id"], iso)
+    if existing is not None:
+        return apply_update(library, location, rel_path, is_dir)
+    row = {
+        "pub_id": str(uuid.uuid4()),
+        **iso.db_fields(),
+        "inode": meta.inode, "device": meta.device,
+        "size_in_bytes": meta.size_in_bytes, "hidden": meta.hidden,
+        "date_created": _iso_ts(meta.created_at),
+        "date_modified": _iso_ts(meta.modified_at),
+        "date_indexed": utc_now().isoformat(),
+    }
+    sync, emit = _emit(library)
+    with db.transaction():
+        db.insert_many(FilePath, [row], or_ignore=True)
+        if emit:
+            sync.shared_create_many(FilePath, [row])
+    if emit:
+        sync.created()
+    library.emit("invalidate_query", {"key": "search.paths"})
+    return True
+
+
+def apply_update(library: "Library", location: dict[str, Any],
+                 rel_path: str, is_dir: bool) -> bool:
+    """update_file (utils.rs:338): refresh metadata; content changes clear the
+    cas_id/object link so re-identification runs."""
+    db = library.db
+    iso = IsolatedFilePathData.from_relative(location["id"], rel_path, is_dir)
+    row = _row_for(library, location["id"], iso)
+    if row is None:
+        return apply_create(library, location, rel_path, is_dir)
+    abs_path = iso.absolute_path(location["path"])
+    try:
+        st = abs_path.stat()
+    except OSError:
+        return False
+    meta = FilePathMetadata.from_stat(abs_path, st)
+    content_changed = ((row.get("size_in_bytes") or 0) != meta.size_in_bytes
+                       or abs(_mtime_of(row) - meta.modified_at) > 0.001)
+    values: dict[str, Any] = {
+        "size_in_bytes": meta.size_in_bytes,
+        "inode": meta.inode, "device": meta.device,
+        "date_modified": _iso_ts(meta.modified_at),
+        "hidden": meta.hidden,
+    }
+    if content_changed and not is_dir:
+        values["cas_id"] = None
+        values["object_id"] = None
+    sync, emit = _emit(library)
+    ops = []
+    with db.transaction():
+        db.update(FilePath, {"id": row["id"]}, values)
+        if emit:
+            for field in ("size_in_bytes", "date_modified", "cas_id"):
+                if field in values:
+                    ops.append(sync.shared_update(
+                        FilePath, row["pub_id"], field, values[field]))
+            if ops:
+                sync.log_ops(ops)
+    if emit and ops:
+        sync.created()
+    library.emit("invalidate_query", {"key": "search.paths"})
+    return content_changed
+
+
+def apply_rename(library: "Library", location: dict[str, Any],
+                 from_rel: str, to_rel: str, is_dir: bool) -> None:
+    """rename (utils.rs:606): move the row to its new identity; for
+    directories rewrite every descendant's materialized_path prefix. Keeps
+    cas_id/object (a rename is not a content change)."""
+    db = library.db
+    from_iso = IsolatedFilePathData.from_relative(location["id"], from_rel, is_dir)
+    to_iso = IsolatedFilePathData.from_relative(location["id"], to_rel, is_dir)
+    row = _row_for(library, location["id"], from_iso)
+    if row is None:
+        # never indexed (e.g. moved in and instantly renamed) — treat as create
+        apply_create(library, location, to_rel, is_dir)
+        return
+    # if something already sits at the target identity, drop it first
+    # (the reference checks for an existing file_path at the new path)
+    clash = _row_for(library, location["id"], to_iso)
+    if clash is not None and clash["id"] != row["id"]:
+        apply_remove_row(library, clash)
+    sync, emit = _emit(library)
+    ops = []
+    with db.transaction():
+        db.update(FilePath, {"id": row["id"]}, {
+            "materialized_path": to_iso.materialized_path,
+            "name": to_iso.name, "extension": to_iso.extension,
+            "date_modified": utc_now().isoformat(),
+        })
+        if emit:
+            for field, value in (("materialized_path", to_iso.materialized_path),
+                                 ("name", to_iso.name),
+                                 ("extension", to_iso.extension)):
+                ops.append(sync.shared_update(FilePath, row["pub_id"], field, value))
+        if is_dir:
+            old_prefix = from_iso.child_materialized_path()
+            new_prefix = to_iso.child_materialized_path()
+            descendants = db.query(
+                "SELECT id, pub_id, materialized_path FROM file_path "
+                "WHERE location_id = ? AND materialized_path LIKE ?",
+                [location["id"], old_prefix + "%"])
+            for d in descendants:
+                new_mp = new_prefix + d["materialized_path"][len(old_prefix):]
+                db.update(FilePath, {"id": d["id"]}, {"materialized_path": new_mp})
+                if emit:
+                    ops.append(sync.shared_update(
+                        FilePath, d["pub_id"], "materialized_path", new_mp))
+        if emit and ops:
+            sync.log_ops(ops)
+    if emit and ops:
+        sync.created()
+    library.emit("invalidate_query", {"key": "search.paths"})
+
+
+def apply_remove_row(library: "Library", row: dict[str, Any]) -> None:
+    from ..objects.fs import _remove_rows
+
+    _remove_rows(library, row)
+    library.emit("invalidate_query", {"key": "search.paths"})
+
+
+def apply_remove(library: "Library", location: dict[str, Any],
+                 rel_path: str) -> None:
+    """remove (utils.rs:698): drop the row and, for directories, the whole
+    subtree, emitting sync deletes."""
+    for is_dir in (False, True):  # the delete event may not carry is_dir reliably
+        iso = IsolatedFilePathData.from_relative(location["id"], rel_path, is_dir)
+        row = _row_for(library, location["id"], iso)
+        if row is not None:
+            apply_remove_row(library, row)
+            return
+
+
+def _iso_ts(ts: float) -> str:
+    import datetime as dt
+
+    return dt.datetime.fromtimestamp(ts, dt.timezone.utc).isoformat()
+
+
+def _mtime_of(row: dict[str, Any]) -> float:
+    value = row.get("date_modified")
+    if value is None:
+        return 0.0
+    if isinstance(value, (int, float)):
+        return float(value)
+    if isinstance(value, str):
+        import datetime as dt
+
+        try:
+            return dt.datetime.fromisoformat(value).timestamp()
+        except ValueError:
+            return 0.0
+    return value.timestamp()
+
+
+# ---------------------------------------------------------------------------
+# Event handler (linux.rs debounce semantics)
+# ---------------------------------------------------------------------------
+
+class EventHandler:
+    """Normalized-event → DB actions with the Linux handler's buffering:
+    create/modify are debounced 100ms per path (coalescing write bursts),
+    rename pairs match on cookie, dangling moved_from evict to removes after
+    1s, and changed files get re-identified in one shallow pass per flush."""
+
+    def __init__(self, library: "Library", location: dict[str, Any],
+                 rules: CompiledRules, backend) -> None:
+        self.library = library
+        self.location = location
+        self.rules = rules
+        self.backend = backend
+        self.files_to_update: dict[str, float] = {}
+        self.rename_from: dict[int, tuple[str, bool, float]] = {}
+        self.need_identify = False
+
+    def _rel(self, path: str) -> str | None:
+        root = self.location["path"].rstrip("/")
+        if path == root:
+            return None
+        if not path.startswith(root + "/"):
+            return None
+        return path[len(root) + 1:]
+
+    def _allowed(self, rel_path: str, is_dir: bool, abs_path: str) -> bool:
+        try:
+            return self.rules.allows_path(rel_path, is_dir, abs_path=abs_path)
+        except Exception:
+            return True
+
+    def handle(self, ev: RawEvent) -> None:
+        if ev.kind == "overflow":
+            # the kernel dropped events; reconcile with a full light pass
+            from . import light_scan_location
+
+            logger.warning("watcher queue overflow; rescanning location %s",
+                           self.location["id"])
+            try:
+                light_scan_location(self.library, self.location["id"])
+            except Exception:
+                logger.exception("overflow rescan failed")
+            return
+        rel = self._rel(ev.path)
+        if rel is None or os.path.basename(ev.path) == ".spacedrive":
+            return
+        if not self._allowed(rel, ev.is_dir, ev.path):
+            return
+        now = time.monotonic()
+        if ev.kind == "create":
+            if ev.is_dir:
+                self.backend.watch_new_dir(ev.path)
+                self._index_subtree(rel)
+            else:
+                self.files_to_update[ev.path] = now
+        elif ev.kind == "modify":
+            if not ev.is_dir:
+                self.files_to_update[ev.path] = now
+        elif ev.kind == "moved_from":
+            self.rename_from[ev.cookie] = (ev.path, ev.is_dir, now)
+        elif ev.kind == "moved_to":
+            pending = self.rename_from.pop(ev.cookie, None)
+            if pending is not None:
+                from_path, is_dir, _ = pending
+                from_rel = self._rel(from_path)
+                if is_dir:
+                    self.backend.note_dir_moved(from_path, ev.path)
+                if from_rel is not None:
+                    apply_rename(self.library, self.location, from_rel, rel, is_dir)
+                else:
+                    self._moved_in(rel, ev)
+            else:
+                self._moved_in(rel, ev)
+        elif ev.kind == "delete":
+            self.files_to_update.pop(ev.path, None)
+            apply_remove(self.library, self.location, rel)
+
+    def _moved_in(self, rel: str, ev: RawEvent) -> None:
+        """moved_to with no matching moved_from = arrived from outside the
+        watched tree (linux.rs module docs) — a plain create."""
+        if ev.is_dir:
+            self.backend.watch_new_dir(ev.path)
+            self._index_subtree(rel)
+        else:
+            self.files_to_update[ev.path] = time.monotonic()
+
+    def _index_subtree(self, rel_path: str) -> None:
+        """A directory appeared (created or moved in): index it recursively —
+        the reference receives a bare Create Dir and walks it."""
+        from .indexer_job import _entry_to_row
+        from .walker import db_fetcher_for, walk
+
+        db = self.library.db
+        if not apply_create(self.library, self.location, rel_path, True):
+            return
+        result = walk(self.location["id"], self.location["path"], self.rules,
+                      db_fetcher_for(db, self.location["id"]),
+                      sub_path=rel_path, include_root=False)
+        rows = [_entry_to_row(e) for e in result.walked]
+        if rows:
+            sync, emit = _emit(self.library)
+            with db.transaction():
+                db.insert_many(FilePath, rows, or_ignore=True)
+                if emit:
+                    sync.shared_create_many(FilePath, rows)
+            if emit:
+                sync.created()
+            self.need_identify = True
+        self.library.emit("invalidate_query", {"key": "search.paths"})
+
+    def tick(self) -> None:
+        now = time.monotonic()
+        # flush debounced updates older than the window
+        ready = [p for p, t in self.files_to_update.items()
+                 if now - t >= HUNDRED_MILLIS]
+        for path in ready:
+            del self.files_to_update[path]
+            rel = self._rel(path)
+            if rel is None:
+                continue
+            if apply_update(self.library, self.location, rel, False):
+                self.need_identify = True
+        # evict dangling renames (moved outside the location) to removes
+        for cookie, (path, is_dir, t) in list(self.rename_from.items()):
+            if now - t >= ONE_SECOND:
+                del self.rename_from[cookie]
+                rel = self._rel(path)
+                if rel is not None:
+                    apply_remove(self.library, self.location, rel)
+        if self.need_identify and not self.files_to_update:
+            self.need_identify = False
+            from ..objects.file_identifier import shallow_identify
+
+            try:
+                shallow_identify(self.library, self.location["id"])
+            except Exception:
+                logger.exception("watcher re-identify failed")
+
+
+# ---------------------------------------------------------------------------
+# The watcher actor
+# ---------------------------------------------------------------------------
+
+def _make_backend(root: str):
+    if sys.platform.startswith("linux"):
+        try:
+            return InotifyBackend(root)
+        except OSError as e:
+            logger.warning("inotify unavailable (%s); polling fallback", e)
+    return PollingBackend(root)
+
+
+class LocationWatcher:
+    """Per-location watcher thread (LocationWatcher, watcher/mod.rs:69-76):
+    owns a backend + handler, applies events until stopped. ``ignore_path``
+    mirrors the IgnorePath channel that fs jobs use to mute their own writes."""
+
+    def __init__(self, library: "Library", location_id: int,
+                 backend_factory: Callable[[str], Any] | None = None,
+                 poll_interval: float = 0.25) -> None:
+        row = library.db.find_one(Location, {"id": location_id})
+        if row is None or not row.get("path"):
+            raise ValueError(f"location {location_id} has no path")
+        if not Path(row["path"]).is_dir():
+            raise ValueError(f"location path missing on disk: {row['path']}")
+        self.library = library
+        self.location = row
+        self.poll_interval = poll_interval
+        self._ignored: set[str] = set()
+        self._ignored_lock = threading.Lock()
+        self.backend = (backend_factory or _make_backend)(row["path"])
+        rules = CompiledRules(rules_for_location(library.db, location_id))
+        self.handler = EventHandler(library, row, rules, self.backend)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"watcher-{location_id}", daemon=True)
+        self._thread.start()
+
+    def ignore_path(self, path: str | Path, ignore: bool) -> None:
+        with self._ignored_lock:
+            if ignore:
+                self._ignored.add(str(path))
+            else:
+                self._ignored.discard(str(path))
+
+    def _is_ignored(self, path: str) -> bool:
+        with self._ignored_lock:
+            if not self._ignored:
+                return False
+            for ig in self._ignored:
+                if path == ig or path.startswith(ig.rstrip("/") + "/"):
+                    return True
+        return False
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                events = self.backend.read(self.poll_interval)
+                for ev in events:
+                    if self._is_ignored(ev.path):
+                        continue
+                    self.handler.handle(ev)
+                self.handler.tick()
+            except Exception:
+                logger.exception("watcher loop error (location %s)",
+                                 self.location["id"])
+                time.sleep(0.5)
+
+    def flush(self, timeout: float = 3.0) -> None:
+        """Testing/shutdown aid: wait until debounce buffers drain."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if (not self.handler.files_to_update and not self.handler.rename_from
+                    and not self.handler.need_identify):
+                return
+            time.sleep(0.05)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self.backend.close()

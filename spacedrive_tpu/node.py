@@ -41,10 +41,18 @@ def _probe_accelerator() -> dict[str, Any]:
 
 
 class Node:
-    def __init__(self, data_dir: str | Path, probe_accelerator: bool = True) -> None:
+    def __init__(self, data_dir: str | Path, probe_accelerator: bool = True,
+                 watch_locations: bool | None = None) -> None:
+        import os
+
         self.data_dir = Path(data_dir)
         self.data_dir.mkdir(parents=True, exist_ok=True)
         self.config = ConfigManager(NodeConfig.load(self.data_dir))
+        # location-watcher feature gate (the reference's `location-watcher`
+        # cargo feature, location/manager/mod.rs:23-32)
+        if watch_locations is None:
+            watch_locations = not os.environ.get("SD_NO_WATCHER")
+        self.watch_locations = watch_locations
         self.events = EventBus()
         self.jobs = Jobs()
         self.libraries = Libraries(self.data_dir, node=self)

@@ -24,6 +24,10 @@ if "jax" in sys.modules:
 
 import pytest  # noqa: E402
 
+# Watchers are opt-in per test (Node(watch_locations=True)); keeping them off
+# by default stops every location-creating test from spawning inotify threads.
+os.environ.setdefault("SD_NO_WATCHER", "1")
+
 
 def pytest_configure(config):
     # persistent XLA compilation cache keeps repeat suite runs fast
