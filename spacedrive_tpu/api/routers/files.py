@@ -6,6 +6,7 @@ from __future__ import annotations
 from pathlib import Path
 
 from ...models import FilePath, MediaData, Object, utc_now
+from ...objects.crypto_jobs import FileDecryptorJob, FileEncryptorJob
 from ...objects.fs import (FileCopierJob, FileCutterJob, FileDeleterJob,
                            FileEraserJob, create_directory, create_file,
                            file_path_abs, find_available_name)
@@ -195,6 +196,26 @@ def mount(router) -> None:
     def erase_files(node, library, arg):
         return node.jobs.spawn(library, [FileEraserJob({
             "sources": arg["sources"], "passes": arg.get("passes", 2)})])
+
+    @router.library_mutation("files.encryptFiles")
+    def encrypt_files(node, library, arg):
+        """api/files.rs encryptFiles → FileEncryptorJob (fs/encrypt.rs)."""
+        return node.jobs.spawn(library, [FileEncryptorJob({
+            "sources": arg["sources"],
+            "password": arg.get("password"),
+            "key_uuid": arg.get("key_uuid"),
+            "algorithm": arg.get("algorithm", "XChaCha20Poly1305"),
+            "metadata": arg.get("metadata", False),
+            "erase_original": arg.get("erase_original", False)})])
+
+    @router.library_mutation("files.decryptFiles")
+    def decrypt_files(node, library, arg):
+        """api/files.rs decryptFiles → FileDecryptorJob (fs/decrypt.rs)."""
+        return node.jobs.spawn(library, [FileDecryptorJob({
+            "sources": arg["sources"],
+            "password": arg.get("password"),
+            "key_uuid": arg.get("key_uuid"),
+            "erase_original": arg.get("erase_original", False)})])
 
 
 def _sync_update(library, obj: dict, field: str, value) -> None:

@@ -1,0 +1,71 @@
+"""keys.* procedures — the key-manager surface.
+
+Reference: core/src/api/keys.rs (24 procedures, shipped UNMOUNTED —
+api/mod.rs:173 comments out `keys.mount()` because the keymanager is
+disconnected upstream). Here the key manager works, so the core set is
+mounted: setup/unlock/lock state, stored-key CRUD, mount/unmount.
+"""
+
+from __future__ import annotations
+
+from ..router import ApiError
+
+
+def mount(router) -> None:
+    def _km(node):
+        km = getattr(node, "key_manager", None)
+        if km is None:
+            raise ApiError("no key manager on this node")
+        return km
+
+    @router.query("keys.isSetup")
+    def is_setup(node, _arg=None):
+        return _km(node).is_setup
+
+    @router.query("keys.isUnlocked")
+    def is_unlocked(node, _arg=None):
+        return _km(node).is_unlocked
+
+    @router.mutation("keys.setup")
+    def setup(node, password: str):
+        _km(node).setup(password)
+        return True
+
+    @router.mutation("keys.unlockKeyManager")
+    def unlock(node, password: str):
+        from ...crypto.keymanager import KeyManagerError
+
+        try:
+            _km(node).unlock(password)
+        except KeyManagerError as e:
+            raise ApiError(str(e))
+        return True
+
+    @router.mutation("keys.lockKeyManager")
+    def lock(node, _arg=None):
+        _km(node).lock()
+        return True
+
+    @router.query("keys.list")
+    def list_keys(node, _arg=None):
+        return _km(node).list_keys()
+
+    @router.mutation("keys.add")
+    def add(node, arg):
+        name = (arg or {}).get("name", "") if isinstance(arg, dict) else (arg or "")
+        return _km(node).add_key(name)
+
+    @router.mutation("keys.mount")
+    def mount_key(node, key_uuid: str):
+        _km(node).mount(key_uuid)
+        return True
+
+    @router.mutation("keys.unmount")
+    def unmount_key(node, key_uuid: str):
+        _km(node).unmount(key_uuid)
+        return True
+
+    @router.mutation("keys.deleteFromLibrary")
+    def delete(node, key_uuid: str):
+        _km(node).delete_key(key_uuid)
+        return True

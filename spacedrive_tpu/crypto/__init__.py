@@ -1,0 +1,35 @@
+"""sd-crypto equivalent: AEAD streams, password hashing, encrypted headers.
+
+Clean-room counterpart of the reference's `crates/crypto` (4.8k LoC Rust):
+same construction choices — XChaCha20Poly1305 / AES-256-GCM behind an LE31
+STREAM, 1MiB blocks (primitives.rs:27), Argon2id / BalloonBlake3 password
+hashing (keys/hashing.rs:19-50), magic-byte header with up to two keyslots
+(header/file.rs, keyslot.rs) — implemented on Python's `cryptography`
+primitives plus this repo's spec-derived BLAKE3 for key derivation. The
+container format is this framework's own (the ecosystems are not
+wire-compatible anyway); the capability surface matches.
+"""
+
+from .hashing import HashingAlgorithm, Params
+from .header import FileHeader, Keyslot, MAGIC_BYTES
+from .keymanager import KeyManager
+from .primitives import (
+    AEAD_TAG_LEN,
+    BLOCK_LEN,
+    ENCRYPTED_KEY_LEN,
+    KEY_LEN,
+    SALT_LEN,
+    Protected,
+    derive_key,
+    generate_master_key,
+    generate_nonce,
+    generate_salt,
+)
+from .stream import Algorithm, Decryptor, Encryptor
+
+__all__ = [
+    "AEAD_TAG_LEN", "Algorithm", "BLOCK_LEN", "Decryptor", "ENCRYPTED_KEY_LEN",
+    "Encryptor", "FileHeader", "HashingAlgorithm", "KEY_LEN", "KeyManager",
+    "Keyslot", "MAGIC_BYTES", "Params", "Protected", "SALT_LEN", "derive_key",
+    "generate_master_key", "generate_nonce", "generate_salt",
+]

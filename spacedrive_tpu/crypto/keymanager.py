@@ -1,0 +1,188 @@
+"""Key manager: a root key unlocked by a master password, guarding stored keys.
+
+Reference: crates/crypto/src/keys/keymanager.rs (note the reference ships it
+disconnected — library.rs:48-49 and api/mod.rs:173 comment out `keys.mount()`;
+here it is wired into the encrypt/decrypt jobs as an optional key source).
+
+Model: `setup(master_password)` creates a random root key, seals it into a
+keyslot-style record persisted as JSON-in-library-dir; `unlock` recovers it.
+Stored keys are random 32-byte keys sealed under the root key; `mount(uuid)`
+exposes one to jobs, `unmount` drops it from memory. Secrets never persist
+unencrypted.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import uuid as uuid_mod
+from pathlib import Path
+
+from .hashing import HashingAlgorithm
+from .header import Keyslot
+from .primitives import Protected, generate_master_key
+from .stream import Algorithm, CryptoError, Decryptor, Encryptor
+
+
+def _b64(raw: bytes) -> str:
+    return base64.b64encode(raw).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s.encode())
+
+
+def _slot_to_json(slot: Keyslot) -> dict:
+    return {
+        "version": slot.version,
+        "algorithm": slot.algorithm.value,
+        "hashing": _b64(slot.hashing_algorithm.encode()),
+        "salt": _b64(slot.salt),
+        "content_salt": _b64(slot.content_salt),
+        "master_key": _b64(slot.master_key),
+        "nonce": _b64(slot.nonce),
+    }
+
+
+def _slot_from_json(obj: dict) -> Keyslot:
+    return Keyslot(
+        version=obj["version"],
+        algorithm=Algorithm(obj["algorithm"]),
+        hashing_algorithm=HashingAlgorithm.decode(_unb64(obj["hashing"])),
+        salt=_unb64(obj["salt"]),
+        content_salt=_unb64(obj["content_salt"]),
+        master_key=_unb64(obj["master_key"]),
+        nonce=_unb64(obj["nonce"]),
+    )
+
+
+class KeyManagerError(Exception):
+    pass
+
+
+class KeyManager:
+    def __init__(self, store_path: str | Path) -> None:
+        self.store_path = Path(store_path)
+        self._lock = threading.RLock()
+        self._root: Protected | None = None
+        self._mounted: dict[str, Protected] = {}
+        self._store = self._load()
+
+    # -- persistence ---------------------------------------------------------
+    def _load(self) -> dict:
+        if self.store_path.exists():
+            try:
+                return json.loads(self.store_path.read_text())
+            except (OSError, json.JSONDecodeError):
+                pass
+        return {"root_slot": None, "keys": {}}
+
+    def _save(self) -> None:
+        self.store_path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self.store_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(self._store, indent=1))
+        tmp.replace(self.store_path)
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def is_setup(self) -> bool:
+        return self._store.get("root_slot") is not None
+
+    @property
+    def is_unlocked(self) -> bool:
+        return self._root is not None
+
+    def setup(self, master_password: str | Protected) -> None:
+        with self._lock:
+            if self.is_setup:
+                raise KeyManagerError("key manager is already set up")
+            pw = master_password if isinstance(master_password, Protected) \
+                else Protected(master_password)
+            root = generate_master_key()
+            slot = Keyslot.new(Algorithm.XCHACHA20_POLY1305,
+                               HashingAlgorithm.argon2id(), pw, root)
+            self._store["root_slot"] = _slot_to_json(slot)
+            self._save()
+            self._root = root
+
+    def unlock(self, master_password: str | Protected) -> None:
+        with self._lock:
+            if not self.is_setup:
+                raise KeyManagerError("key manager is not set up")
+            pw = master_password if isinstance(master_password, Protected) \
+                else Protected(master_password)
+            slot = _slot_from_json(self._store["root_slot"])
+            try:
+                self._root = slot.unseal(pw)
+            except CryptoError as e:
+                raise KeyManagerError("incorrect master password") from e
+
+    def lock(self) -> None:
+        with self._lock:
+            if self._root is not None:
+                self._root.zeroize()
+            self._root = None
+            for key in self._mounted.values():
+                key.zeroize()
+            self._mounted.clear()
+
+    def _require_root(self) -> Protected:
+        if self._root is None:
+            raise KeyManagerError("key manager is locked")
+        return self._root
+
+    # -- stored keys ---------------------------------------------------------
+    def add_key(self, name: str = "") -> str:
+        """Create + persist a new random key sealed under the root key;
+        returns its uuid (auto-mounted)."""
+        with self._lock:
+            root = self._require_root()
+            key = generate_master_key()
+            algorithm = Algorithm.XCHACHA20_POLY1305
+            nonce = algorithm.generate_nonce()
+            sealed = Encryptor.encrypt_bytes(root, nonce, algorithm, key.expose())
+            kid = str(uuid_mod.uuid4())
+            self._store["keys"][kid] = {
+                "name": name, "algorithm": algorithm.value,
+                "nonce": _b64(nonce), "key": _b64(sealed),
+            }
+            self._save()
+            self._mounted[kid] = key
+            return kid
+
+    def mount(self, kid: str) -> None:
+        with self._lock:
+            root = self._require_root()
+            rec = self._store["keys"].get(kid)
+            if rec is None:
+                raise KeyManagerError(f"no stored key {kid}")
+            if kid in self._mounted:
+                return
+            self._mounted[kid] = Decryptor.decrypt_bytes(
+                root, _unb64(rec["nonce"]), Algorithm(rec["algorithm"]),
+                _unb64(rec["key"]))
+
+    def unmount(self, kid: str) -> None:
+        with self._lock:
+            key = self._mounted.pop(kid, None)
+            if key is not None:
+                key.zeroize()
+
+    def get_key(self, kid: str) -> Protected:
+        with self._lock:
+            if kid not in self._mounted:
+                self.mount(kid)
+            return self._mounted[kid]
+
+    def delete_key(self, kid: str) -> None:
+        with self._lock:
+            self.unmount(kid)
+            self._store["keys"].pop(kid, None)
+            self._save()
+
+    def list_keys(self) -> list[dict]:
+        with self._lock:
+            return [{"uuid": kid, "name": rec.get("name", ""),
+                     "mounted": kid in self._mounted}
+                    for kid, rec in self._store["keys"].items()]

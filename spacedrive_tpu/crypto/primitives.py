@@ -1,0 +1,86 @@
+"""Crypto constants + small helpers (reference: crates/crypto/src/primitives.rs)."""
+
+from __future__ import annotations
+
+import secrets
+
+from ..objects import blake3_ref
+
+#: streaming block size — 1 MiB (primitives.rs:27)
+BLOCK_LEN = 1_048_576
+#: Poly1305/GCM tag length (primitives.rs:30)
+AEAD_TAG_LEN = 16
+#: master keys are 32 bytes (primitives.rs:36)
+KEY_LEN = 32
+#: encrypted master key = key + tag (primitives.rs:33)
+ENCRYPTED_KEY_LEN = KEY_LEN + AEAD_TAG_LEN
+#: salt length (primitives.rs:19)
+SALT_LEN = 16
+#: secret-key length (primitives.rs:22)
+SECRET_KEY_LEN = 18
+
+#: domain-separation contexts for key derivation (primitives.rs:61-68; ours —
+#: a clean-room format needs its own domains)
+ROOT_KEY_CONTEXT = "spacedrive_tpu 2026-07-29 root key derivation"
+MASTER_PASSWORD_CONTEXT = "spacedrive_tpu 2026-07-29 master password verification"
+FILE_KEY_CONTEXT = "spacedrive_tpu 2026-07-29 file key derivation"
+
+
+class Protected:
+    """Best-effort zeroizing secret wrapper (reference protected.rs). Python
+    cannot guarantee erasure of immutable bytes, so secrets are held in a
+    mutable bytearray wiped on ``zeroize()``/GC, and ``repr`` never leaks."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self, value: bytes | bytearray | str) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._buf = bytearray(value)
+
+    def expose(self) -> bytes:
+        return bytes(self._buf)
+
+    def zeroize(self) -> None:
+        for i in range(len(self._buf)):
+            self._buf[i] = 0
+        self._buf.clear()
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def __repr__(self) -> str:
+        return "Protected(<redacted>)"
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Protected):
+            return secrets.compare_digest(bytes(self._buf), bytes(other._buf))
+        return NotImplemented
+
+    def __del__(self) -> None:
+        try:
+            self.zeroize()
+        except Exception:
+            pass
+
+
+def generate_master_key() -> Protected:
+    return Protected(secrets.token_bytes(KEY_LEN))
+
+
+def generate_salt() -> bytes:
+    return secrets.token_bytes(SALT_LEN)
+
+
+def generate_secret_key() -> Protected:
+    return Protected(secrets.token_bytes(SECRET_KEY_LEN))
+
+
+def generate_nonce(length: int) -> bytes:
+    return secrets.token_bytes(length)
+
+
+def derive_key(key: bytes, salt: bytes, context: str) -> bytes:
+    """``Key::derive`` (keyslot.rs KEK derivation): BLAKE3 derive_key over
+    key‖salt under a domain-separation context."""
+    return blake3_ref.derive_key(context, key + salt, KEY_LEN)

@@ -1,0 +1,155 @@
+"""AEAD streams: 1MiB blocks under an LE31 STREAM construction.
+
+Reference: crates/crypto/src/crypto/stream.rs — Encryptor/Decryptor over
+XChaCha20Poly1305 or AES-256-GCM, reading BLOCK_LEN blocks and sealing each
+with the `aead` crate's EncryptorLE31. The LE31 scheme (implemented here
+from its definition) extends the caller's nonce with a 4-byte little-endian
+word carrying a 31-bit block counter and a last-block bit, so blocks cannot
+be reordered, truncated, or spliced across streams. Caller nonce lengths
+match the reference's Algorithm::nonce_len(): 20 bytes for XChaCha (full 24
+minus 4) and 8 for AES-GCM (full 12 minus 4) — types.rs:139-143.
+
+AAD (the serialized header) is bound to the FIRST block only, exactly like
+encrypt_streams (stream.rs: aad passed on block 0).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import BinaryIO
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from .primitives import AEAD_TAG_LEN, BLOCK_LEN, Protected, generate_nonce
+from .xchacha import XChaCha20Poly1305
+
+
+class CryptoError(Exception):
+    pass
+
+
+class Algorithm(enum.Enum):
+    XCHACHA20_POLY1305 = 0
+    AES_256_GCM = 1
+
+    @property
+    def nonce_len(self) -> int:
+        # stream nonce = full AEAD nonce minus the 4-byte LE31 word
+        return 20 if self is Algorithm.XCHACHA20_POLY1305 else 8
+
+    def generate_nonce(self) -> bytes:
+        return generate_nonce(self.nonce_len)
+
+    def _aead(self, key: bytes):
+        if self is Algorithm.XCHACHA20_POLY1305:
+            return XChaCha20Poly1305(key)
+        return AESGCM(key)
+
+
+_LAST_BLOCK = 1 << 31
+
+
+class _Stream:
+    def __init__(self, key: Protected, nonce: bytes, algorithm: Algorithm) -> None:
+        if len(nonce) != algorithm.nonce_len:
+            raise CryptoError(
+                f"nonce length mismatch: got {len(nonce)}, "
+                f"want {algorithm.nonce_len} for {algorithm.name}")
+        if len(key) != 32:
+            raise CryptoError("key must be 32 bytes")
+        self._aead = algorithm._aead(key.expose())
+        self._nonce = nonce
+        self._counter = 0
+        self._finished = False
+
+    def _next_nonce(self, last: bool) -> bytes:
+        if self._finished:
+            raise CryptoError("stream already finalized")
+        if self._counter >= _LAST_BLOCK:
+            raise CryptoError("LE31 counter exhausted")
+        word = self._counter | (_LAST_BLOCK if last else 0)
+        if last:
+            self._finished = True
+        else:
+            self._counter += 1
+        return self._nonce + word.to_bytes(4, "little")
+
+
+class Encryptor(_Stream):
+    def encrypt_next(self, plaintext: bytes, aad: bytes = b"") -> bytes:
+        return self._aead.encrypt(self._next_nonce(False), plaintext, aad or None)
+
+    def encrypt_last(self, plaintext: bytes, aad: bytes = b"") -> bytes:
+        return self._aead.encrypt(self._next_nonce(True), plaintext, aad or None)
+
+    @classmethod
+    def encrypt_streams(cls, key: Protected, nonce: bytes, algorithm: Algorithm,
+                        reader: BinaryIO, writer: BinaryIO,
+                        aad: bytes = b"") -> int:
+        """Block-by-block file encryption (stream.rs encrypt_streams): read
+        BLOCK_LEN, seal, write; AAD authenticated with block 0. Returns
+        ciphertext bytes written."""
+        enc = cls(key, nonce, algorithm)
+        written = 0
+        block = reader.read(BLOCK_LEN)
+        first = True
+        while True:
+            nxt = reader.read(BLOCK_LEN)
+            this_aad = aad if first else b""
+            if nxt:
+                out = enc.encrypt_next(block, this_aad)
+            else:
+                out = enc.encrypt_last(block, this_aad)
+            writer.write(out)
+            written += len(out)
+            if not nxt:
+                return written
+            block, first = nxt, False
+
+    @classmethod
+    def encrypt_bytes(cls, key: Protected, nonce: bytes, algorithm: Algorithm,
+                      data: bytes, aad: bytes = b"") -> bytes:
+        """One-shot small-payload seal (stream.rs encrypt_bytes) — used for
+        master keys in keyslots and header metadata blobs."""
+        return cls(key, nonce, algorithm).encrypt_last(data, aad)
+
+
+class Decryptor(_Stream):
+    def decrypt_next(self, ciphertext: bytes, aad: bytes = b"") -> bytes:
+        try:
+            return self._aead.decrypt(self._next_nonce(False), ciphertext, aad or None)
+        except Exception as e:
+            raise CryptoError("decryption failed (wrong key or corrupt data)") from e
+
+    def decrypt_last(self, ciphertext: bytes, aad: bytes = b"") -> bytes:
+        try:
+            return self._aead.decrypt(self._next_nonce(True), ciphertext, aad or None)
+        except Exception as e:
+            raise CryptoError("decryption failed (wrong key or corrupt data)") from e
+
+    @classmethod
+    def decrypt_streams(cls, key: Protected, nonce: bytes, algorithm: Algorithm,
+                        reader: BinaryIO, writer: BinaryIO,
+                        aad: bytes = b"") -> int:
+        dec = cls(key, nonce, algorithm)
+        cipher_block = BLOCK_LEN + AEAD_TAG_LEN
+        written = 0
+        block = reader.read(cipher_block)
+        first = True
+        while True:
+            nxt = reader.read(cipher_block)
+            this_aad = aad if first else b""
+            if nxt:
+                out = dec.decrypt_next(block, this_aad)
+            else:
+                out = dec.decrypt_last(block, this_aad)
+            writer.write(out)
+            written += len(out)
+            if not nxt:
+                return written
+            block, first = nxt, False
+
+    @classmethod
+    def decrypt_bytes(cls, key: Protected, nonce: bytes, algorithm: Algorithm,
+                      data: bytes, aad: bytes = b"") -> Protected:
+        return Protected(cls(key, nonce, algorithm).decrypt_last(data, aad))

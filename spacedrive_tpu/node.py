@@ -58,6 +58,9 @@ class Node:
         self.libraries = Libraries(self.data_dir, node=self)
         self.locations = None  # attached by locations layer
         self.p2p = None  # attached by p2p layer
+        from .crypto.keymanager import KeyManager
+
+        self.key_manager = KeyManager(self.data_dir / "keystore.json")
 
         if probe_accelerator:
             self.config.write(accelerator=_probe_accelerator())

@@ -24,6 +24,9 @@ CHUNK_START = 1 << 0
 CHUNK_END = 1 << 1
 PARENT = 1 << 2
 ROOT = 1 << 3
+KEYED_HASH = 1 << 4
+DERIVE_KEY_CONTEXT = 1 << 5
+DERIVE_KEY_MATERIAL = 1 << 6
 
 IV = (
     0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
@@ -93,24 +96,29 @@ def _words_from_block(block: bytes) -> list[int]:
     return list(struct.unpack("<16I", block))
 
 
-def _chunk_output(chunk: bytes, chunk_counter: int) -> tuple[list[int], list[int], int, int, int]:
+def _chunk_output(chunk: bytes, chunk_counter: int,
+                  key_words: tuple[int, ...] | list[int] = IV,
+                  base_flags: int = 0) -> tuple[list[int], list[int], int, int, int]:
     """Process a whole chunk except its final compression.
 
     Returns (input_cv, final_block_words, counter, final_block_len, final_flags)
-    so the caller can decide whether the last compression is ROOT.
+    so the caller can decide whether the last compression is ROOT. ``key_words``
+    + ``base_flags`` select the mode (hash / keyed_hash / derive_key).
     """
-    cv: list[int] = list(IV)
+    cv: list[int] = list(key_words)
     blocks = [chunk[i : i + BLOCK_LEN] for i in range(0, len(chunk), BLOCK_LEN)] or [b""]
     for i, block in enumerate(blocks[:-1]):
-        flags = CHUNK_START if i == 0 else 0
+        flags = base_flags | (CHUNK_START if i == 0 else 0)
         cv = compress(cv, _words_from_block(block), chunk_counter, BLOCK_LEN, flags)[:8]
     last = blocks[-1]
-    flags = CHUNK_END | (CHUNK_START if len(blocks) == 1 else 0)
+    flags = base_flags | CHUNK_END | (CHUNK_START if len(blocks) == 1 else 0)
     return cv, _words_from_block(last), chunk_counter, len(last), flags
 
 
-def _parent_args(left_cv: list[int], right_cv: list[int]) -> tuple[list[int], list[int], int, int, int]:
-    return list(IV), left_cv + right_cv, 0, BLOCK_LEN, PARENT
+def _parent_args(left_cv: list[int], right_cv: list[int],
+                 key_words: tuple[int, ...] | list[int] = IV,
+                 base_flags: int = 0) -> tuple[list[int], list[int], int, int, int]:
+    return list(key_words), left_cv + right_cv, 0, BLOCK_LEN, PARENT | base_flags
 
 
 def _root_bytes(args: tuple[list[int], list[int], int, int, int], out_len: int) -> bytes:
@@ -125,11 +133,13 @@ def _root_bytes(args: tuple[list[int], list[int], int, int, int], out_len: int) 
     return bytes(out[:out_len])
 
 
-def blake3(data: bytes, out_len: int = OUT_LEN) -> bytes:
+def blake3(data: bytes, out_len: int = OUT_LEN,
+           key_words: tuple[int, ...] | list[int] = IV,
+           base_flags: int = 0) -> bytes:
     """One-shot BLAKE3 via the incremental chunk-stack construction."""
     chunks = [data[i : i + CHUNK_LEN] for i in range(0, len(data), CHUNK_LEN)] or [b""]
     if len(chunks) == 1:
-        cv, words, counter, block_len, flags = _chunk_output(chunks[0], 0)
+        cv, words, counter, block_len, flags = _chunk_output(chunks[0], 0, key_words, base_flags)
         return _root_bytes((cv, words, counter, block_len, flags), out_len)
 
     # chunk stack: push each chunk CV, merging completed subtrees whose size is
@@ -137,23 +147,45 @@ def blake3(data: bytes, out_len: int = OUT_LEN) -> bytes:
     stack: list[list[int]] = []
     total = 0
     for i, chunk in enumerate(chunks[:-1]):
-        cv, words, counter, block_len, flags = _chunk_output(chunk, i)
+        cv, words, counter, block_len, flags = _chunk_output(chunk, i, key_words, base_flags)
         new_cv = compress(cv, words, counter, block_len, flags)[:8]
         total += 1
         t = total
         while t & 1 == 0:
             left = stack.pop()
-            new_cv = compress(*_parent_args(left, new_cv))[:8]
+            new_cv = compress(*_parent_args(left, new_cv, key_words, base_flags))[:8]
             t >>= 1
         stack.append(new_cv)
 
     # final chunk stays un-finalized; fold the stack right-to-left
-    cv, words, counter, block_len, flags = _chunk_output(chunks[-1], len(chunks) - 1)
+    cv, words, counter, block_len, flags = _chunk_output(
+        chunks[-1], len(chunks) - 1, key_words, base_flags)
     right_cv = compress(cv, words, counter, block_len, flags)[:8]
     while len(stack) > 1:
         left = stack.pop()
-        right_cv = compress(*_parent_args(left, right_cv))[:8]
-    return _root_bytes(_parent_args(stack[0], right_cv), out_len)
+        right_cv = compress(*_parent_args(left, right_cv, key_words, base_flags))[:8]
+    return _root_bytes(_parent_args(stack[0], right_cv, key_words, base_flags), out_len)
+
+
+def _key_words(key: bytes) -> tuple[int, ...]:
+    if len(key) != 32:
+        raise ValueError("BLAKE3 key must be exactly 32 bytes")
+    return struct.unpack("<8I", key)
+
+
+def blake3_keyed(key: bytes, data: bytes, out_len: int = OUT_LEN) -> bytes:
+    """keyed_hash mode: the 32-byte key replaces the IV (spec §2.6)."""
+    return blake3(data, out_len, _key_words(key), KEYED_HASH)
+
+
+def derive_key(context: str | bytes, key_material: bytes, out_len: int = OUT_LEN) -> bytes:
+    """derive_key mode (spec §2.6): hash the context string in
+    DERIVE_KEY_CONTEXT mode, then the material keyed by that context key in
+    DERIVE_KEY_MATERIAL mode. This is the KDF behind the reference's
+    ``Key::derive`` (crates/crypto keyslot KEK derivation)."""
+    ctx = context.encode() if isinstance(context, str) else context
+    context_key = blake3(ctx, 32, IV, DERIVE_KEY_CONTEXT)
+    return blake3(key_material, out_len, _key_words(context_key), DERIVE_KEY_MATERIAL)
 
 
 def blake3_hex(data: bytes, out_len: int = OUT_LEN) -> str:
@@ -165,27 +197,32 @@ def blake3_hex(data: bytes, out_len: int = OUT_LEN) -> str:
 # --------------------------------------------------------------------------
 
 
-def _subtree_cv(data: bytes, chunk_counter: int) -> list[int]:
+def _subtree_cv(data: bytes, chunk_counter: int,
+                key_words: tuple[int, ...] | list[int] = IV,
+                base_flags: int = 0) -> list[int]:
     n_chunks = max(1, (len(data) + CHUNK_LEN - 1) // CHUNK_LEN)
     if n_chunks == 1:
-        cv, words, counter, block_len, flags = _chunk_output(data, chunk_counter)
+        cv, words, counter, block_len, flags = _chunk_output(
+            data, chunk_counter, key_words, base_flags)
         return compress(cv, words, counter, block_len, flags)[:8]
     # left subtree takes the largest power-of-two chunk count strictly < n
     left_chunks = 1 << (n_chunks - 1).bit_length() - 1
     split = left_chunks * CHUNK_LEN
-    left = _subtree_cv(data[:split], chunk_counter)
-    right = _subtree_cv(data[split:], chunk_counter + left_chunks)
-    return compress(*_parent_args(left, right))[:8]
+    left = _subtree_cv(data[:split], chunk_counter, key_words, base_flags)
+    right = _subtree_cv(data[split:], chunk_counter + left_chunks, key_words, base_flags)
+    return compress(*_parent_args(left, right, key_words, base_flags))[:8]
 
 
-def blake3_recursive(data: bytes, out_len: int = OUT_LEN) -> bytes:
+def blake3_recursive(data: bytes, out_len: int = OUT_LEN,
+                     key_words: tuple[int, ...] | list[int] = IV,
+                     base_flags: int = 0) -> bytes:
     """Divide-and-conquer construction; must agree with ``blake3`` everywhere."""
     n_chunks = max(1, (len(data) + CHUNK_LEN - 1) // CHUNK_LEN)
     if n_chunks == 1:
-        cv, words, counter, block_len, flags = _chunk_output(data, 0)
+        cv, words, counter, block_len, flags = _chunk_output(data, 0, key_words, base_flags)
         return _root_bytes((cv, words, counter, block_len, flags), out_len)
     left_chunks = 1 << (n_chunks - 1).bit_length() - 1
     split = left_chunks * CHUNK_LEN
-    left = _subtree_cv(data[:split], 0)
-    right = _subtree_cv(data[split:], left_chunks)
-    return _root_bytes(_parent_args(left, right), out_len)
+    left = _subtree_cv(data[:split], 0, key_words, base_flags)
+    right = _subtree_cv(data[split:], left_chunks, key_words, base_flags)
+    return _root_bytes(_parent_args(left, right, key_words, base_flags), out_len)

@@ -1,0 +1,282 @@
+"""Crypto subsystem: KAT vectors, AEAD stream round-trips, header
+serialization, keyslots, key manager, and job-level encrypt→decrypt
+(reference test model: crates/crypto/src/{crypto/mod.rs, header/file.rs,
+keys/hashing.rs} KATs + round-trips)."""
+
+import io
+import os
+
+import pytest
+
+from spacedrive_tpu.crypto import (
+    Algorithm,
+    Decryptor,
+    Encryptor,
+    FileHeader,
+    HashingAlgorithm,
+    KeyManager,
+    Params,
+    Protected,
+    generate_master_key,
+)
+from spacedrive_tpu.crypto.hashing import _balloon_blake3
+from spacedrive_tpu.crypto.header import Keyslot
+from spacedrive_tpu.crypto.keymanager import KeyManagerError
+from spacedrive_tpu.crypto.stream import BLOCK_LEN, CryptoError
+from spacedrive_tpu.crypto.xchacha import XChaCha20Poly1305, hchacha20
+from spacedrive_tpu.objects import blake3_ref
+
+
+# ---------------------------------------------------------------------------
+# primitives: known-answer vectors
+# ---------------------------------------------------------------------------
+
+def test_hchacha20_ietf_vector():
+    """draft-irtf-cfrg-xchacha §2.2.1 test vector."""
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000090000004a0000000031415927")
+    assert hchacha20(key, nonce).hex() == (
+        "82413b4227b27bfed30e42508a877d73"
+        "a0f9e4d58a74a853c12ec41326d3ecdc")
+
+
+def test_xchacha_roundtrip_and_tamper():
+    key = os.urandom(32)
+    aead = XChaCha20Poly1305(key)
+    nonce = os.urandom(24)
+    ct = aead.encrypt(nonce, b"payload", b"aad")
+    assert aead.decrypt(nonce, ct, b"aad") == b"payload"
+    with pytest.raises(Exception):
+        aead.decrypt(nonce, ct, b"other-aad")
+    bad = bytes([ct[0] ^ 1]) + ct[1:]
+    with pytest.raises(Exception):
+        aead.decrypt(nonce, bad, b"aad")
+
+
+def test_blake3_keyed_and_derive_cross_construction():
+    """Keyed/derive_key modes agree between the two independent tree
+    constructions on boundary-spanning sizes."""
+    key = bytes(range(32))
+    for size in (0, 1, 63, 64, 65, 1024, 1025, 3072, 5000):
+        data = bytes((i * 7 + 3) % 256 for i in range(size))
+        kw = blake3_ref._key_words(key)
+        assert blake3_ref.blake3(data, 32, kw, blake3_ref.KEYED_HASH) == \
+            blake3_ref.blake3_recursive(data, 32, kw, blake3_ref.KEYED_HASH), size
+    # derive_key is deterministic and context-separated
+    k1 = blake3_ref.derive_key("context one", b"material")
+    k2 = blake3_ref.derive_key("context two", b"material")
+    assert k1 != k2 and len(k1) == 32
+    assert k1 == blake3_ref.derive_key("context one", b"material")
+    # keyed differs from unkeyed
+    assert blake3_ref.blake3_keyed(key, b"x") != blake3_ref.blake3(b"x")
+
+
+# ---------------------------------------------------------------------------
+# streams
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("algorithm", list(Algorithm))
+def test_stream_roundtrip_multiblock(algorithm):
+    key = generate_master_key()
+    nonce = algorithm.generate_nonce()
+    # 2.5 blocks forces next/next/last sequencing
+    plain = os.urandom(BLOCK_LEN * 2 + BLOCK_LEN // 2)
+    src, dst = io.BytesIO(plain), io.BytesIO()
+    Encryptor.encrypt_streams(key, nonce, algorithm, src, dst, aad=b"hdr")
+    ct = dst.getvalue()
+    assert len(ct) == len(plain) + 3 * 16  # one tag per block
+    out = io.BytesIO()
+    Decryptor.decrypt_streams(key, nonce, algorithm, io.BytesIO(ct), out, aad=b"hdr")
+    assert out.getvalue() == plain
+
+
+def test_stream_rejects_block_reorder():
+    """LE31 counters make block order part of the ciphertext contract."""
+    algorithm = Algorithm.XCHACHA20_POLY1305
+    key = generate_master_key()
+    nonce = algorithm.generate_nonce()
+    plain = os.urandom(BLOCK_LEN * 3)
+    dst = io.BytesIO()
+    Encryptor.encrypt_streams(key, nonce, algorithm, io.BytesIO(plain), dst)
+    ct = dst.getvalue()
+    cb = BLOCK_LEN + 16
+    swapped = ct[cb:2 * cb] + ct[:cb] + ct[2 * cb:]
+    with pytest.raises(CryptoError):
+        Decryptor.decrypt_streams(key, nonce, algorithm,
+                                  io.BytesIO(swapped), io.BytesIO())
+
+
+def test_stream_rejects_truncation():
+    algorithm = Algorithm.XCHACHA20_POLY1305
+    key = generate_master_key()
+    nonce = algorithm.generate_nonce()
+    plain = os.urandom(BLOCK_LEN * 2)
+    dst = io.BytesIO()
+    Encryptor.encrypt_streams(key, nonce, algorithm, io.BytesIO(plain), dst)
+    cb = BLOCK_LEN + 16
+    truncated = dst.getvalue()[:cb]  # drop the last block entirely
+    with pytest.raises(CryptoError):
+        # the kept block was sealed as "next", not "last" — must not verify
+        Decryptor.decrypt_streams(key, nonce, algorithm,
+                                  io.BytesIO(truncated), io.BytesIO())
+
+
+def test_wrong_nonce_length_rejected():
+    key = generate_master_key()
+    with pytest.raises(CryptoError):
+        Encryptor(key, os.urandom(8), Algorithm.XCHACHA20_POLY1305)
+    with pytest.raises(CryptoError):
+        Encryptor(key, os.urandom(20), Algorithm.AES_256_GCM)
+
+
+# ---------------------------------------------------------------------------
+# password hashing
+# ---------------------------------------------------------------------------
+
+def test_balloon_blake3_deterministic_and_salted():
+    pw = Protected(b"password")
+    out1 = _balloon_blake3(pw, b"s" * 16, None, Params.STANDARD)
+    out2 = _balloon_blake3(Protected(b"password"), b"s" * 16, None, Params.STANDARD)
+    out3 = _balloon_blake3(Protected(b"password"), b"t" * 16, None, Params.STANDARD)
+    assert out1 == out2
+    assert out1 != out3
+    assert len(out1.expose()) == 32
+
+
+def test_argon2id_secret_changes_output():
+    algo = HashingAlgorithm.argon2id()
+    salt = b"x" * 16
+    plain = algo.hash(Protected("pw"), salt)
+    secret = algo.hash(Protected("pw"), salt, Protected(b"secretkey123456789"))
+    assert plain != secret
+
+
+# ---------------------------------------------------------------------------
+# header + keyslots
+# ---------------------------------------------------------------------------
+
+def test_header_roundtrip_with_two_keyslots_and_metadata():
+    master = generate_master_key()
+    header = FileHeader.new(Algorithm.XCHACHA20_POLY1305)
+    header.add_keyslot(Protected("password-one"), master)
+    header.add_keyslot(Protected("password-two"), master)
+    header.add_metadata(master, {"name": "secret.txt", "size": 123})
+    header.add_preview_media(master, b"\x89PNG fake bytes")
+    raw = header.serialize()
+
+    parsed, offset = FileHeader.from_bytes(raw)
+    assert offset == len(raw)
+    assert parsed.algorithm is Algorithm.XCHACHA20_POLY1305
+    assert len(parsed.keyslots) == 2
+    assert parsed.aad() == header.aad()
+
+    # either password recovers the master key
+    for pw in ("password-one", "password-two"):
+        mk = parsed.decrypt_master_key(Protected(pw))
+        assert mk.expose() == master.expose()
+    with pytest.raises(CryptoError):
+        parsed.decrypt_master_key(Protected("wrong"))
+
+    mk = parsed.decrypt_master_key(Protected("password-one"))
+    assert parsed.decrypt_metadata(mk) == {"name": "secret.txt", "size": 123}
+    assert parsed.decrypt_preview_media(mk) == b"\x89PNG fake bytes"
+
+
+def test_header_max_two_keyslots():
+    master = generate_master_key()
+    header = FileHeader.new()
+    header.add_keyslot(Protected("a"), master)
+    header.add_keyslot(Protected("b"), master)
+    with pytest.raises(CryptoError):
+        header.add_keyslot(Protected("c"), master)
+
+
+def test_header_bad_magic():
+    with pytest.raises(CryptoError):
+        FileHeader.from_reader(io.BytesIO(b"notmagic" + b"\x00" * 300))
+
+
+def test_keyslot_fixed_size():
+    master = generate_master_key()
+    slot = Keyslot.new(Algorithm.AES_256_GCM, HashingAlgorithm.argon2id(),
+                       Protected("pw"), master)
+    assert len(slot.encode()) == 112  # KEYSLOT_SIZE (keyslot.rs:47)
+    back = Keyslot.decode(slot.encode())
+    assert back.unseal(Protected("pw")).expose() == master.expose()
+
+
+# ---------------------------------------------------------------------------
+# key manager
+# ---------------------------------------------------------------------------
+
+def test_keymanager_lifecycle(tmp_path):
+    km = KeyManager(tmp_path / "keystore.json")
+    assert not km.is_setup
+    km.setup("master-pw")
+    kid = km.add_key("my key")
+    key_bytes = km.get_key(kid).expose()
+    assert len(key_bytes) == 32
+
+    # fresh instance from disk: locked until the master password unlocks it
+    km2 = KeyManager(tmp_path / "keystore.json")
+    assert km2.is_setup and not km2.is_unlocked
+    with pytest.raises(KeyManagerError):
+        km2.get_key(kid)
+    with pytest.raises(KeyManagerError):
+        km2.unlock("wrong-pw")
+    km2.unlock("master-pw")
+    assert km2.get_key(kid).expose() == key_bytes
+    assert km2.list_keys()[0]["name"] == "my key"
+
+    km2.lock()
+    assert not km2.is_unlocked
+    km2.unlock("master-pw")
+    km2.delete_key(kid)
+    assert km2.list_keys() == []
+
+
+# ---------------------------------------------------------------------------
+# job-level e2e
+# ---------------------------------------------------------------------------
+
+def test_encrypt_decrypt_jobs_byte_identical(tmp_data_dir, tmp_path):
+    from spacedrive_tpu.locations import create_location, scan_location
+    from spacedrive_tpu.node import Node
+
+    root = tmp_path / "vault"
+    root.mkdir()
+    payload = os.urandom(300_000)  # sampled-path size, not block-aligned
+    (root / "secret.bin").write_bytes(payload)
+
+    node = Node(tmp_data_dir, probe_accelerator=False)
+    try:
+        lib = node.libraries.create("crypto-lib")
+        loc = create_location(lib, root, hasher="cpu")
+        scan_location(lib, loc["id"])
+        assert node.jobs.wait_idle(120)
+
+        row = lib.db.query("SELECT id FROM file_path WHERE name='secret'")[0]
+        node.router.resolve("files.encryptFiles", {
+            "sources": [row["id"]], "password": "hunter2",
+            "metadata": True, "erase_original": True}, library_id=lib.id)
+        assert node.jobs.wait_idle(120)
+        enc = root / "secret.bin.bytes"
+        assert enc.exists() and not (root / "secret.bin").exists()
+        assert enc.read_bytes()[:7] == b"sdtpenc"
+
+        # wrong password: job reports errors, no plaintext emitted
+        rows = lib.db.query("SELECT id FROM file_path WHERE name='secret.bin'")
+        assert rows, "encrypted file not re-indexed"
+        node.router.resolve("files.decryptFiles", {
+            "sources": [rows[0]["id"]], "password": "wrong"}, library_id=lib.id)
+        assert node.jobs.wait_idle(120)
+        assert not (root / "secret.bin").exists()
+
+        node.router.resolve("files.decryptFiles", {
+            "sources": [rows[0]["id"]], "password": "hunter2",
+            "erase_original": True}, library_id=lib.id)
+        assert node.jobs.wait_idle(120)
+        assert (root / "secret.bin").read_bytes() == payload
+        assert not enc.exists()
+    finally:
+        node.shutdown()
