@@ -8,64 +8,86 @@ mounted: setup/unlock/lock state, stored-key CRUD, mount/unmount.
 
 from __future__ import annotations
 
+import functools
+
+from ...crypto.keymanager import KeyManagerError
 from ..router import ApiError
 
 
-def mount(router) -> None:
-    def _km(node):
-        km = getattr(node, "key_manager", None)
-        if km is None:
-            raise ApiError("no key manager on this node")
-        return km
+def _km(node):
+    km = getattr(node, "key_manager", None)
+    if km is None:
+        raise ApiError("no key manager on this node")
+    return km
 
+
+def _translate(fn):
+    """Locked/not-set-up/wrong-password are client errors, not server bugs."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except KeyManagerError as e:
+            raise ApiError(str(e))
+
+    return wrapper
+
+
+def mount(router) -> None:
     @router.query("keys.isSetup")
+    @_translate
     def is_setup(node, _arg=None):
         return _km(node).is_setup
 
     @router.query("keys.isUnlocked")
+    @_translate
     def is_unlocked(node, _arg=None):
         return _km(node).is_unlocked
 
     @router.mutation("keys.setup")
+    @_translate
     def setup(node, password: str):
         _km(node).setup(password)
         return True
 
     @router.mutation("keys.unlockKeyManager")
+    @_translate
     def unlock(node, password: str):
-        from ...crypto.keymanager import KeyManagerError
-
-        try:
-            _km(node).unlock(password)
-        except KeyManagerError as e:
-            raise ApiError(str(e))
+        _km(node).unlock(password)
         return True
 
     @router.mutation("keys.lockKeyManager")
+    @_translate
     def lock(node, _arg=None):
         _km(node).lock()
         return True
 
     @router.query("keys.list")
+    @_translate
     def list_keys(node, _arg=None):
         return _km(node).list_keys()
 
     @router.mutation("keys.add")
+    @_translate
     def add(node, arg):
         name = (arg or {}).get("name", "") if isinstance(arg, dict) else (arg or "")
         return _km(node).add_key(name)
 
     @router.mutation("keys.mount")
+    @_translate
     def mount_key(node, key_uuid: str):
         _km(node).mount(key_uuid)
         return True
 
     @router.mutation("keys.unmount")
+    @_translate
     def unmount_key(node, key_uuid: str):
         _km(node).unmount(key_uuid)
         return True
 
     @router.mutation("keys.deleteFromLibrary")
+    @_translate
     def delete(node, key_uuid: str):
         _km(node).delete_key(key_uuid)
         return True

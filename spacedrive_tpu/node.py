@@ -74,6 +74,13 @@ class Node:
                 logger.info("cold-resumed %d jobs for library %s", revived, library.id[:8])
         self._start_p2p()
 
+        # dev fixtures (util/debug_initializer.rs:32-56): applied once the
+        # managers are live so declared libraries/locations/scans behave
+        # exactly like API-driven ones
+        from .utils import debug_initializer
+
+        debug_initializer.apply(self)
+
         # api::mount last — validates the invalidation-key contract
         # (api/mod.rs:102, invalidate.rs:82)
         from .api.router import mount as api_mount

@@ -19,10 +19,10 @@ import os
 from pathlib import Path
 from typing import Any
 
-from ..crypto import Algorithm, FileHeader, HashingAlgorithm, Protected
+from ..crypto import Algorithm, FileHeader, Protected
 from ..crypto.primitives import generate_master_key
 from ..crypto.stream import CryptoError, Decryptor, Encryptor
-from ..jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
+from ..jobs import EarlyFinish, JobError, StepResult, WorkerContext
 from .fs import _FsJob, find_available_name
 
 logger = logging.getLogger(__name__)

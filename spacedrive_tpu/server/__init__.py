@@ -1,0 +1,10 @@
+"""Headless server shell (apps/server equivalent).
+
+`python -m spacedrive_tpu.server --data-dir DIR --port N` boots a Node and
+serves /health, /rspc (HTTP + websocket JSON-RPC), /schema, and the
+/spacedrive custom_uri file+thumbnail routes.
+"""
+
+from .shell import Server
+
+__all__ = ["Server"]

@@ -1,0 +1,60 @@
+"""CLI entry: the headless server shell (apps/server/src/main.rs).
+
+Env parity: DATA_DIR and PORT are honored like the reference (main.rs:15-33);
+SD_AUTH=user:password enables basic auth; SD_INIT_DATA points at a debug
+fixture file (util/debug_initializer.rs:79).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="spacedrive_tpu.server")
+    parser.add_argument("--data-dir",
+                        default=os.environ.get("DATA_DIR", "./sd_data"))
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int,
+                        default=int(os.environ.get("PORT", "8080")))
+    parser.add_argument("--auth", default=os.environ.get("SD_AUTH"),
+                        help="user:password for basic auth")
+    parser.add_argument("--log-level", default=os.environ.get("SD_LOG", "INFO"))
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname).1s %(name)s %(message)s")
+
+    from ..node import Node
+    from .shell import Server
+
+    node = Node(args.data_dir)
+    server = Server(node, args.host, args.port, auth=args.auth)
+    server.start()
+    # announce the bound port on stdout so drivers/tests can parse it
+    print(f"LISTENING {server.host}:{server.port}", flush=True)
+
+    stop = {"flag": False}
+
+    def on_signal(_sig, _frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        while not stop["flag"]:
+            signal.pause()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    node.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

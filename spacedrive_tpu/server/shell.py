@@ -1,0 +1,431 @@
+"""Headless server shell: HTTP + websocket transport over the Node's router.
+
+Reference: apps/server/src/main.rs:49-80 (axum: `/health`, `/spacedrive`
+custom_uri, `/rspc` websocket) and core/src/custom_uri.rs:84 (streaming
+file/thumbnail server with HttpRange partial content and remote-over-p2p
+serving). This is the process boundary the reference's entire frontend
+contract crosses; procedures resolve on a worker-thread pool so slow DB
+work never stalls the accept loop.
+
+Routes:
+    GET  /health                                   → "OK"
+    GET  /rspc/<key>?arg=<json>[&library_id=]      → query
+    POST /rspc/<key>   {"arg":..,"library_id":..}  → query or mutation
+    GET  /rspc/ws (Upgrade: websocket)             → JSON-RPC incl. subscriptions
+    GET  /spacedrive/thumbnail/<shard>/<cas>.webp  → thumbnail cache, ranged
+    GET  /spacedrive/file/<library>/<loc>/<fp_id>  → file bytes, ranged;
+         owned by another instance → fetched over the p2p File header
+    GET  /schema                                   → router schema export
+
+websocket JSON-RPC (the rspc wire shape, packages/client core.ts):
+    → {"id":1,"method":"query"|"mutation","params":{"path":k,"input":..}}
+    ← {"jsonrpc":"2.0","id":1,"result":{"type":"response","data":..}}
+    → {"id":2,"method":"subscription","params":{"path":k,"input":..}}
+    ← {"jsonrpc":"2.0","id":2,"result":{"type":"event","data":..}} (each event)
+    → {"id":3,"method":"subscriptionStop","params":{"subscriptionId":2}}
+Library-scoped procedures take input = {"library_id":.., "arg":..} — the
+LibraryArgs<T> envelope (api/utils/library.rs:50).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import io
+import json
+import logging
+import secrets
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import TYPE_CHECKING, Any
+
+from ..api.router import ApiError
+from .http import (
+    HttpError,
+    Request,
+    Response,
+    WebSocket,
+    parse_range,
+    read_request,
+    write_response,
+)
+
+if TYPE_CHECKING:
+    from ..node import Node
+
+logger = logging.getLogger(__name__)
+
+#: cap for spooled remote-over-p2p serves (see _serve_remote)
+MAX_REMOTE_SPOOL = 64 * 1024 * 1024
+
+
+class Server:
+    def __init__(self, node: "Node", host: str = "127.0.0.1", port: int = 8080,
+                 auth: str | None = None) -> None:
+        """``auth``: optional "user:password" enabling basic auth on every
+        route except /health (the reference server's basic-auth util)."""
+        self.node = node
+        self.host = host
+        self.port = port
+        self.auth = auth
+        self._server: asyncio.AbstractServer | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._pool = ThreadPoolExecutor(max_workers=8,
+                                        thread_name_prefix="rspc")
+        self._ready = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        """Run the accept loop on a dedicated thread; returns once bound."""
+        self._thread = threading.Thread(target=self._run, name="sd-server",
+                                        daemon=True)
+        self._thread.start()
+        if not self._ready.wait(10):
+            raise RuntimeError("server failed to bind")
+
+    def _run(self) -> None:
+        asyncio.run(self._serve())
+
+    async def _serve(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(
+            self._client, self.host, self.port)
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        self._ready.set()
+        logger.info("server listening on %s:%s", self.host, self.port)
+        async with self._server:
+            try:
+                await self._server.serve_forever()
+            except asyncio.CancelledError:
+                pass
+
+    def stop(self) -> None:
+        loop, server = self._loop, self._server
+        if loop is not None and server is not None:
+            loop.call_soon_threadsafe(server.close)
+            # serve_forever unblocks when the server closes
+            loop.call_soon_threadsafe(
+                lambda: [t.cancel() for t in asyncio.all_tasks(loop)])
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._pool.shutdown(wait=False)
+
+    # -- connection handling -------------------------------------------------
+    async def _client(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    req = await read_request(reader)
+                except HttpError as e:
+                    await write_response(
+                        writer, Request("GET", "/", {}, {}, b""),
+                        Response.error(e.status, str(e)))
+                    break
+                if req is None:
+                    break
+                if req.header("upgrade", "").lower() == "websocket":
+                    try:
+                        await self._websocket(req, reader, writer)
+                    except HttpError as e:
+                        await write_response(writer, req,
+                                             Response.error(e.status, str(e)))
+                    break
+                try:
+                    resp = await self._route(req)
+                except HttpError as e:
+                    resp = Response.error(e.status, str(e))
+                except ApiError as e:
+                    resp = Response.error(400, str(e))
+                except Exception:
+                    logger.exception("request failed: %s %s", req.method, req.path)
+                    resp = Response.error(500)
+                await write_response(writer, req, resp)
+                if req.header("connection", "").lower() == "close":
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, RuntimeError):
+                pass
+
+    def _check_auth(self, req: Request) -> None:
+        if self.auth is None:
+            return
+        header = req.header("authorization")
+        expect = "Basic " + base64.b64encode(self.auth.encode()).decode()
+        if not secrets.compare_digest(header, expect):
+            raise HttpError(401, "authentication required")
+
+    async def _route(self, req: Request) -> Response:
+        parts = [p for p in req.path.split("/") if p]
+        if req.path == "/health":
+            return Response.text("OK")
+        self._check_auth(req)
+        if not parts:
+            return Response.json({"server": "spacedrive_tpu",
+                                  "node": self.node.config.get().get("name")})
+        if parts[0] == "rspc":
+            return await self._rspc_http(req, "/".join(parts[1:]))
+        if parts[0] == "schema":
+            return Response.json(self.node.router.schema())
+        if parts[0] == "spacedrive":
+            return await self._custom_uri(req, parts[1:])
+        raise HttpError(404)
+
+    # -- rspc over plain HTTP ------------------------------------------------
+    async def _rspc_http(self, req: Request, key: str) -> Response:
+        if not key:
+            raise HttpError(404)
+        if req.method == "GET":
+            arg = json.loads(req.query["arg"]) if "arg" in req.query else None
+            library_id = req.query.get("library_id")
+        elif req.method == "POST":
+            payload = json.loads(req.body.decode() or "{}")
+            arg = payload.get("arg")
+            library_id = payload.get("library_id")
+        else:
+            raise HttpError(405)
+        try:
+            result = await self._resolve(key, arg, library_id)
+        except ApiError as e:
+            return Response.json({"error": str(e)}, 400)
+        return Response.json({"result": result})
+
+    async def _resolve(self, key: str, arg: Any, library_id: str | None) -> Any:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._pool, lambda: self.node.router.resolve(key, arg, library_id))
+
+    # -- custom_uri (custom_uri.rs:84) ---------------------------------------
+    async def _custom_uri(self, req: Request, parts: list[str]) -> Response:
+        if req.method not in ("GET", "HEAD"):
+            raise HttpError(405)
+        if len(parts) == 3 and parts[0] == "thumbnail":
+            from ..objects.media.thumbnail import thumbnail_dir
+
+            shard, name = parts[1], parts[2]
+            if "/" in name or ".." in name or ".." in shard:
+                raise HttpError(400)
+            path = Path(thumbnail_dir(self.node.data_dir)) / shard / name
+            if not path.is_file():
+                raise HttpError(404, "no such thumbnail")
+            rng = parse_range(req.header("range"), path.stat().st_size)
+            return Response(headers={"content-type": "image/webp"},
+                            file_path=path, file_range=rng)
+        if len(parts) == 4 and parts[0] == "file":
+            return await self._serve_file(req, parts[1], parts[2], parts[3])
+        raise HttpError(404)
+
+    async def _serve_file(self, req: Request, library_id: str,
+                          location_id: str, file_path_id: str) -> Response:
+        from ..models import FilePath, Instance, Location
+
+        try:
+            library = self.node.libraries.get(library_id)
+        except KeyError:
+            raise HttpError(404, "no such library")
+        db = library.db
+        row = db.find_one(FilePath, {"id": int(file_path_id)})
+        if row is None or row["location_id"] != int(location_id):
+            raise HttpError(404, "no such file_path")
+        location = db.find_one(Location, {"id": row["location_id"]})
+        if location is None:
+            raise HttpError(404, "no such location")
+
+        if location.get("instance_id") not in (None, library.instance_id):
+            return await self._serve_remote(req, library, location, row)
+
+        from ..objects.fs import file_path_abs
+
+        try:
+            _row, path = file_path_abs(db, row["id"])
+            size = path.stat().st_size
+        except (OSError, ValueError) as e:
+            raise HttpError(404, f"file missing on disk: {e}")
+        rng = parse_range(req.header("range"), size)
+        ext = (row.get("extension") or "").lower()
+        return Response(headers={"content-type": _mime(ext)},
+                        file_path=path, file_range=rng)
+
+    async def _serve_remote(self, req: Request, library, location,
+                            row) -> Response:
+        """ServeFrom::Remote (custom_uri.rs:64-69): the location belongs to
+        another instance — fetch the ranged bytes over the p2p File header."""
+        from ..models import Instance
+        from ..p2p.identity import remote_identity_of
+        from ..p2p.spaceblock import Range
+
+        p2p = self.node.p2p
+        if p2p is None:
+            raise HttpError(404, "remote file and p2p is offline")
+        instance = library.db.find_one(Instance, {"id": location["instance_id"]})
+        if instance is None:
+            raise HttpError(404, "unknown owning instance")
+        try:
+            peer_id = remote_identity_of(instance["identity"]).encode()
+        except Exception:
+            raise HttpError(404, "instance has no p2p identity")
+        if peer_id not in p2p.peers:
+            raise HttpError(404, "owning node is not connected")
+        size = row.get("size_in_bytes") or 0
+        rng = parse_range(req.header("range"), size) if size else None
+        start, end = rng if rng else (0, size)
+        # remote bytes are spooled before responding; bound the spool so a
+        # handful of concurrent video fetches cannot OOM the shell — large
+        # remote reads must come as ranged requests
+        if end - start > MAX_REMOTE_SPOOL:
+            raise HttpError(
+                416, f"remote serve is capped at {MAX_REMOTE_SPOOL} bytes "
+                     f"per request; use Range")
+        sink = io.BytesIO()
+        future = asyncio.run_coroutine_threadsafe(
+            p2p.request_file(peer_id, library.id, row["pub_id"],
+                             Range(start, end if rng else None), sink),
+            p2p._loop)
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, lambda: future.result(60))
+        except Exception as e:
+            raise HttpError(404, f"remote fetch failed: {e}")
+        body = sink.getvalue()
+        headers = {"content-type": _mime((row.get("extension") or "").lower()),
+                   "accept-ranges": "bytes"}
+        status = 200
+        if rng:
+            headers["content-range"] = f"bytes {start}-{end - 1}/{size}"
+            status = 206
+        return Response(status, headers, body)
+
+    # -- rspc over websocket -------------------------------------------------
+    async def _websocket(self, req: Request, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        self._check_auth(req)
+        key = req.header("sec-websocket-key")
+        if not key:
+            raise HttpError(400, "missing websocket key")
+        accept = WebSocket.accept_key(key)
+        writer.write(
+            ("HTTP/1.1 101 Switching Protocols\r\n"
+             "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"Sec-WebSocket-Accept: {accept}\r\n\r\n").encode())
+        await writer.drain()
+        ws = WebSocket(reader, writer)
+        subs: dict[Any, tuple[Any, threading.Thread]] = {}
+        loop = asyncio.get_running_loop()
+        send_lock = asyncio.Lock()
+
+        async def send(obj: dict) -> None:
+            async with send_lock:
+                await ws.send_text(json.dumps(obj, default=str))
+
+        def pump(sub_id: Any, subscription) -> None:
+            """Worker thread: blocking-drain a Subscription into the socket."""
+            for event in subscription:
+                payload = {"jsonrpc": "2.0", "id": sub_id,
+                           "result": {"type": "event", "data": _event_wire(event)}}
+                fut = asyncio.run_coroutine_threadsafe(send(payload), loop)
+                try:
+                    fut.result(10)
+                except Exception:
+                    break
+
+        try:
+            while True:
+                raw = await ws.recv()
+                if raw is None:
+                    break
+                try:
+                    msg = json.loads(raw)
+                except json.JSONDecodeError:
+                    await send({"jsonrpc": "2.0", "id": None,
+                                "result": {"type": "error",
+                                           "data": {"code": 400,
+                                                    "message": "bad json"}}})
+                    continue
+                await self._ws_message(msg, send, subs, pump)
+        finally:
+            for subscription, thread in subs.values():
+                subscription.close()
+            for _subscription, thread in subs.values():
+                thread.join(timeout=2)
+
+    async def _ws_message(self, msg: dict, send, subs: dict, pump) -> None:
+        msg_id = msg.get("id")
+        method = msg.get("method")
+        params = msg.get("params") or {}
+        path = params.get("path", "")
+        input_ = params.get("input")
+        library_id, arg = _split_library_args(input_)
+
+        async def reply_error(code: int, message: str) -> None:
+            await send({"jsonrpc": "2.0", "id": msg_id,
+                        "result": {"type": "error",
+                                   "data": {"code": code, "message": message}}})
+
+        if method in ("query", "mutation"):
+            try:
+                data = await self._resolve(path, arg, library_id)
+            except ApiError as e:
+                await reply_error(400, str(e))
+                return
+            except Exception:
+                logger.exception("ws %s %s failed", method, path)
+                await reply_error(500, "internal error")
+                return
+            await send({"jsonrpc": "2.0", "id": msg_id,
+                        "result": {"type": "response", "data": data}})
+        elif method == "subscription":
+            try:
+                subscription = self.node.router.subscribe(path, arg, library_id)
+            except ApiError as e:
+                await reply_error(400, str(e))
+                return
+            thread = threading.Thread(target=pump, args=(msg_id, subscription),
+                                      name=f"ws-sub-{path}", daemon=True)
+            subs[msg_id] = (subscription, thread)
+            thread.start()
+            await send({"jsonrpc": "2.0", "id": msg_id,
+                        "result": {"type": "started"}})
+        elif method == "subscriptionStop":
+            sub_id = params.get("subscriptionId", msg_id)
+            pair = subs.pop(sub_id, None)
+            if pair is not None:
+                pair[0].close()
+            await send({"jsonrpc": "2.0", "id": msg_id,
+                        "result": {"type": "stopped"}})
+        else:
+            await reply_error(400, f"unknown method {method!r}")
+
+
+def _split_library_args(input_: Any) -> tuple[str | None, Any]:
+    """LibraryArgs envelope: {"library_id": .., "arg": ..} → (lib, arg)."""
+    if isinstance(input_, dict) and "library_id" in input_:
+        return input_["library_id"], input_.get("arg")
+    return None, input_
+
+
+def _event_wire(event: Any) -> Any:
+    if hasattr(event, "kind"):
+        return {"kind": event.kind, "payload": getattr(event, "payload", None),
+                "library_id": getattr(event, "library_id", None)}
+    return event
+
+
+_MIME = {
+    "webp": "image/webp", "png": "image/png", "jpg": "image/jpeg",
+    "jpeg": "image/jpeg", "gif": "image/gif", "svg": "image/svg+xml",
+    "mp4": "video/mp4", "webm": "video/webm", "mp3": "audio/mpeg",
+    "pdf": "application/pdf", "txt": "text/plain", "json": "application/json",
+    "html": "text/html",
+}
+
+
+def _mime(ext: str) -> str:
+    return _MIME.get(ext, "application/octet-stream")

@@ -1,0 +1,89 @@
+"""Debug initializer: declarative dev fixtures applied at boot.
+
+Reference: core/src/util/debug_initializer.rs:32-56 — an `sd_init.json`
+(path from SD_INIT_DATA, :79, else `<data_dir>/sd_init.json`) declares
+libraries and locations to ensure/reset on startup; upstream uses it as the
+de-facto e2e harness, and the server shell tests here do the same.
+
+Schema:
+{
+  "libraries": [
+    {"name": "dev", "reset_on_startup": false,
+     "locations": [{"path": "/tmp/tree", "scan": true, "hasher": "hybrid"}]}
+  ]
+}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from pathlib import Path
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from ..node import Node
+
+logger = logging.getLogger(__name__)
+
+
+def init_config_path(data_dir: str | Path) -> Path | None:
+    env = os.environ.get("SD_INIT_DATA")
+    if env:
+        return Path(env)
+    default = Path(data_dir) / "sd_init.json"
+    return default if default.exists() else None
+
+
+def apply(node: "Node") -> None:
+    """Idempotent: existing libraries/locations are reused unless
+    reset_on_startup asks for a clean slate (debug_initializer.rs:40-52)."""
+    path = init_config_path(node.data_dir)
+    if path is None:
+        return
+    try:
+        config: dict[str, Any] = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        logger.warning("sd_init.json unreadable (%s); skipping fixtures", e)
+        return
+
+    from ..locations import create_location, scan_location
+    from ..models import Location
+
+    for spec in config.get("libraries", []):
+        name = spec.get("name") or "debug"
+        existing = [lib for lib in node.libraries.list()
+                    if lib.config.get("name") == name]
+        if existing and spec.get("reset_on_startup"):
+            logger.info("sd_init: resetting library %r", name)
+            for lib in existing:
+                node.libraries.delete(lib.id)
+            existing = []
+        library = existing[0] if existing else node.libraries.create(name)
+        for loc_spec in spec.get("locations", []):
+            loc_path = Path(loc_spec["path"])
+            if not loc_path.is_dir():
+                logger.warning("sd_init: location path missing: %s", loc_path)
+                continue
+            row = None
+            for candidate in library.db.find(Location):
+                if candidate["path"] and Path(candidate["path"]) == loc_path.resolve():
+                    row = candidate
+                    break
+            if row is None:
+                try:
+                    row = create_location(
+                        library, loc_path,
+                        name=loc_spec.get("name"),
+                        hasher=loc_spec.get("hasher", "hybrid"))
+                except Exception as e:
+                    logger.warning("sd_init: create_location(%s): %s", loc_path, e)
+                    continue
+            if loc_spec.get("scan"):
+                try:
+                    scan_location(library, row["id"])
+                except Exception:
+                    logger.exception("sd_init: scan failed for %s", loc_path)
+        logger.info("sd_init: library %r ready (%d locations)", name,
+                    len(spec.get("locations", [])))
