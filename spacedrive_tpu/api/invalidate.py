@@ -34,6 +34,7 @@ USED_KEYS: set[str] = {
     "jobs.reports",
     "notifications.get",
     "libraries.list",
+    "search.duplicates",
 }
 
 _RUNTIME_KEYS: set[str] = set()

@@ -156,6 +156,29 @@ def mount(router) -> None:
                               include_hidden=bool(arg.get("include_hidden")),
                               with_cas_ids=bool(arg.get("with_cas_ids")))
 
+    @router.library_query("search.duplicates")
+    def duplicates(node, library, arg):
+        """Persisted near-duplicate pairs written by the chained
+        dedup_detector job (near_duplicate table)."""
+        arg = arg or {}
+        where, params = "1=1", []
+        if arg.get("location_id") is not None:
+            where = "(fa.location_id = ? OR fb.location_id = ?)"
+            params = [arg["location_id"], arg["location_id"]]
+        limit = max(0, min(int(arg.get("take", 200)), 1000))
+        rows = library.db.query(
+            f"SELECT nd.id, nd.similarity, nd.date_detected, "
+            f"fa.id AS a_id, fa.materialized_path AS a_dir, fa.name AS a_name, "
+            f"fa.extension AS a_ext, fa.size_in_bytes AS a_size, "
+            f"fb.id AS b_id, fb.materialized_path AS b_dir, fb.name AS b_name, "
+            f"fb.extension AS b_ext, fb.size_in_bytes AS b_size "
+            f"FROM near_duplicate nd "
+            f"JOIN file_path fa ON nd.file_path_a_id = fa.id "
+            f"JOIN file_path fb ON nd.file_path_b_id = fb.id "
+            f"WHERE {where} ORDER BY nd.similarity DESC, nd.id LIMIT ?",
+            params + [limit])
+        return [dict(r) for r in rows]
+
     @router.library_query("search.nearDuplicates")
     def near_duplicates(node, library, arg):
         """TPU MinHash similarity groups (beyond the reference's exact-cas_id

@@ -18,3 +18,14 @@ __all__ = [
     "merge_metadata", "MAX_WORKERS", "Jobs", "JobReport", "JobStatus",
     "Worker", "WorkerCommand", "WorkerContext",
 ]
+
+
+def register_builtin_jobs() -> None:
+    """Import every job-bearing module so JOB_REGISTRY is fully populated
+    BEFORE cold resume runs — a checkpointed job whose module was never
+    imported would otherwise be unresumable and get canceled (the
+    reference's name→type dispatch macro lists all types statically,
+    job/manager.rs:376-401; this is the import-time equivalent)."""
+    from ..locations import indexer_job  # noqa: F401
+    from ..objects import crypto_jobs, dedup, file_identifier, fs, validator  # noqa: F401
+    from ..objects.media import processor  # noqa: F401

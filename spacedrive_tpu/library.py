@@ -80,6 +80,9 @@ class Library:
         return self.db.insert(Instance, row)
 
     def close(self) -> None:
+        remover = getattr(self, "orphan_remover", None)
+        if remover is not None:
+            remover.stop()
         self.db.close()
 
 
@@ -143,12 +146,15 @@ class Libraries:
 
     def _attach_services(self, library: Library) -> None:
         from .config import BackendFeature
+        from .objects.gc import OrphanRemoverActor
         from .sync.manager import SyncManager  # cycle-free local import
 
         library.sync = SyncManager(library)
         if self.node is not None:
             features = self.node.config.get().get("features", [])
             library.sync.emit_messages = BackendFeature.SYNC_EMIT_MESSAGES in features
+        # per-library GC (library.rs holds the orphan remover on Library)
+        library.orphan_remover = OrphanRemoverActor(library)
 
     def create(self, name: str, description: str = "",
                lib_id: str | None = None,

@@ -100,6 +100,7 @@ def scan_location(library: "Library", location_id: int,
                   sub_path: str | None = None) -> str:
     """The 3-stage chained pipeline (location/mod.rs:428-459):
     indexer → file_identifier → media_processor. Returns head job id."""
+    from ..objects.dedup import DedupDetectorJob
     from ..objects.file_identifier import FileIdentifierJob
     from ..objects.media.processor import MediaProcessorJob
 
@@ -112,6 +113,10 @@ def scan_location(library: "Library", location_id: int,
     jobs = [IndexerJob(args), FileIdentifierJob(dict(args))]
     if row.get("generate_preview_media") is not False:
         jobs.append(MediaProcessorJob(dict(args)))
+    # 4th chained stage (ours): persist near-duplicate pairs found by the
+    # device MinHash sweep — full scans only, sub-path rescans skip it
+    if not sub_path:
+        jobs.append(DedupDetectorJob({"location_id": location_id}))
     return library.node.jobs.spawn(library, jobs, action="scan_location")
 
 

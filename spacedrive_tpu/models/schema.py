@@ -394,6 +394,25 @@ class Notification(Model):
     }
 
 
+class NearDuplicate(Model):
+    """Near-duplicate pair found by the MinHash detector (this framework's
+    extension — the reference only collapses exact cas_id matches). Derived,
+    local-only data (like thumbnails): not synced, rebuilt by rescans, rows
+    cascade away with their file_paths."""
+
+    TABLE = "near_duplicate"
+    FIELDS = {
+        "id": _pk(),
+        "file_path_a_id": Field(_I, nullable=False,
+                                references="file_path.id", on_delete="CASCADE"),
+        "file_path_b_id": Field(_I, nullable=False,
+                                references="file_path.id", on_delete="CASCADE"),
+        "similarity": Field("REAL", nullable=False),
+        "date_detected": Field(_D),
+    }
+    UNIQUES = (("file_path_a_id", "file_path_b_id"),)
+
+
 ALL_MODELS: tuple[type[Model], ...] = (
     Instance,  # referenced by op-log tables, create first
     SharedOperationRow,
@@ -418,6 +437,7 @@ ALL_MODELS: tuple[type[Model], ...] = (
     IndexerRulesInLocation,
     Preference,
     Notification,
+    NearDuplicate,
 )
 
 SYNCED_MODELS: dict[str, type[Model]] = {

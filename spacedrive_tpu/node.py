@@ -61,11 +61,17 @@ class Node:
         from .crypto.keymanager import KeyManager
 
         self.key_manager = KeyManager(self.data_dir / "keystore.json")
+        from .objects.gc import ThumbnailRemoverActor
+
+        self.thumbnail_remover = ThumbnailRemoverActor(self)
 
         if probe_accelerator:
             self.config.write(accelerator=_probe_accelerator())
 
         # ordering-critical start sequence (lib.rs:126-130)
+        from .jobs import register_builtin_jobs
+
+        register_builtin_jobs()  # JOB_REGISTRY must be full before cold_resume
         self._start_locations()
         self.libraries.init()
         for library in self.libraries.list():
@@ -122,4 +128,5 @@ class Node:
             self.locations.stop()
         if self.p2p is not None:
             self.p2p.stop()
+        self.thumbnail_remover.stop()
         self.libraries.close()

@@ -15,6 +15,7 @@ from typing import TYPE_CHECKING, Any
 
 import numpy as np
 
+from ..jobs import EarlyFinish, StatefulJob, StepResult, WorkerContext
 from ..models import FilePath
 from .cas import MINIMUM_FILE_SIZE, SAMPLED_MESSAGE_LEN
 
@@ -46,7 +47,7 @@ def find_near_duplicates(library: "Library", location_id: int | None = None,
         f"SELECT * FROM file_path WHERE {where} ORDER BY id LIMIT ?",
         params + [limit])]
     if len(rows_db) < 2:
-        return {"groups": [], "scanned": len(rows_db), "errors": []}
+        return {"groups": [], "pairs": [], "scanned": len(rows_db), "errors": []}
 
     from .fs import location_path_of
 
@@ -96,6 +97,7 @@ def find_near_duplicates(library: "Library", location_id: int | None = None,
     # rows keeps this O(n_dup * n))
     groups: dict[int, list[int]] = {}
     assigned: dict[int, int] = {}
+    pairs: list[dict[str, Any]] = []
     flagged = [i for i in range(n) if dup[i]]
     for i in flagged:
         eq = (sigs[i][None, :] == sigs[:i]).sum(axis=1)
@@ -104,8 +106,75 @@ def find_near_duplicates(library: "Library", location_id: int | None = None,
             root = assigned.get(j, j)
             groups.setdefault(root, [root] if root not in assigned else []).append(i)
             assigned[i] = root
+            pairs.append({"a": rows_db[j], "b": rows_db[i],
+                          "similarity": float(eq[j]) / K})
     out_groups = []
     for root, members in groups.items():
         ids = sorted({root, *members})
         out_groups.append([rows_db[i] for i in ids])
-    return {"groups": out_groups, "scanned": n, "errors": errors}
+    return {"groups": out_groups, "pairs": pairs, "scanned": n,
+            "errors": errors}
+
+
+class DedupDetectorJob(StatefulJob):
+    """Chained detector persisting near-dup pairs into `near_duplicate`
+    (this framework's 4th pipeline stage after indexer → identifier →
+    media; the reference has no analogue — it only collapses exact
+    cas_id matches). One step = one device MinHash batch over up to
+    DEVICE_LIMIT sampled-size files; bigger locations are truncated
+    loudly (no silent caps) until windowed all-pairs lands."""
+
+    NAME = "dedup_detector"
+    IS_BATCHED = True
+
+    #: rows per detection pass (one device all-pairs batch)
+    DEVICE_LIMIT = 8192
+
+    def init(self, ctx: WorkerContext):
+        db = ctx.library.db
+        location_id = self.init_args["location_id"]
+        count = db.query(
+            "SELECT COUNT(*) n FROM file_path WHERE is_dir = 0 "
+            "AND location_id = ? AND size_in_bytes > ?",
+            [location_id, MINIMUM_FILE_SIZE])[0]["n"]
+        if count < 2:
+            raise EarlyFinish("not enough sampled-size files for dedup")
+        if count > self.DEVICE_LIMIT:
+            logger.warning(
+                "dedup_detector: location %s has %d eligible files; only the "
+                "first %d are compared this pass", location_id, count,
+                self.DEVICE_LIMIT)
+        data = {"location_id": location_id,
+                "threshold": float(self.init_args.get("threshold", 0.8))}
+        return data, [{"kind": "detect"}], {"pairs_found": 0, "scanned": 0}
+
+    def execute_step(self, ctx: WorkerContext, data, step, step_number):
+        from ..models import NearDuplicate, utc_now
+
+        db = ctx.library.db
+        result = find_near_duplicates(
+            ctx.library, data["location_id"], threshold=data["threshold"],
+            limit=self.DEVICE_LIMIT)
+        rows = []
+        for pair in result["pairs"]:
+            a, b = pair["a"]["id"], pair["b"]["id"]
+            rows.append({"file_path_a_id": min(a, b),
+                         "file_path_b_id": max(a, b),
+                         "similarity": pair["similarity"],
+                         "date_detected": utc_now()})
+        with db.transaction():
+            # rescan refreshes the location's pair set
+            db.query(
+                "DELETE FROM near_duplicate WHERE file_path_a_id IN "
+                "(SELECT id FROM file_path WHERE location_id = ?)",
+                [data["location_id"]])
+            if rows:
+                db.insert_many(NearDuplicate, rows, or_ignore=True)
+        ctx.progress(message=f"{len(rows)} near-duplicate pairs")
+        return StepResult(metadata={"pairs_found": len(rows),
+                                    "scanned": result["scanned"]},
+                          errors=[str(e) for e in result["errors"]])
+
+    def finalize(self, ctx: WorkerContext, data, run_metadata):
+        ctx.library.emit("invalidate_query", {"key": "search.duplicates"})
+        return run_metadata

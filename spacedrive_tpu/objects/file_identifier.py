@@ -25,7 +25,7 @@ from ..jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
 from ..models import FilePath, Location, Object, utc_now
 from ..sync.crdt import ref
 from .hasher import get_hasher
-from .kind import kind_from_extension
+from .kind import kind_from_extension  # noqa: F401 (re-exported for callers)
 
 
 def ref_obj(pub_id: str):
@@ -148,7 +148,8 @@ class FileIdentifierJob(StatefulJob):
             # 3. create one object per unique new cas_id (+ one per empty file)
             created = 0
             for cas, members in need_object.items():
-                oid, opub = self._create_object(ctx, members[0], emit, ops)
+                oid, opub = self._create_object(ctx, members[0], emit, ops,
+                                                data["location_path"])
                 created += 1
                 for row in members:
                     db.update(FilePath, {"id": row["id"]}, {"object_id": oid})
@@ -156,7 +157,8 @@ class FileIdentifierJob(StatefulJob):
                         ops.append(sync.shared_update(
                             FilePath, row["pub_id"], "object_id", ref_obj(opub)))
             for row in empty:
-                oid, opub = self._create_object(ctx, row, emit, ops)
+                oid, opub = self._create_object(ctx, row, emit, ops,
+                                                data["location_path"])
                 created += 1
                 db.update(FilePath, {"id": row["id"]}, {"object_id": oid})
                 if emit:
@@ -175,10 +177,18 @@ class FileIdentifierJob(StatefulJob):
                           errors=errors)
 
     def _create_object(self, ctx: WorkerContext, row: dict, emit: bool,
-                       ops: list | None = None) -> int:
+                       ops: list | None = None,
+                       location_path: str | None = None) -> int:
+        from .magic import resolve_kind
+
         db = ctx.library.db
         pub_id = str(uuid.uuid4())
-        kind = kind_from_extension(row.get("extension"), bool(row.get("is_dir")))
+        # magic-byte disambiguation for conflicting/unknown extensions
+        # (file_identifier/mod.rs:75 → magic.rs)
+        kind = resolve_kind(
+            row.get("extension"),
+            _abs_path(location_path, row) if location_path else None,
+            bool(row.get("is_dir")))
         oid = db.insert(Object, {
             "pub_id": pub_id,
             "kind": kind,

@@ -304,3 +304,7 @@ def _remove_rows(library, row: dict[str, Any]) -> None:
             sync.log_ops(ops)
     if ops:
         sync.created()
+    # removed paths may have orphaned their objects; the actor debounces
+    remover = getattr(library, "orphan_remover", None)
+    if remover is not None:
+        remover.invoke()

@@ -1,0 +1,217 @@
+"""GC actors: orphan-object remover + thumbnail remover.
+
+Reference semantics:
+- core/src/object/orphan_remover.rs:12-13 — per-library actor, 1-minute tick
+  plus an `invoke()` signal, debounced to at most one cleanup per 10s;
+  deletes objects with no file_paths in batches of 512 together with their
+  link rows.
+- core/src/object/thumbnail_remover.rs:31-32 — node-level actor over every
+  loaded library, 30s cadence for explicitly-marked cas_ids and a half-hour
+  full sweep deleting cached thumbnails whose cas_id exists in no library.
+
+Both are plain daemon threads here (the repo's actor idiom); intervals are
+constructor args so tests tick them deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from pathlib import Path
+from typing import TYPE_CHECKING, Iterable
+
+if TYPE_CHECKING:
+    from ..library import Library
+    from ..node import Node
+
+logger = logging.getLogger(__name__)
+
+TEN_SECONDS = 10.0
+ONE_MINUTE = 60.0
+THIRTY_SECS = 30.0
+HALF_HOUR = 30.0 * 60.0
+
+_ORPHAN_BATCH = 512
+
+
+class OrphanRemoverActor:
+    """Deletes Objects that no longer have any FilePath pointing at them
+    (orphan_remover.rs process_clean_up)."""
+
+    def __init__(self, library: "Library", tick_interval: float = ONE_MINUTE,
+                 debounce: float = TEN_SECONDS) -> None:
+        self.library = library
+        self.tick_interval = tick_interval
+        self.debounce = debounce
+        self._signal = threading.Event()
+        self._stop = threading.Event()
+        self._last_checked = 0.0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"orphan-remover-{library.id[:8]}")
+        self._thread.start()
+
+    def invoke(self) -> None:
+        self._signal.set()
+
+    def _run(self) -> None:
+        import time
+
+        while not self._stop.is_set():
+            self._signal.wait(self.tick_interval)
+            if self._stop.is_set():
+                return
+            self._signal.clear()
+            # debounce: at most one cleanup per `debounce` seconds
+            if time.monotonic() - self._last_checked < self.debounce:
+                continue
+            try:
+                self.process_clean_up()
+            except Exception:
+                logger.exception("orphan cleanup failed")
+            self._last_checked = time.monotonic()
+
+    def process_clean_up(self) -> int:
+        """Batched delete loop; returns total objects removed."""
+        db = self.library.db
+        removed = 0
+        while True:
+            rows = db.query(
+                "SELECT o.id FROM object o WHERE NOT EXISTS "
+                "(SELECT 1 FROM file_path fp WHERE fp.object_id = o.id) "
+                "LIMIT ?", [_ORPHAN_BATCH])
+            ids = [r["id"] for r in rows]
+            if not ids:
+                return removed
+            marks = ",".join("?" for _ in ids)
+            # the orphan predicate is repeated inside every DELETE: an object
+            # that gained a file_path link since the SELECT must survive
+            # (the reference's delete_many carries the same filter)
+            still_orphan = (f"object_id IN (SELECT o.id FROM object o "
+                            f"WHERE o.id IN ({marks}) AND NOT EXISTS "
+                            f"(SELECT 1 FROM file_path fp WHERE fp.object_id = o.id))")
+            with db.transaction():
+                # link rows first (tag_on_object in the reference; this
+                # schema also carries label/space/album links + media_data)
+                for table in ("tag_on_object", "label_on_object",
+                              "object_in_space", "object_in_album",
+                              "media_data"):
+                    db.query(f"DELETE FROM {table} WHERE {still_orphan}", ids)
+                db.query(
+                    f"DELETE FROM object WHERE id IN ({marks}) AND NOT EXISTS "
+                    f"(SELECT 1 FROM file_path fp WHERE fp.object_id = object.id)",
+                    ids)
+            removed += len(ids)  # counts candidates; re-linked ones survive
+            logger.debug("removed %d orphaned objects", len(ids))
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._signal.set()
+        self._thread.join(timeout=5)
+
+
+class ThumbnailRemoverActor:
+    """Sweeps the cas-sharded thumbnail cache, deleting entries whose cas_id
+    is referenced by no loaded library (thumbnail_remover.rs worker)."""
+
+    def __init__(self, node: "Node", batch_interval: float = THIRTY_SECS,
+                 full_interval: float = HALF_HOUR) -> None:
+        self.node = node
+        self.batch_interval = batch_interval
+        self.full_interval = full_interval
+        self._marked: set[str] = set()
+        self._marked_lock = threading.Lock()
+        self._signal = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="thumbnail-remover")
+        self._thread.start()
+
+    def mark_for_deletion(self, cas_ids: Iterable[str]) -> None:
+        """Explicit enqueue (cas_ids_to_delete channel in the reference):
+        deleted right away on the next short tick, no liveness check."""
+        with self._marked_lock:
+            self._marked.update(cas_ids)
+        self._signal.set()
+
+    def _run(self) -> None:
+        import time
+
+        last_full = 0.0
+        while not self._stop.is_set():
+            self._signal.wait(self.batch_interval)
+            if self._stop.is_set():
+                return
+            self._signal.clear()
+            try:
+                self.process_marked()
+                if time.monotonic() - last_full >= self.full_interval:
+                    self.full_sweep()
+                    last_full = time.monotonic()
+            except Exception:
+                logger.exception("thumbnail GC failed")
+
+    def _thumb_dir(self) -> Path:
+        from .media.thumbnail import thumbnail_dir
+
+        return Path(thumbnail_dir(self.node.data_dir))
+
+    def process_marked(self) -> int:
+        with self._marked_lock:
+            marked, self._marked = self._marked, set()
+        removed = 0
+        for cas_id in marked:
+            if self._delete_thumb(cas_id):
+                removed += 1
+        return removed
+
+    def full_sweep(self) -> int:
+        """Delete every cached thumbnail whose cas_id no library references
+        (the half-hour pass of thumbnail_remover.rs)."""
+        base = self._thumb_dir()
+        if not base.is_dir():
+            return 0
+        on_disk: list[str] = []
+        for shard in base.iterdir():
+            if not shard.is_dir():
+                continue
+            for entry in shard.glob("*.webp"):
+                on_disk.append(entry.stem)
+        if not on_disk:
+            return 0
+        alive: set[str] = set()
+        for library in self.node.libraries.list():
+            for start in range(0, len(on_disk), 500):
+                chunk = on_disk[start:start + 500]
+                marks = ",".join("?" for _ in chunk)
+                for row in library.db.query(
+                        f"SELECT DISTINCT cas_id FROM file_path "
+                        f"WHERE cas_id IN ({marks})", chunk):
+                    alive.add(row["cas_id"])
+        removed = 0
+        for cas_id in on_disk:
+            if cas_id not in alive and self._delete_thumb(cas_id):
+                removed += 1
+        if removed:
+            logger.info("thumbnail GC removed %d stale thumbnails", removed)
+        return removed
+
+    def _delete_thumb(self, cas_id: str) -> bool:
+        path = self._thumb_dir() / cas_id[:2] / f"{cas_id}.webp"
+        try:
+            path.unlink()
+        except FileNotFoundError:
+            return False
+        except OSError as e:
+            logger.warning("could not delete thumbnail %s: %s", cas_id, e)
+            return False
+        # prune empty shard dirs
+        try:
+            path.parent.rmdir()
+        except OSError:
+            pass
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._signal.set()
+        self._thread.join(timeout=5)

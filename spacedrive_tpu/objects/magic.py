@@ -1,0 +1,132 @@
+"""Magic-byte kind resolution for conflicting/unknown extensions.
+
+Reference: crates/file-ext/src/magic.rs — extensions with several plausible
+formats (`ExtensionPossibility::Conflicts`, e.g. ``ts`` TypeScript vs
+MPEG-TS, ``db`` SQLite vs anything) are disambiguated by header signatures;
+the identifier consults it at file_identifier/mod.rs:75. Table-driven here:
+each signature is (offset, bytes) pairs that must all match within the
+first 512 bytes.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+from .kind import ObjectKind, kind_from_extension
+
+logger = logging.getLogger(__name__)
+
+HEADER_LEN = 512
+
+#: (kind, [(offset, signature bytes), ...]) — first match wins, ordered
+#: most-specific first (RIFF/ftyp containers before generic prefixes)
+MAGIC_SIGNATURES: list[tuple[int, list[tuple[int, bytes]]]] = [
+    # containers whose subtype picks the kind
+    (ObjectKind.IMAGE, [(0, b"RIFF"), (8, b"WEBP")]),
+    (ObjectKind.AUDIO, [(0, b"RIFF"), (8, b"WAVE")]),
+    (ObjectKind.VIDEO, [(0, b"RIFF"), (8, b"AVI ")]),
+    (ObjectKind.IMAGE, [(4, b"ftypheic")]),
+    (ObjectKind.IMAGE, [(4, b"ftypheix")]),
+    (ObjectKind.IMAGE, [(4, b"ftypavif")]),
+    (ObjectKind.AUDIO, [(4, b"ftypM4A")]),
+    (ObjectKind.VIDEO, [(4, b"ftyp")]),          # generic ISO-BMFF → video
+    # images
+    (ObjectKind.IMAGE, [(0, b"\x89PNG\r\n\x1a\n")]),
+    (ObjectKind.IMAGE, [(0, b"\xff\xd8\xff")]),
+    (ObjectKind.IMAGE, [(0, b"GIF87a")]),
+    (ObjectKind.IMAGE, [(0, b"GIF89a")]),
+    (ObjectKind.IMAGE, [(0, b"II*\x00")]),        # TIFF LE
+    (ObjectKind.IMAGE, [(0, b"MM\x00*")]),        # TIFF BE
+    (ObjectKind.IMAGE, [(0, b"BM")]),
+    (ObjectKind.IMAGE, [(0, b"8BPS")]),           # psd
+    # audio
+    (ObjectKind.AUDIO, [(0, b"ID3")]),
+    (ObjectKind.AUDIO, [(0, b"\xff\xfb")]),
+    (ObjectKind.AUDIO, [(0, b"\xff\xf3")]),
+    (ObjectKind.AUDIO, [(0, b"fLaC")]),
+    (ObjectKind.AUDIO, [(0, b"OggS")]),
+    (ObjectKind.AUDIO, [(0, b"MThd")]),           # midi
+    # video
+    (ObjectKind.VIDEO, [(0, b"\x1a\x45\xdf\xa3")]),  # EBML: mkv/webm
+    (ObjectKind.VIDEO, [(0, b"\x47"), (188, b"\x47")]),  # MPEG-TS sync beat
+    (ObjectKind.VIDEO, [(0, b"\x00\x00\x01\xba")]),  # MPEG-PS
+    # archives
+    (ObjectKind.ARCHIVE, [(0, b"PK\x03\x04")]),
+    (ObjectKind.ARCHIVE, [(0, b"\x1f\x8b")]),     # gzip
+    (ObjectKind.ARCHIVE, [(0, b"7z\xbc\xaf\x27\x1c")]),
+    (ObjectKind.ARCHIVE, [(0, b"Rar!\x1a\x07")]),
+    (ObjectKind.ARCHIVE, [(0, b"BZh")]),
+    (ObjectKind.ARCHIVE, [(0, b"\xfd7zXZ\x00")]),
+    (ObjectKind.ARCHIVE, [(0, b"\x28\xb5\x2f\xfd")]),  # zstd
+    (ObjectKind.ARCHIVE, [(257, b"ustar")]),      # tar
+    # executables
+    (ObjectKind.EXECUTABLE, [(0, b"\x7fELF")]),
+    (ObjectKind.EXECUTABLE, [(0, b"MZ")]),
+    (ObjectKind.EXECUTABLE, [(0, b"\xca\xfe\xba\xbe")]),  # mach-o fat / class
+    (ObjectKind.EXECUTABLE, [(0, b"\xcf\xfa\xed\xfe")]),  # mach-o 64
+    # documents / databases / fonts / misc
+    (ObjectKind.DOCUMENT, [(0, b"%PDF-")]),
+    (ObjectKind.DATABASE, [(0, b"SQLite format 3\x00")]),
+    (ObjectKind.FONT, [(0, b"\x00\x01\x00\x00\x00")]),  # ttf
+    (ObjectKind.FONT, [(0, b"OTTO")]),
+    (ObjectKind.FONT, [(0, b"wOFF")]),
+    (ObjectKind.FONT, [(0, b"wOF2")]),
+    (ObjectKind.ENCRYPTED, [(0, b"sdtpenc")]),    # this framework's header
+    (ObjectKind.IMAGE, [(0, b"<svg")]),
+    (ObjectKind.BOOK, [(0, b"%!PS")]),
+]
+
+#: extensions whose meaning is ambiguous enough that magic wins when found
+#: (the Conflicts arm of ExtensionPossibility, magic.rs:12-15)
+CONFLICTING_EXTENSIONS = {
+    "ts",    # TypeScript vs MPEG-TS
+    "mts",   # MPEG-TS vs Metal shader
+    "m2ts",
+    "db",    # SQLite vs generic data
+    "key",   # key material vs Keynote
+    "s",     # assembly vs other
+    "raw",   # camera raw vs raw bytes
+    "dat",
+    "bin",
+    "mid",   # midi vs other
+}
+
+
+def sniff_kind(head: bytes) -> int | None:
+    """Header bytes → ObjectKind, or None when no signature matches."""
+    for kind, parts in MAGIC_SIGNATURES:
+        if all(head[off:off + len(sig)] == sig for off, sig in parts):
+            return kind
+    return None
+
+
+def _read_head(path: str | Path) -> bytes:
+    try:
+        with open(path, "rb") as fh:
+            return fh.read(HEADER_LEN)
+    except OSError:
+        return b""
+
+
+def resolve_kind(extension: str | None, path: str | Path | None = None,
+                 is_dir: bool = False, head: bytes | None = None) -> int:
+    """Extension-first resolution with magic-byte override for conflicting
+    or unknown extensions (Extension::resolve_conflicting semantics):
+    a confident extension wins without touching the disk; otherwise the
+    header decides; the extension table is the fallback."""
+    ext_kind = kind_from_extension(extension, is_dir)
+    if is_dir:
+        return ext_kind
+    ext = (extension or "").lower().lstrip(".")
+    needs_magic = ext in CONFLICTING_EXTENSIONS or ext_kind == ObjectKind.UNKNOWN
+    if not needs_magic:
+        return ext_kind
+    if head is None:
+        if path is None:
+            return ext_kind
+        head = _read_head(path)
+    if not head:
+        return ext_kind
+    sniffed = sniff_kind(head)
+    return sniffed if sniffed is not None else ext_kind

@@ -138,6 +138,9 @@ class Server:
                     resp = await self._route(req)
                 except HttpError as e:
                     resp = Response.error(e.status, str(e))
+                    if e.status == 401:
+                        resp.headers["www-authenticate"] = \
+                            'Basic realm="spacedrive"'
                 except ApiError as e:
                     resp = Response.error(400, str(e))
                 except Exception:
@@ -160,7 +163,13 @@ class Server:
             return
         header = req.header("authorization")
         expect = "Basic " + base64.b64encode(self.auth.encode()).decode()
-        if not secrets.compare_digest(header, expect):
+        try:
+            ok = secrets.compare_digest(header.encode("utf-8", "replace"),
+                                        expect.encode())
+        except Exception:
+            ok = False
+        if not ok:
+            # the Basic challenge makes browsers show a credential prompt
             raise HttpError(401, "authentication required")
 
     async def _route(self, req: Request) -> Response:
@@ -183,15 +192,22 @@ class Server:
     async def _rspc_http(self, req: Request, key: str) -> Response:
         if not key:
             raise HttpError(404)
-        if req.method == "GET":
-            arg = json.loads(req.query["arg"]) if "arg" in req.query else None
-            library_id = req.query.get("library_id")
-        elif req.method == "POST":
-            payload = json.loads(req.body.decode() or "{}")
-            arg = payload.get("arg")
-            library_id = payload.get("library_id")
-        else:
-            raise HttpError(405)
+        try:
+            if req.method == "GET":
+                # GET is side-effect-free: queries only (mutations need POST)
+                proc = self.node.router.procedures.get(key)
+                if proc is not None and proc.kind != "query":
+                    raise HttpError(405, f"{key} is a {proc.kind}; use POST")
+                arg = json.loads(req.query["arg"]) if "arg" in req.query else None
+                library_id = req.query.get("library_id")
+            elif req.method == "POST":
+                payload = json.loads(req.body.decode() or "{}")
+                arg = payload.get("arg")
+                library_id = payload.get("library_id")
+            else:
+                raise HttpError(405)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise HttpError(400, f"malformed request payload: {e}")
         try:
             result = await self._resolve(key, arg, library_id)
         except ApiError as e:
@@ -231,9 +247,13 @@ class Server:
             library = self.node.libraries.get(library_id)
         except KeyError:
             raise HttpError(404, "no such library")
+        try:
+            fp_id, loc_id = int(file_path_id), int(location_id)
+        except ValueError:
+            raise HttpError(400, "file/location ids must be integers")
         db = library.db
-        row = db.find_one(FilePath, {"id": int(file_path_id)})
-        if row is None or row["location_id"] != int(location_id):
+        row = db.find_one(FilePath, {"id": fp_id})
+        if row is None or row["location_id"] != loc_id:
             raise HttpError(404, "no such file_path")
         location = db.find_one(Location, {"id": row["location_id"]})
         if location is None:

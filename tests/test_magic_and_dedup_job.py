@@ -1,0 +1,132 @@
+"""Magic-byte kind resolution (VERDICT r2 item 7) + productized dedup
+(item 6): mislabeled files classify by header, and the chained
+dedup_detector persists pairs surfaced via search.duplicates."""
+
+import random
+
+import pytest
+
+from spacedrive_tpu.locations import create_location, scan_location
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.objects.kind import ObjectKind
+from spacedrive_tpu.objects.magic import resolve_kind, sniff_kind
+
+PNG = b"\x89PNG\r\n\x1a\n" + b"\x00" * 100
+JPG = b"\xff\xd8\xff\xe0" + b"\x00" * 100
+PDF = b"%PDF-1.7\n" + b"x" * 100
+SQLITE = b"SQLite format 3\x00" + b"\x00" * 100
+ZIP = b"PK\x03\x04" + b"\x00" * 100
+ELF = b"\x7fELF" + b"\x00" * 100
+MKV = b"\x1a\x45\xdf\xa3" + b"\x00" * 100
+MPEG_TS = (b"\x47" + b"\x00" * 187) * 3  # 0x47 sync byte every 188 bytes
+TYPESCRIPT = b"export const x: number = 1;\n" * 10
+
+
+@pytest.mark.parametrize("head,expected", [
+    (PNG, ObjectKind.IMAGE),
+    (JPG, ObjectKind.IMAGE),
+    (PDF, ObjectKind.DOCUMENT),
+    (SQLITE, ObjectKind.DATABASE),
+    (ZIP, ObjectKind.ARCHIVE),
+    (ELF, ObjectKind.EXECUTABLE),
+    (MKV, ObjectKind.VIDEO),
+    (MPEG_TS, ObjectKind.VIDEO),
+    (b"RIFF\x00\x00\x00\x00WEBP", ObjectKind.IMAGE),
+    (b"RIFF\x00\x00\x00\x00WAVE", ObjectKind.AUDIO),
+    (b"ID3\x04" + b"\x00" * 20, ObjectKind.AUDIO),
+    (b"sdtpenc" + b"\x00" * 20, ObjectKind.ENCRYPTED),
+    (TYPESCRIPT, None),  # no signature — text stays with the extension
+])
+def test_sniff_kind_table(head, expected):
+    assert sniff_kind(head) == expected
+
+
+def test_resolve_conflicting_ts(tmp_path):
+    """`.ts` is TypeScript by extension table but MPEG-TS when the header
+    says so (the Conflicts case of magic.rs)."""
+    code = tmp_path / "app.ts"
+    code.write_bytes(TYPESCRIPT)
+    video = tmp_path / "clip.ts"
+    video.write_bytes(MPEG_TS)
+    assert resolve_kind("ts", code) == ObjectKind.CODE
+    assert resolve_kind("ts", video) == ObjectKind.VIDEO
+
+
+def test_resolve_unknown_extension_by_magic(tmp_path):
+    mystery = tmp_path / "export.qqq"
+    mystery.write_bytes(PDF)
+    assert resolve_kind("qqq", mystery) == ObjectKind.DOCUMENT
+    # no file access needed when the extension is confident
+    assert resolve_kind("png", None) == ObjectKind.IMAGE
+
+
+def test_resolve_db_extension(tmp_path):
+    real_db = tmp_path / "data.db"
+    real_db.write_bytes(SQLITE)
+    assert resolve_kind("db", real_db) == ObjectKind.DATABASE
+
+
+def test_identifier_applies_magic_kinds(tmp_path, tmp_data_dir):
+    """A scan classifies a PNG-bytes file mislabeled .ts as IMAGE."""
+    tree = tmp_path / "mixed"
+    tree.mkdir()
+    (tree / "sneaky.ts").write_bytes(PNG)
+    (tree / "honest.ts").write_bytes(TYPESCRIPT)
+    node = Node(tmp_data_dir, probe_accelerator=False)
+    try:
+        lib = node.libraries.create("magic-lib")
+        loc = create_location(lib, str(tree), hasher="cpu")
+        scan_location(lib, loc["id"])
+        assert node.jobs.wait_idle(90)
+        rows = lib.db.query(
+            "SELECT fp.name, o.kind FROM file_path fp "
+            "JOIN object o ON fp.object_id = o.id WHERE fp.is_dir = 0")
+        kinds = {r["name"]: r["kind"] for r in rows}
+        assert kinds["sneaky"] == ObjectKind.IMAGE
+        assert kinds["honest"] == ObjectKind.CODE
+    finally:
+        node.shutdown()
+
+
+def test_dedup_job_persists_pairs(tmp_path, tmp_data_dir):
+    """Full scan → dedup_detector chained stage → search.duplicates returns
+    the planted near-dup pair from the DB (VERDICT item 6 done-criteria)."""
+    tree = tmp_path / "photos"
+    tree.mkdir()
+    rng = random.Random(17)
+    original = bytearray(rng.randbytes(280_000))
+    (tree / "fam_a.raw").write_bytes(original)
+    edited = bytearray(original)
+    for _ in range(25):
+        edited[rng.randrange(len(edited))] ^= 0xFF
+    (tree / "fam_b.raw").write_bytes(edited)
+    (tree / "noise.raw").write_bytes(rng.randbytes(280_000))
+
+    node = Node(tmp_data_dir, probe_accelerator=False)
+    try:
+        lib = node.libraries.create("dedup-job")
+        loc = create_location(lib, str(tree), hasher="cpu")
+        scan_location(lib, loc["id"])
+        assert node.jobs.wait_idle(120)
+
+        # the chained job persisted rows
+        persisted = lib.db.query("SELECT * FROM near_duplicate")
+        assert len(persisted) == 1
+        assert persisted[0]["similarity"] >= 0.8
+
+        # surfaced through the API
+        pairs = node.router.resolve("search.duplicates",
+                                    {"location_id": loc["id"]},
+                                    library_id=lib.id)
+        assert len(pairs) == 1
+        names = {pairs[0]["a_name"], pairs[0]["b_name"]}
+        assert names == {"fam_a", "fam_b"}
+
+        # deleting one side cascades the pair away
+        fp_id = pairs[0]["a_id"]
+        node.router.resolve("files.deleteFiles", {"sources": [fp_id]},
+                            library_id=lib.id)
+        assert node.jobs.wait_idle(60)
+        assert lib.db.query("SELECT * FROM near_duplicate") == []
+    finally:
+        node.shutdown()
