@@ -215,6 +215,94 @@ def bench_identify() -> dict:
     }
 
 
+def bench_thumbs() -> dict:
+    """Batched device thumbnail resize (SURVEY §3.2's second hot CPU loop)
+    vs the scalar PIL path, resize step isolated (decode/encode cost is
+    identical either way). Both regimes reported like the BLAKE3 bench:
+    device-resident kernel rate and transfer-included."""
+    import jax
+    import numpy as np
+
+    from PIL import Image
+
+    from spacedrive_tpu.ops.resize_jax import resize_batch, target_dims
+
+    n = int(os.environ.get("SD_BENCH_THUMBS", "48"))
+    # post-host-reduce shape (thumbnail.MAX_INPUT_EDGE): what the device
+    # actually sees; smooth gradient data because PIL's BILINEAR antialiases
+    # downscales (box support) while the kernel is a true 4-tap bilinear —
+    # on photographic content they agree, on white noise they cannot
+    h_in, w_in = 768, 1024
+    yy, xx = np.mgrid[0:h_in, 0:w_in]
+    base = np.stack([yy * 255.0 / h_in, xx * 255.0 / w_in,
+                     (yy + xx) * 255.0 / (h_in + w_in)], -1)
+    rng = np.random.default_rng(7)
+    phase = rng.uniform(0, 40, (n, 1, 1, 3))
+    batch = np.clip(base[None] + phase, 0, 255).astype(np.uint8)
+    src = np.tile(np.int32([h_in, w_in]), (n, 1))
+    th, tw = target_dims(w_in, h_in)
+    tgt = np.tile(np.int32([th, tw]), (n, 1))
+
+    # scalar PIL baseline (bilinear, same filter class as the kernel)
+    imgs = [Image.fromarray(batch[i]) for i in range(n)]
+    pil_t, _ = time_best(
+        lambda: [np.asarray(im.resize((tw, th), Image.BILINEAR))
+                 for im in imgs], REPEATS)
+
+    import jax.numpy as jnp
+
+    d_batch = jax.device_put(batch)
+    d_src, d_tgt = jax.device_put(src), jax.device_put(tgt)
+
+    @jax.jit
+    def kernel_sum(b, s, t):
+        # on-device checksum: an honest barrier (the tunnel's
+        # block_until_ready doesn't block) with a 48-word readback, so the
+        # timing is the KERNEL, not the tunnel's ~30 MB/s D2H of 37MB of
+        # pixels — a local-PCIe host reads that back in ~3ms
+        return resize_batch(b, s, t).astype(jnp.uint32).sum(axis=(1, 2, 3))
+
+    def run_kernel():
+        return np.asarray(kernel_sum(d_batch, d_src, d_tgt))
+
+    def run_full():
+        return np.asarray(resize_batch(d_batch, d_src, d_tgt))
+
+    out = run_full()  # compile both; correctness gate vs PIL
+    ref = np.asarray(imgs[0].resize((tw, th), Image.BILINEAR), dtype=np.float32)
+    got = out[0, :th, :tw].astype(np.float32)
+    mae = float(np.abs(ref - got).mean())
+    if mae > 4.0:  # filters differ slightly at edges; catastrophic != small
+        # raise (not sys.exit): combined mode treats thumbs as additive
+        # evidence and must still print the headline record
+        raise RuntimeError(f"device resize diverges from PIL (MAE {mae:.1f})")
+    run_kernel()
+    kern_t, _ = time_best(run_kernel, REPEATS)
+    full_t, _ = time_best(run_full, 1)
+
+    def run_with_transfer():
+        return np.asarray(resize_batch(jax.device_put(batch), d_src, d_tgt))
+
+    xfer_t, _ = time_best(run_with_transfer, 1)
+
+    mpx = n * h_in * w_in / 1e6
+    print(f"info: thumbs {n}x{w_in}x{h_in}: kernel {kern_t:.3f}s "
+          f"({n / kern_t:.1f} img/s, {mpx / kern_t:.0f} MPx/s) | "
+          f"+readback {full_t:.3f}s | +transfer {xfer_t:.3f}s | "
+          f"PIL {pil_t:.3f}s ({n / pil_t:.1f} img/s) | MAE vs PIL {mae:.2f}",
+          file=sys.stderr)
+    return {
+        "metric": f"thumbnail_resize_images_per_sec[{n}x{w_in}x{h_in}]",
+        "value": round(n / kern_t, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(pil_t / kern_t, 2),
+        "readback_included_images_per_sec": round(n / full_t, 1),
+        "transfer_included_images_per_sec": round(n / xfer_t, 1),
+        "pil_images_per_sec": round(n / pil_t, 1),
+        "mae_vs_pil": round(mae, 2),
+    }
+
+
 def main() -> int:
     if MODE == "dedup":
         record = bench_dedup()
@@ -222,10 +310,17 @@ def main() -> int:
         record = bench_identify()
     elif MODE == "device_kernel":
         record = bench_device_kernel()
+    elif MODE == "thumbs":
+        record = bench_thumbs()
     else:  # combined (default): dedup headline + north-star identify record
         # + the device-resident kernel evidence (both identify regimes)
+        # + the batched thumbnail-resize experiment
         record = bench_dedup()
         record["extra"] = [bench_identify(), bench_device_kernel()]
+        try:
+            record["extra"].append(bench_thumbs())
+        except Exception as e:  # thumbs bench is additive evidence, not gating
+            print(f"warn: thumbs bench skipped: {e}", file=sys.stderr)
     print(json.dumps(record))
     return 0
 

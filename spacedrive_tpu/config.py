@@ -45,7 +45,10 @@ class BackendFeature:
 
     SYNC_EMIT_MESSAGES = "syncEmitMessages"
     FILES_OVER_P2P = "filesOverP2P"
-    ALL = (SYNC_EMIT_MESSAGES, FILES_OVER_P2P)
+    #: route image-thumbnail resizing through the batched device kernel
+    #: (ops/resize_jax.py) instead of scalar PIL — this framework's flag
+    TPU_THUMBNAILS = "tpuThumbnails"
+    ALL = (SYNC_EMIT_MESSAGES, FILES_OVER_P2P, TPU_THUMBNAILS)
 
 
 class NodeConfig(VersionedConfig):

@@ -61,9 +61,12 @@ class OrphanRemoverActor:
             if self._stop.is_set():
                 return
             self._signal.clear()
-            # debounce: at most one cleanup per `debounce` seconds
-            if time.monotonic() - self._last_checked < self.debounce:
-                continue
+            # debounce: at most one cleanup per `debounce` seconds — an
+            # invoke inside the window is deferred to the boundary, not
+            # dropped into the next full tick
+            wait_left = self.debounce - (time.monotonic() - self._last_checked)
+            if wait_left > 0 and self._stop.wait(wait_left):
+                return
             try:
                 self.process_clean_up()
             except Exception:

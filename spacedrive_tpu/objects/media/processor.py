@@ -14,7 +14,8 @@ from typing import Any
 from ...jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
 from ...models import FilePath, Location, MediaData
 from .metadata import extract_media_data
-from .thumbnail import can_generate_thumbnail, generate_thumbnail
+from .thumbnail import (can_generate_thumbnail, generate_thumbnail,
+                        generate_thumbnails_batched)
 
 logger = logging.getLogger(__name__)
 
@@ -57,24 +58,46 @@ class MediaProcessorJob(StatefulJob):
 
     def execute_step(self, ctx: WorkerContext, data: dict, step: dict,
                      step_number: int) -> StepResult:
+        from ...config import BackendFeature
+        from ..file_identifier import _abs_path
+
         db = ctx.library.db
         node = ctx.library.node
         data_dir = node.data_dir if node else "."
+        use_device = (node is not None
+                      and node.config.has_feature(BackendFeature.TPU_THUMBNAILS))
         errors: list[str] = []
         thumbs = 0
         extracted = 0
         t0 = time.perf_counter()
+
+        entries = []  # (row, path, ext)
         for fp_id in step["ids"]:
             row = db.find_one(FilePath, {"id": fp_id})
             if row is None or not row.get("cas_id"):
                 continue
-            from ..file_identifier import _abs_path
+            entries.append((row, _abs_path(data["location_path"], row),
+                            (row.get("extension") or "").lower()))
 
-            path = _abs_path(data["location_path"], row)
-            ext = (row.get("extension") or "").lower()
+        made: dict[str, object] = {}
+        if use_device:
+            # the step IS the device batch: one resize call per 10 entries
+            try:
+                made = generate_thumbnails_batched(
+                    [(path, row["cas_id"], ext)
+                     for row, path, ext in entries if can_generate_thumbnail(ext)],
+                    data_dir)
+            except Exception as e:
+                errors.append(f"batched thumbnails: {e!r}")
+                use_device = False
+
+        for row, path, ext in entries:
             try:
                 if can_generate_thumbnail(ext):
-                    out = generate_thumbnail(path, data_dir, row["cas_id"], ext)
+                    if use_device:
+                        out = made.get(row["cas_id"])
+                    else:
+                        out = generate_thumbnail(path, data_dir, row["cas_id"], ext)
                     if out is not None:
                         thumbs += 1
                         ctx.library.emit("new_thumbnail", {"cas_id": row["cas_id"]})

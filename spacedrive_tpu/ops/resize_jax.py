@@ -1,0 +1,140 @@
+"""Batched bilinear resize on device — the thumbnailer's hot loop, TPU-first.
+
+The reference resizes one image at a time on CPU (sd-images +
+thumbnail/mod.rs:95-110 √(262144/wh) scale). Thumbnails have per-image
+target sizes, which naively breaks batching; the shapes are made static
+with the pad-and-mask scheme the BLAKE3 kernel uses:
+
+- inputs pad into a fixed (B, H_in, W_in, 3) canvas (host pre-reduces
+  anything bigger by integer box factors — cheap and antialiasing-friendly);
+- every output lives in a fixed (B, 512, 512, 3) canvas — 512² is exactly
+  the 262,144 px² target area, so any aspect ratio's thumbnail fits;
+- per-image (src_h, src_w) and (tgt_h, tgt_w) vectors drive the sampling
+  arithmetic as data, not shape, so ONE compiled program serves every batch
+  (no recompilation storms).
+
+MXU formulation: bilinear resampling is separable, so instead of 4 gathers
+per output pixel (gathers are slow paths on TPU) each image is resized by
+two dense contractions with per-image interpolation matrices built on
+device from the dim vectors:
+
+    out[b] = A_y[b] (512×H_in) · img[b] (H_in×W_in×3) · A_x[b]ᵀ (W_in×512)
+
+Each A row holds the two bilinear taps for one output coordinate (rows past
+the image's own target dims are all-zero, which doubles as the mask). The
+contractions are plain batched matmuls — exactly what the systolic array is
+for — and XLA fuses the A-matrix construction into the pipeline. Compute is
+float32 (bf16's ~8 mantissa bits would band 8-bit channels).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: output canvas edge: ceil(sqrt(262144)) — thumbnail/mod.rs target area
+CANVAS = 512
+
+
+def _interp_matrix(size_in: int, actual, target, canvas: int) -> jax.Array:
+    """(canvas, size_in) bilinear resampling matrix for one axis: row i
+    carries weights (1-w, w) at source taps floor(s), floor(s)+1 where
+    s = (i+0.5)·actual/target − 0.5; rows i ≥ target are zero (mask)."""
+    actual_f = actual.astype(jnp.float32)
+    target_f = target.astype(jnp.float32)
+    idx = jnp.arange(canvas, dtype=jnp.float32)
+    src = jnp.clip((idx + 0.5) * (actual_f / target_f) - 0.5,
+                   0.0, actual_f - 1.0)
+    i0 = jnp.floor(src).astype(jnp.int32)
+    i1 = jnp.minimum(i0 + 1, actual - 1)
+    w = src - i0.astype(jnp.float32)
+    cols = jnp.arange(size_in, dtype=jnp.int32)
+    # i0 == i1 at the clamped edge: the two one-hots overlap and the
+    # weights still sum to 1
+    m = ((cols[None, :] == i0[:, None]) * (1.0 - w)[:, None]
+         + (cols[None, :] == i1[:, None]) * w[:, None])
+    return jnp.where((idx < target_f)[:, None], m, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("canvas",))
+def resize_batch(images: jax.Array, src_hw: jax.Array, tgt_hw: jax.Array,
+                 canvas: int = CANVAS) -> jax.Array:
+    """(B, H_in, W_in, 3) uint8 → (B, canvas, canvas, 3) uint8.
+
+    src_hw/tgt_hw: (B, 2) int32 actual and target (h, w) per image; the
+    region outside each image's (tgt_h, tgt_w) is zeroed.
+    """
+    _, h_in, w_in, _ = images.shape
+    images_f = images.astype(jnp.float32)
+
+    ay = jax.vmap(lambda s, t: _interp_matrix(h_in, s, t, canvas))(
+        src_hw[:, 0], tgt_hw[:, 0])                      # (B, canvas, H_in)
+    ax = jax.vmap(lambda s, t: _interp_matrix(w_in, s, t, canvas))(
+        src_hw[:, 1], tgt_hw[:, 1])                      # (B, canvas, W_in)
+
+    rows = jnp.einsum("bih,bhwc->biwc", ay, images_f)    # vertical pass
+    out = jnp.einsum("bjw,biwc->bijc", ax, rows)         # horizontal pass
+    return jnp.clip(jnp.round(out), 0.0, 255.0).astype(jnp.uint8)
+
+
+def target_dims(w: int, h: int, target_px: float = float(CANVAS * CANVAS)
+                ) -> tuple[int, int]:
+    """√(target/wh) scale preserving aspect (thumbnail/mod.rs:95-100);
+    returns (th, tw). Deviation from the scalar path: an extreme-aspect
+    image whose longer edge exceeds the canvas is scaled down further so it
+    fits — aspect is preserved, only the degenerate very-long-thin case
+    shrinks below the 262144 px² budget."""
+    import math
+
+    if w * h <= target_px:
+        factor = 1.0
+    else:
+        factor = math.sqrt(target_px / (w * h))
+    longest = max(w, h) * factor
+    if longest > CANVAS:
+        factor *= CANVAS / longest
+    th = max(1, min(CANVAS, round(h * factor)))
+    tw = max(1, min(CANVAS, round(w * factor)))
+    return th, tw
+
+
+def resize_batch_host(arrays: list[np.ndarray],
+                      max_input_edge: int = 2048) -> list[np.ndarray]:
+    """Host convenience wrapper: decoded RGB uint8 arrays (any sizes) →
+    per-image thumbnails (cropped to their own target dims).
+
+    Arrays larger than ``max_input_edge`` must be pre-reduced by the caller
+    (PIL ``Image.reduce`` by an integer factor keeps this cheap); the batch
+    pads to the largest input in the batch.
+    """
+    if not arrays:
+        return []
+    bad = [i for i, a in enumerate(arrays)
+           if max(a.shape[0], a.shape[1]) > max_input_edge]
+    if bad:
+        raise ValueError(f"inputs {bad} exceed max_input_edge={max_input_edge}")
+    # shape buckets: dims round up to 256-multiples and the batch count to a
+    # power of two, so the jitted kernel compiles O(few dozen) variants total
+    # instead of one per distinct batch shape (the recompilation storm the
+    # pad-and-mask design exists to prevent)
+    h_in = _bucket(max(a.shape[0] for a in arrays), max_input_edge)
+    w_in = _bucket(max(a.shape[1] for a in arrays), max_input_edge)
+    n_real = len(arrays)
+    n = max(1, 1 << (n_real - 1).bit_length())
+    batch = np.zeros((n, h_in, w_in, 3), np.uint8)
+    src = np.ones((n, 2), np.int32)   # padding lanes: 1×1 src → 1×1 tgt
+    tgt = np.ones((n, 2), np.int32)
+    for i, a in enumerate(arrays):
+        batch[i, : a.shape[0], : a.shape[1]] = a
+        src[i] = (a.shape[0], a.shape[1])
+        tgt[i] = target_dims(a.shape[1], a.shape[0])
+    out = np.asarray(resize_batch(jnp.asarray(batch), jnp.asarray(src),
+                                  jnp.asarray(tgt)))
+    return [out[i, : tgt[i, 0], : tgt[i, 1]] for i in range(n_real)]
+
+
+def _bucket(value: int, cap: int) -> int:
+    return min(cap, ((value + 255) // 256) * 256)

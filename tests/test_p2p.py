@@ -391,7 +391,18 @@ def test_wire_is_encrypted_after_ephemerals(two_nodes):
     t = threading.Thread(target=pump, daemon=True)
     t.start()
     # dial THROUGH the proxy so every byte is captured
-    a.p2p.run_coro(a.p2p._ping(("127.0.0.1", proxy_port)), timeout=15)
+    a.p2p.run_coro(a.p2p._ping(("127.0.0.1", proxy_port)), timeout=30)
+    # under CPU load the pump thread lags the exchange; wait until the
+    # capture has drained (stable for 0.5s, 8s overall cap) before stopping
+    deadline = time.monotonic() + 8
+    stable_since, last_len = time.monotonic(), -1
+    while time.monotonic() < deadline:
+        if len(captured) != last_len:
+            last_len = len(captured)
+            stable_since = time.monotonic()
+        elif time.monotonic() - stable_since >= 0.5 and last_len > 0:
+            break
+        time.sleep(0.05)
     done.set()
     t.join(timeout=5)
     proxy.close()
