@@ -1,0 +1,308 @@
+"""Typed Python client for a spacedrive_tpu server.
+
+The analogue of packages/client's generated `core.ts` bindings
+(api/mod.rs:205-212 codegen): the client fetches the server's /schema
+export (the same document schema/api.json snapshots) and validates every
+call against it — unknown procedures or kind misuse (mutating via query
+etc.) fail client-side with the valid options listed, which is the
+rspc-typed-client guarantee re-expressed at runtime.
+
+Transports: queries/mutations over plain HTTP POST, subscriptions over the
+/rspc/ws websocket (RFC 6455 client, stdlib only). Library-scoped
+procedures take ``library_id=`` which the client folds into the
+LibraryArgs envelope.
+
+    client = SpacedriveClient("http://127.0.0.1:8080")
+    libs = client.query("libraries.list")
+    client.mutation("locations.fullRescan", {"location_id": 1},
+                    library_id=libs[0]["id"])
+    with client.subscribe("jobs.progress", library_id=libs[0]["id"]) as sub:
+        for event in sub:
+            ...
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import queue
+import secrets
+import socket
+import struct
+import threading
+import urllib.parse
+import urllib.request
+from typing import Any, Iterator
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class ClientError(Exception):
+    pass
+
+
+class ProcedureError(ClientError):
+    """Server-side procedure failure (the {"error": ...} envelope)."""
+
+
+class SpacedriveClient:
+    def __init__(self, base_url: str, auth: str | None = None,
+                 timeout: float = 60.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._headers = {"content-type": "application/json"}
+        if auth:
+            self._headers["authorization"] = \
+                "Basic " + base64.b64encode(auth.encode()).decode()
+        self.schema = self._fetch_schema()
+        self.procedures: dict[str, dict[str, Any]] = {
+            p["key"]: p for p in self.schema["procedures"]}
+
+    # -- plumbing ------------------------------------------------------------
+    def _fetch_schema(self) -> dict[str, Any]:
+        req = urllib.request.Request(self.base_url + "/schema",
+                                     headers=self._headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read().decode())
+        except Exception as e:
+            raise ClientError(f"could not fetch schema from {self.base_url}: {e}")
+
+    def _check(self, key: str, kind: str) -> None:
+        proc = self.procedures.get(key)
+        if proc is None:
+            options = [k for k in self.procedures
+                       if k.split(".")[0] == key.split(".")[0]]
+            raise ClientError(
+                f"unknown procedure {key!r}; same-router options: {options}")
+        if proc["kind"] != kind:
+            raise ClientError(f"{key} is a {proc['kind']}, not a {kind}")
+
+    def _call(self, key: str, arg: Any, library_id: str | None) -> Any:
+        body = json.dumps({"arg": arg, "library_id": library_id}).encode()
+        req = urllib.request.Request(f"{self.base_url}/rspc/{key}", data=body,
+                                     headers=self._headers, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read().decode()).get("error", str(e))
+            except Exception:
+                message = str(e)
+            raise ProcedureError(f"{key}: {message}")
+        if "error" in payload:
+            raise ProcedureError(f"{key}: {payload['error']}")
+        return payload["result"]
+
+    # -- public surface ------------------------------------------------------
+    def query(self, key: str, arg: Any = None,
+              library_id: str | None = None) -> Any:
+        self._check(key, "query")
+        return self._call(key, arg, library_id)
+
+    def mutation(self, key: str, arg: Any = None,
+                 library_id: str | None = None) -> Any:
+        self._check(key, "mutation")
+        return self._call(key, arg, library_id)
+
+    def health(self) -> bool:
+        req = urllib.request.Request(self.base_url + "/health",
+                                     headers=self._headers)
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read() == b"OK"
+
+    def subscribe(self, key: str, arg: Any = None,
+                  library_id: str | None = None) -> "ClientSubscription":
+        self._check(key, "subscription")
+        return ClientSubscription(self, key, arg, library_id)
+
+    def file_url(self, library_id: str, location_id: int,
+                 file_path_id: int) -> str:
+        return (f"{self.base_url}/spacedrive/file/"
+                f"{library_id}/{location_id}/{file_path_id}")
+
+    def thumbnail_url(self, cas_id: str) -> str:
+        return f"{self.base_url}/spacedrive/thumbnail/{cas_id[:2]}/{cas_id}.webp"
+
+    def fetch_bytes(self, url: str, byte_range: tuple[int, int] | None = None
+                    ) -> bytes:
+        headers = dict(self._headers)
+        if byte_range is not None:
+            headers["range"] = f"bytes={byte_range[0]}-{byte_range[1] - 1}"
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read()
+
+
+class ClientSubscription:
+    """Context-managed event stream over the websocket; iterate for events."""
+
+    def __init__(self, client: SpacedriveClient, key: str, arg: Any,
+                 library_id: str | None) -> None:
+        self._client = client
+        self._key = key
+        self._id = 1
+        self._q: queue.Queue[Any] = queue.Queue(maxsize=1024)
+        self._closed = threading.Event()
+        self._sock = self._upgrade()
+        input_ = ({"library_id": library_id, "arg": arg}
+                  if library_id is not None else arg)
+        self._send({"id": self._id, "method": "subscription",
+                    "params": {"path": key, "input": input_}})
+        # events may legally arrive before the 'started' ack (the server's
+        # pump races the ack send) — buffer them rather than failing
+        started = False
+        for _ in range(64):
+            first = self._recv_msg(timeout=client.timeout)
+            if first is None:
+                break
+            rtype = first.get("result", {}).get("type")
+            if rtype == "started":
+                started = True
+                break
+            if rtype == "event":
+                self._offer(first["result"]["data"])
+                continue
+            break
+        if not started:
+            raise ClientError(f"subscription {key} refused: {first}")
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name=f"sub-{key}")
+        self._thread.start()
+
+    # -- ws plumbing ---------------------------------------------------------
+    def _upgrade(self) -> socket.socket:
+        parsed = urllib.parse.urlsplit(self._client.base_url)
+        host, port = parsed.hostname, parsed.port or 80
+        sock = socket.create_connection((host, port),
+                                        timeout=self._client.timeout)
+        key = base64.b64encode(secrets.token_bytes(16)).decode()
+        auth_line = ""
+        if "authorization" in self._client._headers:
+            auth_line = (f"Authorization: "
+                         f"{self._client._headers['authorization']}\r\n")
+        sock.sendall(
+            (f"GET /rspc/ws HTTP/1.1\r\nHost: {host}:{port}\r\n"
+             "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+             f"{auth_line}"
+             f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+             ).encode())
+        head = b""
+        while b"\r\n\r\n" not in head:
+            chunk = sock.recv(4096)
+            if not chunk:
+                raise ClientError("server closed during websocket upgrade")
+            head += chunk
+        status = head.split(b"\r\n", 1)[0]
+        if b"101" not in status:
+            raise ClientError(f"websocket upgrade refused: {status.decode()}")
+        expect = base64.b64encode(hashlib.sha1(
+            (key + _WS_GUID).encode()).digest()).decode()
+        if expect.encode() not in head:
+            raise ClientError("bad Sec-WebSocket-Accept")
+        self._buf = head.split(b"\r\n\r\n", 1)[1]
+        return sock
+
+    def _send(self, obj: dict) -> None:
+        payload = json.dumps(obj).encode()
+        mask = secrets.token_bytes(4)
+        head = bytearray([0x81])
+        n = len(payload)
+        if n < 126:
+            head.append(0x80 | n)
+        elif n < 1 << 16:
+            head.append(0x80 | 126)
+            head += struct.pack(">H", n)
+        else:
+            head.append(0x80 | 127)
+            head += struct.pack(">Q", n)
+        self._sock.sendall(bytes(head) + mask
+                           + bytes(b ^ mask[i & 3]
+                                   for i, b in enumerate(payload)))
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("websocket closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _recv_msg(self, timeout: float) -> dict | None:
+        self._sock.settimeout(timeout)
+        while True:
+            b1, b2 = self._read_exact(2)
+            opcode, length = b1 & 0x0F, b2 & 0x7F
+            if length == 126:
+                (length,) = struct.unpack(">H", self._read_exact(2))
+            elif length == 127:
+                (length,) = struct.unpack(">Q", self._read_exact(8))
+            payload = self._read_exact(length)
+            if opcode == 0x8:
+                return None
+            if opcode in (0x9, 0xA):
+                continue
+            return json.loads(payload.decode())
+
+    def _offer(self, item: Any) -> None:
+        """Non-blocking enqueue; lossy like the server-side broadcast."""
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            try:  # drop oldest to keep the close sentinel deliverable
+                self._q.get_nowait()
+                self._q.put_nowait(item)
+            except (queue.Empty, queue.Full):
+                pass
+
+    def _pump(self) -> None:
+        try:
+            while not self._closed.is_set():
+                msg = self._recv_msg(timeout=3600)
+                if msg is None:
+                    break
+                result = msg.get("result", {})
+                if result.get("type") == "event":
+                    self._offer(result["data"])
+        except (ConnectionError, OSError, socket.timeout):
+            pass
+        finally:
+            self._offer(None)
+
+    # -- consumption ---------------------------------------------------------
+    def get(self, timeout: float | None = None) -> Any | None:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def __iter__(self) -> Iterator[Any]:
+        while not self._closed.is_set():
+            event = self._q.get()
+            if event is None:
+                return
+            yield event
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._send({"id": self._id + 1, "method": "subscriptionStop",
+                        "params": {"subscriptionId": self._id}})
+        except (ConnectionError, OSError):
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._offer(None)
+
+    def __enter__(self) -> "ClientSubscription":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -173,6 +173,7 @@ class DynJob:
         JobCanceled, or JobError on fatal failure.
         """
         state = self.state
+        run_t0 = time.perf_counter()  # per-phase timing (job/mod.rs:591,798,858)
         errors: list[str] = list(filter(None, (self.report.errors_text or "").split("\n\n")))
         # expose to the pause path: JobPaused must carry these so they survive
         # the checkpoint (a resume re-reads them from report.errors_text)
@@ -217,6 +218,9 @@ class DynJob:
                          self.job.NAME, state.step_number - 1, time.perf_counter() - t0)
 
         metadata = self.job.finalize(ctx, state.data or {}, state.run_metadata)
+        logger.info("Total job run time %.3fs (%s, %d steps)",
+                    time.perf_counter() - run_t0, self.job.NAME,
+                    state.step_number)
         return metadata, errors
 
     def serialize_state(self) -> bytes:

@@ -93,6 +93,56 @@ class LibraryManagerEvent:
     INSTANCES_MODIFIED = "instances_modified"
 
 
+class _Subscriber:
+    """One mpscrr-backed event subscriber: callback subscribers get a drain
+    thread that runs the fn and acks; channel subscribers ack themselves."""
+
+    ACK_TIMEOUT = 30.0
+
+    def __init__(self, fn: Callable[[str, "Library"], None] | None,
+                 sender=None, receiver_ref=None) -> None:
+        from .utils.mpscrr import channel
+
+        if sender is not None:
+            self._sender = sender
+            self._receiver_ref = receiver_ref  # weakref: drop → auto-evict
+            return
+        self._sender, receiver = channel()
+        self._receiver_ref = None
+        self._fn = fn
+
+        def drain() -> None:
+            for req in receiver:
+                event, library = req.message
+                try:
+                    fn(event, library)
+                except Exception:
+                    logger.exception("library event subscriber failed (%s)",
+                                     event)
+                finally:
+                    req.respond()
+
+        threading.Thread(target=drain, daemon=True,
+                         name="library-events").start()
+
+    def deliver(self, event: str, library: "Library") -> bool:
+        """Send + await ack. Returns False when the subscriber is gone
+        (caller unsubscribes it)."""
+        from .utils.mpscrr import ChannelClosed
+
+        if self._receiver_ref is not None and self._receiver_ref() is None:
+            return False  # channel receiver was garbage-collected unclosed
+        try:
+            self._sender.send((event, library), timeout=self.ACK_TIMEOUT)
+            return True
+        except ChannelClosed:
+            return False
+        except TimeoutError:
+            logger.error("library event subscriber did not ack %s within %ss",
+                         event, self.ACK_TIMEOUT)
+            return True
+
+
 class Libraries:
     """Loads and owns every library under ``<data_dir>/libraries``."""
 
@@ -101,26 +151,48 @@ class Libraries:
         self.node = node
         self._lock = threading.RLock()
         self._libraries: dict[str, Library] = {}
-        self._subscribers: list[Callable[[str, Library], None]] = []
+        self._subscribers: list["_Subscriber"] = []
 
-    # -- events -------------------------------------------------------------
+    # -- events (mpscrr ack'd broadcast, manager/mod.rs:42-48) ---------------
     def subscribe(self, fn: Callable[[str, Library], None]) -> None:
-        """Register for (event, library) callbacks; replays Load for already-
-        loaded libraries (the mpscrr ack-subscription pattern, manager:42-48)."""
+        """Register for (event, library) callbacks over an mpscrr channel:
+        a drain thread runs the callback and acks, and ``_emit`` waits for
+        every subscriber's ack so boot-ordering consumers (watchers, NLM,
+        cold resume) have definitely processed Load before boot continues.
+        Replays Load for already-loaded libraries."""
+        sub = _Subscriber(fn)
         with self._lock:
-            self._subscribers.append(fn)
+            self._subscribers.append(sub)
             current = list(self._libraries.values())
         for lib in current:
-            fn(LibraryManagerEvent.LOAD, lib)
+            sub.deliver(LibraryManagerEvent.LOAD, lib)
+
+    def subscribe_channel(self):
+        """Raw mpscrr Receiver for consumers that drain themselves; each
+        Request.message is (event, library) and must be respond()ed.
+        close() the receiver to unsubscribe — a receiver that is simply
+        garbage-collected is auto-evicted on the next emit (weakref)."""
+        import weakref
+
+        from .utils.mpscrr import channel
+
+        sender, receiver = channel()
+        sub = _Subscriber(None, sender=sender,
+                          receiver_ref=weakref.ref(receiver))
+        with self._lock:
+            self._subscribers.append(sub)
+        return receiver
 
     def _emit(self, event: str, library: Library) -> None:
         with self._lock:
             subs = list(self._subscribers)
-        for fn in subs:
-            try:
-                fn(event, library)
-            except Exception:
-                logger.exception("library event subscriber failed (%s)", event)
+        for sub in subs:
+            if not sub.deliver(event, library):
+                with self._lock:
+                    try:
+                        self._subscribers.remove(sub)
+                    except ValueError:
+                        pass
 
     # -- lifecycle ----------------------------------------------------------
     def init(self) -> None:

@@ -224,6 +224,11 @@ class MediaData(Model):
         "description": Field(_T),
         "copyright": Field(_T),
         "exif_version": Field(_T),
+        # audio/video stream metadata (ffprobe extractor; the reference's
+        # audio_data/video_data are stubs — schema.prisma:296 MediaData)
+        "duration_seconds": Field("REAL"),
+        "bit_rate": Field(_I),
+        "streams": Field(_J),
         "object_id": Field(_I, nullable=False, unique=True, references="object.id", on_delete="CASCADE"),
     }
 

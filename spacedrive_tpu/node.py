@@ -47,6 +47,10 @@ class Node:
 
         self.data_dir = Path(data_dir)
         self.data_dir.mkdir(parents=True, exist_ok=True)
+        # rotating file log + stdout (Node::init_logger, lib.rs:137-194)
+        from .utils.tracing import init_logger
+
+        init_logger(self.data_dir)
         self.config = ConfigManager(NodeConfig.load(self.data_dir))
         # location-watcher feature gate (the reference's `location-watcher`
         # cargo feature, location/manager/mod.rs:23-32)

@@ -1,14 +1,19 @@
-"""Media metadata extraction (EXIF → MediaData rows).
+"""Media metadata extraction (EXIF / stream probing → MediaData rows).
 
 Mirrors core/src/object/media/media_data_extractor.rs + sd-media-metadata:
-image dimensions, capture date, camera fields, GPS location. PIL's EXIF
-reader replaces the Rust exif crate; audio/video metadata are stubs in the
-reference too.
+image dimensions, capture date, camera fields (exposure/aperture/ISO/
+focal length/lens/orientation), GPS location with plus-code encoding
+(image/geographic/pluscodes.rs — Open Location Code implemented from the
+public spec), and audio/video stream metadata via ffprobe (the reference's
+audio/video extractors are stubs; here they are real when ffprobe exists).
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import shutil
+import subprocess
 from typing import Any
 
 logger = logging.getLogger(__name__)
@@ -18,12 +23,29 @@ _EXIF_TAGS = {
     36867: "media_date", 315: "artist", 33432: "copyright", 36864: "exif_version",
 }
 
+#: ExifIFD (0x8769) camera detail tags → camera_data keys
+_EXIF_IFD_TAGS = {
+    33434: "exposure_time", 33437: "f_number", 34855: "iso",
+    37386: "focal_length", 37385: "flash", 42035: "lens_make",
+    42036: "lens_model",
+}
+
+AUDIO_EXTENSIONS = {"mp3", "wav", "flac", "ogg", "m4a", "aac", "opus", "wma"}
+
+_FFPROBE = shutil.which("ffprobe")
+
 
 def extract_media_data(path: str, extension: str) -> dict[str, Any] | None:
-    from .thumbnail import THUMBNAILABLE_IMAGE_EXTENSIONS
+    from .thumbnail import THUMBNAILABLE_IMAGE_EXTENSIONS, THUMBNAILABLE_VIDEO_EXTENSIONS
 
-    if extension not in THUMBNAILABLE_IMAGE_EXTENSIONS:
-        return None
+    if extension in THUMBNAILABLE_IMAGE_EXTENSIONS:
+        return _extract_image(path)
+    if extension in THUMBNAILABLE_VIDEO_EXTENSIONS or extension in AUDIO_EXTENSIONS:
+        return _extract_av(path)
+    return None
+
+
+def _extract_image(path: str) -> dict[str, Any] | None:
     try:
         from PIL import Image
 
@@ -37,17 +59,88 @@ def extract_media_data(path: str, extension: str) -> dict[str, Any] | None:
                     out[name] = str(value)
                 elif name in ("camera_make", "camera_model"):
                     camera[name] = str(value)
+            orientation = exif.get(274)
+            if orientation:
+                camera["orientation"] = int(orientation)
+            software = exif.get(305)
+            if software:
+                camera["software"] = str(software)
+            try:
+                ifd = exif.get_ifd(0x8769)
+                for tag, name in _EXIF_IFD_TAGS.items():
+                    if tag in ifd:
+                        value = ifd[tag]
+                        camera[name] = (float(value)
+                                        if isinstance(value, (int, float)) or
+                                        hasattr(value, "__float__")
+                                        else str(value))
+            except Exception:
+                pass
             if camera:
                 out["camera_data"] = camera
             gps = exif.get_ifd(0x8825) if hasattr(exif, "get_ifd") else None
             if gps:
                 loc = _gps_to_decimal(gps)
                 if loc:
+                    loc["pluscode"] = encode_pluscode(
+                        loc["latitude"], loc["longitude"])
                     out["media_location"] = loc
             return out
     except Exception as e:
         logger.debug("no media data for %s: %s", path, e)
         return None
+
+
+def _extract_av(path: str) -> dict[str, Any] | None:
+    """ffprobe-backed stream metadata (duration, codecs, dims, rates)."""
+    if _FFPROBE is None:
+        return None
+    try:
+        proc = subprocess.run(
+            [_FFPROBE, "-v", "error", "-print_format", "json",
+             "-show_format", "-show_streams", path],
+            capture_output=True, timeout=30, check=True)
+        probe = json.loads(proc.stdout.decode())
+    except Exception as e:
+        logger.debug("ffprobe failed for %s: %s", path, e)
+        return None
+    out: dict[str, Any] = {}
+    fmt = probe.get("format", {})
+    streams_out = []
+    for stream in probe.get("streams", []):
+        entry: dict[str, Any] = {
+            "codec_type": stream.get("codec_type"),
+            "codec": stream.get("codec_name"),
+        }
+        if stream.get("codec_type") == "video":
+            entry["width"] = stream.get("width")
+            entry["height"] = stream.get("height")
+            rate = stream.get("avg_frame_rate", "0/1")
+            try:
+                num, _, den = rate.partition("/")
+                entry["fps"] = round(float(num) / float(den or 1), 3)
+            except (ValueError, ZeroDivisionError):
+                pass
+            if "width" in stream and "height" in stream:
+                out["dimensions"] = {"width": stream["width"],
+                                     "height": stream["height"]}
+        elif stream.get("codec_type") == "audio":
+            entry["channels"] = stream.get("channels")
+            entry["sample_rate"] = stream.get("sample_rate")
+        streams_out.append(entry)
+    duration = fmt.get("duration")
+    if duration is not None:
+        out["duration_seconds"] = round(float(duration), 3)
+    if fmt.get("bit_rate"):
+        out["bit_rate"] = int(fmt["bit_rate"])
+    if streams_out:
+        out["streams"] = streams_out
+    tags = fmt.get("tags", {}) or {}
+    for src, dst in (("artist", "artist"), ("copyright", "copyright"),
+                     ("creation_time", "media_date")):
+        if tags.get(src):
+            out[dst] = str(tags[src])
+    return out or None
 
 
 def _gps_to_decimal(gps: dict) -> dict[str, float] | None:
@@ -66,3 +159,36 @@ def _gps_to_decimal(gps: dict) -> dict[str, float] | None:
         return {"latitude": latitude, "longitude": longitude}
     except Exception:
         return None
+
+
+# ---------------------------------------------------------------------------
+# Open Location Code (plus codes) — implemented from the public spec
+# (reference: sd-media-metadata image/geographic/pluscodes.rs)
+# ---------------------------------------------------------------------------
+
+_OLC_ALPHABET = "23456789CFGHJMPQRVWX"
+_OLC_SEPARATOR = "+"
+_OLC_PAIR_CODE_LEN = 10
+
+
+def encode_pluscode(latitude: float, longitude: float,
+                    code_length: int = _OLC_PAIR_CODE_LEN) -> str:
+    """Standard 10-digit plus code (e.g. 8FVC9G8F+6X)."""
+    lat = min(90.0, max(-90.0, latitude))
+    lon = longitude
+    while lon < -180.0:
+        lon += 360.0
+    while lon >= 180.0:
+        lon -= 360.0
+    # positive integer space at the finest pair resolution: 1/8000 degree
+    # (5 base-20 digit pairs); the 90°/180° edge clips into the last cell
+    lat_val = min(int((lat + 90.0) * 8000), 180 * 8000 - 1)
+    lon_val = min(int((lon + 180.0) * 8000), 360 * 8000 - 1)
+    digits: list[str] = []
+    for _ in range(_OLC_PAIR_CODE_LEN // 2):
+        digits.append(_OLC_ALPHABET[lon_val % 20])
+        digits.append(_OLC_ALPHABET[lat_val % 20])
+        lat_val //= 20
+        lon_val //= 20
+    code = "".join(reversed(digits))
+    return code[:8] + _OLC_SEPARATOR + code[8:]

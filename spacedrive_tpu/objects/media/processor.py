@@ -96,6 +96,14 @@ class MediaProcessorJob(StatefulJob):
                 if can_generate_thumbnail(ext):
                     if use_device:
                         out = made.get(row["cas_id"])
+                        if out is None:
+                            # device batch skipped it (decode/encode failed):
+                            # scalar retry, and the failure goes on record
+                            out = generate_thumbnail(path, data_dir,
+                                                     row["cas_id"], ext)
+                            if out is None:
+                                errors.append(f"{path}: thumbnail failed "
+                                              f"(device batch + scalar retry)")
                     else:
                         out = generate_thumbnail(path, data_dir, row["cas_id"], ext)
                     if out is not None:

@@ -105,10 +105,13 @@ class Server:
     def stop(self) -> None:
         loop, server = self._loop, self._server
         if loop is not None and server is not None:
-            loop.call_soon_threadsafe(server.close)
-            # serve_forever unblocks when the server closes
-            loop.call_soon_threadsafe(
-                lambda: [t.cancel() for t in asyncio.all_tasks(loop)])
+            try:
+                loop.call_soon_threadsafe(server.close)
+                # serve_forever unblocks when the server closes
+                loop.call_soon_threadsafe(
+                    lambda: [t.cancel() for t in asyncio.all_tasks(loop)])
+            except RuntimeError:
+                pass  # loop already closed (shutdown race) — nothing to stop
         if self._thread is not None:
             self._thread.join(timeout=5)
         self._pool.shutdown(wait=False)
@@ -347,7 +350,10 @@ class Server:
 
         def pump(sub_id: Any, subscription) -> None:
             """Worker thread: blocking-drain a Subscription into the socket."""
+            flt = getattr(subscription, "filter", None)
             for event in subscription:
+                if flt is not None and not flt(event):
+                    continue  # subscriptions stream only their own variants
                 payload = {"jsonrpc": "2.0", "id": sub_id,
                            "result": {"type": "event", "data": _event_wire(event)}}
                 fut = asyncio.run_coroutine_threadsafe(send(payload), loop)
@@ -410,9 +416,10 @@ class Server:
             thread = threading.Thread(target=pump, args=(msg_id, subscription),
                                       name=f"ws-sub-{path}", daemon=True)
             subs[msg_id] = (subscription, thread)
-            thread.start()
+            # ack BEFORE the pump starts so 'started' precedes any event
             await send({"jsonrpc": "2.0", "id": msg_id,
                         "result": {"type": "started"}})
+            thread.start()
         elif method == "subscriptionStop":
             sub_id = params.get("subscriptionId", msg_id)
             pair = subs.pop(sub_id, None)

@@ -1,0 +1,125 @@
+"""mpscrr: multi-producer / single-consumer request-RESPONSE channel.
+
+Reference: core/src/util/mpscrr.rs (330 LoC) — the library manager's event
+subscription uses it so an emitter can await acknowledgement from every
+subscriber before proceeding (load ordering depends on it: watchers, NLM,
+and job cold-resume must have processed Load before boot continues).
+
+Shape: ``channel()`` returns (Sender, Receiver). Each ``send`` enqueues a
+Request carrying the message and a response slot; the consumer handles the
+request and ``respond``s (any value; None = plain ack), unblocking the
+producer. Dropping/closing the receiver wakes all pending producers with
+ChannelClosed, mirroring the Rust half's drop semantics.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Request:
+    """One in-flight message; the consumer must call respond() exactly once."""
+
+    __slots__ = ("message", "_event", "_response", "_closed")
+
+    def __init__(self, message: Any) -> None:
+        self.message = message
+        self._event = threading.Event()
+        self._response: Any = None
+        self._closed = False
+
+    def respond(self, value: Any = None) -> None:
+        self._response = value
+        self._event.set()
+
+    def _abort(self) -> None:
+        self._closed = True
+        self._event.set()
+
+    def wait(self, timeout: float | None = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("no response from receiver")
+        if self._closed:
+            raise ChannelClosed("receiver dropped before responding")
+        return self._response
+
+
+class Receiver:
+    def __init__(self, capacity: int = 256) -> None:
+        self._q: queue.Queue[Request] = queue.Queue(maxsize=capacity)
+        self._closed = threading.Event()
+
+    def recv(self, timeout: float | None = None) -> Request | None:
+        if self._closed.is_set() and self._q.empty():
+            return None
+        try:
+            req = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return req
+
+    def __iter__(self) -> Iterator[Request]:
+        while True:
+            if self._closed.is_set() and self._q.empty():
+                return
+            try:
+                req = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if req is None:  # close sentinel
+                return
+            yield req
+
+    def close(self) -> None:
+        """Wake pending producers with ChannelClosed; stop iteration."""
+        self._closed.set()
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req._abort()
+        try:
+            self._q.put_nowait(None)  # unblock a blocked iterator
+        except queue.Full:
+            pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+
+class Sender:
+    def __init__(self, receiver: Receiver) -> None:
+        self._receiver = receiver
+
+    def send(self, message: Any, timeout: float | None = None) -> Any:
+        """Enqueue + block for the consumer's response (ack)."""
+        return self.send_async(message).wait(timeout)
+
+    def send_async(self, message: Any) -> Request:
+        """Enqueue without waiting; call .wait() on the returned Request."""
+        if self._receiver.closed:
+            raise ChannelClosed("receiver is closed")
+        req = Request(message)
+        try:
+            self._receiver._q.put(req, timeout=5)
+        except queue.Full:
+            # a full queue means SLOW, not gone — closed is the only
+            # gone-signal (a caller must not evict a live-but-busy consumer)
+            raise TimeoutError("receiver queue full (consumer is slow)")
+        if self._receiver.closed:
+            req._abort()
+        return req
+
+
+def channel(capacity: int = 256) -> tuple[Sender, Receiver]:
+    rx = Receiver(capacity)
+    return Sender(rx), rx

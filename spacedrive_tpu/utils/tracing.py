@@ -1,0 +1,74 @@
+"""Logger bootstrap: rotating file appender + stdout, env-filtered.
+
+Reference: Node::init_logger (core/src/lib.rs:137-194) — daily-rotated
+non-blocking file appender (sd.log, keep 4) plus a stdout layer with an
+EnvFilter (RUST_LOG), and a global panic hook logging file:line. Here:
+TimedRotatingFileHandler (midnight, backupCount=4) under <data_dir>/logs,
+stdout at SD_LOG level (module overrides via "module=LEVEL" segments, the
+EnvFilter syntax subset), and sys.excepthook logging uncaught exceptions.
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+import sys
+from pathlib import Path
+
+_FORMAT = "%(asctime)s %(levelname).1s %(name)s %(message)s"
+_installed = False
+
+
+def init_logger(data_dir: str | Path, level: str | None = None) -> None:
+    """Idempotent; SD_LOG examples: "INFO", "DEBUG",
+    "INFO,spacedrive_tpu.locations=DEBUG"."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+
+    spec = level or os.environ.get("SD_LOG", "INFO")
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    root_level = "INFO"
+    overrides: list[tuple[str, str]] = []
+    for part in parts:
+        if "=" in part:
+            module, _, lvl = part.partition("=")
+            overrides.append((module.strip(), lvl.strip().upper()))
+        else:
+            root_level = part.upper()
+
+    pkg_logger = logging.getLogger("spacedrive_tpu")
+    pkg_logger.setLevel(getattr(logging, root_level, logging.INFO))
+    for module, lvl in overrides:
+        logging.getLogger(module).setLevel(getattr(logging, lvl, logging.INFO))
+
+    formatter = logging.Formatter(_FORMAT)
+
+    log_dir = Path(data_dir) / "logs"
+    try:
+        log_dir.mkdir(parents=True, exist_ok=True)
+        file_handler = logging.handlers.TimedRotatingFileHandler(
+            log_dir / "sd.log", when="midnight", backupCount=4,
+            encoding="utf-8", delay=True)
+        file_handler.setFormatter(formatter)
+        pkg_logger.addHandler(file_handler)
+    except OSError as e:
+        logging.getLogger(__name__).warning("no file logging: %s", e)
+
+    if not any(isinstance(h, logging.StreamHandler)
+               for h in logging.getLogger().handlers):
+        stream = logging.StreamHandler()
+        stream.setFormatter(formatter)
+        logging.getLogger().addHandler(stream)
+
+    # panic-hook analogue (lib.rs:181-191): uncaught exceptions hit the log
+    previous = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        if exc_type is not KeyboardInterrupt:
+            pkg_logger.critical("uncaught exception", exc_info=(exc_type, exc, tb))
+        previous(exc_type, exc, tb)
+
+    sys.excepthook = hook

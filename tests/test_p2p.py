@@ -431,3 +431,109 @@ def test_dial_known_identity_pins_handshake(two_nodes, tmp_path):
             a.p2p.run_coro(a.p2p.open_stream(b_ident), timeout=15)
     finally:
         c.shutdown()
+
+
+def test_concurrent_exchanges_between_one_peer_pair(two_nodes, tmp_path):
+    """Two live sync directions + a spacedrop + a ranged file pull running
+    SIMULTANEOUSLY between the same peer pair: no interleaving corruption,
+    nothing lost (VERDICT r2 item 10 — hardens the one-connection-per-
+    exchange model under real concurrency)."""
+    import threading
+
+    a, b = two_nodes
+    lib_a = a.libraries.create("concurrent-lib")
+    lib_a.sync.emit_messages = True
+
+    tree = tmp_path / "ctree"
+    tree.mkdir()
+    payload = bytes(range(256)) * 3000  # 768 KiB served over p2p
+    (tree / "served.bin").write_bytes(payload)
+    from spacedrive_tpu.locations import create_location, scan_location
+
+    loc = create_location(lib_a, str(tree), hasher="cpu")
+    scan_location(lib_a, loc["id"])
+    assert a.jobs.wait_idle(60)
+    fp = lib_a.db.find_one(FilePath, {"name": "served"})
+
+    a.config.toggle_feature(BackendFeature.FILES_OVER_P2P)
+    a.config.write(p2p_auto_accept_library=lib_a.id)
+    b.router.resolve("p2p.pair", {"peer_id": addr_of(a)})
+    lib_b = wait_for(lambda: next((l for l in b.libraries.list()
+                                   if l.id == lib_a.id), None),
+                     msg="library mirrored")
+    wait_for(lambda: lib_b.db.count(FilePath) == lib_a.db.count(FilePath),
+             msg="initial replication")
+    lib_b.sync.emit_messages = True
+
+    # spacedrop setup
+    gift = tmp_path / "concurrent_gift.bin"
+    gift_payload = bytes(reversed(range(256))) * 2000  # 512 KiB
+    gift.write_bytes(gift_payload)
+    inbox = tmp_path / "cinbox"
+    inbox.mkdir()
+    events = []
+    b.events.on(lambda ev: events.append(ev) if ev.kind == "p2p" else None)
+
+    N = 25
+    errors: list[str] = []
+
+    def writer(lib, prefix):
+        try:
+            for i in range(N):
+                pub = f"{prefix}-{i}"
+                lib.sync.write_ops(
+                    [lib.sync.shared_create(Tag, pub, {"name": pub})],
+                    lambda db, p=pub: db.insert(Tag, {"pub_id": p, "name": p}))
+                time.sleep(0.01)
+        except Exception as e:
+            errors.append(f"{prefix}: {e!r}")
+
+    def file_puller():
+        import io
+
+        try:
+            for _ in range(3):
+                sink = io.BytesIO()
+                n = b.p2p.run_coro(b.p2p.request_file(
+                    addr_of(a), lib_a.id, fp["pub_id"],
+                    Range(1000, 500_000), sink), timeout=60)
+                if sink.getvalue() != payload[1000:500_000]:
+                    errors.append("ranged pull corrupted")
+        except Exception as e:
+            errors.append(f"puller: {e!r}")
+
+    threads = [threading.Thread(target=writer, args=(lib_a, "from-a")),
+               threading.Thread(target=writer, args=(lib_b, "from-b")),
+               threading.Thread(target=file_puller)]
+    for t in threads:
+        t.start()
+    # fire the spacedrop while both sync directions + the pull are running
+    a.router.resolve("p2p.spacedrop",
+                     {"peer_id": addr_of(b), "paths": [str(gift)]})
+    ev = wait_for(lambda: next((e for e in list(events)
+                                if e.payload.get("type") == "SpacedropRequest"
+                                and e.payload.get("name") == gift.name), None),
+                  timeout=30, msg="spacedrop request during load")
+    b.router.resolve("p2p.acceptSpacedrop",
+                     {"id": ev.payload["id"], "target_dir": str(inbox)})
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "exchange thread hung"
+    assert errors == [], errors
+
+    # every tag from both bursts landed on both nodes, names intact
+    def tags_of(lib):
+        return {r["pub_id"]: r["name"] for r in lib.db.find(Tag)
+                if r["pub_id"].startswith(("from-a-", "from-b-"))}
+
+    expected = ({f"from-a-{i}": f"from-a-{i}" for i in range(N)}
+                | {f"from-b-{i}": f"from-b-{i}" for i in range(N)})
+    wait_for(lambda: tags_of(lib_a) == expected, timeout=60,
+             msg="tags converged on a")
+    wait_for(lambda: tags_of(lib_b) == expected, timeout=60,
+             msg="tags converged on b")
+
+    # spacedrop landed uncorrupted despite the concurrent traffic
+    wait_for(lambda: (inbox / gift.name).exists()
+             and (inbox / gift.name).read_bytes() == gift_payload,
+             timeout=60, msg="spacedrop landed under load")
