@@ -4,6 +4,7 @@ delete."""
 from __future__ import annotations
 
 from ...statistics import update_statistics
+from ..invalidate import invalidate_query
 
 
 def mount(router) -> None:
@@ -25,6 +26,7 @@ def mount(router) -> None:
     @router.mutation("libraries.create")
     def create(node, arg):
         lib = node.libraries.create(arg["name"], arg.get("description", ""))
+        invalidate_query(lib, "libraries.list")
         return {"id": lib.id, "name": lib.name}
 
     @router.mutation("libraries.edit")

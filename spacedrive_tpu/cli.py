@@ -1,0 +1,184 @@
+"""CLI shell: `python -m spacedrive_tpu.cli <command>`.
+
+Reference: apps/cli/src/main.rs (85 LoC — inspects sd-crypto encrypted file
+headers via FileHeader::from_reader). That surface is `inspect` here; the
+CLI additionally fronts a running server through the typed client (the
+headless operations a desktop shell would expose):
+
+    inspect <file.bytes>                     encrypted-header details
+    serve  [--data-dir D] [--port N]         alias for the server shell
+    libraries [--url U]                      list libraries
+    scan --library L --location N [--url U]  kick a rescan
+    search --library L [--term T] [--url U]  file_path search
+    jobs --library L [--url U]               job reports
+    duplicates --library L [--url U]         persisted near-dup pairs
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_inspect(args: argparse.Namespace) -> int:
+    """FileHeader::from_reader dump (apps/cli main.rs:14-23)."""
+    from .crypto.header import FileHeader
+    from .crypto.stream import CryptoError
+
+    try:
+        with open(args.file, "rb") as fh:
+            header = FileHeader.from_reader(fh)
+    except FileNotFoundError:
+        print(f"error: no such file: {args.file}", file=sys.stderr)
+        return 1
+    except CryptoError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"Encrypted file: {args.file}")
+    print(f"  header version: {header.version}")
+    print(f"  algorithm:      {header.algorithm.name}")
+    print(f"  keyslots:       {len(header.keyslots)}")
+    for i, slot in enumerate(header.keyslots):
+        print(f"    [{i}] v{slot.version} {slot.algorithm.name} "
+              f"{slot.hashing_algorithm.kind}/{slot.hashing_algorithm.params.value}")
+    print(f"  metadata:       {'present (sealed)' if header.metadata else 'none'}")
+    print(f"  preview media:  "
+          f"{'present (sealed)' if header.preview_media else 'none'}")
+    return 0
+
+
+def _client(args: argparse.Namespace):
+    from .client import SpacedriveClient
+
+    return SpacedriveClient(args.url, auth=getattr(args, "auth", None))
+
+
+def _resolve_library(client, selector: str) -> str:
+    libs = client.query("libraries.list")
+    for lib in libs:
+        if lib["id"] == selector or lib["name"] == selector:
+            return lib["id"]
+    names = [f"{l['name']} ({l['id'][:8]})" for l in libs]
+    print(f"error: no library {selector!r}; have: {names}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def cmd_libraries(args) -> int:
+    for lib in _client(args).query("libraries.list"):
+        print(f"{lib['id']}  {lib['name']}")
+    return 0
+
+
+def cmd_scan(args) -> int:
+    client = _client(args)
+    lib_id = _resolve_library(client, args.library)
+    job_id = client.mutation("locations.fullRescan",
+                             {"location_id": args.location}, library_id=lib_id)
+    print(f"scan started: job {job_id}")
+    return 0
+
+
+def cmd_search(args) -> int:
+    client = _client(args)
+    lib_id = _resolve_library(client, args.library)
+    arg = {"take": args.take}
+    if args.term:
+        arg["search"] = args.term
+    result = client.query("search.paths", arg, library_id=lib_id)
+    for row in result["items"]:
+        full = row["name"] + (f".{row['extension']}"
+                              if row["extension"] and not row["is_dir"] else "")
+        kind = "dir " if row["is_dir"] else "file"
+        print(f"{kind} {row['materialized_path']}{full}  "
+              f"{row.get('size_in_bytes') or 0}B  cas={row.get('cas_id') or '-'}")
+    return 0
+
+
+def cmd_jobs(args) -> int:
+    from .jobs.report import JobStatus
+
+    client = _client(args)
+    lib_id = _resolve_library(client, args.library)
+
+    def status_name(value):
+        return JobStatus.NAMES.get(value, str(value))
+
+    for report in client.query("jobs.reports", library_id=lib_id):
+        print(f"{report['id'][:8]} {report['name']:<18} "
+              f"{status_name(report['status'])}")
+        for child in report.get("children", []):
+            print(f"  └ {child['id'][:8]} {child['name']:<16} "
+                  f"{status_name(child['status'])}")
+    return 0
+
+
+def cmd_duplicates(args) -> int:
+    client = _client(args)
+    lib_id = _resolve_library(client, args.library)
+    pairs = client.query("search.duplicates", {}, library_id=lib_id)
+    for p in pairs:
+        print(f"{p['similarity']:.2f}  {p['a_dir']}{p['a_name']}  ~  "
+              f"{p['b_dir']}{p['b_name']}")
+    if not pairs:
+        print("no near-duplicate pairs recorded")
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from .server.__main__ import main as serve_main
+
+    argv = ["--data-dir", args.data_dir, "--port", str(args.port)]
+    return serve_main(argv)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="spacedrive_tpu.cli")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("inspect", help="inspect an encrypted .bytes file header")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("serve", help="run the headless server shell")
+    p.add_argument("--data-dir", default="./sd_data")
+    p.add_argument("--port", type=int, default=8080)
+    p.set_defaults(fn=cmd_serve)
+
+    def net(p):
+        p.add_argument("--url", default="http://127.0.0.1:8080")
+        p.add_argument("--auth", default=None)
+
+    p = sub.add_parser("libraries", help="list libraries")
+    net(p)
+    p.set_defaults(fn=cmd_libraries)
+
+    p = sub.add_parser("scan", help="rescan a location")
+    net(p)
+    p.add_argument("--library", required=True)
+    p.add_argument("--location", type=int, required=True)
+    p.set_defaults(fn=cmd_scan)
+
+    p = sub.add_parser("search", help="search file paths")
+    net(p)
+    p.add_argument("--library", required=True)
+    p.add_argument("--term", default=None)
+    p.add_argument("--take", type=int, default=50)
+    p.set_defaults(fn=cmd_search)
+
+    p = sub.add_parser("jobs", help="list job reports")
+    net(p)
+    p.add_argument("--library", required=True)
+    p.set_defaults(fn=cmd_jobs)
+
+    p = sub.add_parser("duplicates", help="list persisted near-dup pairs")
+    net(p)
+    p.add_argument("--library", required=True)
+    p.set_defaults(fn=cmd_duplicates)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -147,27 +147,37 @@ class ClientSubscription:
         self._q: queue.Queue[Any] = queue.Queue(maxsize=1024)
         self._closed = threading.Event()
         self._sock = self._upgrade()
-        input_ = ({"library_id": library_id, "arg": arg}
-                  if library_id is not None else arg)
-        self._send({"id": self._id, "method": "subscription",
-                    "params": {"path": key, "input": input_}})
-        # events may legally arrive before the 'started' ack (the server's
-        # pump races the ack send) — buffer them rather than failing
-        started = False
-        for _ in range(64):
-            first = self._recv_msg(timeout=client.timeout)
-            if first is None:
+        try:
+            input_ = ({"library_id": library_id, "arg": arg}
+                      if library_id is not None else arg)
+            self._send({"id": self._id, "method": "subscription",
+                        "params": {"path": key, "input": input_}})
+            # events may legally arrive before the 'started' ack (the
+            # server's pump races the ack send) — buffer, don't fail
+            started = False
+            first = None
+            for _ in range(64):
+                first = self._recv_msg(timeout=client.timeout)
+                if first is None:
+                    break
+                rtype = first.get("result", {}).get("type")
+                if rtype == "started":
+                    started = True
+                    break
+                if rtype == "event":
+                    self._offer(first["result"]["data"])
+                    continue
                 break
-            rtype = first.get("result", {}).get("type")
-            if rtype == "started":
-                started = True
-                break
-            if rtype == "event":
-                self._offer(first["result"]["data"])
-                continue
-            break
-        if not started:
-            raise ClientError(f"subscription {key} refused: {first}")
+            if not started:
+                raise ClientError(f"subscription {key} refused: {first}")
+        except (ClientError, ConnectionError, OSError) as e:
+            try:
+                self._sock.close()  # no leaked fds on refused subscriptions
+            except OSError:
+                pass
+            if isinstance(e, ClientError):
+                raise
+            raise ClientError(f"subscription {key} failed: {e}")
         self._thread = threading.Thread(target=self._pump, daemon=True,
                                         name=f"sub-{key}")
         self._thread.start()
@@ -175,9 +185,16 @@ class ClientSubscription:
     # -- ws plumbing ---------------------------------------------------------
     def _upgrade(self) -> socket.socket:
         parsed = urllib.parse.urlsplit(self._client.base_url)
-        host, port = parsed.hostname, parsed.port or 80
+        tls = parsed.scheme == "https"
+        host = parsed.hostname
+        port = parsed.port or (443 if tls else 80)
         sock = socket.create_connection((host, port),
                                         timeout=self._client.timeout)
+        if tls:
+            import ssl
+
+            sock = ssl.create_default_context().wrap_socket(
+                sock, server_hostname=host)
         key = base64.b64encode(secrets.token_bytes(16)).decode()
         auth_line = ""
         if "authorization" in self._client._headers:

@@ -1,0 +1,87 @@
+"""Mobile-FFI bridge: the JSON-string core interface a host shell embeds.
+
+Reference: apps/mobile/modules/sd-core/core/src/lib.rs — the mobile shells
+embed the whole Node in-process and talk to it through a JSON-RPC string
+bridge (`handle_core_msg`, :61-117) plus an event pump
+(`spawn_core_event_listener`, :119+). Same pattern here: the C shim
+(native/sd_core_ffi.cc) embeds CPython and calls these four functions; a
+JNI/Swift host needs nothing but a C ABI.
+
+Wire shapes:
+    handle_core_msg('{"id":1,"key":"libraries.list","arg":null,
+                     "library_id":null}')
+        → '{"id":1,"result":[...]}' or '{"id":1,"error":"..."}'
+    poll_core_event(timeout_ms) → '{"kind":"job_progress",...}' or '' (none)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any
+
+_node = None
+_events = None
+_lock = threading.Lock()
+
+
+def init_core(data_dir: str) -> str:
+    """Boot the Node (idempotent per process). Returns '{"ok":true}'."""
+    global _node, _events
+    with _lock:
+        if _node is not None:
+            return json.dumps({"ok": True, "already": True})
+        from .node import Node
+
+        try:
+            _node = Node(data_dir)
+            _events = _node.events.subscribe()
+        except Exception as e:
+            return json.dumps({"ok": False, "error": repr(e)})
+        return json.dumps({"ok": True})
+
+
+def handle_core_msg(raw: str) -> str:
+    """One JSON-RPC request → one JSON response (lib.rs:61-117)."""
+    try:
+        msg = json.loads(raw)
+    except json.JSONDecodeError as e:
+        return json.dumps({"id": None, "error": f"bad json: {e}"})
+    msg_id = msg.get("id")
+    if _node is None:
+        return json.dumps({"id": msg_id, "error": "core not initialized"})
+    try:
+        result = _node.router.resolve(msg.get("key", ""), msg.get("arg"),
+                                      msg.get("library_id"))
+        return json.dumps({"id": msg_id, "result": result}, default=str)
+    except Exception as e:
+        return json.dumps({"id": msg_id, "error": str(e)})
+
+
+def poll_core_event(timeout_ms: int = 0) -> str:
+    """Next CoreEvent as JSON, or "" when none arrives in time (the event
+    pump the host's listener thread drives, lib.rs:119+)."""
+    if _events is None:
+        return ""
+    event = _events.get(timeout=max(0, timeout_ms) / 1000.0)
+    if event is None:
+        return ""
+    return json.dumps({"kind": event.kind,
+                       "payload": getattr(event, "payload", None),
+                       "library_id": getattr(event, "library_id", None)},
+                      default=str)
+
+
+def shutdown_core() -> str:
+    global _node, _events
+    with _lock:
+        if _node is None:
+            return json.dumps({"ok": True, "already": True})
+        try:
+            if _events is not None:
+                _events.close()
+            _node.shutdown()
+        finally:
+            _node = None
+            _events = None
+        return json.dumps({"ok": True})

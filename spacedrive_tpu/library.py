@@ -100,15 +100,15 @@ class _Subscriber:
     ACK_TIMEOUT = 30.0
 
     def __init__(self, fn: Callable[[str, "Library"], None] | None,
-                 sender=None, receiver_ref=None) -> None:
+                 sender=None) -> None:
         from .utils.mpscrr import channel
 
         if sender is not None:
+            # channel-mode: the mpscrr Sender holds the receiver weakly, so
+            # a dropped-unclosed receiver reads as ChannelClosed → eviction
             self._sender = sender
-            self._receiver_ref = receiver_ref  # weakref: drop → auto-evict
             return
         self._sender, receiver = channel()
-        self._receiver_ref = None
         self._fn = fn
 
         def drain() -> None:
@@ -130,8 +130,6 @@ class _Subscriber:
         (caller unsubscribes it)."""
         from .utils.mpscrr import ChannelClosed
 
-        if self._receiver_ref is not None and self._receiver_ref() is None:
-            return False  # channel receiver was garbage-collected unclosed
         try:
             self._sender.send((event, library), timeout=self.ACK_TIMEOUT)
             return True
@@ -171,14 +169,12 @@ class Libraries:
         """Raw mpscrr Receiver for consumers that drain themselves; each
         Request.message is (event, library) and must be respond()ed.
         close() the receiver to unsubscribe — a receiver that is simply
-        garbage-collected is auto-evicted on the next emit (weakref)."""
-        import weakref
-
+        garbage-collected is auto-evicted on the next emit (the mpscrr
+        Sender only holds it weakly)."""
         from .utils.mpscrr import channel
 
         sender, receiver = channel()
-        sub = _Subscriber(None, sender=sender,
-                          receiver_ref=weakref.ref(receiver))
+        sub = _Subscriber(None, sender=sender)
         with self._lock:
             self._subscribers.append(sub)
         return receiver

@@ -18,10 +18,12 @@ _DIR = Path(__file__).parent
 _BUILD = _DIR / "_build"
 
 
-def build_shared(name: str, sources: list[str], extra_flags: list[str] | None = None) -> Path:
+def build_shared(name: str, sources: list[str], extra_flags: list[str] | None = None,
+                 extra_libs: list[str] | None = None) -> Path:
     """Compile ``sources`` (relative to native/) into ``_build/lib<name>.so``,
     rebuilding only when a source is newer than the artifact. Concurrent
-    builders race benignly: each compiles to a temp file then renames."""
+    builders race benignly: each compiles to a temp file then renames.
+    ``extra_libs`` (-l/-L flags) go AFTER the sources — link order matters."""
     out = _BUILD / f"lib{name}.so"
     srcs = [_DIR / s for s in sources]
     if out.exists() and all(out.stat().st_mtime >= s.stat().st_mtime for s in srcs):
@@ -33,6 +35,7 @@ def build_shared(name: str, sources: list[str], extra_flags: list[str] | None = 
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
         *(extra_flags or []),
         *map(str, srcs), "-o", tmp,
+        *(extra_libs or []),
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
@@ -42,3 +45,16 @@ def build_shared(name: str, sources: list[str], extra_flags: list[str] | None = 
             os.unlink(tmp)
         raise
     return out
+
+
+def build_ffi() -> Path:
+    """Build the embedded-core C-ABI shim (sd_core_ffi.cc) against this
+    interpreter's libpython (python3-config --embed flags)."""
+    includes = subprocess.run(
+        ["python3-config", "--includes"],
+        check=True, capture_output=True, text=True).stdout.split()
+    ldflags = subprocess.run(
+        ["python3-config", "--ldflags", "--embed"],
+        check=True, capture_output=True, text=True).stdout.split()
+    return build_shared("sdcoreffi", ["sd_core_ffi.cc"],
+                        extra_flags=includes, extra_libs=ldflags)

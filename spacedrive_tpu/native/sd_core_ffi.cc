@@ -1,0 +1,165 @@
+// Mobile/desktop FFI shim: a C ABI around the embedded core.
+//
+// Reference pattern: apps/mobile/modules/sd-core — the Rust core is built as
+// a static lib exposing `handle_core_msg` over a C ABI so JNI (android) and
+// Swift (ios) hosts can embed the whole Node in-process (core/src/lib.rs:
+// 61-117 JSON-RPC string bridge + :119+ event pump). Here the core is
+// Python, so the shim embeds CPython and forwards the same four calls to
+// spacedrive_tpu.ffi. A host needs nothing but this header surface:
+//
+//     int   sd_core_init(const char* data_dir, const char* python_path);
+//     char* sd_core_msg(const char* json);        // caller frees: sd_core_free
+//     char* sd_core_poll_event(int timeout_ms);   // "" when none; free it
+//     void  sd_core_shutdown(void);
+//     void  sd_core_free(char* s);
+//
+// Build: g++ -shared -fPIC sd_core_ffi.cc $(python3-config --includes
+//        --ldflags --embed) — native/__init__.py's build_ffi() does this.
+
+#include <Python.h>
+
+#include <cstdarg>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_mutex;
+bool g_inited = false;
+PyObject* g_module = nullptr;  // spacedrive_tpu.ffi
+bool g_we_own_interpreter = false;
+
+char* dup_cstr(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (out != nullptr) std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+// Call ffi.<fn>(<one arg built from format under the GIL>) and return its
+// str result (empty string on error, with the Python error printed to
+// stderr for the host's logcat equivalent). Argument CONSTRUCTION must also
+// happen under the GIL — building PyObjects without it is a crash.
+std::string call_str(const char* fn, const char* format, ...) {
+  std::string out;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* args = nullptr;
+  bool args_ok = true;
+  if (format != nullptr) {
+    va_list va;
+    va_start(va, format);
+    args = Py_VaBuildValue(format, va);
+    va_end(va);
+    args_ok = args != nullptr;
+    if (!args_ok) PyErr_Print();
+  }
+  PyObject* callee = (g_module != nullptr && args_ok)
+                         ? PyObject_GetAttrString(g_module, fn)
+                         : nullptr;
+  if (callee != nullptr) {
+    PyObject* result = PyObject_CallObject(callee, args);
+    if (result != nullptr) {
+      const char* utf8 = PyUnicode_AsUTF8(result);
+      if (utf8 != nullptr) out = utf8;
+      Py_DECREF(result);
+    } else {
+      PyErr_Print();
+    }
+    Py_DECREF(callee);
+  } else if (args_ok && g_module == nullptr) {
+    std::fprintf(stderr, "sd_core: module not loaded\n");
+  } else if (args_ok) {
+    PyErr_Print();
+  }
+  Py_XDECREF(args);
+  PyGILState_Release(gil);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. `python_path` (may be NULL) is prepended to
+// sys.path so the host can point at the packaged spacedrive_tpu tree.
+int sd_core_init(const char* data_dir, const char* python_path) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  if (g_inited) return 0;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);  // no signal handlers: the host owns signals.
+    g_we_own_interpreter = true;  // this thread now HOLDS the GIL
+  }
+  PyGILState_STATE gil{};
+  if (!g_we_own_interpreter) gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    // embedded interpreters have no sys.argv; libraries that peek at it
+    // (absl, multiprocessing) misbehave without one
+    PyObject* argv = Py_BuildValue("[s]", "sd_core");
+    if (argv != nullptr) {
+      PySys_SetObject("argv", argv);
+      Py_DECREF(argv);
+    }
+    if (python_path != nullptr && python_path[0] != '\0') {
+      PyObject* sys_path = PySys_GetObject("path");  // borrowed
+      PyObject* entry = PyUnicode_FromString(python_path);
+      if (sys_path == nullptr || entry == nullptr ||
+          PyList_Insert(sys_path, 0, entry) != 0) {
+        PyErr_Print();
+        Py_XDECREF(entry);
+        break;
+      }
+      Py_DECREF(entry);
+    }
+    g_module = PyImport_ImportModule("spacedrive_tpu.ffi");
+    if (g_module == nullptr) {
+      PyErr_Print();
+      break;
+    }
+    PyObject* result = PyObject_CallMethod(g_module, "init_core", "s", data_dir);
+    if (result == nullptr) {
+      PyErr_Print();
+      break;
+    }
+    const char* utf8 = PyUnicode_AsUTF8(result);
+    bool ok = utf8 != nullptr && std::strstr(utf8, "\"ok\": true") != nullptr;
+    if (!ok) {
+      std::fprintf(stderr, "sd_core_init: init_core returned %s\n",
+                   utf8 == nullptr ? "<non-str>" : utf8);
+    }
+    Py_DECREF(result);
+    if (!ok) break;
+    rc = 0;
+    g_inited = true;
+  } while (false);
+  if (g_we_own_interpreter) {
+    // release the init GIL so host threads can call in via PyGILState_Ensure
+    PyEval_SaveThread();
+  } else {
+    PyGILState_Release(gil);
+  }
+  return rc;
+}
+
+char* sd_core_msg(const char* json) {
+  if (!g_inited) return dup_cstr("{\"error\":\"core not initialized\"}");
+  return dup_cstr(call_str("handle_core_msg", "(s)",
+                           json == nullptr ? "" : json));
+}
+
+char* sd_core_poll_event(int timeout_ms) {
+  if (!g_inited) return dup_cstr("");
+  return dup_cstr(call_str("poll_core_event", "(i)", timeout_ms));
+}
+
+void sd_core_shutdown(void) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  if (!g_inited) return;
+  call_str("shutdown_core", nullptr);
+  g_inited = false;
+}
+
+void sd_core_free(char* s) { std::free(s); }
+
+}  // extern "C"

@@ -1,0 +1,51 @@
+/* Foreign-host demo for the C-ABI core shim (sd_core_ffi.cc): a plain C
+ * program — the stand-in for a JNI/Swift mobile host — embeds the core,
+ * creates a library over the JSON bridge, lists it back, drains one event,
+ * and shuts down. Exit 0 only if every step round-trips. */
+#include <stdio.h>
+#include <string.h>
+
+extern int sd_core_init(const char* data_dir, const char* python_path);
+extern char* sd_core_msg(const char* json);
+extern char* sd_core_poll_event(int timeout_ms);
+extern void sd_core_shutdown(void);
+extern void sd_core_free(char* s);
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <data_dir> <python_path>\n", argv[0]);
+    return 2;
+  }
+  if (sd_core_init(argv[1], argv[2]) != 0) {
+    fprintf(stderr, "sd_core_init failed\n");
+    return 1;
+  }
+  char* resp = sd_core_msg(
+      "{\"id\":1,\"key\":\"libraries.create\",\"arg\":{\"name\":\"ffi-lib\"}}");
+  printf("create: %s\n", resp);
+  int ok = resp != NULL && strstr(resp, "\"result\"") != NULL &&
+           strstr(resp, "ffi-lib") != NULL;
+  sd_core_free(resp);
+  if (!ok) { sd_core_shutdown(); return 1; }
+
+  resp = sd_core_msg("{\"id\":2,\"key\":\"libraries.list\",\"arg\":null}");
+  printf("list: %s\n", resp);
+  ok = resp != NULL && strstr(resp, "ffi-lib") != NULL;
+  sd_core_free(resp);
+  if (!ok) { sd_core_shutdown(); return 1; }
+
+  /* library creation broadcast at least one invalidation event */
+  char* event = sd_core_poll_event(2000);
+  printf("event: %s\n", event);
+  ok = event != NULL && strstr(event, "\"kind\"") != NULL;
+  sd_core_free(event);
+
+  /* error path: unknown key comes back as an error envelope, not a crash */
+  resp = sd_core_msg("{\"id\":3,\"key\":\"no.suchProcedure\"}");
+  printf("bad key: %s\n", resp);
+  int err_ok = resp != NULL && strstr(resp, "\"error\"") != NULL;
+  sd_core_free(resp);
+
+  sd_core_shutdown();
+  return (ok && err_ok) ? 0 : 1;
+}

@@ -171,8 +171,7 @@ _OLC_SEPARATOR = "+"
 _OLC_PAIR_CODE_LEN = 10
 
 
-def encode_pluscode(latitude: float, longitude: float,
-                    code_length: int = _OLC_PAIR_CODE_LEN) -> str:
+def encode_pluscode(latitude: float, longitude: float) -> str:
     """Standard 10-digit plus code (e.g. 8FVC9G8F+6X)."""
     lat = min(90.0, max(-90.0, latitude))
     lon = longitude

@@ -97,8 +97,14 @@ class Receiver:
 
 
 class Sender:
+    """Holds the Receiver WEAKLY (like the Rust half: a sender must not keep
+    a dropped receiver alive) — a garbage-collected, never-closed receiver
+    reads as ChannelClosed on the next send instead of leaking forever."""
+
     def __init__(self, receiver: Receiver) -> None:
-        self._receiver = receiver
+        import weakref
+
+        self._receiver = weakref.ref(receiver)
 
     def send(self, message: Any, timeout: float | None = None) -> Any:
         """Enqueue + block for the consumer's response (ack)."""
@@ -106,16 +112,17 @@ class Sender:
 
     def send_async(self, message: Any) -> Request:
         """Enqueue without waiting; call .wait() on the returned Request."""
-        if self._receiver.closed:
-            raise ChannelClosed("receiver is closed")
+        receiver = self._receiver()
+        if receiver is None or receiver.closed:
+            raise ChannelClosed("receiver is closed or collected")
         req = Request(message)
         try:
-            self._receiver._q.put(req, timeout=5)
+            receiver._q.put(req, timeout=5)
         except queue.Full:
             # a full queue means SLOW, not gone — closed is the only
             # gone-signal (a caller must not evict a live-but-busy consumer)
             raise TimeoutError("receiver queue full (consumer is slow)")
-        if self._receiver.closed:
+        if receiver.closed:
             req._abort()
         return req
 

@@ -57,7 +57,9 @@ def init_logger(data_dir: str | Path, level: str | None = None) -> None:
     except OSError as e:
         logging.getLogger(__name__).warning("no file logging: %s", e)
 
-    if not any(isinstance(h, logging.StreamHandler)
+    # exact-type check: FileHandler subclasses StreamHandler, and a host
+    # app's file handler must not suppress the stdout layer
+    if not any(type(h) is logging.StreamHandler
                for h in logging.getLogger().handlers):
         stream = logging.StreamHandler()
         stream.setFormatter(formatter)

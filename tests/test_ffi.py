@@ -1,0 +1,159 @@
+"""Mobile-FFI bridge: the JSON string interface (spacedrive_tpu.ffi) and the
+C-ABI shim driven by a REAL foreign host — a plain C program embedding the
+core the way a JNI/Swift shell would (reference: apps/mobile/modules/sd-core
+core/src/lib.rs:61-117)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# python side of the bridge (in a subprocess: init_core boots a real Node and
+# the module is process-global)
+# ---------------------------------------------------------------------------
+
+def test_ffi_python_bridge_roundtrip(tmp_path):
+    script = r"""
+import json, sys
+from spacedrive_tpu import ffi
+
+data_dir = sys.argv[1]
+print(ffi.handle_core_msg("{}"))  # before init: error envelope
+assert json.loads(ffi.init_core(data_dir))["ok"]
+
+resp = json.loads(ffi.handle_core_msg(json.dumps(
+    {"id": 7, "key": "libraries.create", "arg": {"name": "bridge-lib"}})))
+assert resp["id"] == 7 and resp["result"]["name"] == "bridge-lib", resp
+lib_id = resp["result"]["id"]
+
+resp = json.loads(ffi.handle_core_msg(json.dumps(
+    {"id": 8, "key": "search.paths", "arg": {}, "library_id": lib_id})))
+assert resp["result"]["items"] == []
+
+# bad payloads are error envelopes, never raises
+assert "error" in json.loads(ffi.handle_core_msg("not json"))
+assert "error" in json.loads(ffi.handle_core_msg('{"id":9,"key":"nope"}'))
+
+event = ffi.poll_core_event(2000)
+assert event and json.loads(event)["kind"]
+assert json.loads(ffi.shutdown_core())["ok"]
+print("BRIDGE OK")
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SD_P2P_DISABLED"] = "1"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", script, str(tmp_path / "d")],
+                          capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "BRIDGE OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# the C host
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ffi_demo_binary(tmp_path_factory):
+    from spacedrive_tpu.native import _BUILD, build_ffi
+
+    shim = build_ffi()
+    demo = tmp_path_factory.mktemp("ffi") / "sd_ffi_demo"
+    subprocess.run(
+        ["gcc", str(REPO / "spacedrive_tpu/native/sd_ffi_demo.c"),
+         "-o", str(demo), f"-L{_BUILD}", "-lsdcoreffi",
+         f"-Wl,-rpath,{_BUILD}"],
+        check=True, capture_output=True, text=True)
+    return demo
+
+
+def test_c_host_embeds_core(ffi_demo_binary, tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SD_P2P_DISABLED"] = "1"
+    env["SD_NO_WATCHER"] = "1"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [str(ffi_demo_binary), str(tmp_path / "core_data"), str(REPO)],
+        capture_output=True, text=True, timeout=180, env=env)
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "ffi-lib" in proc.stdout
+    assert '"error"' in proc.stdout  # the bad-key envelope printed
+
+
+# ---------------------------------------------------------------------------
+# CLI shell (apps/cli analogue)
+# ---------------------------------------------------------------------------
+
+def test_cli_inspect_encrypted_header(tmp_path, capsys):
+    from spacedrive_tpu import cli
+    from spacedrive_tpu.crypto import Algorithm, FileHeader, Protected
+    from spacedrive_tpu.crypto.primitives import generate_master_key
+    from spacedrive_tpu.crypto.stream import Encryptor
+
+    master = generate_master_key()
+    header = FileHeader.new(Algorithm.XCHACHA20_POLY1305)
+    header.add_keyslot(Protected("pw"), master)
+    header.add_metadata(master, {"name": "x"})
+    target = tmp_path / "thing.bytes"
+    with open(target, "wb") as fh:
+        header.write(fh)
+        import io
+
+        Encryptor.encrypt_streams(master, header.nonce, header.algorithm,
+                                  io.BytesIO(b"payload"), fh, header.aad())
+
+    assert cli.main(["inspect", str(target)]) == 0
+    out = capsys.readouterr().out
+    assert "XCHACHA20_POLY1305" in out
+    assert "keyslots:       1" in out
+    assert "metadata:       present" in out
+
+    # not an encrypted file
+    plain = tmp_path / "plain.txt"
+    plain.write_text("nope")
+    assert cli.main(["inspect", str(plain)]) == 1
+
+
+def test_cli_against_live_server(tmp_data_dir, tmp_path, capsys):
+    from spacedrive_tpu import cli
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.server import Server
+
+    node = Node(tmp_data_dir, probe_accelerator=False)
+    server = Server(node, port=0)
+    server.start()
+    try:
+        tree = tmp_path / "clitree"
+        tree.mkdir()
+        (tree / "doc.txt").write_text("cli test")
+        lib = node.libraries.create("cli-lib")
+        from spacedrive_tpu.locations import create_location, scan_location
+
+        loc = create_location(lib, str(tree), hasher="cpu")
+        scan_location(lib, loc["id"])
+        assert node.jobs.wait_idle(60)
+
+        url = f"http://127.0.0.1:{server.port}"
+        assert cli.main(["libraries", "--url", url]) == 0
+        assert "cli-lib" in capsys.readouterr().out
+
+        assert cli.main(["search", "--url", url, "--library", "cli-lib",
+                         "--term", "doc"]) == 0
+        out = capsys.readouterr().out
+        assert "/doc.txt" in out and "cas=" in out
+
+        assert cli.main(["jobs", "--url", url, "--library", "cli-lib"]) == 0
+        out = capsys.readouterr().out
+        assert "indexer" in out and "Completed" in out
+    finally:
+        server.stop()
+        node.shutdown()
