@@ -24,6 +24,14 @@ def mount(router) -> None:
             "accelerator": cfg.get("accelerator"),
         }
 
+    @router.subscription("invalidation.listen")
+    def invalidation_listen(node, _arg):
+        """Stream of invalidate_query events — the frontend cache-refresh
+        feed (mount_invalidate, api/mod.rs:183)."""
+        from ._util import filtered_subscription
+
+        return filtered_subscription(node, {"invalidate_query"})
+
     @router.mutation("toggleFeatureFlag")
     def toggle_feature_flag(node, feature: str):
         """Flip a BackendFeature; returns the new enabled state."""

@@ -8,7 +8,9 @@ contract crosses; procedures resolve on a worker-thread pool so slow DB
 work never stalls the accept loop.
 
 Routes:
+    GET  /                                         → embedded web explorer
     GET  /health                                   → "OK"
+    GET  /info                                     → server/node JSON
     GET  /rspc/<key>?arg=<json>[&library_id=]      → query
     POST /rspc/<key>   {"arg":..,"library_id":..}  → query or mutation
     GET  /rspc/ws (Upgrade: websocket)             → JSON-RPC incl. subscriptions
@@ -181,6 +183,11 @@ class Server:
             return Response.text("OK")
         self._check_auth(req)
         if not parts:
+            from .webui import INDEX_HTML
+
+            return Response(headers={"content-type": "text/html; charset=utf-8"},
+                            body=INDEX_HTML.encode())
+        if parts[0] == "info":
             return Response.json({"server": "spacedrive_tpu",
                                   "node": self.node.config.get().get("name")})
         if parts[0] == "rspc":
@@ -413,6 +420,9 @@ class Server:
             except ApiError as e:
                 await reply_error(400, str(e))
                 return
+            stale = subs.pop(msg_id, None)
+            if stale is not None:
+                stale[0].close()  # re-used id: stop the old stream first
             thread = threading.Thread(target=pump, args=(msg_id, subscription),
                                       name=f"ws-sub-{path}", daemon=True)
             subs[msg_id] = (subscription, thread)

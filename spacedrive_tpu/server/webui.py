@@ -1,0 +1,306 @@
+"""Embedded web explorer: the `interface/` + `apps/web` stand-in.
+
+The reference ships a 19k-LoC React app; a TPU-host framework needs a
+working window into the node more than a design system, so the shell embeds
+a single-file vanilla-JS explorer (no build step, no assets pipeline —
+axum's `feature = "assets"` embedded-dist analogue, apps/server main.rs).
+It drives the same wire contract a full frontend would: rspc HTTP calls,
+the /rspc/ws subscription socket for live job progress + invalidation, and
+custom_uri thumbnails/files.
+"""
+
+INDEX_HTML = r"""<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>spacedrive_tpu</title>
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<style>
+  :root {
+    --bg: #12121a; --panel: #1a1b26; --panel2: #20212e; --text: #c8cad4;
+    --dim: #7a7d8f; --accent: #5b8cff; --ok: #3fb97f; --warn: #e0b050;
+  }
+  * { box-sizing: border-box; }
+  body { margin: 0; background: var(--bg); color: var(--text);
+         font: 14px/1.45 system-ui, sans-serif; display: flex; height: 100vh; }
+  aside { width: 230px; background: var(--panel); padding: 14px;
+          display: flex; flex-direction: column; gap: 10px; flex-shrink: 0; }
+  main { flex: 1; padding: 16px 20px; overflow-y: auto; }
+  h1 { font-size: 15px; margin: 0 0 4px; color: #fff; }
+  h2 { font-size: 12px; text-transform: uppercase; letter-spacing: .08em;
+       color: var(--dim); margin: 12px 0 6px; }
+  select, input, button {
+    background: var(--panel2); color: var(--text); border: 1px solid #2e3040;
+    border-radius: 6px; padding: 6px 8px; font: inherit; width: 100%;
+  }
+  button { cursor: pointer; width: auto; }
+  button:hover { border-color: var(--accent); }
+  .loc { padding: 6px 8px; border-radius: 6px; cursor: pointer;
+         display: flex; justify-content: space-between; }
+  .loc:hover, .loc.active { background: var(--panel2); }
+  .crumbs { color: var(--dim); margin-bottom: 10px; }
+  .crumbs a { color: var(--accent); cursor: pointer; text-decoration: none; }
+  .grid { display: grid; grid-template-columns: repeat(auto-fill, minmax(120px, 1fr));
+          gap: 10px; }
+  .item { background: var(--panel); border-radius: 8px; padding: 8px;
+          text-align: center; cursor: pointer; overflow: hidden; }
+  .item:hover { outline: 1px solid var(--accent); }
+  .thumb { height: 80px; display: flex; align-items: center;
+           justify-content: center; font-size: 34px; }
+  .thumb img { max-width: 100%; max-height: 80px; border-radius: 4px; }
+  .name { font-size: 12px; white-space: nowrap; overflow: hidden;
+          text-overflow: ellipsis; }
+  .meta { font-size: 11px; color: var(--dim); }
+  #jobs .job { padding: 6px 8px; background: var(--panel2); border-radius: 6px;
+               margin-bottom: 6px; font-size: 12px; }
+  .bar { height: 4px; background: #2e3040; border-radius: 2px; margin-top: 4px; }
+  .bar > div { height: 100%; background: var(--accent); border-radius: 2px;
+               transition: width .3s; }
+  .pill { font-size: 10px; padding: 1px 7px; border-radius: 9px;
+          background: var(--panel2); color: var(--dim); }
+  table { width: 100%; border-collapse: collapse; font-size: 13px; }
+  td, th { text-align: left; padding: 5px 8px; border-bottom: 1px solid #23242f; }
+  #status { font-size: 11px; color: var(--dim); margin-top: auto; }
+</style>
+</head>
+<body>
+<aside>
+  <h1>spacedrive_tpu</h1>
+  <h2>Library</h2>
+  <select id="library"></select>
+  <h2>Locations</h2>
+  <div id="locations"></div>
+  <h2>Search</h2>
+  <input id="search" placeholder="search files… (enter)">
+  <h2>Views</h2>
+  <div class="loc" data-view="duplicates">near-duplicates</div>
+  <h2>Jobs</h2>
+  <div id="jobs"></div>
+  <div id="status">connecting…</div>
+</aside>
+<main>
+  <div class="crumbs" id="crumbs"></div>
+  <div id="content" class="grid"></div>
+</main>
+<script>
+const state = { library: null, location: null, dir: "/", ws: null };
+const KIND_ICONS = {0:"📄",2:"📁",3:"📝",5:"🖼️",6:"🎵",7:"🎬",8:"🗜️",9:"⚙️",
+                    11:"🔒",20:"💻",21:"🗃️",22:"📚",23:"🧾"};
+
+async function rspc(key, arg, libraryId) {
+  const r = await fetch(`/rspc/${key}`, {method:"POST",
+    headers:{"content-type":"application/json"},
+    body: JSON.stringify({arg: arg ?? null, library_id: libraryId ?? state.library})});
+  const body = await r.json();
+  if (body.error) throw new Error(`${key}: ${body.error}`);
+  return body.result;
+}
+
+function el(tag, attrs = {}, text = "") {
+  const n = document.createElement(tag);
+  Object.assign(n, attrs);
+  if (text) n.textContent = text;
+  return n;
+}
+
+async function loadLibraries() {
+  const libs = await rspc("libraries.list", null, null);
+  const sel = document.getElementById("library");
+  sel.innerHTML = "";
+  for (const lib of libs) sel.append(el("option", {value: lib.id}, lib.name));
+  if (libs.length) { state.library = libs[0].id; await loadLocations(); }
+  sel.onchange = async () => {
+    state.library = sel.value;
+    state.location = null;  // locations are per-library
+    state.dir = "/";
+    await loadLocations();
+  };
+}
+
+async function loadLocations() {
+  const locs = await rspc("locations.list");
+  const box = document.getElementById("locations");
+  box.innerHTML = "";
+  for (const loc of locs) {
+    const row = el("div", {className: "loc"});
+    row.append(el("span", {}, loc.name || loc.path));
+    const scan = el("button", {title: "rescan"}, "↻");
+    scan.onclick = async (e) => { e.stopPropagation();
+      await rspc("locations.fullRescan", {location_id: loc.id}); };
+    row.append(scan);
+    row.onclick = () => { state.location = loc.id; state.dir = "/"; browse(); };
+    box.append(row);
+  }
+  if (state.location === null) state.location = locs.length ? locs[0].id : null;
+  browse();
+}
+
+function crumbs() {
+  const c = document.getElementById("crumbs");
+  c.innerHTML = "";
+  const parts = state.dir.split("/").filter(Boolean);
+  const root = el("a", {}, "root"); root.onclick = () => { state.dir = "/"; browse(); };
+  c.append(root);
+  let acc = "/";
+  for (const part of parts) {
+    acc += part + "/";
+    const target = acc;
+    c.append(document.createTextNode(" / "));
+    const a = el("a", {}, part);
+    a.onclick = () => { state.dir = target; browse(); };
+    c.append(a);
+  }
+}
+
+async function browse() {
+  if (state.library === null || state.location === null) return;
+  crumbs();
+  const res = await rspc("search.paths",
+    {location_id: state.location, materialized_path: state.dir, take: 500});
+  render(res.items ?? res);
+}
+
+function render(items) {
+  const box = document.getElementById("content");
+  box.className = "grid";
+  box.innerHTML = "";
+  items.sort((a, b) => (b.is_dir - a.is_dir) || a.name.localeCompare(b.name));
+  for (const it of items) {
+    if (!it.name) continue;
+    const card = el("div", {className: "item"});
+    const thumb = el("div", {className: "thumb"});
+    if (it.cas_id && it.object_kind === 5) {
+      const img = el("img", {loading: "lazy",
+        src: `/spacedrive/thumbnail/${it.cas_id.slice(0,2)}/${it.cas_id}.webp`});
+      img.onerror = () => { thumb.textContent = KIND_ICONS[5]; };
+      thumb.append(img);
+    } else {
+      thumb.textContent = KIND_ICONS[it.is_dir ? 2 : (it.object_kind ?? 0)] || "📄";
+    }
+    const full = it.name + (it.extension && !it.is_dir ? "." + it.extension : "");
+    card.append(thumb, el("div", {className: "name", title: full}, full),
+      el("div", {className: "meta"},
+         it.is_dir ? "folder" : fmtSize(it.size_in_bytes)));
+    card.onclick = () => {
+      if (it.is_dir) {
+        state.location = it.location_id;  // search results may span locations
+        state.dir = `${it.materialized_path}${it.name}/`;
+        browse();
+      }
+      else window.open(
+        `/spacedrive/file/${state.library}/${it.location_id}/${it.id}`, "_blank");
+    };
+    box.append(card);
+  }
+  if (!items.length) box.append(el("div", {className: "meta"}, "empty"));
+}
+
+function fmtSize(n) {
+  if (n == null) return "";
+  const units = ["B","KiB","MiB","GiB","TiB"];
+  let i = 0; while (n >= 1024 && i < units.length - 1) { n /= 1024; i++; }
+  return `${n.toFixed(n >= 10 || i === 0 ? 0 : 1)} ${units[i]}`;
+}
+
+document.getElementById("search").addEventListener("keydown", async (e) => {
+  if (e.key !== "Enter") return;
+  const res = await rspc("search.paths", {search: e.target.value, take: 200});
+  document.getElementById("crumbs").textContent =
+    `search: ${e.target.value}`;
+  render(res.items ?? res);
+});
+
+document.querySelector('[data-view="duplicates"]').onclick = async () => {
+  const pairs = await rspc("search.duplicates", {});
+  const box = document.getElementById("content");
+  box.className = ""; box.innerHTML = "";
+  document.getElementById("crumbs").textContent = "near-duplicate pairs";
+  const table = el("table");
+  table.append(el("tr", {innerHTML:
+    "<th>similarity</th><th>file a</th><th>file b</th>"}));
+  for (const p of pairs) {
+    const tr = el("tr");
+    tr.append(el("td", {}, p.similarity.toFixed(2)),
+              el("td", {}, `${p.a_dir}${p.a_name}.${p.a_ext ?? ""}`),
+              el("td", {}, `${p.b_dir}${p.b_name}.${p.b_ext ?? ""}`));
+    table.append(tr);
+  }
+  if (!pairs.length) table.append(el("tr", {innerHTML:
+    "<td colspan=3>no pairs recorded</td>"}));
+  box.append(table);
+};
+
+// live updates: jobs.progress + invalidation over the rspc websocket.
+// ONE resubscribe interval lives outside connectWs (reconnects must not
+// stack timers), and switching libraries stops the old progress stream.
+let liveWs = null;
+let subbedLib = null;
+setInterval(() => {
+  if (liveWs && liveWs.readyState === WebSocket.OPEN &&
+      state.library && state.library !== subbedLib) {
+    if (subbedLib !== null) {
+      liveWs.send(JSON.stringify({id: 3, method: "subscriptionStop",
+        params: {subscriptionId: 2}}));
+    }
+    subbedLib = state.library;
+    liveWs.send(JSON.stringify({id: 2, method: "subscription",
+      params: {path: "jobs.progress",
+               input: {library_id: state.library, arg: null}}}));
+  }
+}, 500);
+
+function connectWs() {
+  const scheme = location.protocol === "https:" ? "wss" : "ws";
+  const ws = new WebSocket(`${scheme}://${location.host}/rspc/ws`);
+  liveWs = ws;
+  const status = document.getElementById("status");
+  const jobs = {};
+  ws.onopen = () => {
+    status.textContent = "live";
+    ws.send(JSON.stringify({id: 1, method: "subscription",
+      params: {path: "invalidation.listen", input: null}}));
+  };
+  ws.onclose = () => {
+    status.textContent = "disconnected — retrying…";
+    subbedLib = null;
+    setTimeout(connectWs, 2000);
+  };
+  ws.onmessage = (m) => {
+    const msg = JSON.parse(m.data);
+    const data = msg.result?.data;
+    if (!data) return;
+    if (msg.id === 2 && data.kind === "job_progress") {
+      const p = data.payload || {};
+      jobs[p.id] = p;
+      const box = document.getElementById("jobs");
+      box.innerHTML = "";
+      for (const job of Object.values(jobs)) {
+        const total = job.task_count || 1;
+        const done = job.completed_task_count || 0;
+        const row = el("div", {className: "job"});
+        row.append(el("div", {}, `${job.name || "job"} `),
+                   el("span", {className: "pill"}, `${done}/${total}`));
+        const bar = el("div", {className: "bar"});
+        bar.append(el("div", {style: `width:${100 * done / total}%`}));
+        row.append(bar);
+        if (done >= total) setTimeout(() => { delete jobs[job.id];
+          row.remove(); }, 4000);
+        box.append(row);
+      }
+    }
+    if (msg.id === 1 && data.kind === "invalidate_query") {
+      const key = data.payload?.key;
+      if (key === "search.paths") browse();
+      if (key === "locations.list" || key === "libraries.list") loadLocations();
+      if (key === "search.duplicates") { /* view refreshes on click */ }
+    }
+  };
+}
+
+loadLibraries().then(connectWs).catch(e => {
+  document.getElementById("status").textContent = e.message;
+});
+</script>
+</body>
+</html>
+"""

@@ -371,3 +371,35 @@ def test_watcher_live_in_server_process(server_proc):
             return
         time.sleep(0.5)
     raise AssertionError("watcher did not surface the live file over HTTP")
+
+
+def test_web_ui_served_and_invalidation_stream(server_proc):
+    """GET / serves the embedded explorer; invalidation.listen streams
+    invalidate_query events over the websocket (mount_invalidate analogue)."""
+    _proc, port, _tree = server_proc
+    base = _base(port)
+    status, headers, body = _get(base, "/")
+    assert status == 200 and headers["content-type"].startswith("text/html")
+    assert b"<title>spacedrive_tpu</title>" in body
+    assert b"/rspc/ws" in body  # the live socket the UI opens
+
+    libs = _rspc(base, "libraries.list")
+    lib_id = libs[0]["id"]
+    locs = _rspc(base, "locations.list", None, lib_id)
+
+    ws = WsClient("127.0.0.1", port)
+    try:
+        ws.send({"id": 1, "method": "subscription",
+                 "params": {"path": "invalidation.listen", "input": None}})
+        assert ws.recv()["result"]["type"] == "started"
+        _rspc(base, "locations.fullRescan", {"location_id": locs[0]["id"]}, lib_id)
+        deadline = time.monotonic() + 30
+        got = None
+        while time.monotonic() < deadline:
+            msg = ws.recv(timeout=20)
+            if msg and msg["id"] == 1 and msg["result"]["type"] == "event":
+                got = msg["result"]["data"]
+                break
+        assert got and got["kind"] == "invalidate_query", got
+    finally:
+        ws.close()
