@@ -43,7 +43,7 @@ def mount(router) -> None:
     def create(node, library, arg):
         row = create_location(library, arg["path"], name=arg.get("name"),
                               indexer_rule_names=arg.get("indexer_rules"),
-                              hasher=arg.get("hasher", "tpu"),
+                              hasher=arg.get("hasher", "hybrid"),
                               dry_run=arg.get("dry_run", False))
         if not arg.get("dry_run"):
             scan_location(library, row["id"])
@@ -89,6 +89,20 @@ def mount(router) -> None:
         library.db.update(Location, {"id": location_id}, {"path": str(path)})
         invalidate_query(library, "locations.list")
         return location_id
+
+    @router.library_mutation("locations.addLibrary")
+    def add_library(node, library, arg):
+        """Add an already-spacedrive'd directory to THIS library too
+        (LocationCreateArgs::add_library — the dotfile keeps per-library
+        entries so several libraries can track one directory)."""
+        from ...locations import create_location
+
+        row = create_location(library, arg["path"], name=arg.get("name"),
+                              indexer_rule_names=arg.get("indexer_rules"),
+                              hasher=arg.get("hasher", "hybrid"))
+        scan_location(library, row["id"])  # same pipeline kick as create
+        invalidate_query(library, "locations.list")
+        return row
 
     @router.library_mutation("locations.fullRescan")
     def full_rescan(node, library, arg):

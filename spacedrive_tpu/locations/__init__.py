@@ -33,7 +33,7 @@ class LocationError(Exception):
 
 def create_location(library: "Library", path: str | Path, name: str | None = None,
                     indexer_rule_names: list[str] | None = None,
-                    hasher: str = "tpu", dry_run: bool = False) -> dict[str, Any]:
+                    hasher: str = "hybrid", dry_run: bool = False) -> dict[str, Any]:
     """LocationCreateArgs::create — validates the path, writes the metadata
     dotfile, inserts the row, links default indexer rules."""
     path = Path(path).resolve()

@@ -1,0 +1,91 @@
+"""ctypes binding for the native image helper (sd_images.cc).
+
+The sd-images equivalent: JPEG/PNG decode straight into numpy RGB buffers
+(JPEG downscales in DCT space during decode) and WebP encoding via libwebp
+— the same C cores the reference's image/webp crates bind. Import fails
+cleanly on hosts without the toolchain/libs; callers fall back to PIL.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from pathlib import Path
+
+import numpy as np
+
+from . import build_shared
+
+_lib = ctypes.CDLL(str(build_shared(
+    "sdimages", ["sd_images.cc"],
+    extra_libs=["-ljpeg", "-lpng", "-lwebp"])))
+
+_lib.sd_image_decode_rgb.argtypes = [
+    ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+    ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+]
+_lib.sd_image_decode_rgb.restype = ctypes.c_int64
+
+_lib.sd_image_encode_webp.argtypes = [
+    ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_float,
+    ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+]
+_lib.sd_image_encode_webp.restype = ctypes.c_uint64
+
+_lib.sd_webp_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+_lib.sd_webp_free.restype = None
+
+#: formats the native decoder handles; everything else goes to the fallback
+NATIVE_DECODE_EXTENSIONS = {"jpg", "jpeg", "png"}
+
+
+class ImageDecodeError(Exception):
+    pass
+
+
+_scratch = threading.local()
+
+
+def _scratch_buf(nbytes: int) -> np.ndarray:
+    """Per-thread reusable decode buffer: thumbnail batches call decode_rgb
+    once per image, and reallocating ~190 MiB per call churns the allocator
+    and spikes RSS next to the JAX runtime."""
+    buf = getattr(_scratch, "buf", None)
+    if buf is None or buf.nbytes < nbytes:
+        buf = np.empty(nbytes, np.uint8)
+        _scratch.buf = buf
+    return buf
+
+
+def decode_rgb(path: str | Path, max_edge: int = 0,
+               max_pixels: int = 64_000_000) -> np.ndarray:
+    """Decode to an (h, w, 3) uint8 array. ``max_edge`` > 0 lets JPEG
+    downscale during decode (output edge stays above max_edge; the caller
+    finishes with its own resampler). Raises ImageDecodeError on
+    unsupported/corrupt input (sd-images' max-size guards kept via
+    ``max_pixels``)."""
+    buf = _scratch_buf(max_pixels * 3)
+    w = ctypes.c_int32(0)
+    h = ctypes.c_int32(0)
+    n = _lib.sd_image_decode_rgb(
+        str(path).encode(), buf.ctypes.data, buf.nbytes, max_edge,
+        ctypes.byref(w), ctypes.byref(h))
+    if n <= 0:
+        raise ImageDecodeError(f"native decode failed for {path} (rc={n})")
+    return buf[:n].reshape(h.value, w.value, 3).copy()
+
+
+def encode_webp(rgb: np.ndarray, quality: float = 30.0) -> bytes:
+    if rgb.ndim != 3 or rgb.shape[2] != 3 or rgb.dtype != np.uint8:
+        raise ValueError("encode_webp wants (h, w, 3) uint8")
+    rgb = np.ascontiguousarray(rgb)
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    n = _lib.sd_image_encode_webp(
+        rgb.ctypes.data, rgb.shape[1], rgb.shape[0], float(quality),
+        ctypes.byref(out))
+    if n == 0:
+        raise ImageDecodeError("webp encode failed")
+    try:
+        return ctypes.string_at(out, n)
+    finally:
+        _lib.sd_webp_free(out)

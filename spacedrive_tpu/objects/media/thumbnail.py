@@ -72,10 +72,45 @@ def generate_thumbnail(source: str | Path, data_dir: str | Path, cas_id: str,
         return None
 
 
+_NATIVE_IMAGES: list | None = None  # [module_or_None] once probed
+
+
+def _native_images():
+    """sd-images equivalent (C++ libjpeg/libpng/libwebp) if buildable.
+    The probe result is cached — a failed import involves a g++ attempt and
+    must not re-run per image."""
+    global _NATIVE_IMAGES
+    if _NATIVE_IMAGES is None:
+        try:
+            from ...native import images_native
+
+            _NATIVE_IMAGES = [images_native]
+        except Exception as e:
+            logger.info("native image helper unavailable (%s); using PIL", e)
+            _NATIVE_IMAGES = [None]
+    return _NATIVE_IMAGES[0]
+
+
+def _native_decode(source: Path, max_edge: int):
+    """numpy RGB via the native decoder, or None → caller uses PIL."""
+    native = _native_images()
+    ext = source.suffix.lstrip(".").lower()
+    if native is None or ext not in native.NATIVE_DECODE_EXTENSIONS:
+        return None
+    try:
+        return native.decode_rgb(source, max_edge=max_edge)
+    except Exception as e:
+        logger.debug("native decode fell back to PIL for %s: %s", source, e)
+        return None
+
+
 def _image_thumbnail(source: Path, out: Path) -> Path:
     from PIL import Image
 
-    with Image.open(source) as img:
+    # native decode (JPEG prescaled in DCT space near the target)
+    arr = _native_decode(source, MAX_INPUT_EDGE)
+    img = Image.fromarray(arr) if arr is not None else Image.open(source)
+    with img:
         img = img.convert("RGB") if img.mode not in ("RGB", "RGBA") else img
         w, h = img.size
         # scale so w*h ≈ TARGET_PX (thumbnail/mod.rs:95-100 sqrt scale factor)
@@ -83,9 +118,23 @@ def _image_thumbnail(source: Path, out: Path) -> Path:
             factor = math.sqrt(TARGET_PX / (w * h))
             img = img.resize((max(1, round(w * factor)), max(1, round(h * factor))))
         tmp = out.with_suffix(".tmp.webp")
-        img.save(tmp, "WEBP", quality=WEBP_QUALITY)
+        _save_webp(img, tmp)
     tmp.replace(out)
     return out
+
+
+def _save_webp(img, tmp: Path) -> None:
+    native = _native_images()
+    if native is not None:
+        try:
+            import numpy as np
+
+            rgb = np.asarray(img.convert("RGB"), dtype=np.uint8)
+            tmp.write_bytes(native.encode_webp(rgb, WEBP_QUALITY))
+            return
+        except Exception as e:
+            logger.debug("native webp encode fell back to PIL: %s", e)
+    img.save(tmp, "WEBP", quality=WEBP_QUALITY)
 
 
 def _video_thumbnail(source: Path, out: Path) -> Path | None:
@@ -113,11 +162,21 @@ MAX_INPUT_EDGE = 1024
 
 
 def _decode_for_device(source: Path):
-    """PIL decode + integer box-reduce to ≤MAX_INPUT_EDGE (cheap antialias
-    pre-pass; the device kernel does the fractional bilinear step)."""
+    """Decode (native libjpeg/libpng when available, JPEG prescaled in DCT
+    space) + integer box-reduce to ≤MAX_INPUT_EDGE — cheap antialias
+    pre-pass; the device kernel does the fractional bilinear step."""
     import numpy as np
     from PIL import Image
 
+    arr = _native_decode(source, MAX_INPUT_EDGE)
+    if arr is not None:
+        edge = max(arr.shape[0], arr.shape[1])
+        if edge > MAX_INPUT_EDGE:  # PNG has no in-decode scaling
+            k = -(-edge // MAX_INPUT_EDGE)
+            h, w = (arr.shape[0] // k) * k, (arr.shape[1] // k) * k
+            arr = arr[:h, :w].reshape(h // k, k, w // k, k, 3) \
+                .mean(axis=(1, 3)).astype(np.uint8)
+        return arr
     with Image.open(source) as img:
         img = img.convert("RGB")
         edge = max(img.size)
@@ -175,7 +234,7 @@ def generate_thumbnails_batched(entries, data_dir: str | Path):
         try:
             out.parent.mkdir(parents=True, exist_ok=True)
             tmp = out.with_suffix(".tmp.webp")
-            Image.fromarray(thumb).save(tmp, "WEBP", quality=WEBP_QUALITY)
+            _save_webp(Image.fromarray(thumb), tmp)
             tmp.replace(out)
             out_paths[cas_id] = out
         except Exception as e:

@@ -164,7 +164,8 @@ function render(items) {
   const box = document.getElementById("content");
   box.className = "grid";
   box.innerHTML = "";
-  items.sort((a, b) => (b.is_dir - a.is_dir) || a.name.localeCompare(b.name));
+  items.sort((a, b) => (b.is_dir - a.is_dir)
+    || (a.name ?? "").localeCompare(b.name ?? ""));
   for (const it of items) {
     if (!it.name) continue;
     const card = el("div", {className: "item"});

@@ -1,0 +1,100 @@
+"""Native image stack (sd-images equivalent): libjpeg/libpng decode into
+numpy, DCT-space JPEG prescale, libwebp encode — byte-compared against PIL
+(both bind the same C cores, so JPEG decodes must match exactly)."""
+
+import io
+
+import numpy as np
+import pytest
+
+pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+im = pytest.importorskip("spacedrive_tpu.native.images_native",
+                         reason="native toolchain/image libs unavailable")
+
+
+@pytest.fixture()
+def sample(tmp_path):
+    rng = np.random.default_rng(11)
+    arr = rng.integers(0, 256, (300, 400, 3), dtype=np.uint8)
+    Image.fromarray(arr).save(tmp_path / "s.png")
+    Image.fromarray(arr).save(tmp_path / "s.jpg", quality=92)
+    return tmp_path, arr
+
+
+def test_png_decode_lossless(sample):
+    tmp, arr = sample
+    out = im.decode_rgb(tmp / "s.png")
+    assert np.array_equal(out, arr)
+
+
+def test_jpeg_decode_matches_pil(sample):
+    tmp, _arr = sample
+    native = im.decode_rgb(tmp / "s.jpg")
+    pil = np.asarray(Image.open(tmp / "s.jpg"))
+    # PIL may bundle a different libjpeg build whose IDCT rounds ±1
+    assert native.shape == pil.shape
+    assert np.abs(native.astype(int) - pil.astype(int)).max() <= 1
+
+
+def test_jpeg_dct_prescale(tmp_path):
+    rng = np.random.default_rng(12)
+    big = rng.integers(0, 256, (512, 640, 3), dtype=np.uint8)
+    big = np.tile(big, (8, 8, 1))  # 4096 x 5120
+    Image.fromarray(big).save(tmp_path / "big.jpg", quality=85)
+    out = im.decode_rgb(tmp_path / "big.jpg", max_edge=1024)
+    # largest 1/8..8/8 factor whose result still covers 1024: 5120/4=1280
+    assert out.shape == (1024, 1280, 3)
+
+
+def test_png_16bit_palette_gray_normalize(tmp_path):
+    gray = Image.new("L", (50, 40), 128)
+    gray.save(tmp_path / "g.png")
+    out = im.decode_rgb(tmp_path / "g.png")
+    assert out.shape == (40, 50, 3) and (out == 128).all()
+
+    pal = Image.new("P", (30, 20))
+    pal.putpalette([i for rgb in [(255, 0, 0)] * 256 for i in rgb])
+    pal.save(tmp_path / "p.png")
+    out = im.decode_rgb(tmp_path / "p.png")
+    assert out.shape == (20, 30, 3) and (out[..., 0] == 255).all()
+
+
+def test_webp_encode_roundtrip(sample):
+    _tmp, arr = sample
+    webp = im.encode_webp(arr, quality=80)
+    assert webp[:4] == b"RIFF" and webp[8:12] == b"WEBP"
+    back = np.asarray(Image.open(io.BytesIO(webp)))
+    assert back.shape == arr.shape
+
+
+def test_unsupported_and_corrupt_inputs(tmp_path):
+    (tmp_path / "fake.jpg").write_bytes(b"\xff\xd8\xffgarbage truncated")
+    with pytest.raises(im.ImageDecodeError):
+        im.decode_rgb(tmp_path / "fake.jpg")
+    (tmp_path / "not_an_image.txt").write_text("plain text")
+    with pytest.raises(im.ImageDecodeError):
+        im.decode_rgb(tmp_path / "not_an_image.txt")
+    with pytest.raises(im.ImageDecodeError):
+        im.decode_rgb(tmp_path / "missing.png")
+
+
+def test_thumbnailer_uses_native_path(tmp_path):
+    """generate_thumbnail produces a valid WebP through the native
+    decode/encode path (and the result stays within the target area)."""
+    from spacedrive_tpu.objects.media.thumbnail import (
+        TARGET_PX,
+        generate_thumbnail,
+    )
+
+    rng = np.random.default_rng(13)
+    arr = rng.integers(0, 256, (900, 1400, 3), dtype=np.uint8)
+    Image.fromarray(arr).save(tmp_path / "photo.jpg", quality=90)
+    out = generate_thumbnail(tmp_path / "photo.jpg", tmp_path, "ab" + "0" * 14,
+                             "jpg")
+    assert out is not None and out.exists()
+    body = out.read_bytes()
+    assert body[:4] == b"RIFF" and body[8:12] == b"WEBP"
+    with Image.open(out) as thumb:
+        assert thumb.size[0] * thumb.size[1] <= TARGET_PX * 1.02
