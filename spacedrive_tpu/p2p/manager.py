@@ -31,6 +31,7 @@ from typing import TYPE_CHECKING, Any
 
 from .discovery import Discovery, DiscoveredPeer
 from .identity import Identity, RemoteIdentity, remote_identity_of
+from .mux import MuxConn
 from .proto import (Header, H_FILE, H_PAIR, H_PING, H_SPACEDROP, H_SYNC,
                     ProtocolError, Range, SpaceblockRequest, block_size_for,
                     json_frame, read_block_msg, read_exact, read_json)
@@ -43,7 +44,7 @@ if TYPE_CHECKING:
 
 logger = logging.getLogger(__name__)
 
-MAGIC = b"SDP3"  # bumped with the encrypted-AKE handshake (round 3)
+MAGIC = b"SDP4"  # bumped with multiplexed substreams over one session
 SPACEDROP_TIMEOUT = 60.0  # p2p_manager.rs:42-43
 HANDSHAKE_TIMEOUT = 20.0
 
@@ -86,6 +87,13 @@ class P2PManager:
         self._start_error: BaseException | None = None
         self._spacedrop_in: dict[str, dict[str, Any]] = {}
         self._spacedrop_cancel: dict[str, asyncio.Event] = {}
+        # one multiplexed connection per peer identity (spacetime semantics:
+        # every exchange is a substream of a single authenticated session).
+        # _muxes is the dial CACHE; _live_muxes tracks every connection for
+        # shutdown (a cache eviction must not orphan a parked handler)
+        self._muxes: dict[str, "MuxConn"] = {}
+        self._live_muxes: set["MuxConn"] = set()
+        self._mux_dial_locks: dict[str, asyncio.Lock] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -133,6 +141,12 @@ class P2PManager:
             pinger.cancel()
         if self.discovery:
             await self.discovery.stop()
+        # release every persistent session FIRST: 3.12's Server.wait_closed
+        # waits for connection handlers, which park on mux.closed
+        for mux in list(self._live_muxes):
+            await mux.aclose()
+        self._live_muxes.clear()
+        self._muxes.clear()
         self._server.close()
         await self._server.wait_closed()
 
@@ -209,10 +223,16 @@ class P2PManager:
             await asyncio.sleep(10)
 
     async def _ping(self, addr: tuple[str, int]) -> None:
-        reader, writer, _meta = await self._open_stream_addr(addr)
+        """Ping = metadata refresh: sessions now outlive the handshake, so
+        the responder replies with CURRENT metadata (new libraries/instances
+        advertised since connect) and the sender re-registers it."""
+        reader, writer, _meta = await self.open_stream(f"{addr[0]}:{addr[1]}")
         try:
             writer.write(Header.ping().to_bytes())
             await writer.drain()
+            fresh = await asyncio.wait_for(read_json(reader), 10)
+            if fresh.get("identity"):
+                self._register_connected(fresh, addr[0])
         finally:
             writer.close()
 
@@ -319,16 +339,19 @@ class P2PManager:
         return sr, sw, meta
 
     async def open_stream(self, peer_id: str):
-        """(reader, writer, peer_metadata) — encrypted authenticated unicast
-        stream (the analogue of ``Manager::stream(peer_id)``, manager.rs). A
-        failed connect demotes a known peer so dead static peers don't stay
-        Connected and stall every sync round."""
+        """(reader, writer, peer_metadata) — one SUBSTREAM of the peer's
+        multiplexed authenticated session (``Manager::stream(peer_id)`` over
+        the spacetime UnicastStream semantics): the first exchange dials and
+        handshakes once; every further exchange multiplexes over the live
+        connection. A failed connect demotes a known peer so dead static
+        peers don't stay Connected and stall every sync round."""
         # a peer_id that is an identity (not host:port dialing) pins the
         # handshake to that identity
         expected = peer_id if peer_id in self.peers else None
         try:
-            return await self._open_stream_addr(self._resolve_addr(peer_id),
-                                                expected)
+            mux, meta = await self._get_mux(peer_id, expected)
+            sub = mux.open_substream()
+            return sub, sub, meta
         except (OSError, asyncio.TimeoutError, ProtocolError):
             peer = self.peers.get(peer_id)
             if peer is not None and peer.connected:
@@ -336,6 +359,69 @@ class P2PManager:
                 self.emit({"type": "DisconnectedPeer", "identity": peer.identity})
                 self.nlm.peer_lost(peer)
             raise
+
+    async def _get_mux(self, peer_id: str,
+                       expected_identity: str | None) -> tuple[MuxConn, dict]:
+        """Live mux for the peer, dialing + handshaking if needed. The dial
+        is locked per peer so concurrent exchanges share ONE connection."""
+        existing = self._muxes.get(peer_id)
+        if existing is not None and existing.alive:
+            return existing, existing.meta
+        lock = self._mux_dial_locks.setdefault(peer_id, asyncio.Lock())
+        async with lock:
+            existing = self._muxes.get(peer_id)
+            if existing is not None and existing.alive:
+                return existing, existing.meta
+            sr, sw, meta = await self._open_stream_addr(
+                self._resolve_addr(peer_id), expected_identity)
+            peer = self.peers[meta["identity"]]
+            mux = self._adopt_connection(sr, sw, meta, peer, initiator=True)
+            if peer_id != meta["identity"]:  # host:port dial: index both ways
+                self._muxes[peer_id] = mux
+            return mux, meta
+
+    def _adopt_connection(self, sr, sw, meta: dict, peer: Peer,
+                          initiator: bool) -> MuxConn:
+        """Wrap a freshly-handshaken connection in a mux, register it, and
+        arrange teardown bookkeeping."""
+        ident = meta["identity"]
+
+        async def on_inbound(sub) -> None:
+            await self._dispatch_substream(sub, peer)
+
+        mux = MuxConn(sr, sw, initiator=initiator, on_inbound=on_inbound,
+                      name=f"{'out' if initiator else 'in'}:{ident[:8]}")
+        mux.meta = meta
+        old = self._muxes.get(ident)
+        self._muxes[ident] = mux
+        self._live_muxes.add(mux)
+
+        async def reap() -> None:
+            await mux.closed.wait()
+            self._live_muxes.discard(mux)
+            for key in [k for k, v in list(self._muxes.items()) if v is mux]:
+                self._muxes.pop(key, None)
+            # demote only when NO live session to this identity remains —
+            # scanned over _live_muxes (a crossed-dial session may be alive
+            # yet evicted from the dial cache)
+            still_alive = [v for v in self._live_muxes
+                           if v.alive
+                           and getattr(v, "meta", {}).get("identity") == ident]
+            if peer.connected and not still_alive:
+                peer.connected = False
+                self.emit({"type": "DisconnectedPeer", "identity": ident})
+                self.nlm.peer_lost(peer)
+            elif still_alive and self._muxes.get(ident) is None:
+                # keep the surviving session reachable for future dials
+                self._muxes[ident] = still_alive[0]
+
+        task = asyncio.get_running_loop().create_task(reap())
+        task.add_done_callback(self._log_task_error)
+        if old is not None and old.alive and old is not mux:
+            # simultaneous dial crossed an inbound connection; keep both
+            # alive (streams on each still work), newest wins the index
+            logger.debug("mux to %s replaced while alive", ident[:8])
+        return mux
 
     # -- cross-thread helpers ------------------------------------------------
     def run_coro(self, coro, timeout: float | None = None):
@@ -364,25 +450,54 @@ class P2PManager:
             sr, sw, meta = await asyncio.wait_for(
                 self._handshake_in(reader, writer), HANDSHAKE_TIMEOUT)
             peer = self._register_connected(meta, host)
-            header = await Header.from_stream(sr)
-            if header.kind == H_PING:
-                pass  # handshake already refreshed metadata
-            elif header.kind == H_PAIR:
-                await self.pairing.responder(sr, sw, peer)
-            elif header.kind == H_SYNC:
-                await self.nlm.responder(sr, sw, header.payload, peer)
-            elif header.kind == H_SPACEDROP:
-                await self._spacedrop_receive(sr, sw, header.payload, peer)
-            elif header.kind == H_FILE:
-                await self._serve_file(sr, sw, header.payload, peer)
-            else:
-                logger.warning("unhandled header kind %s", header.kind)
         except (ProtocolError, asyncio.TimeoutError, OSError) as e:
             logger.debug("p2p connection from %s failed: %s", host, e)
+            writer.close()
+            return
         except Exception:
             logger.exception("p2p connection handler crashed")
-        finally:
             writer.close()
+            return
+        # hold the accept callback open for the mux'd session's lifetime —
+        # every exchange from this peer arrives as a substream
+        mux = self._adopt_connection(sr, sw, meta, peer, initiator=False)
+        await mux.closed.wait()
+
+    async def _dispatch_substream(self, sub, peer: Peer) -> None:
+        """One inbound substream = one header-tagged exchange
+        (protocol.rs:13-27 dispatch, previously one-per-connection)."""
+        failed = True
+        try:
+            header = await Header.from_stream(sub)
+            if header.kind == H_PING:
+                # reply with CURRENT metadata: persistent sessions mean the
+                # handshake snapshot goes stale as libraries/instances change
+                sub.write(json_frame(
+                    {**self.metadata(), "identity": self.remote_identity.encode()}))
+                await sub.drain()
+            elif header.kind == H_PAIR:
+                await self.pairing.responder(sub, sub, peer)
+            elif header.kind == H_SYNC:
+                await self.nlm.responder(sub, sub, header.payload, peer)
+            elif header.kind == H_SPACEDROP:
+                await self._spacedrop_receive(sub, sub, header.payload, peer)
+            elif header.kind == H_FILE:
+                await self._serve_file(sub, sub, header.payload, peer)
+            else:
+                logger.warning("unhandled header kind %s", header.kind)
+            failed = False
+        except (ProtocolError, asyncio.TimeoutError, OSError,
+                asyncio.IncompleteReadError) as e:
+            logger.debug("p2p exchange from %s failed: %s", peer.identity[:8], e)
+        except Exception:
+            logger.exception("p2p substream handler crashed")
+        finally:
+            if failed:
+                # a crashed exchange RESETS so the remote fails fast instead
+                # of pumping data into an unread buffer until the cap
+                sub.reset()
+            else:
+                sub.close()
 
     # -- spacedrop -----------------------------------------------------------
     def spacedrop(self, peer_id: str, paths: list[str]) -> list[str]:

@@ -1,0 +1,220 @@
+"""Substream multiplexing (spacetime semantics): framing, interleaving,
+half-close, reset, buffer cap, and the one-connection-per-peer-pair
+property of the manager integration."""
+
+import asyncio
+
+import pytest
+
+from spacedrive_tpu.p2p.mux import BUFFER_CAP, FRAME_MAX, MuxConn, MuxError
+
+
+class _Pipe:
+    """In-memory duplex: two (reader, writer) pairs wired crosswise."""
+
+    @staticmethod
+    async def make():
+        a_r, b_r = asyncio.StreamReader(), asyncio.StreamReader()
+
+        class W:
+            def __init__(self, peer_reader):
+                self._peer = peer_reader
+                self.closed = False
+
+            def write(self, data: bytes) -> None:
+                if not self.closed:
+                    self._peer.feed_data(data)
+
+            async def drain(self) -> None:
+                pass
+
+            def close(self) -> None:
+                if not self.closed:
+                    self.closed = True
+                    self._peer.feed_eof()
+
+            async def wait_closed(self) -> None:
+                pass
+
+            def get_extra_info(self, name, default=None):
+                return default
+
+        return (a_r, W(b_r)), (b_r, W(a_r))
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_substream_echo_and_interleaving():
+    async def main():
+        (ar, aw), (br, bw) = await _Pipe.make()
+        served = []
+
+        async def echo(sub):
+            while True:
+                try:
+                    n = int.from_bytes(await sub.readexactly(4), "big")
+                except asyncio.IncompleteReadError:
+                    break
+                payload = await sub.readexactly(n)
+                served.append(payload[:8])
+                sub.write(len(payload).to_bytes(4, "big") + payload[::-1])
+                await sub.drain()
+            sub.close()
+
+        async def no_inbound(sub):
+            raise AssertionError("initiator should get no inbound streams")
+
+        client = MuxConn(ar, aw, initiator=True, on_inbound=no_inbound)
+        server = MuxConn(br, bw, initiator=False, on_inbound=echo)
+
+        # two substreams used concurrently, payloads larger than FRAME_MAX
+        async def exchange(tag: bytes, size: int):
+            sub = client.open_substream()
+            payload = tag * (size // len(tag))
+            sub.write(len(payload).to_bytes(4, "big") + payload)
+            await sub.drain()
+            n = int.from_bytes(await sub.readexactly(4), "big")
+            out = await sub.readexactly(n)
+            assert out == payload[::-1]
+            sub.close()
+
+        await asyncio.wait_for(asyncio.gather(
+            exchange(b"AAAA", FRAME_MAX * 2 + 1000),
+            exchange(b"BBBB", FRAME_MAX * 3 + 4),
+            exchange(b"CCCC", 128),
+        ), timeout=20)
+        assert len(served) == 3
+        await client.aclose()
+        await server.aclose()
+
+    _run(main())
+
+
+def test_half_close_keeps_reverse_direction():
+    async def main():
+        (ar, aw), (br, bw) = await _Pipe.make()
+        done = asyncio.Event()
+
+        async def responder(sub):
+            data = await sub.read(-1)  # until client half-closes
+            sub.write(b"got:" + data)
+            sub.close()
+            done.set()
+
+        client = MuxConn(ar, aw, initiator=True,
+                         on_inbound=lambda s: asyncio.sleep(0))
+        server = MuxConn(br, bw, initiator=False, on_inbound=responder)
+        sub = client.open_substream()
+        sub.write(b"payload")
+        sub.close()  # half-close: we can still READ the reply
+        reply = await asyncio.wait_for(sub.read(-1), 10)
+        assert reply == b"got:payload"
+        with pytest.raises(MuxError):
+            sub.write(b"more")
+        await asyncio.wait_for(done.wait(), 5)
+        await client.aclose()
+        await server.aclose()
+
+    _run(main())
+
+
+def test_reset_fails_pending_reads():
+    async def main():
+        (ar, aw), (br, bw) = await _Pipe.make()
+        inbound = []
+
+        async def hold(sub):
+            inbound.append(sub)
+            await asyncio.sleep(3600)
+
+        client = MuxConn(ar, aw, initiator=True,
+                         on_inbound=lambda s: asyncio.sleep(0))
+        server = MuxConn(br, bw, initiator=False, on_inbound=hold)
+        sub = client.open_substream()
+        sub.write(b"x")
+        await sub.drain()
+        await asyncio.sleep(0.05)
+        sub.reset()
+        await asyncio.sleep(0.05)
+        # remote's copy sees EOF after the RESET frame (buffered bytes first)
+        assert inbound
+        assert await asyncio.wait_for(inbound[0].read(-1), 5) == b"x"
+        assert inbound[0].at_eof()
+        await client.aclose()
+        await server.aclose()
+
+    _run(main())
+
+
+def test_buffer_cap_resets_flooding_stream(monkeypatch):
+    monkeypatch.setattr("spacedrive_tpu.p2p.mux.BUFFER_CAP", 64 * 1024)
+
+    async def main():
+        (ar, aw), (br, bw) = await _Pipe.make()
+
+        async def never_reads(sub):
+            await asyncio.sleep(3600)
+
+        client = MuxConn(ar, aw, initiator=True,
+                         on_inbound=lambda s: asyncio.sleep(0))
+        server = MuxConn(br, bw, initiator=False, on_inbound=never_reads)
+        sub = client.open_substream()
+        reset_seen = False
+        for _ in range(10):  # 10 × 16KiB > 64KiB cap
+            try:
+                sub.write(b"z" * 16 * 1024)
+                await sub.drain()
+            except MuxError:
+                reset_seen = True  # RESET landed mid-flood
+                break
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.1)
+        if not reset_seen:
+            with pytest.raises(MuxError):
+                sub.write(b"more")
+        assert server.alive  # only the stream died, not the connection
+        await client.aclose()
+        await server.aclose()
+
+    _run(main())
+
+
+def test_one_connection_per_peer_pair(tmp_path):
+    """Exchanges in BOTH directions between two live nodes share a single
+    multiplexed TCP connection (the QUIC-session property)."""
+    from spacedrive_tpu.node import Node
+
+    a = Node(tmp_path / "a", probe_accelerator=False)
+    b = Node(tmp_path / "b", probe_accelerator=False)
+    try:
+        import time
+
+        b.router.resolve("p2p.debugConnect", {"addr": f"127.0.0.1:{a.p2p.port}"})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+                len(a.p2p._live_muxes) != 1 or len(b.p2p._live_muxes) != 1):
+            time.sleep(0.05)  # a's accept handler adopts async of b's dial
+        assert len(b.p2p._live_muxes) == 1
+        assert len(a.p2p._live_muxes) == 1
+        # reverse-direction exchange reuses the same session (a knows b's
+        # identity from the inbound handshake)
+        b_ident = b.p2p.remote_identity.encode()
+        a.p2p.run_coro(_reverse_ping(a, b_ident), timeout=15)
+        assert len(a.p2p._live_muxes) == 1, "reverse ping must reuse the mux"
+        assert len(b.p2p._live_muxes) == 1
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+async def _reverse_ping(node, peer_ident: str):
+    from spacedrive_tpu.p2p.proto import Header
+
+    reader, writer, _meta = await node.p2p.open_stream(peer_ident)
+    try:
+        writer.write(Header.ping().to_bytes())
+        await writer.drain()
+    finally:
+        writer.close()
