@@ -152,9 +152,15 @@ def mount(router) -> None:
         """Non-indexed directory listing (api/search.rs:328 /
         location/non_indexed.rs)."""
         arg = arg or {}
-        return walk_ephemeral(arg["path"],
-                              include_hidden=bool(arg.get("include_hidden")),
-                              with_cas_ids=bool(arg.get("with_cas_ids")))
+        with_thumbs = bool(arg.get("with_thumbnails"))
+        return walk_ephemeral(
+            arg["path"],
+            include_hidden=bool(arg.get("include_hidden")),
+            # thumbnails are keyed by cas_id, so with_thumbnails implies it
+            with_cas_ids=bool(arg.get("with_cas_ids")) or with_thumbs,
+            # with_thumbnails: generate on-the-fly previews into the node's
+            # cache (served at /spacedrive/thumbnail/...)
+            node=node if with_thumbs else None)
 
     @router.library_query("search.duplicates")
     def duplicates(node, library, arg):

@@ -26,8 +26,15 @@ def _default_rules(include_hidden: bool) -> CompiledRules:
 
 
 def walk_ephemeral(path: str | Path, include_hidden: bool = False,
-                   with_cas_ids: bool = False) -> dict[str, Any]:
-    """One-directory listing → {entries, errors}; no DB writes."""
+                   with_cas_ids: bool = False,
+                   node: Any = None) -> dict[str, Any]:
+    """One-directory listing → {entries, errors}; no DB writes.
+
+    With ``node`` set (and cas_ids on), thumbnailable images get on-the-fly
+    thumbnails into the node's sharded cache (non_indexed.rs:27-36), rows
+    carry ``has_thumbnail``, and the cas_ids register with the thumbnail
+    remover so the next GC sweep doesn't collect them (the reference's
+    non_indexed_thumbnails channel, thumbnail_remover.rs)."""
     root = Path(path)
     if not root.is_dir():
         raise NotADirectoryError(str(root))
@@ -66,4 +73,55 @@ def walk_ephemeral(path: str | Path, include_hidden: bool = False,
             entries.append(row)
         except OSError as e:
             errors.append(f"stat {entry.name}: {e}")
+    if node is not None:
+        _attach_thumbnails(node, entries, errors)
     return {"entries": entries, "errors": errors}
+
+
+#: new thumbnails generated per ephemeralPaths request — keeps a first browse
+#: of a huge folder bounded; remaining entries report pending and get their
+#: thumbs on subsequent requests (cache hits are free and uncounted)
+EPHEMERAL_THUMBS_PER_REQUEST = 32
+
+
+def _attach_thumbnails(node: Any, entries: list[dict[str, Any]],
+                       errors: list[str]) -> None:
+    from ..objects.media.thumbnail import (can_generate_thumbnail,
+                                           generate_thumbnail,
+                                           thumbnail_path)
+
+    remover = getattr(node, "thumbnail_remover", None)
+
+    def shield(cas: str) -> None:
+        # register BEFORE reporting has_thumbnail: a concurrent full sweep
+        # must not collect a thumb the response is about to advertise
+        if remover is not None:
+            remover.register_ephemeral([cas])
+
+    generated = 0
+    pending = 0
+    for row in entries:
+        cas = row.get("cas_id")
+        if not cas or not can_generate_thumbnail(row.get("extension")):
+            continue
+        out = thumbnail_path(node.data_dir, cas)
+        if out.exists():
+            shield(cas)
+            row["has_thumbnail"] = True
+            continue
+        if generated >= EPHEMERAL_THUMBS_PER_REQUEST:
+            pending += 1
+            row["has_thumbnail"] = False
+            continue
+        shield(cas)
+        made = generate_thumbnail(row["path"], node.data_dir, cas,
+                                  row.get("extension"))
+        generated += 1
+        if made is None:
+            errors.append(f"thumbnail {row['name']}")
+            continue
+        row["has_thumbnail"] = True
+    if pending:
+        # loud cap (no silent truncation): callers re-request to fill in
+        errors.append(f"{pending} thumbnails deferred "
+                      f"(cap {EPHEMERAL_THUMBS_PER_REQUEST}/request)")

@@ -122,6 +122,7 @@ class ThumbnailRemoverActor:
         self.batch_interval = batch_interval
         self.full_interval = full_interval
         self._marked: set[str] = set()
+        self._ephemeral: dict[str, float] = {}  # cas_id → last browse time
         self._marked_lock = threading.Lock()
         self._signal = threading.Event()
         self._stop = threading.Event()
@@ -129,12 +130,27 @@ class ThumbnailRemoverActor:
                                         name="thumbnail-remover")
         self._thread.start()
 
+    #: ephemeral (non-indexed) thumbnails survive sweeps this long after
+    #: their last browse (the reference keeps a registry instead of a TTL —
+    #: non_indexed_thumbnails_cas_ids channel, thumbnail_remover.rs)
+    EPHEMERAL_TTL = 24 * 3600.0
+
     def mark_for_deletion(self, cas_ids: Iterable[str]) -> None:
         """Explicit enqueue (cas_ids_to_delete channel in the reference):
         deleted right away on the next short tick, no liveness check."""
         with self._marked_lock:
             self._marked.update(cas_ids)
         self._signal.set()
+
+    def register_ephemeral(self, cas_ids: Iterable[str]) -> None:
+        """Shield non-indexed thumbnails (no library row references them)
+        from the full sweep while they're recently browsed."""
+        import time
+
+        now = time.time()
+        with self._marked_lock:
+            for cas in cas_ids:
+                self._ephemeral[cas] = now
 
     def _run(self) -> None:
         import time
@@ -190,9 +206,17 @@ class ThumbnailRemoverActor:
                         f"SELECT DISTINCT cas_id FROM file_path "
                         f"WHERE cas_id IN ({marks})", chunk):
                     alive.add(row["cas_id"])
+        import time
+
+        cutoff = time.time() - self.EPHEMERAL_TTL
+        with self._marked_lock:
+            self._ephemeral = {c: t for c, t in self._ephemeral.items()
+                               if t >= cutoff}
+            shielded = set(self._ephemeral)
         removed = 0
         for cas_id in on_disk:
-            if cas_id not in alive and self._delete_thumb(cas_id):
+            if (cas_id not in alive and cas_id not in shielded
+                    and self._delete_thumb(cas_id)):
                 removed += 1
         if removed:
             logger.info("thumbnail GC removed %d stale thumbnails", removed)

@@ -121,3 +121,33 @@ def test_actors_stop_cleanly(node, tmp_path):
     assert not lib.orphan_remover._thread.is_alive()
     node.thumbnail_remover.stop()
     assert not node.thumbnail_remover._thread.is_alive()
+
+
+def test_ephemeral_thumbnails_and_gc_shield(node, tmp_path):
+    """Ephemeral browsing generates on-the-fly thumbnails that the full
+    sweep shields while recently browsed (reference non_indexed channel)."""
+    pytest.importorskip("PIL")
+    import numpy as np
+    from PIL import Image
+
+    outside = tmp_path / "not_a_location"
+    outside.mkdir()
+    rng = np.random.default_rng(21)
+    Image.fromarray(rng.integers(0, 256, (300, 400, 3), dtype=np.uint8)).save(
+        outside / "wild.png")
+
+    res = node.router.resolve("search.ephemeralPaths", {
+        "path": str(outside), "with_cas_ids": True, "with_thumbnails": True})
+    row = next(e for e in res["entries"] if e["name"] == "wild")
+    assert row.get("has_thumbnail") and row.get("cas_id")
+    thumb = thumbnail_path(node.data_dir, row["cas_id"])
+    assert thumb.exists()
+
+    # no library references this cas_id, but the sweep must shield it
+    assert node.thumbnail_remover.full_sweep() == 0
+    assert thumb.exists()
+
+    # once the TTL lapses, it's collectable like any stale thumb
+    node.thumbnail_remover._ephemeral[row["cas_id"]] = 0.0
+    assert node.thumbnail_remover.full_sweep() == 1
+    assert not thumb.exists()
