@@ -87,9 +87,9 @@ EPHEMERAL_THUMBS_PER_REQUEST = 32
 def _attach_thumbnails(node: Any, entries: list[dict[str, Any]],
                        errors: list[str]) -> None:
     from ..objects.media.thumbnail import (can_generate_thumbnail,
-                                           generate_thumbnail,
-                                           thumbnail_path)
+                                           generate_thumbnail, thumbnail_dir)
 
+    base = thumbnail_dir(node.data_dir)  # once, not per row (it mkdirs)
     remover = getattr(node, "thumbnail_remover", None)
 
     def shield(cas: str) -> None:
@@ -104,7 +104,7 @@ def _attach_thumbnails(node: Any, entries: list[dict[str, Any]],
         cas = row.get("cas_id")
         if not cas or not can_generate_thumbnail(row.get("extension")):
             continue
-        out = thumbnail_path(node.data_dir, cas)
+        out = base / cas[:2] / f"{cas}.webp"
         if out.exists():
             shield(cas)
             row["has_thumbnail"] = True

@@ -122,7 +122,10 @@ class ThumbnailRemoverActor:
         self.batch_interval = batch_interval
         self.full_interval = full_interval
         self._marked: set[str] = set()
-        self._ephemeral: dict[str, float] = {}  # cas_id → last browse time
+        # cas_id → last browse time; persisted so the 24h TTL survives a
+        # node restart (the first post-boot sweep must not collect thumbs
+        # browsed minutes before the restart)
+        self._ephemeral: dict[str, float] = self._load_ephemeral()
         self._marked_lock = threading.Lock()
         self._signal = threading.Event()
         self._stop = threading.Event()
@@ -151,6 +154,31 @@ class ThumbnailRemoverActor:
         with self._marked_lock:
             for cas in cas_ids:
                 self._ephemeral[cas] = now
+            snapshot = dict(self._ephemeral)
+        self._save_ephemeral(snapshot)
+
+    def _ephemeral_path(self) -> Path:
+        return self._thumb_dir() / "ephemeral.json"
+
+    def _load_ephemeral(self) -> dict[str, float]:
+        import json
+
+        try:
+            raw = json.loads(self._ephemeral_path().read_text())
+            return {str(k): float(v) for k, v in raw.items()}
+        except (OSError, ValueError):
+            return {}
+
+    def _save_ephemeral(self, snapshot: dict[str, float]) -> None:
+        import json
+
+        try:
+            path = self._ephemeral_path()
+            tmp = path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(snapshot))
+            tmp.replace(path)
+        except OSError as e:
+            logger.debug("could not persist ephemeral registry: %s", e)
 
     def _run(self) -> None:
         import time
@@ -212,12 +240,18 @@ class ThumbnailRemoverActor:
         with self._marked_lock:
             self._ephemeral = {c: t for c, t in self._ephemeral.items()
                                if t >= cutoff}
-            shielded = set(self._ephemeral)
         removed = 0
         for cas_id in on_disk:
-            if (cas_id not in alive and cas_id not in shielded
-                    and self._delete_thumb(cas_id)):
-                removed += 1
+            if cas_id in alive:
+                continue
+            # shield check under the registrar's lock, immediately before
+            # the unlink: a browse that registered after the sweep started
+            # must still protect its thumbnail
+            with self._marked_lock:
+                if cas_id in self._ephemeral:
+                    continue
+                if self._delete_thumb(cas_id):
+                    removed += 1
         if removed:
             logger.info("thumbnail GC removed %d stale thumbnails", removed)
         return removed

@@ -132,6 +132,26 @@ def identify_step(mesh: Mesh):
     )
 
 
+@functools.lru_cache(maxsize=None)
+def sharded_resizer(mesh: Mesh):
+    """Batched thumbnail resize with the image batch sharded on ``data``
+    (ops/resize_jax.py's matmul-formulated bilinear): each chip resizes its
+    shard's images fully locally — embarrassingly parallel, no collectives —
+    so a media_processor step's device batch scales linearly across the
+    mesh the way the identify step's hashing does."""
+    from ..ops.resize_jax import resize_batch
+
+    return jax.jit(
+        resize_batch,
+        in_shardings=(
+            _sharding(mesh, DATA_AXIS, None, None, None),
+            _sharding(mesh, DATA_AXIS, None),
+            _sharding(mesh, DATA_AXIS, None),
+        ),
+        out_shardings=_sharding(mesh, DATA_AXIS, None, None, None),
+    )
+
+
 def pad_batch_for_mesh(n: int, mesh: Mesh) -> int:
     """Smallest batch size >= n divisible by the data-axis size."""
     d = mesh.shape[DATA_AXIS]
