@@ -87,33 +87,30 @@ EPHEMERAL_THUMBS_PER_REQUEST = 32
 def _attach_thumbnails(node: Any, entries: list[dict[str, Any]],
                        errors: list[str]) -> None:
     from ..objects.media.thumbnail import (can_generate_thumbnail,
-                                           generate_thumbnail, thumbnail_dir)
+                                           generate_thumbnail, thumbnail_path)
 
-    base = thumbnail_dir(node.data_dir)  # once, not per row (it mkdirs)
     remover = getattr(node, "thumbnail_remover", None)
-
-    def shield(cas: str) -> None:
-        # register BEFORE reporting has_thumbnail: a concurrent full sweep
-        # must not collect a thumb the response is about to advertise
-        if remover is not None:
-            remover.register_ephemeral([cas])
+    candidates = [row for row in entries
+                  if row.get("cas_id")
+                  and can_generate_thumbnail(row.get("extension"))]
+    if remover is not None and candidates:
+        # register BEFORE generating/advertising, ONCE for the whole request
+        # (one registry save): a concurrent full sweep must not collect a
+        # thumb the response is about to advertise
+        remover.register_ephemeral([row["cas_id"] for row in candidates])
 
     generated = 0
     pending = 0
-    for row in entries:
-        cas = row.get("cas_id")
-        if not cas or not can_generate_thumbnail(row.get("extension")):
-            continue
-        out = base / cas[:2] / f"{cas}.webp"
+    for row in candidates:
+        cas = row["cas_id"]
+        out = thumbnail_path(node.data_dir, cas)
         if out.exists():
-            shield(cas)
             row["has_thumbnail"] = True
             continue
         if generated >= EPHEMERAL_THUMBS_PER_REQUEST:
             pending += 1
             row["has_thumbnail"] = False
             continue
-        shield(cas)
         made = generate_thumbnail(row["path"], node.data_dir, cas,
                                   row.get("extension"))
         generated += 1

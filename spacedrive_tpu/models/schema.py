@@ -158,7 +158,10 @@ class Location(Model):
         "sync_preview_media": Field(_B),
         "hidden": Field(_B),
         "date_created": Field(_D),
-        "instance_id": Field(_I),
+        # declared FK so sync emission rewrites it as an instance-pub_id ref
+        # (a raw local int would mis-attribute ownership on mirrored nodes)
+        "instance_id": Field(_I, references="instance.id",
+                             on_delete="SET NULL"),
         # TPU-native: which hasher backend identifies files in this location
         # ("cpu" | "tpu"), the `hasher = "tpu"` flag of BASELINE.json
         "hasher": Field(_T, default="tpu"),
@@ -174,7 +177,8 @@ class FilePath(Model):
         "is_dir": Field(_B),
         "cas_id": Field(_T),
         "integrity_checksum": Field(_T),
-        "location_id": Field(_I),
+        "location_id": Field(_I, references="location.id",
+                             on_delete="CASCADE"),
         "materialized_path": Field(_T),
         "name": Field(_T),
         "extension": Field(_T),
@@ -182,8 +186,8 @@ class FilePath(Model):
         "size_in_bytes": Field(_I),
         "inode": Field(_I),
         "device": Field(_I),
-        "object_id": Field(_I),
-        "key_id": Field(_I),
+        "object_id": Field(_I, references="object.id", on_delete="SET NULL"),
+        "key_id": Field(_I),  # no key table yet (keymanager keeps its own store)
         "date_created": Field(_D),
         "date_modified": Field(_D),
         "date_indexed": Field(_D),

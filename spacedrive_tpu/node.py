@@ -24,26 +24,45 @@ from .library import Libraries
 logger = logging.getLogger(__name__)
 
 
-def _probe_accelerator() -> dict[str, Any]:
-    """Record device kind/count without forcing JAX init failure to be fatal."""
-    try:
-        import jax
+def _probe_accelerator(timeout: float = 25.0) -> dict[str, Any]:
+    """Record device kind/count WITHOUT letting a wedged backend stall boot.
 
-        devices = jax.devices()
-        return {
-            "kind": devices[0].platform if devices else None,
-            "devices": len(devices),
-            "mesh": [len(devices)],
-        }
+    ``jax.devices()`` on a tunneled/remote plugin can block indefinitely when
+    the device service is unreachable, so the probe runs in a disposable
+    subprocess with a hard deadline: a dead tunnel degrades to a CPU-only
+    node instead of hanging every shell at startup."""
+    import json
+    import subprocess
+    import sys
+
+    none = {"kind": None, "devices": 0, "mesh": []}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import json, jax; d = jax.devices(); "
+             "print(json.dumps({'kind': d[0].platform if d else None, "
+             "'devices': len(d), 'mesh': [len(d)]}))"],
+            capture_output=True, timeout=timeout, text=True)
+        if proc.returncode != 0:
+            logger.info("no accelerator available: %s",
+                        (proc.stderr or "").strip().splitlines()[-1:])
+            return none
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except subprocess.TimeoutExpired:
+        logger.warning("accelerator probe timed out after %.0fs (device "
+                       "service unreachable?); continuing CPU-only", timeout)
+        return none
     except Exception as e:  # no accelerator is fine; CPU hasher still works
         logger.info("no accelerator available: %s", e)
-        return {"kind": None, "devices": 0, "mesh": []}
+        return none
 
 
 class Node:
-    def __init__(self, data_dir: str | Path, probe_accelerator: bool = True,
+    def __init__(self, data_dir: str | Path,
+                 probe_accelerator: bool | None = None,
                  watch_locations: bool | None = None) -> None:
         import os
+        import sys
 
         self.data_dir = Path(data_dir)
         self.data_dir.mkdir(parents=True, exist_ok=True)
@@ -57,6 +76,13 @@ class Node:
         if watch_locations is None:
             watch_locations = not os.environ.get("SD_NO_WATCHER")
         self.watch_locations = watch_locations
+        if probe_accelerator is None:
+            # env applies only when the caller didn't decide (like the
+            # watcher gate); embedded hosts (C FFI: sys.executable is the
+            # host binary, not python) can't run the subprocess probe
+            probe_accelerator = (
+                not os.environ.get("SD_NO_ACCEL_PROBE")
+                and "python" in os.path.basename(sys.executable or ""))
         self.events = EventBus()
         self.jobs = Jobs()
         self.libraries = Libraries(self.data_dir, node=self)

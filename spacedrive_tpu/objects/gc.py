@@ -166,7 +166,7 @@ class ThumbnailRemoverActor:
         try:
             raw = json.loads(self._ephemeral_path().read_text())
             return {str(k): float(v) for k, v in raw.items()}
-        except (OSError, ValueError):
+        except Exception:  # best-effort side-file: wrong shape = empty
             return {}
 
     def _save_ephemeral(self, snapshot: dict[str, float]) -> None:

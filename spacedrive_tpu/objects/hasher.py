@@ -232,16 +232,37 @@ class HybridHasher:
         self._cpu_into(paths, sizes, cpu_part, out)
         cpu_rate = k / max(1e-9, _time.perf_counter() - t0)
         t0 = _time.perf_counter()
-        try:
-            self._tpu._hash_sampled(paths, sizes, dev_part, out)
-            device_rate = k / max(1e-9, _time.perf_counter() - t0)
-        except Exception:
+        # the device probe gets a hard deadline: a wedged device service
+        # (dead tunnel) HANGS rather than raising, and a probe that never
+        # returns would stall every scan — run it in a bounded worker
+        import threading as _threading
+
+        probe_err: list[BaseException] = []
+
+        def _device_probe() -> None:
+            try:
+                self._tpu._hash_sampled(paths, sizes, dev_part, out)
+            except BaseException as e:  # noqa: BLE001 — scored below
+                probe_err.append(e)
+
+        worker = _threading.Thread(target=_device_probe, daemon=True,
+                                   name="hybrid-device-probe")
+        worker.start()
+        worker.join(timeout=max(60.0, k * 0.5))
+        if worker.is_alive():
+            logger.warning("hybrid probe: device engine unresponsive after "
+                           "deadline; routing everything to native CPU")
+            self._cpu_into(paths, sizes, dev_part, out)  # same values: benign
+            device_rate = 0.0
+        elif probe_err:
             # a dying device must not leave half-set rates (permanently
             # broken comparisons) — score it dead and finish on CPU
-            logger.exception("hybrid probe: device engine failed; "
-                             "routing everything to native CPU")
+            logger.warning("hybrid probe: device engine failed (%r); "
+                           "routing everything to native CPU", probe_err[0])
             self._cpu_into(paths, sizes, dev_part, out)
             device_rate = 0.0
+        else:
+            device_rate = k / max(1e-9, _time.perf_counter() - t0)
         # set both rates atomically only once both probes concluded
         self._cpu_rate, self._device_rate = cpu_rate, device_rate
         logger.info("hybrid probe: cpu %.0f files/s, device %.0f files/s — %s",

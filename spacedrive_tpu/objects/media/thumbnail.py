@@ -34,12 +34,20 @@ THUMBNAILABLE_VIDEO_EXTENSIONS = {
 _FFMPEG = shutil.which("ffmpeg")
 
 
+_THUMB_DIRS_READY: set[str] = set()
+
+
 def thumbnail_dir(data_dir: str | Path) -> Path:
     d = Path(data_dir) / "thumbnails"
-    d.mkdir(parents=True, exist_ok=True)
-    version_file = d / "version.txt"
-    if not version_file.exists():
-        version_file.write_text(str(THUMBNAIL_VERSION))
+    # mkdir/version-stamp once per data_dir per process: this runs on hot
+    # listing paths (one call per thumbnail_path)
+    key = str(d)
+    if key not in _THUMB_DIRS_READY:
+        d.mkdir(parents=True, exist_ok=True)
+        version_file = d / "version.txt"
+        if not version_file.exists():
+            version_file.write_text(str(THUMBNAIL_VERSION))
+        _THUMB_DIRS_READY.add(key)
     return d
 
 

@@ -33,8 +33,9 @@ from .discovery import Discovery, DiscoveredPeer
 from .identity import Identity, RemoteIdentity, remote_identity_of
 from .mux import MuxConn
 from .proto import (Header, H_FILE, H_PAIR, H_PING, H_SPACEDROP, H_SYNC,
-                    ProtocolError, Range, SpaceblockRequest, block_size_for,
-                    json_frame, read_block_msg, read_exact, read_json)
+                    H_THUMBNAIL, ProtocolError, Range, SpaceblockRequest,
+                    block_size_for, json_frame, read_block_msg, read_exact,
+                    read_json)
 from .secure import (SecureReader, SecureWriter, derive_session_keys,
                      gen_ephemeral, transcript)
 from .spaceblock import receive_file, send_file
@@ -483,6 +484,8 @@ class P2PManager:
                 await self._spacedrop_receive(sub, sub, header.payload, peer)
             elif header.kind == H_FILE:
                 await self._serve_file(sub, sub, header.payload, peer)
+            elif header.kind == H_THUMBNAIL:
+                await self._serve_thumbnail(sub, sub, header.payload, peer)
             else:
                 logger.warning("unhandled header kind %s", header.kind)
             failed = False
@@ -632,6 +635,54 @@ class P2PManager:
         await writer.drain()
         await send_file(writer, path, req)
         await writer.drain()
+
+    async def _serve_thumbnail(self, reader, writer, payload: dict,
+                               peer: Peer) -> None:
+        """Serve a cached preview to an authenticated library member — the
+        on-demand form of the reference's sync_preview_media knob: previews
+        travel when a paired node actually looks at the file."""
+        from ..objects.media.thumbnail import thumbnail_path
+
+        try:
+            library = self.node.libraries.get(payload["library_id"])
+            if peer.identity not in self.nlm.member_nodes(library):
+                raise KeyError("not a member of this library")
+            cas_id = str(payload["cas_id"])
+            # only previews of content this library tracks are disclosable
+            from ..models import FilePath
+
+            if ("/" in cas_id or ".." in cas_id
+                    or library.db.find_one(FilePath, {"cas_id": cas_id}) is None):
+                raise KeyError("no such cas_id in this library")
+            path = thumbnail_path(self.node.data_dir, cas_id)
+            body = path.read_bytes()
+        except (KeyError, OSError) as e:
+            # fixed wire message: raw OSError strings leak local paths
+            logger.debug("thumbnail serve refused (%s): %s", cas_id[:8], e)
+            writer.write(json_frame({"ok": False, "error": "no such thumbnail"}))
+            await writer.drain()
+            return
+        writer.write(json_frame({"ok": True, "size": len(body)}))
+        writer.write(body)
+        await writer.drain()
+
+    async def request_thumbnail(self, peer_id: str, library_id: str,
+                                cas_id: str) -> bytes:
+        """Fetch a member peer's cached preview bytes (custom_uri's remote
+        thumbnail path)."""
+        reader, writer, _meta = await self.open_stream(peer_id)
+        try:
+            writer.write(Header.thumbnail(library_id, cas_id).to_bytes())
+            await writer.drain()
+            head = await read_json(reader)
+            if not head.get("ok"):
+                raise ProtocolError(head.get("error", "thumbnail refused"))
+            size = int(head["size"])
+            if size > 16 * 1024 * 1024:
+                raise ProtocolError("absurd thumbnail size")
+            return await read_exact(reader, size)
+        finally:
+            writer.close()
 
     async def request_file(self, peer_id: str, library_id: str,
                            file_path_pub_id: str, rng: Range,

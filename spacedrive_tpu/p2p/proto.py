@@ -118,6 +118,7 @@ H_PAIR = 2
 H_SYNC = 3
 H_FILE = 4
 H_CONNECTED = 5
+H_THUMBNAIL = 6
 
 
 @dataclass(frozen=True)
@@ -152,6 +153,12 @@ class Header:
     def connected(cls, identities: list[str]) -> "Header":
         return cls(H_CONNECTED, identities)
 
+    @classmethod
+    def thumbnail(cls, library_id: str, cas_id: str) -> "Header":
+        """Fetch a member library's cached preview by cas_id — the on-demand
+        form of the reference's sync_preview_media location knob."""
+        return cls(H_THUMBNAIL, {"library_id": library_id, "cas_id": cas_id})
+
     # wire -----------------------------------------------------------------
     def to_bytes(self) -> bytes:
         b = bytes([self.kind])
@@ -163,7 +170,7 @@ class Header:
             return b + json_frame(self.payload)
         if self.kind == H_SPACEDROP:
             return b + json_frame(self.payload.to_wire())
-        if self.kind in (H_FILE, H_CONNECTED):
+        if self.kind in (H_FILE, H_CONNECTED, H_THUMBNAIL):
             return b + json_frame(self.payload)
         raise ProtocolError(f"unknown header kind {self.kind}")
 
@@ -176,7 +183,7 @@ class Header:
             return cls(kind, str(await read_json(reader)))
         if kind == H_SPACEDROP:
             return cls(kind, SpaceblockRequest.from_wire(await read_json(reader)))
-        if kind in (H_FILE, H_CONNECTED):
+        if kind in (H_FILE, H_CONNECTED, H_THUMBNAIL):
             return cls(kind, await read_json(reader))
         raise ProtocolError(f"invalid header discriminator {kind}")
 

@@ -240,6 +240,15 @@ class Server:
             if "/" in name or ".." in name or ".." in shard:
                 raise HttpError(400)
             path = Path(thumbnail_dir(self.node.data_dir)) / shard / name
+            if not path.is_file() and name.endswith(".webp"):
+                cas_id = name[:-len(".webp")]
+                if shard != cas_id[:2]:
+                    # a mis-sharded URL must not seed cache files the GC's
+                    # canonical-path delete could never find
+                    raise HttpError(404, "no such thumbnail")
+                # preview owned by a paired node: fetch once over p2p into
+                # the local cache (sync_preview_media, on demand)
+                await self._fetch_remote_thumbnail(cas_id, path)
             if not path.is_file():
                 raise HttpError(404, "no such thumbnail")
             rng = parse_range(req.header("range"), path.stat().st_size)
@@ -248,6 +257,53 @@ class Server:
         if len(parts) == 4 and parts[0] == "file":
             return await self._serve_file(req, parts[1], parts[2], parts[3])
         raise HttpError(404)
+
+    async def _fetch_remote_thumbnail(self, cas_id: str, dest: Path) -> None:
+        """Find which paired node owns content with this cas_id and pull its
+        cached preview into ours (best-effort; a miss just 404s)."""
+        from ..models import FilePath, Instance, Location
+
+        p2p = self.node.p2p
+        if p2p is None:
+            return
+        loop = asyncio.get_running_loop()
+
+        def _find_owner():
+            """Blocking DB scan — runs on the worker pool, not the accept
+            loop (the shell's no-DB-on-the-loop rule)."""
+            for library in self.node.libraries.list():
+                row = library.db.find_one(FilePath, {"cas_id": cas_id})
+                if row is None:
+                    continue
+                location = library.db.find_one(
+                    Location, {"id": row["location_id"]})
+                if location is None or location.get("instance_id") in (
+                        None, library.instance_id):
+                    continue  # local content: nothing to fetch
+                instance = library.db.find_one(
+                    Instance, {"id": location["instance_id"]})
+                if instance is None:
+                    continue
+                # the owning NODE's handshake identity (instance identities
+                # are per-library keys, not dialable peers)
+                peer_id = instance.get("node_remote_identity")
+                if peer_id and peer_id in p2p.peers:
+                    yield library, peer_id
+
+        for library, peer_id in await loop.run_in_executor(
+                self._pool, lambda: list(_find_owner())):
+            future = asyncio.run_coroutine_threadsafe(
+                p2p.request_thumbnail(peer_id, library.id, cas_id), p2p._loop)
+            try:
+                body = await loop.run_in_executor(None, lambda: future.result(30))
+            except Exception as e:
+                logger.debug("remote thumbnail %s: %s", cas_id[:8], e)
+                continue
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            tmp = dest.with_suffix(".tmp.webp")
+            tmp.write_bytes(body)
+            tmp.replace(dest)
+            return
 
     async def _serve_file(self, req: Request, library_id: str,
                           location_id: str, file_path_id: str) -> Response:
@@ -289,7 +345,6 @@ class Server:
         """ServeFrom::Remote (custom_uri.rs:64-69): the location belongs to
         another instance — fetch the ranged bytes over the p2p File header."""
         from ..models import Instance
-        from ..p2p.identity import remote_identity_of
         from ..p2p.spaceblock import Range
 
         p2p = self.node.p2p
@@ -298,9 +353,8 @@ class Server:
         instance = library.db.find_one(Instance, {"id": location["instance_id"]})
         if instance is None:
             raise HttpError(404, "unknown owning instance")
-        try:
-            peer_id = remote_identity_of(instance["identity"]).encode()
-        except Exception:
+        peer_id = instance.get("node_remote_identity")
+        if not peer_id:
             raise HttpError(404, "instance has no p2p identity")
         if peer_id not in p2p.peers:
             raise HttpError(404, "owning node is not connected")

@@ -49,6 +49,7 @@ print("BRIDGE OK")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["SD_P2P_DISABLED"] = "1"
+    env["SD_NO_ACCEL_PROBE"] = "1"
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run([sys.executable, "-c", script, str(tmp_path / "d")],
                           capture_output=True, text=True, timeout=120, env=env)
@@ -78,6 +79,7 @@ def test_c_host_embeds_core(ffi_demo_binary, tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["SD_P2P_DISABLED"] = "1"
+    env["SD_NO_ACCEL_PROBE"] = "1"
     env["SD_NO_WATCHER"] = "1"
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run(

@@ -537,3 +537,102 @@ def test_concurrent_exchanges_between_one_peer_pair(two_nodes, tmp_path):
     wait_for(lambda: (inbox / gift.name).exists()
              and (inbox / gift.name).read_bytes() == gift_payload,
              timeout=60, msg="spacedrop landed under load")
+
+
+def test_remote_thumbnail_over_p2p(two_nodes, tmp_path):
+    """A paired node's custom_uri serves thumbnails it doesn't have locally
+    by pulling the owner's cached preview once over p2p (the on-demand form
+    of sync_preview_media)."""
+    pytest.importorskip("PIL")
+    import urllib.request
+
+    import numpy as np
+    from PIL import Image
+
+    from spacedrive_tpu.locations import create_location, scan_location
+    from spacedrive_tpu.objects.media.thumbnail import thumbnail_path
+    from spacedrive_tpu.server import Server
+
+    a, b = two_nodes
+    lib_a = a.libraries.create("thumb-share")
+    lib_a.sync.emit_messages = True
+    tree = tmp_path / "shared_pics"
+    tree.mkdir()
+    rng = np.random.default_rng(33)
+    Image.fromarray(rng.integers(0, 256, (480, 640, 3), dtype=np.uint8)).save(
+        tree / "pic.png")
+    loc = create_location(lib_a, str(tree), hasher="cpu")
+    scan_location(lib_a, loc["id"])
+    assert a.jobs.wait_idle(90)
+
+    cas = lib_a.db.query(
+        "SELECT cas_id FROM file_path WHERE name='pic'")[0]["cas_id"]
+    assert thumbnail_path(a.data_dir, cas).exists(), "owner must have the thumb"
+
+    a.config.write(p2p_auto_accept_library=lib_a.id)
+    b.router.resolve("p2p.pair", {"peer_id": addr_of(a)})
+    lib_b = wait_for(lambda: next((l for l in b.libraries.list()
+                                   if l.id == lib_a.id), None),
+                     msg="library mirrored")
+    wait_for(lambda: lib_b.db.find_one(
+        __import__("spacedrive_tpu.models", fromlist=["FilePath"]).FilePath,
+        {"cas_id": cas}), msg="file_path replicated")
+    assert not thumbnail_path(b.data_dir, cas).exists()
+
+    server = Server(b, port=0)
+    server.start()
+    try:
+        url = (f"http://127.0.0.1:{server.port}"
+               f"/spacedrive/thumbnail/{cas[:2]}/{cas}.webp")
+        with urllib.request.urlopen(url, timeout=60) as resp:
+            body = resp.read()
+        assert body[:4] == b"RIFF" and body[8:12] == b"WEBP"
+        assert body == thumbnail_path(a.data_dir, cas).read_bytes()
+        # cached locally now: survives without the peer
+        assert thumbnail_path(b.data_dir, cas).exists()
+    finally:
+        server.stop()
+
+
+def test_remote_file_served_through_shell(two_nodes, tmp_path):
+    """custom_uri's ServeFrom::Remote path end-to-end: b's HTTP shell serves
+    (ranged) bytes for a file that lives on a, fetched over the p2p File
+    header (custom_uri.rs:64-69)."""
+    import urllib.request
+
+    from spacedrive_tpu.locations import create_location, scan_location
+    from spacedrive_tpu.models import FilePath
+    from spacedrive_tpu.server import Server
+
+    a, b = two_nodes
+    lib_a = a.libraries.create("remote-files")
+    lib_a.sync.emit_messages = True
+    tree = tmp_path / "rtree"
+    tree.mkdir()
+    payload = bytes(range(256)) * 1200  # ~300 KiB
+    (tree / "remote.bin").write_bytes(payload)
+    loc = create_location(lib_a, str(tree), hasher="cpu")
+    scan_location(lib_a, loc["id"])
+    assert a.jobs.wait_idle(60)
+
+    a.config.toggle_feature(BackendFeature.FILES_OVER_P2P)
+    a.config.write(p2p_auto_accept_library=lib_a.id)
+    b.router.resolve("p2p.pair", {"peer_id": addr_of(a)})
+    lib_b = wait_for(lambda: next((l for l in b.libraries.list()
+                                   if l.id == lib_a.id), None),
+                     msg="library mirrored")
+    row = wait_for(lambda: lib_b.db.find_one(FilePath, {"name": "remote"}),
+                   msg="file_path replicated")
+    assert row["location_id"], "replicated row must resolve its location ref"
+
+    server = Server(b, port=0)
+    server.start()
+    try:
+        url = (f"http://127.0.0.1:{server.port}/spacedrive/file/"
+               f"{lib_b.id}/{row['location_id']}/{row['id']}")
+        req = urllib.request.Request(url, headers={"Range": "bytes=100-4099"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 206
+            assert resp.read() == payload[100:4100]
+    finally:
+        server.stop()

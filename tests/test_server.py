@@ -150,6 +150,7 @@ def server_proc(tmp_path_factory):
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     env["SD_P2P_DISABLED"] = "1"
+    env["SD_NO_ACCEL_PROBE"] = "1"
     env.pop("SD_NO_WATCHER", None)  # watchers ON in the shell
     proc = subprocess.Popen(
         [sys.executable, "-m", "spacedrive_tpu.server",
