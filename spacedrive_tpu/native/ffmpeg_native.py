@@ -1,0 +1,95 @@
+"""ctypes binding for the linked FFmpeg wrapper (sd_ffmpeg.cc).
+
+The sd-ffmpeg equivalent (crates/ffmpeg/src/lib.rs:9-33): video frame
+decode for thumbnails — preferring embedded cover art, else seeking 10%
+in — plus stream probing for the media-data extractor and a tiny test
+encoder. Import fails cleanly on hosts without libav* dev headers; callers
+fall back to the ffmpeg CLI or skip video handling.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from . import build_shared
+
+_lib = ctypes.CDLL(str(build_shared(
+    "sdffmpeg", ["sd_ffmpeg.cc"],
+    extra_libs=["-lavformat", "-lavcodec", "-lavutil", "-lswscale"])))
+
+_lib.sd_ffmpeg_probe_json.argtypes = [
+    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64]
+_lib.sd_ffmpeg_probe_json.restype = ctypes.c_int64
+
+_lib.sd_ffmpeg_decode_frame_rgb.argtypes = [
+    ctypes.c_char_p, ctypes.c_double, ctypes.c_int32, ctypes.c_void_p,
+    ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_int32)]
+_lib.sd_ffmpeg_decode_frame_rgb.restype = ctypes.c_int64
+
+_lib.sd_ffmpeg_write_test_video.argtypes = [
+    ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ctypes.c_int32]
+_lib.sd_ffmpeg_write_test_video.restype = ctypes.c_int32
+
+_lib.sd_ffmpeg_err_str.argtypes = [
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+_lib.sd_ffmpeg_err_str.restype = None
+
+#: seek point as a fraction of duration (thumbnailer.rs seek_percentage 0.1)
+SEEK_PERCENTAGE = 0.1
+
+#: default decode edge for video thumbnails (thumbnail/mod.rs:183 passes 256
+#: to to_thumbnail; we decode a little larger so the √-area scale step has
+#: headroom on wide aspect ratios)
+DEFAULT_TARGET_EDGE = 768
+
+
+class FfmpegError(Exception):
+    def __init__(self, code: int):
+        buf = ctypes.create_string_buffer(256)
+        _lib.sd_ffmpeg_err_str(int(code), buf, 256)
+        super().__init__(buf.value.decode(errors="replace"))
+        self.code = int(code)
+
+
+def probe(path: str | Path) -> dict[str, Any]:
+    """Format/stream metadata: duration, bit_rate, container tags, streams
+    (codec, dims, fps, channels, sample_rate, attached_pic)."""
+    cap = 1 << 16
+    buf = ctypes.create_string_buffer(cap)
+    rc = _lib.sd_ffmpeg_probe_json(str(path).encode(), buf, cap)
+    if rc < 0:
+        raise FfmpegError(rc)
+    return json.loads(buf.value.decode(errors="replace"))
+
+
+def decode_frame_rgb(path: str | Path, seek_percent: float = SEEK_PERCENTAGE,
+                     target_edge: int = DEFAULT_TARGET_EDGE) -> np.ndarray:
+    """One representative RGB frame as an (h, w, 3) uint8 array."""
+    edge = target_edge if target_edge > 0 else 8192
+    cap = edge * edge * 3
+    out = np.empty(cap, np.uint8)
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
+    rc = _lib.sd_ffmpeg_decode_frame_rgb(
+        str(path).encode(), float(seek_percent), int(target_edge),
+        out.ctypes.data_as(ctypes.c_void_p), cap,
+        ctypes.byref(w), ctypes.byref(h))
+    if rc < 0:
+        raise FfmpegError(rc)
+    return out[:rc].reshape(h.value, w.value, 3).copy()
+
+
+def write_test_video(path: str | Path, width: int = 64, height: int = 48,
+                     frames: int = 24, fps: int = 12) -> None:
+    """Encode a small gradient video (test fixture generator)."""
+    rc = _lib.sd_ffmpeg_write_test_video(
+        str(path).encode(), int(width), int(height), int(frames), int(fps))
+    if rc != 0:
+        raise FfmpegError(rc)

@@ -36,6 +36,20 @@ def _probe_accelerator(timeout: float = 25.0) -> dict[str, Any]:
     import sys
 
     none = {"kind": None, "devices": 0, "mesh": []}
+    if "python" not in os.path.basename(sys.executable or ""):
+        # embedded host (C FFI): sys.executable is the host binary, so the
+        # subprocess probe can't run — probe in-process instead of leaving
+        # a healthy accelerator undetected (accepting the hang risk the
+        # subprocess path exists to avoid)
+        try:
+            import jax
+
+            d = jax.devices()
+            return {"kind": d[0].platform if d else None,
+                    "devices": len(d), "mesh": [len(d)]}
+        except Exception as e:
+            logger.info("no accelerator available: %s", e)
+            return none
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
@@ -78,11 +92,9 @@ class Node:
         self.watch_locations = watch_locations
         if probe_accelerator is None:
             # env applies only when the caller didn't decide (like the
-            # watcher gate); embedded hosts (C FFI: sys.executable is the
-            # host binary, not python) can't run the subprocess probe
-            probe_accelerator = (
-                not os.environ.get("SD_NO_ACCEL_PROBE")
-                and "python" in os.path.basename(sys.executable or ""))
+            # watcher gate); embedded hosts probe in-process, CLI hosts in
+            # a deadline-guarded subprocess (_probe_accelerator)
+            probe_accelerator = not os.environ.get("SD_NO_ACCEL_PROBE")
         self.events = EventBus()
         self.jobs = Jobs()
         self.libraries = Libraries(self.data_dir, node=self)

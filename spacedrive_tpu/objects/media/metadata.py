@@ -4,8 +4,9 @@ Mirrors core/src/object/media/media_data_extractor.rs + sd-media-metadata:
 image dimensions, capture date, camera fields (exposure/aperture/ISO/
 focal length/lens/orientation), GPS location with plus-code encoding
 (image/geographic/pluscodes.rs — Open Location Code implemented from the
-public spec), and audio/video stream metadata via ffprobe (the reference's
-audio/video extractors are stubs; here they are real when ffprobe exists).
+public spec), and audio/video stream metadata via the linked libavformat
+probe (sd_ffmpeg.cc) with an ffprobe-CLI fallback (the reference's
+audio/video extractors are stubs; here they are real).
 """
 
 from __future__ import annotations
@@ -92,7 +93,11 @@ def _extract_image(path: str) -> dict[str, Any] | None:
 
 
 def _extract_av(path: str) -> dict[str, Any] | None:
-    """ffprobe-backed stream metadata (duration, codecs, dims, rates)."""
+    """Stream metadata (duration, codecs, dims, rates): linked libavformat
+    when the native helper builds, else an ffprobe subprocess."""
+    native = _native_probe(path)
+    if native is not None:
+        return native
     if _FFPROBE is None:
         return None
     try:
@@ -121,12 +126,18 @@ def _extract_av(path: str) -> dict[str, Any] | None:
                 entry["fps"] = round(float(num) / float(den or 1), 3)
             except (ValueError, ZeroDivisionError):
                 pass
-            if "width" in stream and "height" in stream:
-                out["dimensions"] = {"width": stream["width"],
-                                     "height": stream["height"]}
+            # first real video stream defines dimensions; cover art must
+            # not (same rule as the native probe — identical row shapes)
+            attached = (stream.get("disposition") or {}).get("attached_pic")
+            if "width" in stream and "height" in stream and not attached:
+                out.setdefault("dimensions", {"width": stream["width"],
+                                              "height": stream["height"]})
         elif stream.get("codec_type") == "audio":
             entry["channels"] = stream.get("channels")
-            entry["sample_rate"] = stream.get("sample_rate")
+            # ffprobe JSON encodes sample_rate as a string; the native
+            # probe emits ints — both backends must shape rows identically
+            rate = stream.get("sample_rate")
+            entry["sample_rate"] = int(rate) if rate is not None else None
         streams_out.append(entry)
     duration = fmt.get("duration")
     if duration is not None:
@@ -140,6 +151,54 @@ def _extract_av(path: str) -> dict[str, Any] | None:
                      ("creation_time", "media_date")):
         if tags.get(src):
             out[dst] = str(tags[src])
+    return out or None
+
+
+def _native_probe(path: str) -> dict[str, Any] | None:
+    """MediaData dict from the linked FFmpeg probe, shaped identically to
+    the ffprobe path so either backend fills the same columns."""
+    from .thumbnail import _native_ffmpeg
+
+    native = _native_ffmpeg()
+    if native is None:
+        return None
+    try:
+        probe = native.probe(path)
+    except Exception as e:
+        logger.debug("native probe failed for %s: %s", path, e)
+        return None
+    out: dict[str, Any] = {}
+    streams_out = []
+    for stream in probe.get("streams", []):
+        entry: dict[str, Any] = {
+            "codec_type": stream.get("codec_type"),
+            "codec": stream.get("codec"),
+        }
+        if stream.get("codec_type") == "video":
+            entry["width"] = stream.get("width")
+            entry["height"] = stream.get("height")
+            if stream.get("fps"):
+                entry["fps"] = stream["fps"]
+            # cover-art streams must not define the media's dimensions
+            if not stream.get("attached_pic") and "width" in stream:
+                out.setdefault("dimensions", {"width": stream["width"],
+                                              "height": stream["height"]})
+        elif stream.get("codec_type") == "audio":
+            entry["channels"] = stream.get("channels")
+            entry["sample_rate"] = stream.get("sample_rate")
+        streams_out.append(entry)
+    if probe.get("duration_seconds") is not None:
+        out["duration_seconds"] = probe["duration_seconds"]
+    if probe.get("bit_rate"):
+        out["bit_rate"] = int(probe["bit_rate"])
+    if streams_out:
+        out["streams"] = streams_out
+    tags = probe.get("tags", {}) or {}
+    lower = {k.lower(): v for k, v in tags.items()}
+    for src, dst in (("artist", "artist"), ("copyright", "copyright"),
+                     ("creation_time", "media_date")):
+        if lower.get(src):
+            out[dst] = str(lower[src])
     return out or None
 
 

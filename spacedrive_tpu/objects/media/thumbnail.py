@@ -5,9 +5,11 @@ quality 30 (mod.rs:95-110), cache layout ``thumbnails/<shard>/<cas_id>.webp``
 where the shard is the first 2 hex chars of the cas_id (shard.rs:8), and a
 versioned thumbnails directory (directory.rs).
 
-Image decode is PIL (the reference uses its own sd-images + libheif); video
-frame extraction uses the ffmpeg CLI when present (the reference links FFmpeg
-via C FFI — a C++ wrapper is the planned native path).
+Image decode prefers the native C++ helpers (sd_images.cc: libjpeg/libpng/
+libwebp) with a PIL fallback; video frame extraction links FFmpeg the way
+the reference's sd-ffmpeg crate does (sd_ffmpeg.cc over libavformat/
+libavcodec/libswscale, preferring embedded cover art then seeking 10% in —
+crates/ffmpeg/src/thumbnailer.rs), with an ffmpeg-CLI fallback.
 """
 
 from __future__ import annotations
@@ -59,8 +61,24 @@ def thumbnail_path(data_dir: str | Path, cas_id: str) -> Path:
 def can_generate_thumbnail(extension: str | None) -> bool:
     ext = (extension or "").lower()
     return ext in THUMBNAILABLE_IMAGE_EXTENSIONS or (
-        _FFMPEG is not None and ext in THUMBNAILABLE_VIDEO_EXTENSIONS
+        ext in THUMBNAILABLE_VIDEO_EXTENSIONS and _ffmpeg_capable()
     )
+
+
+def _ffmpeg_capable() -> bool:
+    """Can SOME backend decode video here? Answered without compiling:
+    this runs on listing paths, where a synchronous g++ attempt (seconds,
+    repeated each process on hosts where the build fails) is not
+    acceptable. The real build happens on first generation, inside a job."""
+    if _FFMPEG is not None:
+        return True
+    if _NATIVE_FFMPEG is not None:  # probe already ran: trust its answer
+        return _NATIVE_FFMPEG[0] is not None
+    import glob
+
+    return bool(glob.glob("/usr/include/libavformat")
+                or glob.glob("/usr/include/*/libavformat")
+                or glob.glob("/usr/local/include/libavformat"))
 
 
 def generate_thumbnail(source: str | Path, data_dir: str | Path, cas_id: str,
@@ -81,6 +99,22 @@ def generate_thumbnail(source: str | Path, data_dir: str | Path, cas_id: str,
 
 
 _NATIVE_IMAGES: list | None = None  # [module_or_None] once probed
+_NATIVE_FFMPEG: list | None = None
+
+
+def _native_ffmpeg():
+    """Linked FFmpeg decoder (sd_ffmpeg.cc) if buildable; probe cached like
+    the image helper — a failed import involves a g++ attempt."""
+    global _NATIVE_FFMPEG
+    if _NATIVE_FFMPEG is None:
+        try:
+            from ...native import ffmpeg_native
+
+            _NATIVE_FFMPEG = [ffmpeg_native]
+        except Exception as e:
+            logger.info("native ffmpeg unavailable (%s); using CLI if present", e)
+            _NATIVE_FFMPEG = [None]
+    return _NATIVE_FFMPEG[0]
 
 
 def _native_images():
@@ -146,6 +180,27 @@ def _save_webp(img, tmp: Path) -> None:
 
 
 def _video_thumbnail(source: Path, out: Path) -> Path | None:
+    native = _native_ffmpeg()
+    if native is not None:
+        try:
+            from PIL import Image
+
+            # one representative frame (cover art preferred, else 10% in),
+            # then the same √(area) scale + WebP path images take
+            frame = native.decode_frame_rgb(source)
+            tmp = out.with_suffix(".tmp.webp")
+            img = Image.fromarray(frame)
+            w, h = img.size
+            if w * h > TARGET_PX:
+                factor = math.sqrt(TARGET_PX / (w * h))
+                img = img.resize((max(1, round(w * factor)),
+                                  max(1, round(h * factor))))
+            _save_webp(img, tmp)
+            tmp.replace(out)
+            return out
+        except Exception as e:
+            logger.debug("native video decode failed for %s (%s); CLI fallback",
+                         source, e)
     if _FFMPEG is None:
         return None
     tmp = out.with_suffix(".tmp.webp")

@@ -643,11 +643,11 @@ class P2PManager:
         travel when a paired node actually looks at the file."""
         from ..objects.media.thumbnail import thumbnail_path
 
+        cas_id = str(payload.get("cas_id", ""))
         try:
             library = self.node.libraries.get(payload["library_id"])
             if peer.identity not in self.nlm.member_nodes(library):
                 raise KeyError("not a member of this library")
-            cas_id = str(payload["cas_id"])
             # only previews of content this library tracks are disclosable
             from ..models import FilePath
 

@@ -38,6 +38,7 @@ import json
 import logging
 import secrets
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import TYPE_CHECKING, Any
@@ -76,6 +77,11 @@ class Server:
         self._thread: threading.Thread | None = None
         self._pool = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix="rspc")
+        #: cas_id → monotonic deadline: remote-thumbnail misses we won't
+        #: re-chase until the deadline passes
+        self._thumb_miss: dict[str, float] = {}
+        #: cas_id → future resolved when its in-flight remote fetch ends
+        self._thumb_fetch: dict[str, asyncio.Future] = {}
         self._ready = threading.Event()
 
     # -- lifecycle -----------------------------------------------------------
@@ -266,6 +272,36 @@ class Server:
         p2p = self.node.p2p
         if p2p is None:
             return
+        # negative cache: a gallery of misses must not re-run the multi-
+        # library owner scan + p2p round trip on every rerender
+        now = time.monotonic()
+        deadline = self._thumb_miss.get(cas_id)
+        if deadline is not None and now < deadline:
+            return
+        # in-flight dedup: concurrent requests for one cas_id (HEAD+GET,
+        # shared tiles) await the same fetch instead of seeing a "miss"
+        pending = self._thumb_fetch.get(cas_id)
+        if pending is not None:
+            await asyncio.shield(pending)
+            return
+        loop = asyncio.get_running_loop()
+        self._thumb_fetch[cas_id] = loop.create_future()
+        try:
+            await self._fetch_remote_thumbnail_inner(cas_id, dest)
+        finally:
+            fut = self._thumb_fetch.pop(cas_id)
+            fut.set_result(None)
+            if not dest.is_file():
+                if len(self._thumb_miss) > 4096:
+                    self._thumb_miss = {
+                        k: v for k, v in self._thumb_miss.items() if v > now}
+                self._thumb_miss[cas_id] = time.monotonic() + 30.0
+
+    async def _fetch_remote_thumbnail_inner(self, cas_id: str,
+                                            dest: Path) -> None:
+        from ..models import FilePath, Instance, Location
+
+        p2p = self.node.p2p
         loop = asyncio.get_running_loop()
 
         def _find_owner():
@@ -295,14 +331,22 @@ class Server:
             future = asyncio.run_coroutine_threadsafe(
                 p2p.request_thumbnail(peer_id, library.id, cas_id), p2p._loop)
             try:
-                body = await loop.run_in_executor(None, lambda: future.result(30))
+                # wrap_future awaits on the loop — a screenful of misses
+                # must not park default-executor threads for the timeout
+                body = await asyncio.wait_for(asyncio.wrap_future(future), 15)
             except Exception as e:
                 logger.debug("remote thumbnail %s: %s", cas_id[:8], e)
                 continue
-            dest.parent.mkdir(parents=True, exist_ok=True)
-            tmp = dest.with_suffix(".tmp.webp")
-            tmp.write_bytes(body)
-            tmp.replace(dest)
+
+            def _persist():
+                dest.parent.mkdir(parents=True, exist_ok=True)
+                tmp = dest.with_suffix(".tmp.webp")
+                tmp.write_bytes(body)
+                tmp.replace(dest)
+
+            # disk writes follow the same off-loop rule as the DB scan
+            await loop.run_in_executor(self._pool, _persist)
+            self._thumb_miss.pop(cas_id, None)
             return
 
     async def _serve_file(self, req: Request, library_id: str,
