@@ -1,0 +1,79 @@
+"""ctypes binding for HEIF/AVIF decode (sd_heif.cc → dlopen'd libheif).
+
+The sd-images `heif` feature equivalent (crates/images/src/lib.rs:27-28).
+``available()`` is the capability gate — the shared lib always builds (it
+has no link-time libheif dependency), but the runtime library may be
+absent. The encode helper exists purely so tests can synthesize fixtures;
+it reports None when this libheif build ships no HEVC/AV1 encoder.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from pathlib import Path
+
+import numpy as np
+
+from . import build_shared
+
+_lib = ctypes.CDLL(str(build_shared("sdheif", ["sd_heif.cc"],
+                                    extra_libs=["-ldl"])))
+
+_lib.sd_heif_available.argtypes = []
+_lib.sd_heif_available.restype = ctypes.c_int
+
+_lib.sd_heif_decode_rgb.argtypes = [
+    ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+_lib.sd_heif_decode_rgb.restype = ctypes.c_int64
+
+_lib.sd_heif_encode_file.argtypes = [
+    ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+    ctypes.c_int32]
+_lib.sd_heif_encode_file.restype = ctypes.c_int32
+
+HEIF_EXTENSIONS = {"heic", "heif", "avif"}
+
+#: decode ceiling, same guard class as the reference's max-size checks in
+#: crates/images (a hostile heic must not allocate unbounded memory)
+MAX_PIXELS = 64 * 1024 * 1024
+
+
+class HeifError(Exception):
+    pass
+
+
+def available() -> bool:
+    return bool(_lib.sd_heif_available())
+
+
+def decode_rgb(path: str | Path) -> np.ndarray:
+    """Primary image as an (h, w, 3) uint8 array."""
+    cap = MAX_PIXELS * 3
+    out = np.empty(cap, np.uint8)
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
+    rc = _lib.sd_heif_decode_rgb(
+        str(path).encode(), out.ctypes.data_as(ctypes.c_void_p), cap,
+        ctypes.byref(w), ctypes.byref(h))
+    if rc < 0:
+        raise HeifError({-1: "libheif runtime not available",
+                         -3: "image exceeds decode size limit"}.get(
+                             int(rc), f"heif decode failed ({rc})"))
+    return out[:rc].reshape(h.value, w.value, 3).copy()
+
+
+def encode_file(path: str | Path, rgb: np.ndarray,
+                quality: int = 60) -> bool:
+    """Write RGB24 to .heic/.avif; False when no encoder is compiled into
+    the local libheif (callers/tests treat that as 'skip')."""
+    rgb = np.ascontiguousarray(rgb, np.uint8)
+    h, w = rgb.shape[:2]
+    rc = _lib.sd_heif_encode_file(
+        str(path).encode(), rgb.ctypes.data_as(ctypes.c_void_p), w, h,
+        int(quality))
+    if rc == -4:
+        return False
+    if rc != 0:
+        raise HeifError(f"heif encode failed ({rc})")
+    return True

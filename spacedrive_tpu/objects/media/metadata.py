@@ -37,13 +37,35 @@ _FFPROBE = shutil.which("ffprobe")
 
 
 def extract_media_data(path: str, extension: str) -> dict[str, Any] | None:
-    from .thumbnail import THUMBNAILABLE_IMAGE_EXTENSIONS, THUMBNAILABLE_VIDEO_EXTENSIONS
+    from .thumbnail import (
+        HEIF_EXTENSIONS,
+        THUMBNAILABLE_IMAGE_EXTENSIONS,
+        THUMBNAILABLE_VIDEO_EXTENSIONS,
+    )
 
     if extension in THUMBNAILABLE_IMAGE_EXTENSIONS:
         return _extract_image(path)
+    if extension in HEIF_EXTENSIONS:
+        return _extract_heif(path)
     if extension in THUMBNAILABLE_VIDEO_EXTENSIONS or extension in AUDIO_EXTENSIONS:
         return _extract_av(path)
     return None
+
+
+def _extract_heif(path: str) -> dict[str, Any] | None:
+    """Dimensions for HEIF/AVIF primaries (PIL can't open them; EXIF inside
+    HEIF containers is left for a fuller parser)."""
+    from .thumbnail import _native_heif
+
+    heif = _native_heif()
+    if heif is None:
+        return None
+    try:
+        arr = heif.decode_rgb(path)
+    except Exception as e:
+        logger.debug("no media data for %s: %s", path, e)
+        return None
+    return {"dimensions": {"width": arr.shape[1], "height": arr.shape[0]}}
 
 
 def _extract_image(path: str) -> dict[str, Any] | None:

@@ -129,8 +129,10 @@ class MediaProcessorJob(StatefulJob):
 
 def _thumbable_extensions() -> set[str]:
     from .thumbnail import (
+        HEIF_EXTENSIONS,
         THUMBNAILABLE_IMAGE_EXTENSIONS,
         THUMBNAILABLE_VIDEO_EXTENSIONS,
     )
 
-    return THUMBNAILABLE_IMAGE_EXTENSIONS | THUMBNAILABLE_VIDEO_EXTENSIONS
+    return (THUMBNAILABLE_IMAGE_EXTENSIONS | THUMBNAILABLE_VIDEO_EXTENSIONS
+            | HEIF_EXTENSIONS)

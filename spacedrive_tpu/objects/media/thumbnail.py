@@ -32,6 +32,8 @@ THUMBNAILABLE_IMAGE_EXTENSIONS = {
 THUMBNAILABLE_VIDEO_EXTENSIONS = {
     "mp4", "mkv", "avi", "mov", "webm", "m4v", "mpg", "mpeg",
 }
+#: decoded via dlopen'd libheif (sd_heif.cc) when the runtime is present
+HEIF_EXTENSIONS = {"heic", "heif", "avif"}
 
 _FFMPEG = shutil.which("ffmpeg")
 
@@ -62,7 +64,7 @@ def can_generate_thumbnail(extension: str | None) -> bool:
     ext = (extension or "").lower()
     return ext in THUMBNAILABLE_IMAGE_EXTENSIONS or (
         ext in THUMBNAILABLE_VIDEO_EXTENSIONS and _ffmpeg_capable()
-    )
+    ) or (ext in HEIF_EXTENSIONS and _native_heif() is not None)
 
 
 def _ffmpeg_capable() -> bool:
@@ -92,7 +94,7 @@ def generate_thumbnail(source: str | Path, data_dir: str | Path, cas_id: str,
     try:
         if ext in THUMBNAILABLE_VIDEO_EXTENSIONS:
             return _video_thumbnail(Path(source), out)
-        return _image_thumbnail(Path(source), out)
+        return _image_thumbnail(Path(source), out, ext)
     except Exception as e:
         logger.warning("thumbnail failed for %s: %s", source, e)
         return None
@@ -100,6 +102,22 @@ def generate_thumbnail(source: str | Path, data_dir: str | Path, cas_id: str,
 
 _NATIVE_IMAGES: list | None = None  # [module_or_None] once probed
 _NATIVE_FFMPEG: list | None = None
+_NATIVE_HEIF: list | None = None
+
+
+def _native_heif():
+    """libheif-backed decode (sd-images `heif` feature) if the runtime
+    loads; probe cached like the other native helpers."""
+    global _NATIVE_HEIF
+    if _NATIVE_HEIF is None:
+        try:
+            from ...native import heif_native
+
+            _NATIVE_HEIF = [heif_native if heif_native.available() else None]
+        except Exception as e:
+            logger.info("heif support unavailable (%s)", e)
+            _NATIVE_HEIF = [None]
+    return _NATIVE_HEIF[0]
 
 
 def _native_ffmpeg():
@@ -146,11 +164,17 @@ def _native_decode(source: Path, max_edge: int):
         return None
 
 
-def _image_thumbnail(source: Path, out: Path) -> Path:
+def _image_thumbnail(source: Path, out: Path, ext: str | None = None) -> Path:
     from PIL import Image
 
-    # native decode (JPEG prescaled in DCT space near the target)
-    arr = _native_decode(source, MAX_INPUT_EDGE)
+    if (ext or source.suffix.lstrip(".").lower()) in HEIF_EXTENSIONS:
+        heif = _native_heif()
+        if heif is None:
+            raise RuntimeError("libheif runtime not available")
+        arr = heif.decode_rgb(source)
+    else:
+        # native decode (JPEG prescaled in DCT space near the target)
+        arr = _native_decode(source, MAX_INPUT_EDGE)
     img = Image.fromarray(arr) if arr is not None else Image.open(source)
     with img:
         img = img.convert("RGB") if img.mode not in ("RGB", "RGBA") else img
@@ -231,7 +255,13 @@ def _decode_for_device(source: Path):
     import numpy as np
     from PIL import Image
 
-    arr = _native_decode(source, MAX_INPUT_EDGE)
+    if source.suffix.lstrip(".").lower() in HEIF_EXTENSIONS:
+        heif = _native_heif()
+        if heif is None:
+            raise RuntimeError("libheif runtime not available")
+        arr = heif.decode_rgb(source)
+    else:
+        arr = _native_decode(source, MAX_INPUT_EDGE)
     if arr is not None:
         edge = max(arr.shape[0], arr.shape[1])
         if edge > MAX_INPUT_EDGE:  # PNG has no in-decode scaling

@@ -1,0 +1,61 @@
+"""HEIF/AVIF support (sd-images `heif` feature, crates/images lib.rs:27-28):
+dlopen'd libheif decode wired through the thumbnailer, media processor, and
+metadata extractor. Fixtures come from libheif's own encoder; everything
+skips when the runtime or its encoder is missing."""
+
+import numpy as np
+import pytest
+
+hn = pytest.importorskip("spacedrive_tpu.native.heif_native",
+                         reason="native toolchain unavailable")
+if not hn.available():
+    pytest.skip("libheif runtime not present", allow_module_level=True)
+
+from spacedrive_tpu.objects.media import metadata, thumbnail  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def sample_heic(tmp_path_factory):
+    arr = np.linspace(0, 255, 160 * 200 * 3, dtype=np.float64) \
+        .astype(np.uint8).reshape(160, 200, 3)
+    p = tmp_path_factory.mktemp("heif") / "photo.heic"
+    if not hn.encode_file(p, arr):
+        pytest.skip("this libheif build has no HEVC/AV1 encoder")
+    return p, arr
+
+
+def test_decode_round_trip(sample_heic):
+    p, arr = sample_heic
+    out = hn.decode_rgb(p)
+    assert out.shape == arr.shape
+    # lossy but close on a smooth gradient
+    assert np.abs(out.astype(int) - arr.astype(int)).mean() < 4
+
+
+def test_decode_missing_file_raises(tmp_path):
+    with pytest.raises(hn.HeifError):
+        hn.decode_rgb(tmp_path / "nope.heic")
+
+
+def test_thumbnail_pipeline(sample_heic, tmp_path):
+    p, arr = sample_heic
+    assert thumbnail.can_generate_thumbnail("heic")
+    out = thumbnail.generate_thumbnail(p, tmp_path, "beef" * 4, "heic")
+    assert out is not None and out.exists()
+    from PIL import Image
+
+    with Image.open(out) as img:
+        assert img.format == "WEBP" and img.size == (200, 160)
+
+
+def test_batched_thumbnail_path(sample_heic, tmp_path):
+    p, _arr = sample_heic
+    made = thumbnail.generate_thumbnails_batched(
+        [(p, "f00d" * 4, "heic")], tmp_path)
+    assert "f00d" * 4 in made and made["f00d" * 4].exists()
+
+
+def test_media_data_dimensions(sample_heic):
+    p, _arr = sample_heic
+    data = metadata.extract_media_data(str(p), "heic")
+    assert data == {"dimensions": {"width": 200, "height": 160}}
