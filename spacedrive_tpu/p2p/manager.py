@@ -223,6 +223,37 @@ class P2PManager:
                     logger.debug("static peer %s unreachable: %s", entry, e)
             await asyncio.sleep(10)
 
+    async def broadcast(self, data: bytes) -> int:
+        """Send ``data`` down a fresh substream of every CONNECTED peer's
+        live session (spacetime ``Manager::broadcast``,
+        crates/p2p/src/manager.rs:155). Best-effort and concurrent: dead
+        peers are skipped (their sessions get demoted by the failed open).
+        Returns how many peers were reached."""
+        async def one(peer_id: str) -> None:
+            reader, writer, _meta = await self.open_stream(peer_id)
+            try:
+                writer.write(data)
+                await writer.drain()
+            finally:
+                writer.close()
+
+        targets = [p.identity for p in list(self.peers.values()) if p.connected]
+        results = await asyncio.gather(*(one(t) for t in targets),
+                                       return_exceptions=True)
+        return sum(1 for r in results if not isinstance(r, BaseException))
+
+    async def ping_all(self) -> int:
+        """Ping every connected peer, refreshing its metadata from the reply
+        (p2p_manager.rs:546's ``manager.broadcast(Header::Ping)`` — ours
+        reads the metadata answer each ping exchange produces)."""
+        async def one(peer: Peer) -> None:
+            await self._ping((peer.host, peer.port))
+
+        targets = [p for p in list(self.peers.values()) if p.connected]
+        results = await asyncio.gather(*(one(t) for t in targets),
+                                       return_exceptions=True)
+        return sum(1 for r in results if not isinstance(r, BaseException))
+
     async def _ping(self, addr: tuple[str, int]) -> None:
         """Ping = metadata refresh: sessions now outlive the handshake, so
         the responder replies with CURRENT metadata (new libraries/instances

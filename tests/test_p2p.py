@@ -8,6 +8,7 @@ tests/lib.rs); the separate-OS-process variant lives in
 test_p2p_two_process.py.
 """
 
+import asyncio
 import time
 from pathlib import Path
 
@@ -636,3 +637,36 @@ def test_remote_file_served_through_shell(two_nodes, tmp_path):
             assert resp.read() == payload[100:4100]
     finally:
         server.stop()
+
+
+def test_broadcast_and_ping_all(two_nodes):
+    """spacetime Manager::broadcast parity (crates/p2p/src/manager.rs:155)
+    + the ping-all refresh that is its one reference use (p2p_manager.rs:546)."""
+    a, b = two_nodes
+    b.router.resolve("p2p.debugConnect", {"addr": addr_of(a)})
+    wait_for(lambda: any(p["connected"] for p in a.p2p.peer_list()),
+             msg="a sees b connected")
+
+    async def run():
+        from spacedrive_tpu.p2p.proto import Header
+
+        reached = await b.p2p.broadcast(Header.ping().to_bytes())
+        pinged = await b.p2p.ping_all()
+        return reached, pinged
+
+    reached, pinged = asyncio.run_coroutine_threadsafe(run(), b.p2p._loop).result(20)
+    assert reached == 1 and pinged == 1
+    # a name change on A propagates to B's view through the ping refresh
+    a.config.write(name="renamed-node")
+
+    async def refresh():
+        return await b.p2p.ping_all()
+
+    def renamed_seen():
+        # A's metadata() caches for 2s, so poll ping→check until the
+        # rename propagates through a fresh reply
+        asyncio.run_coroutine_threadsafe(refresh(), b.p2p._loop).result(20)
+        peer = next(p for p in b.p2p.peer_list() if p["connected"])
+        return peer["name"] == "renamed-node"
+
+    wait_for(renamed_seen, interval=0.5, msg="rename propagated by ping")
