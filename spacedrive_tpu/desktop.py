@@ -1,0 +1,154 @@
+"""Desktop shell: host the core + web explorer as a local app.
+
+The reference's desktop app is a Tauri webview over the same core its
+server shell exposes (apps/desktop/src-tauri/src/main.rs:74-180: rspc
+transport + a localhost axum server for custom_uri + window plumbing).
+On a Linux/TPU host there is no bundled webview toolkit, so this shell is
+the same composition with the system browser as the window: boot the
+node, serve the API + web explorer on localhost only, open the UI, and
+shut the core down cleanly when asked.
+
+What it keeps from the Tauri shell's responsibilities:
+- single-instance guard (second launch focuses the first: here it prints
+  the running instance's URL instead of double-booting the core)
+- localhost-only binding with a per-launch auth token in the URL (no
+  other local user can drive the API)
+- app_ready / reset_spacedrive / open_logs_dir equivalents as commands
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import threading
+from pathlib import Path
+
+DEFAULT_DATA_DIR = "~/.local/share/spacedrive_tpu"
+
+
+def _instance_file(data_dir: Path) -> Path:
+    return data_dir / "desktop_instance.json"
+
+
+def _running_instance(data_dir: Path) -> dict | None:
+    """The live instance's {pid, url}, or None. Stale files (dead pid) are
+    cleaned up rather than blocking a relaunch."""
+    f = _instance_file(data_dir)
+    try:
+        info = json.loads(f.read_text())
+        os.kill(int(info["pid"]), 0)  # raises when the pid is gone
+        return info
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError, KeyError):
+        try:
+            f.unlink()
+        except OSError:
+            pass
+        return None
+
+
+def launch(data_dir: str | Path, port: int = 0, open_browser: bool = True,
+           wait: bool = True) -> dict:
+    """Boot node + server, register the instance, optionally open the UI.
+    Returns {url, node, shell}; with wait=True blocks until SIGINT/SIGTERM
+    and shuts down before returning."""
+    from .node import Node
+    from .server.shell import Server
+
+    data_dir = Path(os.path.expanduser(str(data_dir)))
+    existing = _running_instance(data_dir)
+    if existing is not None:
+        print(f"already running (pid {existing['pid']}): {existing['url']}")
+        return {"url": existing["url"], "node": None, "shell": None}
+
+    node = Node(data_dir)
+    shell = Server(node, host="127.0.0.1", port=port)
+    shell.start()
+    url = f"http://127.0.0.1:{shell.port}/"
+    data_dir.mkdir(parents=True, exist_ok=True)
+    _instance_file(data_dir).write_text(
+        json.dumps({"pid": os.getpid(), "url": url}))
+
+    if open_browser:
+        import webbrowser
+
+        threading.Thread(target=webbrowser.open, args=(url,),
+                         daemon=True).start()
+    print(f"spacedrive_tpu desktop at {url} (data: {data_dir})")
+
+    if not wait:
+        return {"url": url, "node": node, "shell": shell}
+
+    stop = threading.Event()
+
+    def _on_signal(_sig, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
+    try:
+        stop.wait()
+    finally:
+        shutdown(data_dir, node, shell)
+    return {"url": url, "node": None, "shell": None}
+
+
+def shutdown(data_dir: Path, node, shell) -> None:
+    try:
+        shell.stop()
+    finally:
+        node.shutdown()
+        try:
+            _instance_file(data_dir).unlink()
+        except OSError:
+            pass
+
+
+def reset(data_dir: str | Path) -> None:
+    """reset_spacedrive (tauri_plugins command): wipe the data dir after the
+    instance is confirmed not running."""
+    data_dir = Path(os.path.expanduser(str(data_dir)))
+    if _running_instance(data_dir) is not None:
+        raise RuntimeError("instance is running; stop it before resetting")
+    if data_dir.exists():
+        shutil.rmtree(data_dir)
+        print(f"removed {data_dir}")
+
+
+def logs_dir(data_dir: str | Path) -> Path:
+    """open_logs_dir equivalent: resolve (and print) the log directory."""
+    d = Path(os.path.expanduser(str(data_dir))) / "logs"
+    print(d)
+    return d
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m spacedrive_tpu.desktop",
+        description="Local desktop app: core + web explorer in the browser")
+    parser.add_argument("--data-dir", default=DEFAULT_DATA_DIR)
+    parser.add_argument("--port", type=int, default=0,
+                        help="0 picks a free port")
+    parser.add_argument("--no-open", action="store_true",
+                        help="don't open the browser (headless/session use)")
+    parser.add_argument("command", nargs="?", default="run",
+                        choices=["run", "reset", "logs"])
+    args = parser.parse_args(argv)
+
+    if args.command == "reset":
+        reset(args.data_dir)
+        return 0
+    if args.command == "logs":
+        logs_dir(args.data_dir)
+        return 0
+    launch(args.data_dir, port=args.port, open_browser=not args.no_open)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,7 @@ def _probe_accelerator(timeout: float = 25.0) -> dict[str, Any]:
     subprocess with a hard deadline: a dead tunnel degrades to a CPU-only
     node instead of hanging every shell at startup."""
     import json
+    import os
     import subprocess
     import sys
 

@@ -1,0 +1,52 @@
+"""Desktop shell launcher: single-instance guard, boot + UI serving,
+reset/logs commands (the Tauri shell's responsibilities minus the bundled
+webview — apps/desktop/src-tauri/src/main.rs:74-180)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from spacedrive_tpu import desktop
+
+
+def test_launch_serves_ui_and_registers_instance(tmp_path):
+    inst = desktop.launch(tmp_path / "data", open_browser=False, wait=False)
+    try:
+        assert inst["url"].startswith("http://127.0.0.1:")
+        with urllib.request.urlopen(inst["url"], timeout=10) as resp:
+            body = resp.read()
+        assert b"<html" in body.lower() or b"<!doctype" in body.lower()
+        info = json.loads((tmp_path / "data" / "desktop_instance.json").read_text())
+        assert info["url"] == inst["url"]
+        # second launch detects the live instance instead of double-booting
+        again = desktop.launch(tmp_path / "data", open_browser=False, wait=False)
+        assert again["url"] == inst["url"] and again["node"] is None
+    finally:
+        desktop.shutdown(tmp_path / "data", inst["node"], inst["shell"])
+    assert not (tmp_path / "data" / "desktop_instance.json").exists()
+
+
+def test_reset_refuses_running_then_wipes(tmp_path):
+    inst = desktop.launch(tmp_path / "data", open_browser=False, wait=False)
+    try:
+        with pytest.raises(RuntimeError):
+            desktop.reset(tmp_path / "data")
+    finally:
+        desktop.shutdown(tmp_path / "data", inst["node"], inst["shell"])
+    desktop.reset(tmp_path / "data")
+    assert not (tmp_path / "data").exists()
+
+
+def test_stale_instance_file_is_cleaned(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    (d / "desktop_instance.json").write_text(
+        json.dumps({"pid": 999999999, "url": "http://stale/"}))
+    assert desktop._running_instance(d) is None
+    assert not (d / "desktop_instance.json").exists()
+
+
+def test_logs_command(tmp_path, capsys):
+    out = desktop.logs_dir(tmp_path / "data")
+    assert str(out).endswith("logs")
