@@ -239,6 +239,68 @@ def _video_thumbnail(source: Path, out: Path) -> Path | None:
 
 
 # ---------------------------------------------------------------------------
+# video helper surface (crates/ffmpeg/src/lib.rs:19-47 to_thumbnail /
+# to_webp_bytes, film_strip.rs filter)
+# ---------------------------------------------------------------------------
+
+
+def film_strip_filter(arr):
+    """Overlay sprocket-hole strips down both edges — the film_strip.rs
+    effect, drawn procedurally (dark band, repeating light holes) instead
+    of from baked pattern tiles."""
+    import numpy as np
+
+    arr = np.asarray(arr, dtype=np.uint8)
+    h, w = arr.shape[:2]
+    strip_w = max(4, w // 16)
+    hole_h = max(2, strip_w // 2)
+    period = hole_h * 2
+    out = arr.copy()
+    hole_w = max(1, strip_w // 2)
+    x_off = (strip_w - hole_w) // 2
+    for x0 in (0, w - strip_w):
+        strip = out[:, x0:x0 + strip_w]
+        strip[:] = (strip * 0.15).astype(np.uint8)
+        for y0 in range(period // 2, h - hole_h, period):
+            strip[y0:y0 + hole_h, x_off:x_off + hole_w] = 230
+    return out
+
+
+def video_to_webp_bytes(source: str | Path, size: int = 256,
+                        quality: int = WEBP_QUALITY,
+                        film_strip: bool = False) -> bytes:
+    """One WebP-encoded video thumbnail as bytes (lib.rs to_webp_bytes;
+    the builder's film_strip flag is opt-in here, like core's usage)."""
+    import io
+
+    from PIL import Image
+
+    from ...native import ffmpeg_native
+
+    frame = ffmpeg_native.decode_frame_rgb(Path(source), target_edge=size)
+    if film_strip:
+        frame = film_strip_filter(frame)
+    native = _native_images()
+    if native is not None:
+        try:
+            return native.encode_webp(frame, quality)
+        except Exception:
+            pass
+    buf = io.BytesIO()
+    Image.fromarray(frame).save(buf, "WEBP", quality=quality)
+    return buf.getvalue()
+
+
+def video_to_thumbnail(source: str | Path, out: str | Path, size: int = 256,
+                       quality: int = WEBP_QUALITY,
+                       film_strip: bool = False) -> None:
+    """Write a video thumbnail file (lib.rs to_thumbnail)."""
+    out = Path(out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_bytes(video_to_webp_bytes(source, size, quality, film_strip))
+
+
+# ---------------------------------------------------------------------------
 # batched device path (ops/resize_jax.py)
 # ---------------------------------------------------------------------------
 

@@ -73,3 +73,23 @@ def test_media_data_av_extraction(sample_mp4):
     assert data["duration_seconds"] == pytest.approx(2.0, abs=0.5)
     kinds = {s["codec_type"] for s in data["streams"]}
     assert "video" in kinds
+
+
+def test_to_webp_bytes_and_film_strip(sample_mp4, tmp_path):
+    """lib.rs to_webp_bytes/to_thumbnail surface + the film-strip filter."""
+    plain = thumbnail.video_to_webp_bytes(sample_mp4, size=96)
+    assert plain[:4] == b"RIFF" and b"WEBP" in plain[:16]
+
+    strip = thumbnail.video_to_webp_bytes(sample_mp4, size=96, film_strip=True)
+    from PIL import Image
+    import io
+
+    a = np.asarray(Image.open(io.BytesIO(plain)).convert("RGB"), dtype=int)
+    b = np.asarray(Image.open(io.BytesIO(strip)).convert("RGB"), dtype=int)
+    # the bright right edge darkens under the strip; center column untouched
+    assert b[:, -4:].mean() < a[:, -4:].mean() * 0.6
+    assert abs(b[:, b.shape[1] // 2].mean() - a[:, a.shape[1] // 2].mean()) < 12
+
+    out = tmp_path / "sub" / "thumb.webp"
+    thumbnail.video_to_thumbnail(sample_mp4, out, size=64, film_strip=True)
+    assert out.exists() and out.read_bytes()[:4] == b"RIFF"
