@@ -1,9 +1,13 @@
 """keys.* procedures — the key-manager surface.
 
-Reference: core/src/api/keys.rs (24 procedures, shipped UNMOUNTED —
-api/mod.rs:173 comments out `keys.mount()` because the keymanager is
-disconnected upstream). Here the key manager works, so the core set is
-mounted: setup/unlock/lock state, stored-key CRUD, mount/unmount.
+Reference: core/src/api/keys.rs (shipped UNMOUNTED — api/mod.rs:173
+comments out `keys.mount()` because the keymanager is disconnected
+upstream). Here the key manager works and the surface is mounted:
+setup/unlock/lock/changeMasterPassword state, stored-key CRUD with
+default-key + automount flags, mount/unmount/unmountAll/listMounted, and
+keystore backup/restore. Not carried over: getSecretKey (the reference's
+two-factor onboarding secret — our setup has no secret-key factor) and
+syncKeyToLibrary (upstream's half-wired library key sync).
 """
 
 from __future__ import annotations
@@ -91,3 +95,64 @@ def mount(router) -> None:
     def delete(node, key_uuid: str):
         _km(node).delete_key(key_uuid)
         return True
+
+    @router.query("keys.listMounted")
+    @_translate
+    def list_mounted(node, _arg=None):
+        return _km(node).list_mounted()
+
+    @router.mutation("keys.unmountAll")
+    @_translate
+    def unmount_all(node, _arg=None):
+        return _km(node).unmount_all()
+
+    @router.query("keys.getDefault")
+    @_translate
+    def get_default(node, _arg=None):
+        return _km(node).get_default()
+
+    @router.mutation("keys.setDefault")
+    @_translate
+    def set_default(node, key_uuid: str):
+        _km(node).set_default(key_uuid)
+        return True
+
+    @router.query("keys.getKey")
+    @_translate
+    def get_key(node, key_uuid: str):
+        import base64
+
+        return base64.b64encode(_km(node).get_key(key_uuid).expose()).decode()
+
+    @router.mutation("keys.updateAutomountStatus")
+    @_translate
+    def update_automount(node, arg):
+        _km(node).set_automount(arg["uuid"], bool(arg["status"]))
+        return True
+
+    @router.mutation("keys.changeMasterPassword")
+    @_translate
+    def change_master_password(node, arg):
+        _km(node).change_master_password(arg["current"], arg["new"])
+        return True
+
+    @router.mutation("keys.clearMasterPassword")
+    @_translate
+    def clear_master_password(node, _arg=None):
+        _km(node).clear_master_password()
+        return True
+
+    @router.query("keys.isKeyManagerUnlocking")
+    @_translate
+    def is_unlocking(node, _arg=None):
+        return False  # unlock here is synchronous; never observably mid-flight
+
+    @router.mutation("keys.backupKeystore")
+    @_translate
+    def backup_keystore(node, path: str):
+        return _km(node).backup_keystore(path)
+
+    @router.mutation("keys.restoreKeystore")
+    @_translate
+    def restore_keystore(node, arg):
+        return _km(node).restore_keystore(arg["path"], arg["password"])

@@ -76,7 +76,7 @@ class KeyManager:
                 return json.loads(self.store_path.read_text())
             except (OSError, json.JSONDecodeError):
                 pass
-        return {"root_slot": None, "keys": {}}
+        return {"root_slot": None, "keys": {}, "default": None}
 
     def _save(self) -> None:
         self.store_path.parent.mkdir(parents=True, exist_ok=True)
@@ -117,6 +117,36 @@ class KeyManager:
                 self._root = slot.unseal(pw)
             except CryptoError as e:
                 raise KeyManagerError("incorrect master password") from e
+            # automount (updateAutomountStatus): flagged keys surface as
+            # soon as the manager unlocks
+            for kid, rec in self._store["keys"].items():
+                if rec.get("automount"):
+                    try:
+                        self.mount(kid)
+                    except KeyManagerError:
+                        pass
+
+    def change_master_password(self, current: str | Protected,
+                               new: str | Protected) -> None:
+        """Re-seal the root key under a new master password (keymanager.rs
+        change_master_password). Stored keys are untouched — they are
+        sealed under the root key, which does not change."""
+        with self._lock:
+            self.unlock(current)  # verifies `current`, sets self._root
+            pw = new if isinstance(new, Protected) else Protected(new)
+            slot = Keyslot.new(Algorithm.XCHACHA20_POLY1305,
+                               HashingAlgorithm.argon2id(), pw, self._root)
+            self._store["root_slot"] = _slot_to_json(slot)
+            self._save()
+
+    def clear_master_password(self) -> None:
+        """Drop the in-memory root key WITHOUT unmounting keys: already-
+        mounted keys keep working, but nothing new can be unsealed until
+        the next unlock (keys.rs clearMasterPassword semantics)."""
+        with self._lock:
+            if self._root is not None:
+                self._root.zeroize()
+            self._root = None
 
     def lock(self) -> None:
         with self._lock:
@@ -181,8 +211,94 @@ class KeyManager:
             self._store["keys"].pop(kid, None)
             self._save()
 
+    def unmount_all(self) -> int:
+        with self._lock:
+            n = len(self._mounted)
+            for key in self._mounted.values():
+                key.zeroize()
+            self._mounted.clear()
+            return n
+
     def list_keys(self) -> list[dict]:
         with self._lock:
             return [{"uuid": kid, "name": rec.get("name", ""),
-                     "mounted": kid in self._mounted}
+                     "mounted": kid in self._mounted,
+                     "automount": bool(rec.get("automount")),
+                     "default": kid == self._store.get("default")}
                     for kid, rec in self._store["keys"].items()]
+
+    def list_mounted(self) -> list[str]:
+        with self._lock:
+            return list(self._mounted)
+
+    # -- default key / automount --------------------------------------------
+    def set_default(self, kid: str) -> None:
+        with self._lock:
+            if kid not in self._store["keys"]:
+                raise KeyManagerError(f"no stored key {kid}")
+            self._store["default"] = kid
+            self._save()
+
+    def get_default(self) -> str | None:
+        with self._lock:
+            return self._store.get("default")
+
+    def set_automount(self, kid: str, status: bool) -> None:
+        with self._lock:
+            rec = self._store["keys"].get(kid)
+            if rec is None:
+                raise KeyManagerError(f"no stored key {kid}")
+            rec["automount"] = bool(status)
+            self._save()
+
+    # -- keystore backup / restore -------------------------------------------
+    def backup_keystore(self, path: str | Path) -> int:
+        """Copy the (everything-sealed) keystore out; returns key count."""
+        with self._lock:
+            Path(path).write_text(json.dumps(self._store, indent=1))
+            return len(self._store["keys"])
+
+    def restore_keystore(self, path: str | Path,
+                         password: str | Protected) -> int:
+        """Merge keys from a backup keystore, verifying with THAT keystore's
+        master password and re-sealing each key under our root key. Returns
+        how many keys were imported (duplicates skipped)."""
+        with self._lock:
+            root = self._require_root()
+            try:
+                backup = json.loads(Path(path).read_text())
+            except (OSError, json.JSONDecodeError) as e:
+                raise KeyManagerError(f"unreadable backup: {e}") from e
+            if not backup.get("root_slot"):
+                raise KeyManagerError("backup has no root keyslot")
+            pw = password if isinstance(password, Protected) \
+                else Protected(password)
+            try:
+                their_root = _slot_from_json(backup["root_slot"]).unseal(pw)
+            except (CryptoError, KeyError, ValueError) as e:
+                raise KeyManagerError(
+                    "incorrect backup master password") from e
+            imported = 0
+            for kid, rec in (backup.get("keys") or {}).items():
+                if kid in self._store["keys"]:
+                    continue
+                try:
+                    raw = Decryptor.decrypt_bytes(
+                        their_root, _unb64(rec["nonce"]),
+                        Algorithm(rec["algorithm"]), _unb64(rec["key"]))
+                except (CryptoError, KeyError, ValueError):
+                    continue  # damaged record: import the rest
+                algorithm = Algorithm.XCHACHA20_POLY1305
+                nonce = algorithm.generate_nonce()
+                self._store["keys"][kid] = {
+                    "name": rec.get("name", ""), "algorithm": algorithm.value,
+                    "nonce": _b64(nonce),
+                    "key": _b64(Encryptor.encrypt_bytes(
+                        root, nonce, algorithm, raw.expose())),
+                }
+                raw.zeroize()
+                imported += 1
+            their_root.zeroize()
+            if imported:
+                self._save()
+            return imported

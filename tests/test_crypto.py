@@ -280,3 +280,52 @@ def test_encrypt_decrypt_jobs_byte_identical(tmp_data_dir, tmp_path):
         assert not enc.exists()
     finally:
         node.shutdown()
+
+
+def test_keymanager_defaults_automount_and_password_change(tmp_path):
+    km = KeyManager(tmp_path / "ks.json")
+    km.setup("hunter2")
+    k1, k2 = km.add_key("first"), km.add_key("second")
+    km.set_default(k2)
+    assert km.get_default() == k2
+    km.set_automount(k1, True)
+    assert km.unmount_all() == 2 and km.list_mounted() == []
+
+    # automount kicks in at unlock; change_master_password keeps keys
+    km.change_master_password("hunter2", "correct horse")
+    km.lock()
+    with pytest.raises(KeyManagerError):
+        km.unlock("hunter2")
+    km.unlock("correct horse")
+    assert km.list_mounted() == [k1]
+    rows = {r["uuid"]: r for r in km.list_keys()}
+    assert rows[k2]["default"] and rows[k1]["automount"]
+
+
+def test_keymanager_clear_master_password_keeps_mounted(tmp_path):
+    km = KeyManager(tmp_path / "ks.json")
+    km.setup("pw")
+    kid = km.add_key("k")
+    before = km.get_key(kid).expose()
+    km.clear_master_password()
+    assert not km.is_unlocked
+    assert km.get_key(kid).expose() == before  # mounted key still usable
+    with pytest.raises(KeyManagerError):
+        km.add_key("needs-root")
+
+
+def test_keystore_backup_restore_across_managers(tmp_path):
+    a = KeyManager(tmp_path / "a.json")
+    a.setup("alpha")
+    kid = a.add_key("travel")
+    secret = a.get_key(kid).expose()
+    assert a.backup_keystore(tmp_path / "backup.json") == 1
+
+    b = KeyManager(tmp_path / "b.json")
+    b.setup("beta")
+    with pytest.raises(KeyManagerError):
+        b.restore_keystore(tmp_path / "backup.json", "wrong")
+    assert b.restore_keystore(tmp_path / "backup.json", "alpha") == 1
+    assert b.get_key(kid).expose() == secret  # same key, resealed under b
+    # idempotent: duplicates skipped
+    assert b.restore_keystore(tmp_path / "backup.json", "alpha") == 0
