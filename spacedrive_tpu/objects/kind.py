@@ -3,9 +3,9 @@
 The 24-kind enum matches the reference exactly (crates/file-ext/src/kind.rs:6-55
 — "the order of this enum should never change"). Extension → kind resolution
 mirrors sd-file-ext's extension tables; magic-byte disambiguation for
-conflicting extensions (magic.rs) is a planned refinement — the identifier
-falls back to extension-only resolution like ``Extension::resolve_conflicting``
-with magic off.
+conflicting/unknown extensions and text detection live in ``magic.py``
+(magic.rs / Extension::resolve_conflicting semantics) and are wired into
+the identifier.
 """
 
 from __future__ import annotations

@@ -101,6 +101,23 @@ def sniff_kind(head: bytes) -> int | None:
     return None
 
 
+def looks_text(head: bytes) -> bool:
+    """sd-file-ext's text detection: NUL-free, valid UTF-8 (tolerating a
+    multibyte sequence cut at the sample edge), mostly printable."""
+    if not head or b"\x00" in head:
+        return False
+    try:
+        text = head.decode("utf-8")
+    except UnicodeDecodeError as e:
+        if e.start < len(head) - 4:  # error not at the cut tail: binary
+            return False
+        text = head[:e.start].decode("utf-8")
+        if not text:
+            return False
+    printable = sum(ch.isprintable() or ch in "\t\n\r\f" for ch in text)
+    return printable >= 0.97 * len(text)
+
+
 def _read_head(path: str | Path) -> bytes:
     try:
         with open(path, "rb") as fh:
@@ -129,4 +146,10 @@ def resolve_kind(extension: str | None, path: str | Path | None = None,
     if not head:
         return ext_kind
     sniffed = sniff_kind(head)
-    return sniffed if sniffed is not None else ext_kind
+    if sniffed is not None:
+        return sniffed
+    # no signature: an unknown extension with readable content is TEXT
+    # (sd-file-ext text detection)
+    if ext_kind == ObjectKind.UNKNOWN and looks_text(head):
+        return ObjectKind.TEXT
+    return ext_kind

@@ -130,3 +130,27 @@ def test_dedup_job_persists_pairs(tmp_path, tmp_data_dir):
         assert lib.db.query("SELECT * FROM near_duplicate") == []
     finally:
         node.shutdown()
+
+
+def test_text_detection_for_unknown_extensions(tmp_path):
+    """sd-file-ext text detection: extensionless readable files are TEXT,
+    binary stays UNKNOWN, and real signatures still win."""
+    from spacedrive_tpu.objects.kind import ObjectKind
+    from spacedrive_tpu.objects.magic import looks_text, resolve_kind
+
+    notes = tmp_path / "NOTES"
+    notes.write_text("Plain prose with unicode — привет, 世界.\nSecond line.\n")
+    assert resolve_kind(None, notes) == ObjectKind.TEXT
+    assert resolve_kind("xyzzy", notes) == ObjectKind.TEXT
+
+    blob = tmp_path / "blob"
+    blob.write_bytes(bytes(range(256)) * 8)
+    assert resolve_kind(None, blob) == ObjectKind.UNKNOWN
+
+    png = tmp_path / "image"
+    png.write_bytes(b"\x89PNG\r\n\x1a\n" + b"0" * 64)
+    assert resolve_kind(None, png) == ObjectKind.IMAGE
+
+    # cut multibyte tail tolerated; embedded NUL is binary
+    assert looks_text("héllo".encode()[:6])
+    assert not looks_text(b"ab\x00cd")
