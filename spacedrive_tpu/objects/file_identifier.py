@@ -96,7 +96,7 @@ class FileIdentifierJob(StatefulJob):
                 empty.append(row)  # "We can't do shit with empty files"
 
         t0 = time.perf_counter()
-        hasher = get_hasher(data.get("hasher"))
+        hasher = get_hasher(data.get("hasher"), node=ctx.node)
         paths = [_abs_path(location_path, r) for r in hashable]
         sizes = [r["size_in_bytes"] for r in hashable]
         cas_results = hasher.hash_batch(paths, sizes)

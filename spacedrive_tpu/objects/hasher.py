@@ -134,18 +134,13 @@ class TpuHasher:
     def _hash_small(self, paths, sizes, indices: list[int], out: list) -> None:
         messages = read_sampled_batch([paths[i] for i in indices],
                                       [sizes[i] for i in indices])
-        buckets: dict[int, list[int]] = {}
-        for j, msg in enumerate(messages):
-            if isinstance(msg, Exception):
-                out[indices[j]] = msg
-                continue
-            chunks = max(1, (len(msg) + 1023) // 1024)
-            cap = next(b for b in SMALL_BUCKETS if b >= chunks)
-            buckets.setdefault(cap, []).append(j)
-        for cap, js in sorted(buckets.items()):
-            hexes = self._hash_bucket([messages[j] for j in js], cap)
-            for j, h in zip(js, hexes):
-                out[indices[j]] = h[:16]
+        ok = [j for j, m in enumerate(messages) if not isinstance(m, Exception)]
+        for j, m in enumerate(messages):
+            if isinstance(m, Exception):
+                out[indices[j]] = m
+        ids = _bucketed_hash([messages[j] for j in ok], self._hash_bucket)
+        for j, cid in zip(ok, ids):
+            out[indices[j]] = cid
 
     def _hash_python(self, paths, sizes, indices: list[int], out: list) -> None:
         """No native toolchain: pure-Python gather into the bucketed kernel."""
@@ -404,9 +399,15 @@ _BACKENDS: dict[str, Callable[[], HasherBackend]] = {
 _instances: dict[str, HasherBackend] = {}
 
 
-def get_hasher(name: str | None) -> HasherBackend:
+def get_hasher(name: str | None, node=None) -> HasherBackend:
     """Resolve a backend by location config; unknown/absent → tpu if JAX sees
-    an accelerator, else the native cpu path."""
+    an accelerator, else the native cpu path. ``remote`` binds to the node's
+    p2p mesh and is never cached (it must not outlive the node)."""
+    if name == "remote":
+        if node is not None:
+            return RemoteHasher(node)
+        logger.warning("remote hasher needs a node context; using local")
+        name = "hybrid"
     if name not in _BACKENDS:
         if name is not None:
             logger.warning("unknown hasher backend %r, falling back to default", name)
@@ -414,6 +415,137 @@ def get_hasher(name: str | None) -> HasherBackend:
     if name not in _instances:
         _instances[name] = _BACKENDS[name]()
     return _instances[name]
+
+
+def _bucketed_hash(messages: list[bytes], hash_bucket) -> list[str]:
+    """Bucket variable-size cas messages by chunk count and hash each
+    bucket through ``hash_bucket(msgs, cap)``; returns 16-hex cas_ids in
+    input order. The one bucketing scheme shared by the local small-file
+    path and the H_HASH service."""
+    out: list[str | None] = [None] * len(messages)
+    buckets: dict[int, list[int]] = {}
+    for j, msg in enumerate(messages):
+        chunks = max(1, (len(msg) + 1023) // 1024)
+        cap = next((b for b in SMALL_BUCKETS if b >= chunks), chunks)
+        buckets.setdefault(cap, []).append(j)
+    for cap, js in sorted(buckets.items()):
+        hexes = hash_bucket([messages[j] for j in js], cap)
+        for j, h in zip(js, hexes):
+            out[j] = h[:16]
+    return out  # type: ignore[return-value]
+
+
+def hash_messages(messages: list[bytes]) -> list[str]:
+    """cas_ids for pre-gathered cas messages — the compute side of the
+    shared-hasher service (H_HASH): device-bucketed when an accelerator is
+    present, else native C++ BLAKE3, else the Python oracle."""
+    if _accelerator_available():
+        from ..ops.blake3_jax import blake3_batch_hex
+
+        return _bucketed_hash(
+            messages, lambda msgs, cap: blake3_batch_hex(msgs, max_chunks=cap))
+    try:
+        from ..native import cas_native
+
+        return [cas_native.blake3_hex(m)[:16] for m in messages]
+    except Exception:
+        from .blake3_ref import blake3
+
+        return [blake3(m).hex()[:16] for m in messages]
+
+
+class RemoteHasher:
+    """Route hashing to a paired node that advertises an accelerator — the
+    shared TPU hasher service of BASELINE config 5. Files are sampled
+    LOCALLY (read_sampled_batch: the 56 KiB budget per file, cas.rs
+    layout); only the cas messages travel, so the peer sees samples, never
+    whole files, and only if it shares a library with us (the server
+    enforces membership). Any remote failure falls back to the local
+    hybrid engine for the remainder of the batch."""
+
+    name = "remote"
+
+    #: per-wire-request caps — bound peer memory, stay WELL under the mux's
+    #: 64 MiB per-substream buffer, and keep a lost connection from wasting
+    #: more than one sub-batch of work
+    WIRE_BATCH = 1024
+    WIRE_BATCH_BYTES = 32 * 1024 * 1024
+
+    def __init__(self, node) -> None:
+        self._node = node
+
+    def _pick_peer(self) -> str | None:
+        """A connected peer that (a) advertises an accelerator and (b)
+        shares a library with us — the server refuses non-members, so
+        offering it a batch would waste the whole upload."""
+        p2p = getattr(self._node, "p2p", None)
+        if p2p is None:
+            return None
+        members: set[str] = set()
+        for library in self._node.libraries.list():
+            members |= p2p.nlm.member_nodes(library)
+        for peer in p2p.peer_list():
+            accel = peer.get("accelerator") or {}
+            if (peer.get("connected") and accel.get("devices")
+                    and peer["identity"] in members):
+                return peer["identity"]
+        return None
+
+    def _wire_batches(self, todo: list[int], messages) -> list[list[int]]:
+        """Split by count AND cumulative bytes."""
+        batches: list[list[int]] = []
+        cur: list[int] = []
+        cur_bytes = 0
+        for i in todo:
+            n = len(messages[i])
+            if cur and (len(cur) >= self.WIRE_BATCH
+                        or cur_bytes + n > self.WIRE_BATCH_BYTES):
+                batches.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += n
+        if cur:
+            batches.append(cur)
+        return batches
+
+    def hash_batch(self, paths: list[str | Path],
+                   sizes: list[int]) -> list[str | Exception]:
+        out: list[str | Exception | None] = [None] * len(paths)
+        messages = read_sampled_batch(paths, sizes)
+        todo: list[int] = []
+        for i, msg in enumerate(messages):
+            if isinstance(msg, Exception):
+                out[i] = msg
+            else:
+                todo.append(i)
+
+        peer_id = self._pick_peer()
+        failed: list[int] = []
+        if peer_id is None:
+            failed = todo
+        else:
+            p2p = self._node.p2p
+            batches = self._wire_batches(todo, messages)
+            for bi, idxs in enumerate(batches):
+                try:
+                    ids = p2p.run_coro(p2p.request_hash_batch(
+                        peer_id, [messages[i] for i in idxs]), timeout=120)
+                    for i, cid in zip(idxs, ids):
+                        out[i] = cid
+                except Exception as e:
+                    logger.warning("remote hash batch via %s failed (%s); "
+                                   "hashing locally", peer_id[:8], e)
+                    for rest in batches[bi:]:
+                        failed.extend(rest)
+                    break
+
+        if failed:
+            local = get_hasher("hybrid")
+            results = local.hash_batch([paths[i] for i in failed],
+                                       [sizes[i] for i in failed])
+            for i, r in zip(failed, results):
+                out[i] = r
+        return out  # type: ignore[return-value]
 
 
 def register_backend(name: str, factory: Callable[[], HasherBackend]) -> None:

@@ -109,7 +109,10 @@ def looks_text(head: bytes) -> bool:
     try:
         text = head.decode("utf-8")
     except UnicodeDecodeError as e:
-        if e.start < len(head) - 4:  # error not at the cut tail: binary
+        # only a full HEADER_LEN sample can have a cut multibyte tail, and
+        # a sequence starting ≥4 bytes before the end had room to finish —
+        # anything else is genuinely invalid, not truncated
+        if len(head) < HEADER_LEN or e.start < len(head) - 3:
             return False
         text = head[:e.start].decode("utf-8")
         if not text:

@@ -32,8 +32,8 @@ from typing import TYPE_CHECKING, Any
 from .discovery import Discovery, DiscoveredPeer
 from .identity import Identity, RemoteIdentity, remote_identity_of
 from .mux import MuxConn
-from .proto import (Header, H_FILE, H_PAIR, H_PING, H_SPACEDROP, H_SYNC,
-                    H_THUMBNAIL, ProtocolError, Range, SpaceblockRequest,
+from .proto import (Header, H_FILE, H_HASH, H_PAIR, H_PING, H_SPACEDROP,
+                    H_SYNC, H_THUMBNAIL, ProtocolError, Range, SpaceblockRequest,
                     block_size_for, json_frame, read_block_msg, read_exact,
                     read_json)
 from .secure import (SecureReader, SecureWriter, derive_session_keys,
@@ -517,6 +517,8 @@ class P2PManager:
                 await self._serve_file(sub, sub, header.payload, peer)
             elif header.kind == H_THUMBNAIL:
                 await self._serve_thumbnail(sub, sub, header.payload, peer)
+            elif header.kind == H_HASH:
+                await self._serve_hash_batch(sub, sub, header.payload, peer)
             else:
                 logger.warning("unhandled header kind %s", header.kind)
             failed = False
@@ -696,6 +698,69 @@ class P2PManager:
         writer.write(json_frame({"ok": True, "size": len(body)}))
         writer.write(body)
         await writer.drain()
+
+    # -- shared hasher service (H_HASH, BASELINE config 5) -------------------
+
+    #: per-request limits the server enforces (and the client respects);
+    #: the total must sit well under the mux's 64 MiB per-substream buffer
+    #: or the demux guard resets the stream before the read completes
+    HASH_MAX_COUNT = 4096
+    HASH_MAX_MSG = 256 * 1024          # whole-file path tops out ≈100KiB+8
+    HASH_MAX_TOTAL = 48 * 1024 * 1024
+
+    async def _serve_hash_batch(self, reader, writer, payload: dict,
+                                peer: Peer) -> None:
+        """Hash a member peer's pre-gathered cas messages on OUR engine
+        (device when present). Compute-sharing is restricted to nodes that
+        share at least one library with us — the same trust boundary as
+        file/preview serving."""
+        sizes = payload.get("sizes")
+        if (not isinstance(sizes, list) or not sizes
+                or len(sizes) > self.HASH_MAX_COUNT
+                or not all(isinstance(s, int) and 0 < s <= self.HASH_MAX_MSG
+                           for s in sizes)
+                or sum(sizes) > self.HASH_MAX_TOTAL):
+            writer.write(json_frame({"ok": False, "error": "bad batch shape"}))
+            await writer.drain()
+            return
+        member = any(peer.identity in self.nlm.member_nodes(lib)
+                     for lib in self.node.libraries.list())
+        if not member:
+            # the client writes the payload before reading the reply —
+            # drain it so refused bytes don't sit in the substream buffer
+            # until teardown (and a big batch doesn't hit the demux cap)
+            for s in sizes:
+                await read_exact(reader, s)
+            writer.write(json_frame({"ok": False, "error": "not a member"}))
+            await writer.drain()
+            return
+        messages = [await read_exact(reader, s) for s in sizes]
+
+        from ..objects.hasher import hash_messages
+
+        loop = asyncio.get_running_loop()
+        ids = await loop.run_in_executor(None, hash_messages, messages)
+        writer.write(json_frame({"ok": True, "ids": ids}))
+        await writer.drain()
+
+    async def request_hash_batch(self, peer_id: str,
+                                 messages: list[bytes]) -> list[str]:
+        """Ship cas messages to a peer's hasher; returns cas_ids in order."""
+        reader, writer, _meta = await self.open_stream(peer_id)
+        try:
+            writer.write(Header.hash_batch([len(m) for m in messages]).to_bytes())
+            for m in messages:
+                writer.write(m)
+            await writer.drain()
+            reply = await read_json(reader)
+            if not reply.get("ok"):
+                raise ProtocolError(reply.get("error", "hash batch refused"))
+            ids = reply["ids"]
+            if len(ids) != len(messages):
+                raise ProtocolError("hash batch reply count mismatch")
+            return [str(i) for i in ids]
+        finally:
+            writer.close()
 
     async def request_thumbnail(self, peer_id: str, library_id: str,
                                 cas_id: str) -> bytes:

@@ -119,6 +119,7 @@ H_SYNC = 3
 H_FILE = 4
 H_CONNECTED = 5
 H_THUMBNAIL = 6
+H_HASH = 7
 
 
 @dataclass(frozen=True)
@@ -159,6 +160,13 @@ class Header:
         form of the reference's sync_preview_media location knob."""
         return cls(H_THUMBNAIL, {"library_id": library_id, "cas_id": cas_id})
 
+    @classmethod
+    def hash_batch(cls, sizes: list[int]) -> "Header":
+        """Shared-hasher request (BASELINE config 5): ``sizes[i]`` bytes of
+        pre-gathered cas message follow the header for each item; the peer
+        replies with the cas_ids."""
+        return cls(H_HASH, {"sizes": sizes})
+
     # wire -----------------------------------------------------------------
     def to_bytes(self) -> bytes:
         b = bytes([self.kind])
@@ -170,7 +178,7 @@ class Header:
             return b + json_frame(self.payload)
         if self.kind == H_SPACEDROP:
             return b + json_frame(self.payload.to_wire())
-        if self.kind in (H_FILE, H_CONNECTED, H_THUMBNAIL):
+        if self.kind in (H_FILE, H_CONNECTED, H_THUMBNAIL, H_HASH):
             return b + json_frame(self.payload)
         raise ProtocolError(f"unknown header kind {self.kind}")
 
@@ -183,7 +191,7 @@ class Header:
             return cls(kind, str(await read_json(reader)))
         if kind == H_SPACEDROP:
             return cls(kind, SpaceblockRequest.from_wire(await read_json(reader)))
-        if kind in (H_FILE, H_CONNECTED, H_THUMBNAIL):
+        if kind in (H_FILE, H_CONNECTED, H_THUMBNAIL, H_HASH):
             return cls(kind, await read_json(reader))
         raise ProtocolError(f"invalid header discriminator {kind}")
 

@@ -670,3 +670,63 @@ def test_broadcast_and_ping_all(two_nodes):
         return peer["name"] == "renamed-node"
 
     wait_for(renamed_seen, interval=0.5, msg="rename propagated by ping")
+
+
+def test_remote_hasher_service(two_nodes, tmp_path):
+    """Shared-hasher service (H_HASH, BASELINE config 5): a paired node
+    ships locally-gathered cas messages to a peer advertising an
+    accelerator and gets byte-exact cas_ids back; non-members are refused;
+    remote failure falls back to the local engine."""
+    from spacedrive_tpu.objects.cas import generate_cas_id
+    from spacedrive_tpu.objects.hasher import RemoteHasher
+
+    a, b = two_nodes
+    # a advertises an accelerator (metadata is read from config)
+    a.config.write(accelerator={"kind": "tpu", "devices": 1, "mesh": [1]})
+    lib_a = a.libraries.create("hash-lib")
+    a.config.write(p2p_auto_accept_library=lib_a.id)
+    b.router.resolve("p2p.pair", {"peer_id": addr_of(a)})
+    wait_for(lambda: next((l for l in b.libraries.list() if l.id == lib_a.id),
+                          None), msg="library mirrored")
+    # wait until b sees a as connected WITH the accelerator metadata
+    wait_for(lambda: any(p["connected"] and (p.get("accelerator") or {})
+                         .get("devices") for p in b.p2p.peer_list()),
+             msg="accelerator peer visible")
+
+    files = []
+    rng = __import__("random").Random(7)
+    for i, size in enumerate([100, 4096, 150 * 1024, 300 * 1024]):
+        p = tmp_path / f"f{i}.bin"
+        p.write_bytes(rng.randbytes(size))
+        files.append((p, size))
+
+    hasher = RemoteHasher(b)
+    ids = hasher.hash_batch([p for p, _ in files], [s for _, s in files])
+    assert ids == [generate_cas_id(p, s) for p, s in files]
+
+    # a vanished file surfaces as an exception, others still hash
+    missing = tmp_path / "gone.bin"
+    mixed = hasher.hash_batch([files[0][0], missing], [files[0][1], 64])
+    assert mixed[0] == ids[0] and isinstance(mixed[1], Exception)
+
+    # an unpaired third node is refused service by a
+    c = Node(tmp_path / "c", probe_accelerator=False)
+    try:
+        c.router.resolve("p2p.debugConnect", {"addr": addr_of(a)})
+
+        async def ask():
+            return await c.p2p.request_hash_batch(
+                a.p2p.remote_identity.encode(), [b"\x08" + b"x" * 64])
+
+        import asyncio
+
+        with pytest.raises(Exception, match="member|refused"):
+            asyncio.run_coroutine_threadsafe(ask(), c.p2p._loop).result(20)
+    finally:
+        c.shutdown()
+
+    # no accelerator peers visible -> silent local fallback, same ids
+    a.config.write(accelerator={"kind": None, "devices": 0, "mesh": []})
+    hasher_local = RemoteHasher(c)  # c has no p2p loop anymore: forces fallback
+    ids2 = hasher_local.hash_batch([p for p, _ in files], [s for _, s in files])
+    assert ids2 == ids
