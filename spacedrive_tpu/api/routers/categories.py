@@ -16,6 +16,6 @@ def mount(router) -> None:
         out = []
         for name in CATEGORIES:
             kinds = CATEGORY_KINDS.get(name, ())
-            out.append({"category": name,
+            out.append({"category": name, "kinds": list(kinds),
                         "count": sum(counts.get(k, 0) for k in kinds)})
         return out

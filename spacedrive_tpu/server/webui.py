@@ -58,6 +58,18 @@ INDEX_HTML = r"""<!doctype html>
                transition: width .3s; }
   .pill { font-size: 10px; padding: 1px 7px; border-radius: 9px;
           background: var(--panel2); color: var(--dim); }
+  .tiles { display: grid; grid-template-columns: repeat(auto-fill, minmax(170px, 1fr));
+           gap: 10px; margin-bottom: 16px; }
+  .tile { background: var(--panel); border-radius: 8px; padding: 12px; }
+  .tile .v { font-size: 20px; color: #fff; }
+  .tile .k { font-size: 11px; color: var(--dim); text-transform: uppercase;
+             letter-spacing: .06em; }
+  .dot { display: inline-block; width: 9px; height: 9px; border-radius: 5px;
+         margin-right: 6px; background: var(--accent); }
+  .fav { position: absolute; top: 4px; right: 6px; font-size: 13px;
+         opacity: 0; cursor: pointer; }
+  .item { position: relative; }
+  .item:hover .fav, .fav.on { opacity: 1; }
   table { width: 100%; border-collapse: collapse; font-size: 13px; }
   td, th { text-align: left; padding: 5px 8px; border-bottom: 1px solid #23242f; }
   #status { font-size: 11px; color: var(--dim); margin-top: auto; }
@@ -73,7 +85,12 @@ INDEX_HTML = r"""<!doctype html>
   <h2>Search</h2>
   <input id="search" placeholder="search files… (enter)">
   <h2>Views</h2>
+  <div class="loc" data-view="overview">overview</div>
   <div class="loc" data-view="duplicates">near-duplicates</div>
+  <h2>Tags</h2>
+  <div id="tags"></div>
+  <h2>Peers</h2>
+  <div id="peers" class="meta">none discovered</div>
   <h2>Jobs</h2>
   <div id="jobs"></div>
   <div id="status">connecting…</div>
@@ -114,6 +131,7 @@ async function loadLibraries() {
     state.location = null;  // locations are per-library
     state.dir = "/";
     await loadLocations();
+    loadTags();
   };
 }
 
@@ -170,10 +188,10 @@ function render(items) {
     if (!it.name) continue;
     const card = el("div", {className: "item"});
     const thumb = el("div", {className: "thumb"});
-    if (it.cas_id && it.object_kind === 5) {
+    if (it.cas_id && (it.object_kind === 5 || it.object_kind === 7)) {
       const img = el("img", {loading: "lazy",
         src: `/spacedrive/thumbnail/${it.cas_id.slice(0,2)}/${it.cas_id}.webp`});
-      img.onerror = () => { thumb.textContent = KIND_ICONS[5]; };
+      img.onerror = () => { thumb.textContent = KIND_ICONS[it.object_kind]; };
       thumb.append(img);
     } else {
       thumb.textContent = KIND_ICONS[it.is_dir ? 2 : (it.object_kind ?? 0)] || "📄";
@@ -182,6 +200,30 @@ function render(items) {
     card.append(thumb, el("div", {className: "name", title: full}, full),
       el("div", {className: "meta"},
          it.is_dir ? "folder" : fmtSize(it.size_in_bytes)));
+    if (!it.is_dir && it.object_id != null) {
+      const fav = el("span",
+        {className: "fav" + (it.favorite ? " on" : ""),
+         title: "favorite"}, it.favorite ? "★" : "☆");
+      fav.onclick = async (e) => { e.stopPropagation();
+        await rspc("files.setFavorite",
+          {object_id: it.object_id, favorite: !it.favorite});
+        it.favorite = !it.favorite;
+        fav.textContent = it.favorite ? "★" : "☆";
+        fav.className = "fav" + (it.favorite ? " on" : "");
+      };
+      card.append(fav);
+      card.oncontextmenu = async (e) => {  // right-click: tag it
+        e.preventDefault();
+        const name = prompt(`tag "${full}" with:`);
+        if (!name) return;
+        const tags = await rspc("tags.list");
+        let tag = tags.find(t => t.name === name);
+        if (!tag) tag = await rspc("tags.create", {name});
+        await rspc("tags.assign",
+          {tag_id: tag.id, object_ids: [it.object_id], unassign: false});
+        loadTags();
+      };
+    }
     card.onclick = () => {
       if (it.is_dir) {
         state.location = it.location_id;  // search results may span locations
@@ -230,6 +272,92 @@ document.querySelector('[data-view="duplicates"]').onclick = async () => {
     "<td colspan=3>no pairs recorded</td>"}));
   box.append(table);
 };
+
+document.querySelector('[data-view="overview"]').onclick = async () => {
+  const [stats, cats] = await Promise.all([
+    rspc("libraries.statistics"), rspc("categories.list")]);
+  const box = document.getElementById("content");
+  box.className = ""; box.innerHTML = "";
+  document.getElementById("crumbs").textContent = "overview";
+  const tiles = el("div", {className: "tiles"});
+  const tile = (k, v) => {
+    const t = el("div", {className: "tile"});
+    t.append(el("div", {className: "v"}, v), el("div", {className: "k"}, k));
+    return t;
+  };
+  tiles.append(
+    tile("objects", String(stats.total_object_count ?? 0)),
+    tile("unique content", fmtSize(Number(stats.total_unique_bytes ?? 0))),
+    tile("total indexed", fmtSize(Number(stats.total_bytes_used ?? 0))),
+    tile("previews", fmtSize(Number(stats.preview_media_bytes ?? 0))),
+    tile("disk free", fmtSize(Number(stats.total_bytes_free ?? 0))));
+  box.append(tiles);
+  const table = el("table");
+  table.append(el("tr", {innerHTML: "<th>category</th><th>objects</th>"}));
+  for (const c of cats) {
+    if (!c.count) continue;
+    const tr = el("tr", {style: "cursor:pointer"});
+    tr.append(el("td", {}, c.category), el("td", {}, String(c.count)));
+    tr.onclick = async () => {
+      const arg = c.category === "Favorites" ? {favorite: true, take: 500}
+                                             : {kinds: c.kinds, take: 500};
+      const res = await rspc("search.paths", arg);
+      document.getElementById("crumbs").textContent =
+        `category: ${c.category}`;
+      render(res.items ?? res);
+    };
+    table.append(tr);
+  }
+  box.append(table);
+};
+
+async function loadTags() {
+  const tags = await rspc("tags.list").catch(() => []);
+  const box = document.getElementById("tags");
+  box.innerHTML = "";
+  for (const tag of tags) {
+    const row = el("div", {className: "loc"});
+    const label = el("span");
+    label.append(el("span", {className: "dot",
+      style: tag.color ? `background:${tag.color}` : ""}),
+      document.createTextNode(tag.name));
+    row.append(label);
+    row.onclick = async () => {
+      const res = await rspc("search.paths", {tags: [tag.id], take: 500});
+      document.getElementById("crumbs").textContent = `tag: ${tag.name}`;
+      render(res.items ?? res);
+    };
+    box.append(row);
+  }
+  if (!tags.length)
+    box.append(el("div", {className: "meta"}, "right-click a file to tag"));
+}
+
+async function loadPeers() {
+  const peers = await rspc("p2p.peers", null, null).catch(() => []);
+  const box = document.getElementById("peers");
+  box.innerHTML = "";
+  for (const p of peers) {
+    const row = el("div", {className: "loc", title: p.identity});
+    const label = el("span", {},
+      (p.name || p.identity.slice(0, 10)) +
+      ((p.accelerator || {}).devices ? " ⚡" : ""));
+    row.append(label,
+      el("span", {className: "pill"}, p.connected ? "online" : "seen"));
+    if (p.connected) {
+      const pair = el("button", {title: "pair libraries"}, "pair");
+      pair.onclick = async () => {
+        await rspc("p2p.pair", {peer_id: p.identity}, null);
+        pair.textContent = "sent";
+      };
+      row.append(pair);
+    }
+    box.append(row);
+  }
+  if (!peers.length)
+    box.className = "meta", box.textContent = "none discovered";
+}
+setInterval(loadPeers, 10000);
 
 // live updates: jobs.progress + invalidation over the rspc websocket.
 // ONE resubscribe interval lives outside connectWs (reconnects must not
@@ -298,7 +426,8 @@ function connectWs() {
   };
 }
 
-loadLibraries().then(connectWs).catch(e => {
+loadLibraries().then(() => { connectWs(); loadTags(); loadPeers(); })
+  .catch(e => {
   document.getElementById("status").textContent = e.message;
 });
 </script>

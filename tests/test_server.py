@@ -404,3 +404,24 @@ def test_web_ui_served_and_invalidation_stream(server_proc):
         assert got and got["kind"] == "invalidate_query", got
     finally:
         ws.close()
+
+
+def test_webui_and_category_click_through(server_proc):
+    """The embedded explorer serves its new views (overview, tags, peers)
+    and the category → kinds click-through contract it relies on."""
+    _proc, port, _tree = server_proc
+    base = _base(port)
+    status, _h, body = _get(base, "/")
+    page = body.decode()
+    assert status == 200
+    for marker in ('data-view="overview"', 'data-view="duplicates"',
+                   'id="tags"', 'id="peers"', "libraries.statistics",
+                   "tags.assign", "object_ids", "setFavorite"):
+        assert marker in page, f"explorer missing {marker}"
+    libs = _rspc(base, "libraries.list")
+    lib_id = libs[0]["id"]
+    cats = _rspc(base, "categories.list", None, lib_id)
+    by_name = {c["category"]: c for c in cats}
+    assert "kinds" in by_name["Documents"], "categories must expose kinds"
+    stats = _rspc(base, "libraries.statistics", None, lib_id)
+    assert int(stats["total_object_count"]) >= 0
