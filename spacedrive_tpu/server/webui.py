@@ -350,7 +350,15 @@ async function loadPeers() {
         await rspc("p2p.pair", {peer_id: p.identity}, null);
         pair.textContent = "sent";
       };
-      row.append(pair);
+      const drop = el("button", {title: "spacedrop a file"}, "drop");
+      drop.onclick = async () => {
+        const path = prompt("absolute path of the file to send:");
+        if (!path) return;
+        await rspc("p2p.spacedrop", {peer_id: p.identity, paths: [path]}, null);
+        drop.textContent = "sent";
+        setTimeout(() => { drop.textContent = "drop"; }, 3000);
+      };
+      row.append(pair, drop);
     }
     box.append(row);
   }
@@ -388,6 +396,8 @@ function connectWs() {
     status.textContent = "live";
     ws.send(JSON.stringify({id: 1, method: "subscription",
       params: {path: "invalidation.listen", input: null}}));
+    ws.send(JSON.stringify({id: 4, method: "subscription",
+      params: {path: "p2p.events", input: null}}));
   };
   ws.onclose = () => {
     status.textContent = "disconnected — retrying…";
@@ -416,6 +426,18 @@ function connectWs() {
           row.remove(); }, 4000);
         box.append(row);
       }
+    }
+    if (msg.id === 4 && data.kind === "p2p") {
+      const ev = data.payload || {};
+      if (ev.type === "SpacedropRequest") {
+        const ok = confirm(
+          `Accept spacedrop "${ev.name}" (${fmtSize(ev.size)}) from ` +
+          `${(ev.identity || "").slice(0, 10)}…?`);
+        const dir = ok ? prompt("save into directory:", "/tmp") : null;
+        rspc("p2p.acceptSpacedrop", {id: ev.id, target_dir: dir}, null);
+      }
+      if (["ConnectedPeer", "DisconnectedPeer", "DiscoveredPeer",
+           "ExpiredPeer"].includes(ev.type)) loadPeers();
     }
     if (msg.id === 1 && data.kind === "invalidate_query") {
       const key = data.payload?.key;
