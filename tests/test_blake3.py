@@ -125,3 +125,30 @@ def test_cas_id_shrunk_file_raises(tmp_path):
     p.write_bytes(b"x" * 1000)
     with pytest.raises(EOFError):
         generate_cas_id(p, size=2000)  # stat lied / file truncated mid-scan
+
+
+@pytest.mark.parametrize(
+    "n",
+    # straddle the SIMD group boundaries: 8 chunks (AVX2) and 16 (AVX-512),
+    # with full/partial tails, plus a multi-group multi-MB input
+    [8 * 1024 - 1, 8 * 1024, 8 * 1024 + 1, 16 * 1024 - 1, 16 * 1024,
+     16 * 1024 + 1, 24 * 1024, 17 * 1024 + 5, 1 << 20, (1 << 20) + 321,
+     3 * 1024 * 1024 + 17],
+)
+def test_native_simd_matches_oracle(n):
+    """The native C++ hasher (AVX-512/AVX2 chunk lanes, runtime-dispatched)
+    must byte-match the pure-Python oracle across group boundaries — this
+    covers the validator's full-file path too (sd_blake3_file_hex shares
+    the tree)."""
+    cas_native = pytest.importorskip("spacedrive_tpu.native.cas_native")
+    rng = random.Random(n)
+    data = rng.randbytes(n)
+    assert cas_native.blake3_hex(data) == blake3(data).hex()
+
+
+def test_native_file_hash_matches_oracle(tmp_path):
+    cas_native = pytest.importorskip("spacedrive_tpu.native.cas_native")
+    data = random.Random(9).randbytes(2 * 1024 * 1024 + 777)
+    p = tmp_path / "big.bin"
+    p.write_bytes(data)
+    assert cas_native.blake3_file_hex(p) == blake3(data).hex()
