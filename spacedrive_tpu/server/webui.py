@@ -87,6 +87,7 @@ INDEX_HTML = r"""<!doctype html>
   <h2>Views</h2>
   <div class="loc" data-view="overview">overview</div>
   <div class="loc" data-view="duplicates">near-duplicates</div>
+  <div class="loc" data-view="ephemeral">browse host path…</div>
   <h2>Tags</h2>
   <div id="tags"></div>
   <h2>Peers</h2>
@@ -309,6 +310,55 @@ document.querySelector('[data-view="overview"]').onclick = async () => {
     table.append(tr);
   }
   box.append(table);
+};
+
+// non-indexed browsing (search.ephemeralPaths): any host directory, with
+// on-the-fly thumbnails, no library writes
+async function browseEphemeral(path) {
+  const res = await rspc("search.ephemeralPaths",
+    {path, with_thumbnails: true}, null);
+  const c = document.getElementById("crumbs");
+  c.innerHTML = "";
+  let acc = "";
+  for (const part of path.split("/").filter(Boolean)) {
+    acc += "/" + part;
+    const target = acc;
+    c.append(document.createTextNode(" / "));
+    const a = el("a", {}, part);
+    a.onclick = () => browseEphemeral(target);
+    c.append(a);
+  }
+  c.append(document.createTextNode("  (not indexed)"));
+  const box = document.getElementById("content");
+  box.className = "grid";
+  box.innerHTML = "";
+  const entries = res.entries ?? [];
+  entries.sort((a, b) => (b.is_dir - a.is_dir)
+    || (a.name ?? "").localeCompare(b.name ?? ""));
+  for (const it of entries) {
+    const card = el("div", {className: "item"});
+    const thumb = el("div", {className: "thumb"});
+    if (it.has_thumbnail && it.cas_id) {
+      const img = el("img", {loading: "lazy",
+        src: `/spacedrive/thumbnail/${it.cas_id.slice(0,2)}/${it.cas_id}.webp`});
+      img.onerror = () => { thumb.textContent = KIND_ICONS[it.kind] || "📄"; };
+      thumb.append(img);
+    } else {
+      thumb.textContent = KIND_ICONS[it.is_dir ? 2 : (it.kind ?? 0)] || "📄";
+    }
+    const full = it.name + (it.extension && !it.is_dir ? "." + it.extension : "");
+    card.append(thumb, el("div", {className: "name", title: it.path}, full),
+      el("div", {className: "meta"},
+         it.is_dir ? "folder" : fmtSize(it.size_in_bytes)));
+    if (it.is_dir) card.onclick = () => browseEphemeral(it.path);
+    box.append(card);
+  }
+  if (!entries.length) box.append(el("div", {className: "meta"}, "empty"));
+}
+
+document.querySelector('[data-view="ephemeral"]').onclick = () => {
+  const path = prompt("absolute directory to browse:", "/");
+  if (path) browseEphemeral(path);
 };
 
 async function loadTags() {
