@@ -56,6 +56,20 @@ class IsolatedFilePathData:
         return cls(location_id, parent, stem, ext.lower(), False)
 
     @classmethod
+    def from_parts(cls, location_id: int, rel_dir: str, leaf: str,
+                   is_dir: bool) -> "IsolatedFilePathData":
+        """Fast constructor for the walker's hot loop: the caller already
+        holds the parent dir (location-relative, no slashes wrapping) and
+        the entry name — pure string ops, no PurePosixPath parsing."""
+        parent = f"/{rel_dir}/" if rel_dir else "/"
+        if is_dir:
+            return cls(location_id, parent, leaf, "", True)
+        stem, dot, ext = leaf.rpartition(".")
+        if not dot or not stem:
+            return cls(location_id, parent, leaf, "", False)
+        return cls(location_id, parent, stem, ext.lower(), False)
+
+    @classmethod
     def from_db_row(cls, row: dict[str, Any]) -> "IsolatedFilePathData":
         return cls(
             location_id=row["location_id"],
@@ -124,12 +138,13 @@ class FilePathMetadata:
     hidden: bool
 
     @classmethod
-    def from_stat(cls, path: Path, st: os.stat_result) -> "FilePathMetadata":
+    def from_stat(cls, path: "Path | str", st: os.stat_result) -> "FilePathMetadata":
+        name = path if isinstance(path, str) else path.name
         return cls(
             inode=st.st_ino,
             device=st.st_dev,
             size_in_bytes=st.st_size,
             created_at=getattr(st, "st_ctime", 0.0),
             modified_at=st.st_mtime,
-            hidden=path.name.startswith("."),
+            hidden=name.startswith("."),
         )
