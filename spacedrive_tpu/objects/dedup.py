@@ -27,15 +27,80 @@ logger = logging.getLogger(__name__)
 SAMPLED_STRIDE = ((SAMPLED_MESSAGE_LEN + 1023) // 1024) * 1024  # 58368
 
 
-def find_near_duplicates(library: "Library", location_id: int | None = None,
-                         threshold: float = 0.8,
-                         limit: int = 8192) -> dict[str, Any]:
-    """Similarity groups among sampled-size files. Returns
-    {groups: [[file_path rows...]], scanned, errors}."""
+#: above this row count the all-pairs device sweep gives way to LSH
+#: banding (candidate buckets + exact verification) — O(N·BANDS) instead
+#: of O(N²K)
+ALL_PAIRS_LIMIT = 8192
+
+#: signature batch per device pass (gather + minhash)
+SIG_BATCH = 8192
+
+
+def _paths_of(db, rows_db) -> tuple[list[str], list[int]]:
+    from .fs import location_path_of
+
+    paths, sizes = [], []
+    roots: dict[int, Any] = {}
+    for r in rows_db:
+        loc = r["location_id"]
+        if loc not in roots:
+            roots[loc] = location_path_of(db, loc)
+        rel = (r["materialized_path"] or "/").lstrip("/")
+        name = r["name"] + (f".{r['extension']}" if r["extension"] else "")
+        paths.append(str(roots[loc] / rel / name))
+        sizes.append(r["size_in_bytes"])
+    return paths, sizes
+
+
+def _signatures(paths: list[str], sizes: list[int],
+                errors: list[str]) -> tuple[np.ndarray, np.ndarray]:
+    """(n, K) uint32 MinHash signatures + lengths, computed in SIG_BATCH
+    device passes so corpus size never explodes host/device memory."""
     import jax
 
-    from ..ops.minhash import (K, minhash_rows, pad_for_blocks,
-                               similar_pairs_count)
+    from ..ops.minhash import K, minhash_rows
+
+    n = len(paths)
+    sigs = np.zeros((n, K), np.uint32)
+    lengths = np.zeros(n, np.int32)
+    for start in range(0, n, SIG_BATCH):
+        stop = min(n, start + SIG_BATCH)
+        cnt = stop - start
+        buf = np.zeros((cnt, SAMPLED_STRIDE), np.uint8)
+        lens = np.zeros(cnt, np.int32)
+        try:
+            from ..native import cas_native
+
+            cas_native.gather_batch(paths[start:stop], sizes[start:stop],
+                                    buf, lens)
+        except Exception:
+            from .cas import read_sampled_batch
+
+            msgs = read_sampled_batch(paths[start:stop], sizes[start:stop])
+            for i, m in enumerate(msgs):
+                if isinstance(m, Exception):
+                    errors.append(f"{paths[start + i]}: {m}")
+                    continue
+                buf[i, : len(m)] = np.frombuffer(m, np.uint8)
+                lens[i] = len(m)
+        sigs[start:stop] = np.asarray(minhash_rows(
+            jax.device_put(buf.view(np.uint32).reshape(cnt, SAMPLED_STRIDE // 4)),
+            jax.device_put(lens)))
+        lengths[start:stop] = lens
+    errors += [paths[i] for i in range(n) if lengths[i] == 0]
+    return sigs, lengths
+
+
+def find_near_duplicates(library: "Library", location_id: int | None = None,
+                         threshold: float = 0.8, limit: int = ALL_PAIRS_LIMIT,
+                         method: str = "auto") -> dict[str, Any]:
+    """Similarity groups among sampled-size files. Returns
+    {groups: [[file_path rows...]], pairs, scanned, method, errors}.
+
+    ``method``: ``all_pairs`` (device O(N²K) sweep), ``banded`` (LSH
+    candidate buckets + exact verify, corpus-scale), or ``auto`` (all-pairs
+    up to ALL_PAIRS_LIMIT rows, banded beyond)."""
+    from ..ops.minhash import K
 
     db = library.db
     where = "is_dir = 0 AND size_in_bytes > ?"
@@ -46,89 +111,102 @@ def find_near_duplicates(library: "Library", location_id: int | None = None,
     rows_db = [FilePath.decode_row(r) for r in db.query(
         f"SELECT * FROM file_path WHERE {where} ORDER BY id LIMIT ?",
         params + [limit])]
-    if len(rows_db) < 2:
-        return {"groups": [], "pairs": [], "scanned": len(rows_db), "errors": []}
+    n = len(rows_db)
+    if n < 2:
+        return {"groups": [], "pairs": [], "scanned": n, "errors": [],
+                "method": "none"}
+    if method == "auto":
+        method = "all_pairs" if n <= ALL_PAIRS_LIMIT else "banded"
 
-    from .fs import location_path_of
-
-    paths, sizes, errors = [], [], []
-    roots: dict[int, Any] = {}
-    for r in rows_db:
-        loc = r["location_id"]
-        if loc not in roots:
-            roots[loc] = location_path_of(db, loc)
-        rel = (r["materialized_path"] or "/").lstrip("/")
-        name = r["name"] + (f".{r['extension']}" if r["extension"] else "")
-        paths.append(str(roots[loc] / rel / name))
-        sizes.append(r["size_in_bytes"])
-
-    # gather sampled rows (native if available, python fallback)
-    n = len(paths)
-    buf = np.zeros((n, SAMPLED_STRIDE), np.uint8)
-    lengths = np.zeros(n, np.int32)
-    try:
-        from ..native import cas_native
-
-        cas_native.gather_batch(paths, sizes, buf, lengths)
-    except Exception:
-        from .cas import read_sampled_batch
-
-        msgs = read_sampled_batch(paths, sizes)
-        for i, m in enumerate(msgs):
-            if isinstance(m, Exception):
-                errors.append(f"{paths[i]}: {m}")
-                continue
-            buf[i, : len(m)] = np.frombuffer(m, np.uint8)
-            lengths[i] = len(m)
-    errors += [paths[i] for i in range(n) if lengths[i] == 0]
-
-    sigs = np.asarray(minhash_rows(
-        jax.device_put(buf.view(np.uint32).reshape(n, SAMPLED_STRIDE // 4)),
-        jax.device_put(lengths)))
-    sigs_p, valid = pad_for_blocks(sigs)
-    valid[:n] &= lengths > 0
-
+    errors: list[str] = []
+    paths, sizes = _paths_of(db, rows_db)
+    sigs, lengths = _signatures(paths, sizes, errors)
     thr_k = max(1, int(threshold * K))
+
+    if method == "banded":
+        raw_pairs = _banded_pairs(sigs, lengths > 0, thr_k, errors)
+    else:
+        raw_pairs = _all_pairs(sigs, lengths > 0, thr_k)
+
+    # union-find grouping from verified pairs
+    parent = list(range(n))
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    pairs: list[dict[str, Any]] = []
+    for i, j, m in raw_pairs:
+        pairs.append({"a": rows_db[i], "b": rows_db[j],
+                      "similarity": float(m) / K})
+        parent[find(j)] = find(i)
+    members: dict[int, list[int]] = {}
+    linked = {i for i, _j, _m in raw_pairs} | {j for _i, j, _m in raw_pairs}
+    for i in linked:
+        members.setdefault(find(i), []).append(i)
+    out_groups = [[rows_db[i] for i in sorted(ids)]
+                  for ids in members.values() if len(ids) > 1]
+    return {"groups": out_groups, "pairs": pairs, "scanned": n,
+            "errors": errors, "method": method}
+
+
+def _all_pairs(sigs: np.ndarray, valid_rows: np.ndarray,
+               thr_k: int) -> list[tuple[int, int, int]]:
+    """Device all-pairs sweep → verified (i, j, matches) pairs."""
+    import jax
+
+    from ..ops.minhash import pad_for_blocks, similar_pairs_count
+
+    n = sigs.shape[0]
+    sigs_p, valid = pad_for_blocks(sigs)
+    valid[:n] &= valid_rows
     _total, dup = similar_pairs_count(jax.device_put(sigs_p),
                                       jax.device_put(valid), thr_k)
     dup = np.asarray(dup)[:n]
-
-    # group on host: union by best-match (pairwise check only against flagged
-    # rows keeps this O(n_dup * n))
-    groups: dict[int, list[int]] = {}
-    assigned: dict[int, int] = {}
-    pairs: list[dict[str, Any]] = []
-    flagged = [i for i in range(n) if dup[i]]
-    for i in flagged:
+    out: list[tuple[int, int, int]] = []
+    for i in range(n):
+        if not dup[i]:
+            continue
         eq = (sigs[i][None, :] == sigs[:i]).sum(axis=1)
+        eq[~valid_rows[:i]] = 0
         j = int(np.argmax(eq))
         if eq[j] >= thr_k:
-            root = assigned.get(j, j)
-            groups.setdefault(root, [root] if root not in assigned else []).append(i)
-            assigned[i] = root
-            pairs.append({"a": rows_db[j], "b": rows_db[i],
-                          "similarity": float(eq[j]) / K})
-    out_groups = []
-    for root, members in groups.items():
-        ids = sorted({root, *members})
-        out_groups.append([rows_db[i] for i in ids])
-    return {"groups": out_groups, "pairs": pairs, "scanned": n,
-            "errors": errors}
+            out.append((j, i, int(eq[j])))
+    return out
+
+
+def _banded_pairs(sigs: np.ndarray, valid_rows: np.ndarray, thr_k: int,
+                  errors: list[str]) -> list[tuple[int, int, int]]:
+    """LSH banding: bucket by band keys, exact-verify candidates."""
+    from ..ops.minhash import (band_keys, banded_candidate_pairs,
+                               verify_pairs)
+
+    keys = band_keys(sigs)
+    cand, oversized = banded_candidate_pairs(keys, valid_rows)
+    if oversized:
+        errors.append(
+            f"{oversized} degenerate LSH buckets skipped (> bucket cap); "
+            "their members were not compared")
+    return verify_pairs(sigs, cand, thr_k)
 
 
 class DedupDetectorJob(StatefulJob):
     """Chained detector persisting near-dup pairs into `near_duplicate`
     (this framework's 4th pipeline stage after indexer → identifier →
     media; the reference has no analogue — it only collapses exact
-    cas_id matches). One step = one device MinHash batch over up to
-    DEVICE_LIMIT sampled-size files; bigger locations are truncated
-    loudly (no silent caps) until windowed all-pairs lands."""
+    cas_id matches). ≤ ALL_PAIRS_LIMIT files use the device all-pairs
+    sweep; bigger locations switch to LSH banding (candidate buckets +
+    exact verification) up to DEVICE_LIMIT, beyond which the window is
+    truncated loudly (no silent caps)."""
 
     NAME = "dedup_detector"
     IS_BATCHED = True
 
-    #: rows per detection pass (one device all-pairs batch)
-    DEVICE_LIMIT = 8192
+    #: rows per detection pass (signatures stream through the device in
+    #: SIG_BATCH batches; banding keeps candidate generation linear)
+    DEVICE_LIMIT = 131072
 
     def init(self, ctx: WorkerContext):
         db = ctx.library.db

@@ -95,3 +95,65 @@ def test_near_duplicates_api(tmp_path, tmp_data_dir):
         assert names == {"original", "edited"}
     finally:
         node.shutdown()
+
+
+def test_banded_agrees_with_all_pairs_on_synthetic_sigs():
+    """LSH banding must find the same verified pairs as the exhaustive
+    sweep at the 0.8 threshold (its candidate recall there is ~0.9998)."""
+    import numpy as np
+
+    from spacedrive_tpu.ops.minhash import (K, band_keys,
+                                            banded_candidate_pairs,
+                                            verify_pairs)
+
+    rng = np.random.default_rng(4)
+    n = 2000
+    sigs = rng.integers(0, 2**32, (n, K), dtype=np.uint64).astype(np.uint32)
+    planted = set()
+    for a, b, frac in [(3, 77, 0.95), (100, 101, 0.85), (500, 1999, 1.0),
+                       (800, 801, 0.82)]:
+        keep = int(frac * K)
+        sigs[b, :keep] = sigs[a, :keep]
+        planted.add((a, b))
+    # below threshold: must NOT surface
+    sigs[900, : int(0.5 * K)] = sigs[901, : int(0.5 * K)]
+
+    thr_k = int(0.8 * K)
+    keys = band_keys(sigs)
+    cand, oversized = banded_candidate_pairs(keys, np.ones(n, bool))
+    got = {(i, j) for i, j, _m in verify_pairs(sigs, cand, thr_k)}
+    assert oversized == 0
+    assert got == planted, got
+
+
+def test_banded_find_near_duplicates_end_to_end(tmp_path, tmp_data_dir):
+    """Forcing method='banded' on a real library surfaces the planted
+    near-dup family with the same output shape as the all-pairs path."""
+    from spacedrive_tpu.objects.dedup import find_near_duplicates
+
+    tree = tmp_path / "corpus"
+    tree.mkdir()
+    rng = random.Random(17)
+    base = bytearray(rng.randbytes(200_000))
+    (tree / "a.bin").write_bytes(base)
+    near = bytearray(base)
+    for _ in range(20):
+        near[rng.randrange(len(near))] ^= 0xFF
+    (tree / "b.bin").write_bytes(near)
+    for i in range(10):
+        (tree / f"noise{i}.bin").write_bytes(rng.randbytes(150_000))
+
+    node = Node(tmp_data_dir, probe_accelerator=False)
+    try:
+        lib = node.libraries.create("banded")
+        loc = create_location(lib, str(tree), hasher="cpu")
+        scan_location(lib, loc["id"])
+        assert node.jobs.wait_idle(90)
+        res = find_near_duplicates(lib, loc["id"], method="banded")
+        assert res["method"] == "banded"
+        names = {frozenset(r["name"] for r in g) for g in res["groups"]}
+        assert frozenset({"a", "b"}) in names
+        assert len(res["pairs"]) == 1
+        assert res["pairs"][0]["similarity"] >= 0.8
+    finally:
+        node.shutdown()
