@@ -356,6 +356,11 @@ def test_wire_is_encrypted_after_ephemerals(two_nodes):
     import threading
 
     a, b = two_nodes
+    # default host names can be 2 chars ("vm") — too short for a substring
+    # leak check against random ciphertext (a 2-byte pattern appears by
+    # chance in a few KB). Use distinctive names for the assertion.
+    a.config.write(name="wire-check-node-alpha")
+    b.config.write(name="wire-check-node-bravo")
     captured = bytearray()
     done = threading.Event()
 
@@ -730,3 +735,35 @@ def test_remote_hasher_service(two_nodes, tmp_path):
     hasher_local = RemoteHasher(c)  # c has no p2p loop anymore: forces fallback
     ids2 = hasher_local.hash_batch([p for p, _ in files], [s for _, s in files])
     assert ids2 == ids
+
+
+def test_remote_hasher_splits_wire_batches(two_nodes, tmp_path):
+    """A batch whose cas messages exceed WIRE_BATCH_BYTES must split into
+    multiple H_HASH requests and still return byte-exact ids in order."""
+    from spacedrive_tpu.objects.cas import generate_cas_id
+    from spacedrive_tpu.objects.hasher import RemoteHasher
+
+    a, b = two_nodes
+    a.config.write(accelerator={"kind": "tpu", "devices": 1, "mesh": [1]})
+    lib_a = a.libraries.create("split-lib")
+    a.config.write(p2p_auto_accept_library=lib_a.id)
+    b.router.resolve("p2p.pair", {"peer_id": addr_of(a)})
+    wait_for(lambda: any(p["connected"] and (p.get("accelerator") or {})
+                         .get("devices") for p in b.p2p.peer_list()),
+             msg="accelerator peer visible")
+
+    hasher = RemoteHasher(b)
+    hasher.WIRE_BATCH_BYTES = 1 << 20  # force splitting without 100MB of IO
+    rng = __import__("random").Random(3)
+    paths, sizes = [], []
+    for i in range(40):  # 40 × ~57KiB messages ≈ 2.2 MiB -> ≥3 wire batches
+        p = tmp_path / f"s{i}.bin"
+        p.write_bytes(rng.randbytes(150 * 1024))
+        paths.append(p)
+        sizes.append(150 * 1024)
+    batches = hasher._wire_batches(list(range(40)),
+                                   [b"x" * 57352] * 40)
+    assert len(batches) >= 3
+
+    ids = hasher.hash_batch(paths, sizes)
+    assert ids == [generate_cas_id(p, s) for p, s in zip(paths, sizes)]
