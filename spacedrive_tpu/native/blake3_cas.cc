@@ -141,13 +141,6 @@ Node parent_node(const uint32_t l[8], const uint32_t r[8]) {
   return n;
 }
 
-// left subtree takes the largest power-of-two chunk count < total
-size_t left_chunks(size_t n_chunks) {
-  size_t p = 1;
-  while (p * 2 < n_chunks) p *= 2;
-  return p;
-}
-
 #if defined(__x86_64__)
 
 __attribute__((target("avx2"))) inline __m256i rotr16v(__m256i x) {
@@ -323,63 +316,74 @@ bool have_avx512() {
 
 #endif  // __x86_64__
 
-// Chained CVs for every chunk of a multi-chunk input: SIMD groups of 8
-// full chunks where available, scalar for the remainder + partial tail.
-void hash_chunk_cvs(const uint8_t* data, size_t len, uint64_t counter0,
-                    std::vector<std::array<uint32_t, 8>>& cvs) {
-  size_t n_chunks = (len + CHUNK_LEN - 1) / CHUNK_LEN;
-  size_t full = (len % CHUNK_LEN == 0) ? n_chunks : n_chunks - 1;
-  size_t i = 0;
-#if defined(__x86_64__)
-  if (have_avx512()) {
-    for (; i + 16 <= full; i += 16) {
-      uint32_t out[16][8];
-      hash16_full_chunks(data + i * CHUNK_LEN, counter0 + i, out);
-      for (int l = 0; l < 16; l++)
-        std::memcpy(cvs[i + l].data(), out[l], 32);
-    }
-  }
-  if (have_avx2()) {
-    for (; i + 8 <= full; i += 8) {
-      uint32_t out[8][8];
-      hash8_full_chunks(data + i * CHUNK_LEN, counter0 + i, out);
-      for (int l = 0; l < 8; l++)
-        std::memcpy(cvs[i + l].data(), out[l], 32);
-    }
-  }
-#endif
-  for (; i < n_chunks; i++) {
-    size_t off = i * CHUNK_LEN;
-    size_t clen = len - off < CHUNK_LEN ? len - off : CHUNK_LEN;
-    uint32_t cv[8];
-    chain(chunk_node(data + off, clen, counter0 + i), cv);
-    std::memcpy(cvs[i].data(), cv, 32);
-  }
-}
+// Incremental log-depth merge stack (the spec's streaming construction):
+// chunk CVs push left-to-right and completed equal-size subtrees fold
+// eagerly, so memory stays O(log n) for multi-GB inputs (the mmap'd
+// full-file path must not allocate size/32 bytes of CV buffer).
+struct MergeStack {
+  std::array<uint32_t, 8> stack[64];
+  size_t depth = 0;
+  uint64_t added = 0;
 
-void subtree_cv(const std::vector<std::array<uint32_t, 8>>& cvs, size_t first,
-                size_t count, uint32_t out[8]) {
-  if (count == 1) {
-    std::memcpy(out, cvs[first].data(), 32);
-    return;
+  void push_cv(const uint32_t cv[8]) {
+    std::array<uint32_t, 8> top;
+    std::memcpy(top.data(), cv, 32);
+    added++;
+    for (uint64_t t = added; (t & 1) == 0; t >>= 1) {
+      uint32_t merged[8];
+      chain(parent_node(stack[depth - 1].data(), top.data()), merged);
+      std::memcpy(top.data(), merged, 32);
+      depth--;
+    }
+    std::memcpy(stack[depth].data(), top.data(), 32);
+    depth++;
   }
-  size_t lc = left_chunks(count);
-  uint32_t l[8], r[8];
-  subtree_cv(cvs, first, lc, l);
-  subtree_cv(cvs, first + lc, count - lc, r);
-  chain(parent_node(l, r), out);
-}
+
+  // fold everything below the final (rightmost) subtree; returns the
+  // UNFINALIZED root node (the caller applies ROOT)
+  Node finish(const Node& last) {
+    uint32_t right[8];
+    chain(last, right);
+    while (depth > 1) {
+      uint32_t merged[8];
+      chain(parent_node(stack[depth - 1].data(), right), merged);
+      std::memcpy(right, merged, 32);
+      depth--;
+    }
+    return parent_node(stack[0].data(), right);
+  }
+};
 
 Node tree(const uint8_t* data, size_t len, uint64_t counter) {
   if (len <= CHUNK_LEN) return chunk_node(data, len, counter);
   size_t n_chunks = (len + CHUNK_LEN - 1) / CHUNK_LEN;
-  std::vector<std::array<uint32_t, 8>> cvs(n_chunks);
-  hash_chunk_cvs(data, len, counter, cvs);
-  size_t lc = left_chunks(n_chunks);
-  uint32_t l[8], r[8];
-  subtree_cv(cvs, 0, lc, l);
-  subtree_cv(cvs, lc, n_chunks - lc, r);
-  return parent_node(l, r);
+  size_t prefix = n_chunks - 1;  // all full; the last chunk may be partial
+  MergeStack ms;
+  size_t i = 0;
+#if defined(__x86_64__)
+  if (have_avx512()) {
+    for (; i + 16 <= prefix; i += 16) {
+      uint32_t out[16][8];
+      hash16_full_chunks(data + i * CHUNK_LEN, counter + i, out);
+      for (int l = 0; l < 16; l++) ms.push_cv(out[l]);
+    }
+  }
+  if (have_avx2()) {
+    for (; i + 8 <= prefix; i += 8) {
+      uint32_t out[8][8];
+      hash8_full_chunks(data + i * CHUNK_LEN, counter + i, out);
+      for (int l = 0; l < 8; l++) ms.push_cv(out[l]);
+    }
+  }
+#endif
+  for (; i < prefix; i++) {
+    uint32_t cv[8];
+    chain(chunk_node(data + i * CHUNK_LEN, CHUNK_LEN, counter + i), cv);
+    ms.push_cv(cv);
+  }
+  Node last = chunk_node(data + prefix * CHUNK_LEN, len - prefix * CHUNK_LEN,
+                         counter + prefix);
+  return ms.finish(last);
 }
 
 void blake3_digest(const uint8_t* data, size_t len, uint8_t out[32]) {

@@ -124,6 +124,15 @@ def find_near_duplicates(library: "Library", location_id: int | None = None,
     thr_k = max(1, int(threshold * K))
 
     if method == "banded":
+        if threshold < 0.7:
+            # BANDS/BAND_ROWS are tuned for the 0.8 default; candidate
+            # recall degrades at low thresholds (≈0.64 at s=0.5) — say so
+            # instead of silently under-reporting vs the all-pairs path
+            errors.append(
+                f"banded LSH recall degrades below threshold 0.7 "
+                f"(requested {threshold}); pairs near the threshold may "
+                "be missed — force method='all_pairs' for exhaustive "
+                "comparison")
         raw_pairs = _banded_pairs(sigs, lengths > 0, thr_k, errors)
     else:
         raw_pairs = _all_pairs(sigs, lengths > 0, thr_k)
@@ -137,11 +146,25 @@ def find_near_duplicates(library: "Library", location_id: int | None = None,
             x = parent[x]
         return x
 
-    pairs: list[dict[str, Any]] = []
-    for i, j, m in raw_pairs:
-        pairs.append({"a": rows_db[i], "b": rows_db[j],
-                      "similarity": float(m) / K})
+    for i, j, _m in raw_pairs:
         parent[find(j)] = find(i)
+
+    # collapse cliques to spanning pairs: each row keeps only its best
+    # match, so a 200-file family emits ≤199 rows, not 19,900 (the banded
+    # verifier returns full cliques)
+    best: dict[int, tuple[int, int]] = {}
+    for i, j, m in raw_pairs:
+        for x, y in ((i, j), (j, i)):
+            if m > best.get(x, (0, -1))[0]:
+                best[x] = (m, y)
+    edges: dict[tuple[int, int], int] = {}
+    for x, (m, y) in best.items():
+        key = (x, y) if x < y else (y, x)
+        if m > edges.get(key, 0):
+            edges[key] = m
+    pairs = [{"a": rows_db[i], "b": rows_db[j], "similarity": float(m) / K}
+             for (i, j), m in sorted(edges.items())]
+
     members: dict[int, list[int]] = {}
     linked = {i for i, _j, _m in raw_pairs} | {j for _i, j, _m in raw_pairs}
     for i in linked:
@@ -187,8 +210,9 @@ def _banded_pairs(sigs: np.ndarray, valid_rows: np.ndarray, thr_k: int,
     cand, oversized = banded_candidate_pairs(keys, valid_rows)
     if oversized:
         errors.append(
-            f"{oversized} degenerate LSH buckets skipped (> bucket cap); "
-            "their members were not compared")
+            f"{oversized} oversized LSH buckets collapsed to "
+            "representative pairing (members compared against one "
+            "representative instead of all-pairs)")
     return verify_pairs(sigs, cand, thr_k)
 
 

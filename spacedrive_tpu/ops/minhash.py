@@ -152,9 +152,10 @@ def pad_for_blocks(sigs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 BANDS = 16
 BAND_ROWS = K // BANDS  # 4
 
-#: buckets larger than this contribute no pairs (a degenerate bucket —
-#: thousands of identical trivial signatures — would re-quadratize the
-#: pass); callers surface the skip count
+#: buckets larger than this pair members against ONE representative
+#: instead of all-pairs (a bucket of thousands of identical signatures —
+#: exactly the most-duplicated content — must stay detected without
+#: re-quadratizing the pass); callers surface how many were collapsed
 MAX_BUCKET = 256
 
 
@@ -178,7 +179,10 @@ def band_keys(sigs: np.ndarray) -> np.ndarray:
 def banded_candidate_pairs(keys: np.ndarray,
                            valid: np.ndarray) -> tuple[set, int]:
     """Candidate (i, j) pairs (i < j) from shared band buckets; returns
-    (pairs, oversized_bucket_count)."""
+    (pairs, oversized_bucket_count). Oversized buckets collapse to
+    representative pairing — (first member, each other member) — keeping
+    candidate generation linear while every member stays reachable (the
+    later union-find re-joins the clique through the representative)."""
     buckets: dict = {}
     n = keys.shape[0]
     for i in range(n):
@@ -194,6 +198,9 @@ def banded_candidate_pairs(keys: np.ndarray,
             continue
         if len(members) > MAX_BUCKET:
             oversized += 1
+            rep = members[0]
+            for m in members[1:]:
+                pairs.add((rep, m) if rep < m else (m, rep))
             continue
         for x in range(len(members)):
             for y in range(x + 1, len(members)):

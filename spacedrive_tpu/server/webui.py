@@ -173,6 +173,7 @@ function crumbs() {
 
 async function browse() {
   if (state.library === null || state.location === null) return;
+  state.ephemeralPath = null;  // leaving ephemeral view stops its retries
   crumbs();
   const res = await rspc("search.paths",
     {location_id: state.location, materialized_path: state.dir, take: 500});
@@ -317,6 +318,7 @@ document.querySelector('[data-view="overview"]').onclick = async () => {
 async function browseEphemeral(path) {
   const res = await rspc("search.ephemeralPaths",
     {path, with_thumbnails: true}, null);
+  state.ephemeralPath = path;
   const c = document.getElementById("crumbs");
   c.innerHTML = "";
   let acc = "";
@@ -329,6 +331,21 @@ async function browseEphemeral(path) {
     c.append(a);
   }
   c.append(document.createTextNode("  (not indexed)"));
+  const errs = res.errors ?? [];
+  const deferred = errs.some(e => String(e).includes("deferred"));
+  if (errs.length) {
+    const note = el("span", {className: "pill",
+      title: errs.join("\n")},
+      deferred ? " generating previews…" : ` ${errs.length} errors`);
+    c.append(document.createTextNode(" "), note);
+  }
+  if (deferred) {
+    // the endpoint caps preview generation per request — keep re-asking
+    // while the user is still on this directory
+    setTimeout(() => {
+      if (state.ephemeralPath === path) browseEphemeral(path);
+    }, 1200);
+  }
   const box = document.getElementById("content");
   box.className = "grid";
   box.innerHTML = "";

@@ -157,3 +157,48 @@ def test_banded_find_near_duplicates_end_to_end(tmp_path, tmp_data_dir):
         assert res["pairs"][0]["similarity"] >= 0.8
     finally:
         node.shutdown()
+
+
+def test_oversized_bucket_collapses_to_representative():
+    """A mega-group (hundreds of identical signatures) must stay detected
+    — members pair against a representative instead of being skipped."""
+    import numpy as np
+
+    from spacedrive_tpu.ops.minhash import (K, band_keys,
+                                            banded_candidate_pairs,
+                                            verify_pairs)
+
+    rng = np.random.default_rng(6)
+    n = 400
+    sigs = rng.integers(0, 2**32, (n, K), dtype=np.uint64).astype(np.uint32)
+    sigs[:300] = sigs[0]  # 300 identical files > MAX_BUCKET
+    keys = band_keys(sigs)
+    cand, oversized = banded_candidate_pairs(keys, np.ones(n, bool))
+    assert oversized > 0
+    ver = verify_pairs(sigs, cand, int(0.8 * K))
+    covered = {i for i, _j, _m in ver} | {j for _i, j, _m in ver}
+    assert set(range(300)) <= covered       # everyone reachable
+    assert len(cand) < 2000                 # linear, not 300*299/2
+
+
+def test_spanning_pairs_bound_for_cliques(tmp_path, tmp_data_dir):
+    """k identical files persist ≤ k-1 near_duplicate pairs, not k(k-1)/2."""
+    from spacedrive_tpu.objects.dedup import find_near_duplicates
+
+    tree = tmp_path / "clique"
+    tree.mkdir()
+    base = random.Random(8).randbytes(150_000)
+    for i in range(8):
+        (tree / f"copy{i}.bin").write_bytes(base)
+
+    node = Node(tmp_data_dir, probe_accelerator=False)
+    try:
+        lib = node.libraries.create("clique")
+        loc = create_location(lib, str(tree), hasher="cpu")
+        scan_location(lib, loc["id"])
+        assert node.jobs.wait_idle(90)
+        res = find_near_duplicates(lib, loc["id"], method="banded")
+        assert len(res["groups"]) == 1 and len(res["groups"][0]) == 8
+        assert len(res["pairs"]) <= 7
+    finally:
+        node.shutdown()
