@@ -484,11 +484,25 @@ function connectWs() {
         const total = job.task_count || 1;
         const done = job.completed_task_count || 0;
         const row = el("div", {className: "job"});
-        row.append(el("div", {}, `${job.name || "job"} `),
-                   el("span", {className: "pill"}, `${done}/${total}`));
+        const head = el("div", {style: "display:flex;justify-content:space-between"});
+        head.append(el("span", {}, `${job.name || "job"} `),
+                    el("span", {className: "pill"}, `${done}/${total}`));
+        row.append(head);
         const bar = el("div", {className: "bar"});
         bar.append(el("div", {style: `width:${100 * done / total}%`}));
         row.append(bar);
+        if (done < total) {
+          const ctl = el("div", {style: "margin-top:4px;display:flex;gap:4px"});
+          const pause = el("button", {title: "pause"}, "⏸");
+          pause.onclick = () => rspc("jobs.pause", job.id, null)
+            .catch(() => rspc("jobs.resume", job.id).catch(() => {}));
+          const cancel = el("button", {title: "cancel"}, "✕");
+          cancel.onclick = () => rspc("jobs.cancel", job.id, null)
+            .then(() => { delete jobs[job.id]; row.remove(); })
+            .catch(() => {});
+          ctl.append(pause, cancel);
+          row.append(ctl);
+        }
         if (done >= total) setTimeout(() => { delete jobs[job.id];
           row.remove(); }, 4000);
         box.append(row);
