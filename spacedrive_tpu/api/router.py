@@ -130,14 +130,15 @@ def mount(node: "Node") -> Router:
     """Build the full router (api::mount, mod.rs:102-203) and validate the
     invalidation-key contract."""
     from . import invalidate
-    from .routers import (backups, categories, files, jobs, keys, libraries,
-                          locations, nodes, notifications, p2p, preferences,
-                          root, search, sync, tags, volumes)
+    from .routers import (backups, categories, collections, files, jobs,
+                          keys, libraries, locations, nodes, notifications,
+                          p2p, preferences, root, search, sync, tags,
+                          volumes)
 
     router = Router(node)
     for module in (root, libraries, locations, search, files, jobs, tags,
                    volumes, nodes, notifications, preferences, backups,
-                   categories, sync, p2p, keys):
+                   categories, sync, p2p, keys, collections):
         module.mount(router)
     invalidate.validate(router)
     return router

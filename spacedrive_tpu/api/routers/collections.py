@@ -1,0 +1,78 @@
+"""spaces.* / albums.* / labels.* procedures.
+
+The reference defines these models in schema.prisma (:323-454) but ships
+no procedures for them (the frontend's spaces UI is mock data); here the
+schema gets a working surface: collection CRUD, membership, and member
+listings shaped like search.paths rows so the explorer renders them with
+the same grid.
+"""
+
+from __future__ import annotations
+
+from ...models import Album, Space
+from ...objects import collections as col
+
+
+def _mount_collection(router, key: str, model) -> None:
+    @router.library_query(f"{key}.list")
+    def list_all(node, library, _arg):
+        return col.list_collections(library, model)
+
+    @router.library_mutation(f"{key}.create")
+    def create(node, library, arg):
+        extra = {}
+        if model is Space and isinstance(arg, dict) and arg.get("description"):
+            extra["description"] = arg["description"]
+        if model is Album:
+            extra["is_hidden"] = bool(
+                isinstance(arg, dict) and arg.get("is_hidden"))
+        name = arg["name"] if isinstance(arg, dict) else str(arg)
+        return col.create_collection(library, model, name, **extra)
+
+    @router.library_mutation(f"{key}.update")
+    def update(node, library, arg):
+        values = {k: arg.get(k) for k in ("name", "description", "is_hidden")
+                  if k in model.FIELDS}
+        col.update_collection(library, model, arg["id"], **values)
+        return None
+
+    @router.library_mutation(f"{key}.delete")
+    def delete(node, library, collection_id: int):
+        col.delete_collection(library, model, collection_id)
+        return None
+
+    @router.library_mutation(f"{key}.addObjects")
+    def add_objects(node, library, arg):
+        return col.set_membership(library, model, arg["id"],
+                                  arg["object_ids"])
+
+    @router.library_mutation(f"{key}.removeObjects")
+    def remove_objects(node, library, arg):
+        return col.set_membership(library, model, arg["id"],
+                                  arg["object_ids"], remove=True)
+
+    @router.library_query(f"{key}.objects")
+    def objects(node, library, collection_id: int):
+        return col.collection_objects(library, model, collection_id)
+
+
+def mount(router) -> None:
+    _mount_collection(router, "spaces", Space)
+    _mount_collection(router, "albums", Album)
+
+    @router.library_query("labels.list")
+    def labels_list(node, library, _arg):
+        return library.db.query(
+            "SELECT lb.*, COUNT(lo.object_id) AS object_count FROM label lb "
+            "LEFT JOIN label_on_object lo ON lo.label_id = lb.id "
+            "GROUP BY lb.id ORDER BY lb.name")
+
+    @router.library_query("labels.getForObject")
+    def labels_for_object(node, library, object_id: int):
+        return col.labels_for_object(library, object_id)
+
+    @router.library_mutation("labels.assign")
+    def labels_assign(node, library, arg):
+        label = col.ensure_label(library, arg["name"])
+        return col.label_objects(library, label["id"], arg["object_ids"],
+                                 remove=bool(arg.get("remove")))

@@ -1,0 +1,138 @@
+"""Spaces, albums, labels — the organizational layer above tags.
+
+Reference: schema.prisma:323-454 defines Space/ObjectInSpace,
+Album/ObjectInAlbum, Label/LabelOnObject as LOCAL models (no sync
+annotations — unlike Tag they don't replicate) and ships no procedures
+for them; here the models get a working CRUD + membership surface so the
+schema isn't dead weight. Link rows are unique per (collection, object)
+and deletes clear memberships first (the FK is RESTRICT, matching the
+reference's non-cascading link tables).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import TYPE_CHECKING, Any
+
+from ..models import (Album, FilePath, Label, LabelOnObject, Object,
+                      ObjectInAlbum, ObjectInSpace, Space, utc_now)
+
+if TYPE_CHECKING:
+    from ..library import Library
+
+
+def _invalidate(library: "Library", key: str) -> None:
+    library.emit("invalidate_query", {"key": key})
+
+
+# -- generic collection helpers (Space and Album share their shape) ----------
+
+_LINKS = {Space: (ObjectInSpace, "space_id", "spaces"),
+          Album: (ObjectInAlbum, "album_id", "albums")}
+
+
+def create_collection(library: "Library", model, name: str,
+                      **extra: Any) -> dict[str, Any]:
+    row = {"pub_id": str(uuid.uuid4()), "name": name,
+           "date_created": utc_now(), "date_modified": utc_now(), **extra}
+    library.db.insert(model, row)
+    _invalidate(library, f"{_LINKS[model][2]}.list")
+    return library.db.find_one(model, {"pub_id": row["pub_id"]})
+
+
+def update_collection(library: "Library", model, collection_id: int,
+                      **values: Any) -> None:
+    values = {k: v for k, v in values.items() if v is not None}
+    if not values:
+        return
+    values["date_modified"] = utc_now()
+    library.db.update(model, {"id": collection_id}, values)
+    _invalidate(library, f"{_LINKS[model][2]}.list")
+
+
+def delete_collection(library: "Library", model, collection_id: int) -> None:
+    link_model, fk, key = _LINKS[model]
+    with library.db.transaction():
+        library.db.delete(link_model, {fk: collection_id})
+        library.db.delete(model, {"id": collection_id})
+    _invalidate(library, f"{key}.list")
+
+
+def set_membership(library: "Library", model, collection_id: int,
+                   object_ids: list[int], remove: bool = False) -> int:
+    """Add/remove objects; returns how many links changed."""
+    link_model, fk, key = _LINKS[model]
+    if library.db.find_one(model, {"id": collection_id}) is None:
+        raise ValueError(f"{model.TABLE} {collection_id} not found")
+    changed = 0
+    for oid in object_ids:
+        if remove:
+            changed += library.db.delete(
+                link_model, {fk: collection_id, "object_id": oid})
+        else:
+            if library.db.find_one(Object, {"id": oid}) is None:
+                continue
+            row: dict[str, Any] = {fk: collection_id, "object_id": oid}
+            if "date_created" in link_model.FIELDS:
+                row["date_created"] = utc_now()
+            library.db.insert(link_model, row, or_ignore=True)
+            changed += 1
+    _invalidate(library, f"{key}.list")
+    return changed
+
+
+def collection_objects(library: "Library", model,
+                       collection_id: int) -> list[dict[str, Any]]:
+    """Member objects with a representative file_path each (display rows)."""
+    link_model, fk, _key = _LINKS[model]
+    return [FilePath.decode_row(r) for r in library.db.query(
+        f"SELECT f.*, o.pub_id AS object_pub_id, o.kind AS object_kind, "
+        f"o.favorite FROM {link_model.TABLE} l "
+        f"JOIN object o ON o.id = l.object_id "
+        f"JOIN file_path f ON f.object_id = o.id "
+        f"WHERE l.{fk} = ? GROUP BY o.id ORDER BY f.name",
+        [collection_id])]
+
+
+def list_collections(library: "Library", model) -> list[dict[str, Any]]:
+    link_model, fk, _key = _LINKS[model]
+    return library.db.query(
+        f"SELECT c.*, COUNT(l.object_id) AS object_count "
+        f"FROM {model.TABLE} c LEFT JOIN {link_model.TABLE} l "
+        f"ON l.{fk} = c.id GROUP BY c.id ORDER BY c.name")
+
+
+# -- labels ------------------------------------------------------------------
+
+def ensure_label(library: "Library", name: str) -> dict[str, Any]:
+    existing = library.db.find_one(Label, {"name": name})
+    if existing is not None:
+        return existing
+    library.db.insert(Label, {"pub_id": str(uuid.uuid4()), "name": name,
+                              "date_created": utc_now(),
+                              "date_modified": utc_now()})
+    _invalidate(library, "labels.list")
+    return library.db.find_one(Label, {"name": name})
+
+
+def label_objects(library: "Library", label_id: int,
+                  object_ids: list[int], remove: bool = False) -> int:
+    changed = 0
+    for oid in object_ids:
+        if remove:
+            changed += library.db.delete(
+                LabelOnObject, {"label_id": label_id, "object_id": oid})
+        else:
+            library.db.insert(LabelOnObject,
+                              {"label_id": label_id, "object_id": oid,
+                               "date_created": utc_now()}, or_ignore=True)
+            changed += 1
+    _invalidate(library, "labels.list")
+    return changed
+
+
+def labels_for_object(library: "Library", object_id: int) -> list[dict[str, Any]]:
+    return library.db.query(
+        "SELECT lb.* FROM label lb JOIN label_on_object lo "
+        "ON lo.label_id = lb.id WHERE lo.object_id = ? ORDER BY lb.name",
+        [object_id])
