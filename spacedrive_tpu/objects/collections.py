@@ -96,10 +96,11 @@ def collection_objects(library: "Library", model,
 
 def list_collections(library: "Library", model) -> list[dict[str, Any]]:
     link_model, fk, _key = _LINKS[model]
-    return library.db.query(
+    return [model.decode_row(r) | {"object_count": r["object_count"]}
+            for r in library.db.query(
         f"SELECT c.*, COUNT(l.object_id) AS object_count "
         f"FROM {model.TABLE} c LEFT JOIN {link_model.TABLE} l "
-        f"ON l.{fk} = c.id GROUP BY c.id ORDER BY c.name")
+        f"ON l.{fk} = c.id GROUP BY c.id ORDER BY c.name")]
 
 
 # -- labels ------------------------------------------------------------------
@@ -132,7 +133,7 @@ def label_objects(library: "Library", label_id: int,
 
 
 def labels_for_object(library: "Library", object_id: int) -> list[dict[str, Any]]:
-    return library.db.query(
+    return [Label.decode_row(r) for r in library.db.query(
         "SELECT lb.* FROM label lb JOIN label_on_object lo "
         "ON lo.label_id = lb.id WHERE lo.object_id = ? ORDER BY lb.name",
-        [object_id])
+        [object_id])]

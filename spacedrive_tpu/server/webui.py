@@ -90,6 +90,8 @@ INDEX_HTML = r"""<!doctype html>
   <div class="loc" data-view="ephemeral">browse host path…</div>
   <h2>Tags</h2>
   <div id="tags"></div>
+  <h2>Albums</h2>
+  <div id="albums"></div>
   <h2>Peers</h2>
   <div id="peers" class="meta">none discovered</div>
   <h2>Jobs</h2>
@@ -133,6 +135,7 @@ async function loadLibraries() {
     state.dir = "/";
     await loadLocations();
     loadTags();
+    loadAlbums();
   };
 }
 
@@ -214,16 +217,36 @@ function render(items) {
         fav.className = "fav" + (it.favorite ? " on" : "");
       };
       card.append(fav);
-      card.oncontextmenu = async (e) => {  // right-click: tag it
+      card.oncontextmenu = (e) => {
         e.preventDefault();
-        const name = prompt(`tag "${full}" with:`);
-        if (!name) return;
-        const tags = await rspc("tags.list");
-        let tag = tags.find(t => t.name === name);
-        if (!tag) tag = await rspc("tags.create", {name});
-        await rspc("tags.assign",
-          {tag_id: tag.id, object_ids: [it.object_id], unassign: false});
-        loadTags();
+        contextMenu(e.pageX, e.pageY, [
+          ["tag…", async () => {
+            const name = prompt(`tag "${full}" with:`);
+            if (!name) return;
+            const tags = await rspc("tags.list");
+            let tag = tags.find(t => t.name === name);
+            if (!tag) tag = await rspc("tags.create", {name});
+            await rspc("tags.assign",
+              {tag_id: tag.id, object_ids: [it.object_id], unassign: false});
+            loadTags();
+          }],
+          ["add to album…", async () => {
+            const name = prompt(`add "${full}" to album:`);
+            if (!name) return;
+            const albums = await rspc("albums.list");
+            let album = albums.find(a => a.name === name);
+            if (!album) album = await rspc("albums.create", {name});
+            await rspc("albums.addObjects",
+              {id: album.id, object_ids: [it.object_id]});
+            loadAlbums();
+          }],
+          ["label…", async () => {
+            const name = prompt(`label "${full}" as:`);
+            if (!name) return;
+            await rspc("labels.assign",
+              {name, object_ids: [it.object_id]});
+          }],
+        ]);
       };
     }
     card.onclick = () => {
@@ -378,6 +401,44 @@ document.querySelector('[data-view="ephemeral"]').onclick = () => {
   if (path) browseEphemeral(path);
 };
 
+let _menu = null;
+function contextMenu(x, y, options) {
+  if (_menu) _menu.remove();
+  const m = el("div", {style: `position:absolute;left:${x}px;top:${y}px;` +
+    "background:var(--panel2);border:1px solid #2e3040;border-radius:6px;" +
+    "padding:4px;z-index:10;min-width:140px"});
+  for (const [label, fn] of options) {
+    const row = el("div", {className: "loc"}, label);
+    row.onclick = () => { m.remove(); _menu = null; fn(); };
+    m.append(row);
+  }
+  _menu = m;
+  document.body.append(m);
+  setTimeout(() => document.addEventListener("click", () => {
+    if (_menu === m) { m.remove(); _menu = null; }
+  }, {once: true}), 0);
+}
+
+async function loadAlbums() {
+  const albums = await rspc("albums.list").catch(() => []);
+  const box = document.getElementById("albums");
+  box.innerHTML = "";
+  for (const album of albums) {
+    if (album.is_hidden) continue;
+    const row = el("div", {className: "loc"});
+    row.append(el("span", {}, album.name),
+               el("span", {className: "pill"}, String(album.object_count)));
+    row.onclick = async () => {
+      const items = await rspc("albums.objects", album.id);
+      document.getElementById("crumbs").textContent = `album: ${album.name}`;
+      render(items);
+    };
+    box.append(row);
+  }
+  if (!albums.length)
+    box.append(el("div", {className: "meta"}, "right-click a file to add"));
+}
+
 async function loadTags() {
   const tags = await rspc("tags.list").catch(() => []);
   const box = document.getElementById("tags");
@@ -529,7 +590,7 @@ function connectWs() {
   };
 }
 
-loadLibraries().then(() => { connectWs(); loadTags(); loadPeers(); })
+loadLibraries().then(() => { connectWs(); loadTags(); loadAlbums(); loadPeers(); })
   .catch(e => {
   document.getElementById("status").textContent = e.message;
 });
