@@ -303,6 +303,78 @@ def bench_thumbs() -> dict:
     }
 
 
+def bench_sync() -> dict:
+    """Two-node CRDT sync throughput (BASELINE config 5's replication
+    half): emit N shared ops on instance A, pull+ingest them on B through
+    the real manager/ingester with the production 1000-op pull batches;
+    vs_baseline = speedup over the reference test's 100-op pull batch
+    (core/crates/sync tests/lib.rs:140)."""
+    import shutil
+
+    from spacedrive_tpu.models import Tag
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.sync.ingest import Ingester
+
+    n_ops = int(os.environ.get("SD_BENCH_SYNC_OPS", "3000"))
+    tmp = Path(tempfile.mkdtemp(prefix="sd_bench_sync_"))
+    try:
+        node_a = Node(tmp / "a", probe_accelerator=False, watch_locations=False)
+        node_b = Node(tmp / "b", probe_accelerator=False, watch_locations=False)
+        lib_a = node_a.libraries.create("bench")
+        lib_b = node_b.libraries.create("bench-mirror")
+        lib_a.sync.emit_messages = True
+        lib_a.add_remote_instance(lib_b.instance())
+        lib_b.add_remote_instance(lib_a.instance())
+
+        t0 = time.perf_counter()
+        for start in range(0, n_ops, 200):
+            ops, rows = [], []
+            for i in range(start, min(n_ops, start + 200)):
+                pub = f"bench-tag-{i}"
+                ops.append(lib_a.sync.shared_create(
+                    Tag, pub, {"name": f"t{i}"}))
+                rows.append({"pub_id": pub, "name": f"t{i}"})
+            lib_a.sync.write_ops(
+                ops, lambda db, rows=rows: [db.insert(Tag, r) for r in rows])
+        emit_t = time.perf_counter() - t0
+
+        def pull_all(batch: int) -> float:
+            # fresh floor each run: reset B's view by ingesting into a
+            # throwaway mirror library
+            mirror = node_b.libraries.create(f"m-{batch}")
+            mirror.add_remote_instance(lib_a.instance())
+            ingester = Ingester(mirror)
+            t = time.perf_counter()
+            total = 0
+            while True:
+                ops, has_more = lib_a.sync.get_ops(
+                    mirror.sync.timestamps(), batch)
+                total += ingester.receive(ops)
+                if not has_more:
+                    break
+            dt = time.perf_counter() - t
+            assert total >= n_ops, (total, n_ops)
+            return dt
+
+        ref_t = pull_all(100)   # the reference test's pull batch
+        prod_t = pull_all(1000)  # production batch
+        rate = n_ops / prod_t
+        print(f"info: sync {n_ops} shared ops: emit {emit_t:.2f}s | "
+              f"ingest batch=1000 {prod_t:.2f}s ({rate:,.0f} ops/s) | "
+              f"batch=100 {ref_t:.2f}s", file=sys.stderr)
+        node_a.shutdown()
+        node_b.shutdown()
+        return {
+            "metric": f"sync_ingest_ops_per_sec[{n_ops}ops,2node]",
+            "value": round(rate, 1),
+            "unit": "ops/sec",
+            "vs_baseline": round(ref_t / prod_t, 2),
+            "emit_ops_per_sec": round(n_ops / emit_t, 1),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     if MODE == "dedup":
         record = bench_dedup()
@@ -321,6 +393,10 @@ def main() -> int:
             record["extra"].append(bench_thumbs())
         except Exception as e:  # thumbs bench is additive evidence, not gating
             print(f"warn: thumbs bench skipped: {e}", file=sys.stderr)
+        try:
+            record["extra"].append(bench_sync())
+        except Exception as e:
+            print(f"warn: sync bench skipped: {e}", file=sys.stderr)
     print(json.dumps(record))
     return 0
 
