@@ -42,6 +42,11 @@ class ClientError(Exception):
     pass
 
 
+class _IdleTimeout(Exception):
+    """Socket read timed out at a frame BOUNDARY — pure idleness, the
+    subscription pump retries; a mid-frame timeout stays fatal."""
+
+
 class ProcedureError(ClientError):
     """Server-side procedure failure (the {"error": ...} envelope)."""
 
@@ -251,7 +256,13 @@ class ClientSubscription:
     def _recv_msg(self, timeout: float) -> dict | None:
         self._sock.settimeout(timeout)
         while True:
-            b1, b2 = self._read_exact(2)
+            # a timeout before ANY frame byte is plain idleness (retryable);
+            # one mid-frame means a desynced/stalled stream (close path)
+            try:
+                first = self._read_exact(1)
+            except socket.timeout as e:
+                raise _IdleTimeout() from e
+            b1, b2 = first[0], self._read_exact(1)[0]
             opcode, length = b1 & 0x0F, b2 & 0x7F
             if length == 126:
                 (length,) = struct.unpack(">H", self._read_exact(2))
@@ -278,13 +289,19 @@ class ClientSubscription:
     def _pump(self) -> None:
         try:
             while not self._closed.is_set():
-                msg = self._recv_msg(timeout=3600)
+                try:
+                    msg = self._recv_msg(timeout=3600)
+                except _IdleTimeout:
+                    # an idle hour is NOT a close: a quiet subscription
+                    # (no job activity) must keep waiting, not silently
+                    # end the caller's iteration
+                    continue
                 if msg is None:
                     break
                 result = msg.get("result", {})
                 if result.get("type") == "event":
                     self._offer(result["data"])
-        except (ConnectionError, OSError, socket.timeout):
+        except (ConnectionError, OSError):
             pass
         finally:
             self._offer(None)

@@ -206,26 +206,32 @@ class FileHeader:
         magic = reader.read(len(MAGIC_BYTES))
         if magic != MAGIC_BYTES:
             raise CryptoError("not an encrypted file (bad magic)")
-        version, algo = struct.unpack("<HB", reader.read(3))
-        if version != HEADER_VERSION:
-            raise CryptoError(f"unsupported header version {version}")
-        algorithm = Algorithm(algo)
-        nonce = reader.read(_NONCE_PAD)[:algorithm.nonce_len]
-        keyslots = []
-        for _ in range(MAX_KEYSLOTS):
-            slot = Keyslot.decode(reader.read(KEYSLOT_SIZE))
-            if slot is not None:
-                keyslots.append(slot)
-        blobs: list[bytes | None] = []
-        for _ in range(2):
-            present = reader.read(1)
-            if present == b"\x01":
-                (length,) = struct.unpack("<I", reader.read(4))
-                if length > 64 * 1024 * 1024:
-                    raise CryptoError("header attachment too large")
-                blobs.append(reader.read(length))
-            else:
-                blobs.append(None)
+        # truncated/corrupt headers surface as CryptoError — callers
+        # (decrypt job per-file error handling, cli inspect) catch exactly
+        # that, never struct.error/KeyError/ValueError from the guts
+        try:
+            version, algo = struct.unpack("<HB", reader.read(3))
+            if version != HEADER_VERSION:
+                raise CryptoError(f"unsupported header version {version}")
+            algorithm = Algorithm(algo)
+            nonce = reader.read(_NONCE_PAD)[:algorithm.nonce_len]
+            keyslots = []
+            for _ in range(MAX_KEYSLOTS):
+                slot = Keyslot.decode(reader.read(KEYSLOT_SIZE))
+                if slot is not None:
+                    keyslots.append(slot)
+            blobs: list[bytes | None] = []
+            for _ in range(2):
+                present = reader.read(1)
+                if present == b"\x01":
+                    (length,) = struct.unpack("<I", reader.read(4))
+                    if length > 64 * 1024 * 1024:
+                        raise CryptoError("header attachment too large")
+                    blobs.append(reader.read(length))
+                else:
+                    blobs.append(None)
+        except (struct.error, KeyError, ValueError, IndexError) as e:
+            raise CryptoError(f"corrupt encrypted-file header: {e}") from e
         return cls(version, algorithm, nonce, keyslots, blobs[0], blobs[1])
 
     @classmethod

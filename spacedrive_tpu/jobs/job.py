@@ -61,6 +61,12 @@ class StatefulJob:
 
     NAME: ClassVar[str] = ""
     IS_BATCHED: ClassVar[bool] = False
+    #: init_args keys REDACTED from every persisted checkpoint (job table
+    #: rows live in the unencrypted library DB — a plaintext password in a
+    #: report would defeat the encryption job that stored it). A job
+    #: resumed from a checkpoint sees these keys missing and must either
+    #: fail that step cleanly or use a persistable reference (key_uuid).
+    SECRET_INIT_KEYS: ClassVar[tuple[str, ...]] = ()
 
     def __init__(self, init_args: dict[str, Any]) -> None:
         self.init_args = init_args
@@ -117,9 +123,12 @@ class JobState:
         self.step_number = step_number
         self.run_metadata = run_metadata
 
-    def serialize(self) -> bytes:
+    def serialize(self, secret_keys: tuple[str, ...] = ()) -> bytes:
+        init_args = ({k: v for k, v in self.init_args.items()
+                      if k not in secret_keys}
+                     if secret_keys else self.init_args)
         return json.dumps({
-            "init_args": self.init_args,
+            "init_args": init_args,
             "data": self.data,
             "steps": self.steps,
             "step_number": self.step_number,
@@ -224,4 +233,4 @@ class DynJob:
         return metadata, errors
 
     def serialize_state(self) -> bytes:
-        return self.state.serialize()
+        return self.state.serialize(self.job.SECRET_INIT_KEYS)

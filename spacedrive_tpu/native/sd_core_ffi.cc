@@ -134,8 +134,13 @@ int sd_core_init(const char* data_dir, const char* python_path) {
     g_inited = true;
   } while (false);
   if (g_we_own_interpreter) {
-    // release the init GIL so host threads can call in via PyGILState_Ensure
+    // release the init GIL so host threads can call in via PyGILState_Ensure.
+    // Clear the flag: the GIL is no longer held by anyone, so a RETRY of
+    // sd_core_init (e.g. after a bad python_path) must take the
+    // PyGILState_Ensure path like every other caller — leaving the flag
+    // set would run Python C-API calls without the GIL.
     PyEval_SaveThread();
+    g_we_own_interpreter = false;
   } else {
     PyGILState_Release(gil);
   }

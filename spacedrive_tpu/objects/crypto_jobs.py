@@ -39,7 +39,10 @@ def _resolve_key(ctx: WorkerContext, init_args: dict[str, Any]) -> Protected:
         if km is None:
             raise JobError("no key manager on this node")
         return Protected(km.get_key(key_uuid).expose())
-    raise JobError("encryptFiles needs a password or a key_uuid")
+    raise JobError(
+        "needs a password or a key_uuid (passwords are never persisted in "
+        "checkpoints — a crypto job resumed after shutdown must use a "
+        "key-manager key_uuid or be re-submitted)")
 
 
 class FileEncryptorJob(_FsJob):
@@ -48,6 +51,7 @@ class FileEncryptorJob(_FsJob):
     erase_original: bool."""
 
     NAME = "file_encryptor"
+    SECRET_INIT_KEYS = ("password",)
 
     def init(self, ctx: WorkerContext):
         steps = []
@@ -119,6 +123,7 @@ class FileDecryptorJob(_FsJob):
     erase_original: bool."""
 
     NAME = "file_decryptor"
+    SECRET_INIT_KEYS = ("password",)
 
     def init(self, ctx: WorkerContext):
         steps = []

@@ -647,16 +647,23 @@ class P2PManager:
             writer.write(json_frame({"ok": False, "error": "filesOverP2P disabled"}))
             await writer.drain()
             return
-        try:
+        def _lookup():
+            # blocking DB/stat work — off the p2p loop (the single-writer
+            # DB lock being held by a scan must not stall every session)
             library = self.node.libraries.get(payload["library_id"])
             # only nodes paired into the library may read its files
             if peer.identity not in self.nlm.member_nodes(library):
                 raise KeyError("not a member of this library")
-            row = library.db.find_one(FilePath, {"pub_id": payload["file_path_pub_id"]})
+            row = library.db.find_one(
+                FilePath, {"pub_id": payload["file_path_pub_id"]})
             if row is None:
                 raise KeyError("file_path not found")
-            _row, path = file_path_abs(library.db, row["id"])
-            size = path.stat().st_size
+            _row, p = file_path_abs(library.db, row["id"])
+            return p, p.stat().st_size
+
+        try:
+            path, size = await asyncio.get_running_loop().run_in_executor(
+                None, _lookup)
         except (KeyError, OSError) as e:
             writer.write(json_frame({"ok": False, "error": str(e)}))
             await writer.drain()
@@ -677,7 +684,9 @@ class P2PManager:
         from ..objects.media.thumbnail import thumbnail_path
 
         cas_id = str(payload.get("cas_id", ""))
-        try:
+
+        def _lookup() -> bytes:
+            # blocking DB/disk work — off the p2p loop
             library = self.node.libraries.get(payload["library_id"])
             if peer.identity not in self.nlm.member_nodes(library):
                 raise KeyError("not a member of this library")
@@ -687,8 +696,11 @@ class P2PManager:
             if ("/" in cas_id or ".." in cas_id
                     or library.db.find_one(FilePath, {"cas_id": cas_id}) is None):
                 raise KeyError("no such cas_id in this library")
-            path = thumbnail_path(self.node.data_dir, cas_id)
-            body = path.read_bytes()
+            return thumbnail_path(self.node.data_dir, cas_id).read_bytes()
+
+        try:
+            body = await asyncio.get_running_loop().run_in_executor(
+                None, _lookup)
         except (KeyError, OSError) as e:
             # fixed wire message: raw OSError strings leak local paths
             logger.debug("thumbnail serve refused (%s): %s", cas_id[:8], e)
@@ -723,8 +735,9 @@ class P2PManager:
             writer.write(json_frame({"ok": False, "error": "bad batch shape"}))
             await writer.drain()
             return
-        member = any(peer.identity in self.nlm.member_nodes(lib)
-                     for lib in self.node.libraries.list())
+        member = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: any(peer.identity in self.nlm.member_nodes(lib)
+                              for lib in self.node.libraries.list()))
         if not member:
             # the client writes the payload before reading the reply —
             # drain it so refused bytes don't sit in the substream buffer

@@ -329,3 +329,35 @@ def test_keystore_backup_restore_across_managers(tmp_path):
     assert b.get_key(kid).expose() == secret  # same key, resealed under b
     # idempotent: duplicates skipped
     assert b.restore_keystore(tmp_path / "backup.json", "alpha") == 0
+
+
+def test_job_checkpoints_never_persist_passwords(tmp_path):
+    """files.encryptFiles with a password must not write that password into
+    the job table (the library DB is unencrypted — a plaintext password in
+    a report would defeat the encryption it performed)."""
+    import json as _json
+
+    from spacedrive_tpu.locations import create_location, scan_location
+    from spacedrive_tpu.node import Node
+
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "doc.txt").write_bytes(b"secret contents " * 100)
+    node = Node(tmp_path / "data", probe_accelerator=False)
+    try:
+        lib = node.libraries.create("enc")
+        loc = create_location(lib, str(tree), hasher="cpu")
+        scan_location(lib, loc["id"])
+        assert node.jobs.wait_idle(60)
+        fp = lib.db.query("SELECT id FROM file_path WHERE name='doc'")[0]["id"]
+        node.router.resolve("files.encryptFiles",
+                            {"sources": [fp], "password": "hunter2-s3cret"},
+                            library_id=lib.id)
+        assert node.jobs.wait_idle(60)
+        assert (tree / "doc.txt.bytes").exists()
+        for row in lib.db.query("SELECT data, metadata FROM job"):
+            for blob in (row["data"], row["metadata"]):
+                assert not blob or b"hunter2-s3cret" not in (
+                    blob if isinstance(blob, bytes) else str(blob).encode())
+    finally:
+        node.shutdown()
