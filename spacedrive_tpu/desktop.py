@@ -11,8 +11,10 @@ shut the core down cleanly when asked.
 What it keeps from the Tauri shell's responsibilities:
 - single-instance guard (second launch focuses the first: here it prints
   the running instance's URL instead of double-booting the core)
-- localhost-only binding with a per-launch auth token in the URL (no
-  other local user can drive the API)
+- localhost-only binding; pass ``--auth user:password`` to additionally
+  require credentials on multi-user hosts (any local user can reach a
+  localhost port — an unauthenticated API there exposes e.g.
+  keys.getKey to other accounts)
 - app_ready / reset_spacedrive / open_logs_dir equivalents as commands
 """
 
@@ -53,10 +55,11 @@ def _running_instance(data_dir: Path) -> dict | None:
 
 
 def launch(data_dir: str | Path, port: int = 0, open_browser: bool = True,
-           wait: bool = True) -> dict:
+           wait: bool = True, auth: str | None = None) -> dict:
     """Boot node + server, register the instance, optionally open the UI.
     Returns {url, node, shell}; with wait=True blocks until SIGINT/SIGTERM
-    and shuts down before returning."""
+    and shuts down before returning. ``auth``: "user:password" to require
+    basic auth on every route (recommended on multi-user hosts)."""
     from .node import Node
     from .server.shell import Server
 
@@ -67,7 +70,7 @@ def launch(data_dir: str | Path, port: int = 0, open_browser: bool = True,
         return {"url": existing["url"], "node": None, "shell": None}
 
     node = Node(data_dir)
-    shell = Server(node, host="127.0.0.1", port=port)
+    shell = Server(node, host="127.0.0.1", port=port, auth=auth)
     shell.start()
     url = f"http://127.0.0.1:{shell.port}/"
     data_dir.mkdir(parents=True, exist_ok=True)
@@ -136,6 +139,9 @@ def main(argv: list[str] | None = None) -> int:
                         help="0 picks a free port")
     parser.add_argument("--no-open", action="store_true",
                         help="don't open the browser (headless/session use)")
+    parser.add_argument("--auth", default=None, metavar="USER:PASSWORD",
+                        help="require basic auth (recommended on "
+                             "multi-user hosts)")
     parser.add_argument("command", nargs="?", default="run",
                         choices=["run", "reset", "logs"])
     args = parser.parse_args(argv)
@@ -146,7 +152,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "logs":
         logs_dir(args.data_dir)
         return 0
-    launch(args.data_dir, port=args.port, open_browser=not args.no_open)
+    launch(args.data_dir, port=args.port, open_browser=not args.no_open,
+           auth=args.auth)
     return 0
 
 

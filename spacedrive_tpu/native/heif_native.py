@@ -32,6 +32,11 @@ _lib.sd_heif_encode_file.argtypes = [
     ctypes.c_int32]
 _lib.sd_heif_encode_file.restype = ctypes.c_int32
 
+_lib.sd_heif_dims.argtypes = [
+    ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+    ctypes.POINTER(ctypes.c_int32)]
+_lib.sd_heif_dims.restype = ctypes.c_int32
+
 HEIF_EXTENSIONS = {"heic", "heif", "avif"}
 
 #: decode ceiling, same guard class as the reference's max-size checks in
@@ -47,9 +52,29 @@ def available() -> bool:
     return bool(_lib.sd_heif_available())
 
 
+def dims(path: str | Path) -> tuple[int, int]:
+    """(width, height) of the primary image — parses the container only,
+    no HEVC decode (the metadata extractor's path)."""
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
+    rc = _lib.sd_heif_dims(str(path).encode(), ctypes.byref(w),
+                           ctypes.byref(h))
+    if rc != 0:
+        raise HeifError("libheif runtime not available" if rc == -1
+                        else f"unreadable heif file ({rc})")
+    return w.value, h.value
+
+
 def decode_rgb(path: str | Path) -> np.ndarray:
-    """Primary image as an (h, w, 3) uint8 array."""
-    cap = MAX_PIXELS * 3
+    """Primary image as an (h, w, 3) uint8 array. The buffer is sized from
+    the declared dimensions (probed without decoding), capped at
+    MAX_PIXELS — not a fixed 192 MiB per call."""
+    dw, dh = dims(path)
+    if dw * dh > MAX_PIXELS:
+        raise HeifError("image exceeds decode size limit")
+    # the decoded plane may be slightly larger than declared (codec
+    # alignment); leave modest headroom, the C side still bounds the copy
+    cap = max(dw + 64, 64) * max(dh + 64, 64) * 3
     out = np.empty(cap, np.uint8)
     w = ctypes.c_int32()
     h = ctypes.c_int32()

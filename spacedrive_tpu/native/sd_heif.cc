@@ -61,6 +61,8 @@ struct Heif {
   decode_image_t decode_image;
   image_release_t image_release;
   image_plane_ro_t image_plane_ro;
+  image_get_dim_t image_width;
+  image_get_dim_t image_height;
   ctx_get_encoder_t ctx_get_encoder;
   encoder_release_t encoder_release;
   encoder_lossy_q_t encoder_set_quality;
@@ -98,6 +100,8 @@ Heif* load_heif() {
   SD_HEIF_LOAD(image_release, "heif_image_release", image_release_t)
   SD_HEIF_LOAD(image_plane_ro, "heif_image_get_plane_readonly",
                image_plane_ro_t)
+  SD_HEIF_LOAD(image_width, "heif_image_get_width", image_get_dim_t)
+  SD_HEIF_LOAD(image_height, "heif_image_get_height", image_get_dim_t)
   SD_HEIF_LOAD(ctx_get_encoder, "heif_context_get_encoder_for_format",
                ctx_get_encoder_t)
   SD_HEIF_LOAD(encoder_release, "heif_encoder_release", encoder_release_t)
@@ -119,6 +123,30 @@ extern "C" {
 
 int sd_heif_available() { return load_heif() != nullptr; }
 
+// Primary-image dimensions WITHOUT decoding (the metadata extractor's
+// path: reading the handle's declared size costs parsing, not an HEVC
+// decode). Returns 0 on success, -1 unavailable, -2 unreadable.
+int32_t sd_heif_dims(const char* path, int32_t* out_w, int32_t* out_h) {
+  Heif* h = load_heif();
+  if (!h) return -1;
+  void* ctx = h->ctx_alloc();
+  if (!ctx) return -2;
+  void* handle = nullptr;
+  int32_t rc = -2;
+  if (h->ctx_read_file(ctx, path, nullptr).code == 0 &&
+      h->ctx_primary_handle(ctx, &handle).code == 0) {
+    int w = h->handle_width(handle), hh = h->handle_height(handle);
+    if (w > 0 && hh > 0) {
+      *out_w = w;
+      *out_h = hh;
+      rc = 0;
+    }
+  }
+  if (handle) h->handle_release(handle);
+  h->ctx_free(ctx);
+  return rc;
+}
+
 // Decode the primary image of a HEIF/AVIF file to packed RGB24.
 // Returns bytes written (w*h*3) or negative: -1 unavailable, -2 decode
 // failure, -3 buffer too small.
@@ -136,18 +164,27 @@ int64_t sd_heif_decode_rgb(const char* path, uint8_t* out, int64_t cap,
 
   if (h->ctx_read_file(ctx, path, nullptr).code != 0) goto done;
   if (h->ctx_primary_handle(ctx, &handle).code != 0) goto done;
-  w = h->handle_width(handle);
-  hh = h->handle_height(handle);
-  if (w <= 0 || hh <= 0) goto done;
-  if (static_cast<int64_t>(w) * hh * 3 > cap) {
+  // pre-decode guard on the DECLARED size (bounds the decode allocation)
+  if (static_cast<int64_t>(h->handle_width(handle)) *
+          h->handle_height(handle) * 3 > cap) {
     rc = -3;
     goto done;
   }
   if (h->decode_image(handle, &img, kColorspaceRGB, kChromaInterleavedRGB,
                       nullptr).code != 0)
     goto done;
+  // dimensions MUST come from the decoded image, not the container's
+  // declared (ispe) size — a crafted file whose header overstates the
+  // dimensions would otherwise drive the row copy past the plane buffer
+  w = h->image_width(img, kChannelInterleaved);
+  hh = h->image_height(img, kChannelInterleaved);
+  if (w <= 0 || hh <= 0) goto done;
+  if (static_cast<int64_t>(w) * hh * 3 > cap) {
+    rc = -3;
+    goto done;
+  }
   plane = h->image_plane_ro(img, kChannelInterleaved, &stride);
-  if (!plane) goto done;
+  if (!plane || stride < w * 3) goto done;
   for (int y = 0; y < hh; y++)
     memcpy(out + static_cast<int64_t>(y) * w * 3,
            plane + static_cast<int64_t>(y) * stride, static_cast<size_t>(w) * 3);

@@ -53,19 +53,20 @@ def extract_media_data(path: str, extension: str) -> dict[str, Any] | None:
 
 
 def _extract_heif(path: str) -> dict[str, Any] | None:
-    """Dimensions for HEIF/AVIF primaries (PIL can't open them; EXIF inside
-    HEIF containers is left for a fuller parser)."""
+    """Dimensions for HEIF/AVIF primaries, read from the container without
+    an HEVC decode (PIL can't open them; EXIF inside HEIF containers is
+    left for a fuller parser)."""
     from .thumbnail import _native_heif
 
     heif = _native_heif()
     if heif is None:
         return None
     try:
-        arr = heif.decode_rgb(path)
+        w, h = heif.dims(path)
     except Exception as e:
         logger.debug("no media data for %s: %s", path, e)
         return None
-    return {"dimensions": {"width": arr.shape[1], "height": arr.shape[0]}}
+    return {"dimensions": {"width": w, "height": h}}
 
 
 def _extract_image(path: str) -> dict[str, Any] | None:

@@ -270,14 +270,33 @@ def video_to_webp_bytes(source: str | Path, size: int = 256,
                         quality: int = WEBP_QUALITY,
                         film_strip: bool = False) -> bytes:
     """One WebP-encoded video thumbnail as bytes (lib.rs to_webp_bytes;
-    the builder's film_strip flag is opt-in here, like core's usage)."""
+    the builder's film_strip flag is opt-in here, like core's usage).
+    Uses the linked decoder when it builds, else the ffmpeg CLI —
+    the same capability set as generate_thumbnail's video path."""
     import io
 
+    import numpy as np
     from PIL import Image
 
-    from ...native import ffmpeg_native
+    native = _native_ffmpeg()
+    if native is not None:
+        frame = native.decode_frame_rgb(Path(source), target_edge=size)
+    elif _FFMPEG is not None:
+        import subprocess
+        import tempfile
 
-    frame = ffmpeg_native.decode_frame_rgb(Path(source), target_edge=size)
+        with tempfile.TemporaryDirectory() as td:
+            tmp = Path(td) / "frame.png"
+            subprocess.run(
+                [_FFMPEG, "-y", "-loglevel", "error", "-ss", "00:00:01",
+                 "-i", str(source), "-frames:v", "1",
+                 "-vf", f"scale='min({size},iw)':-2", str(tmp)],
+                check=True, timeout=30, capture_output=True)
+            with Image.open(tmp) as img:
+                frame = np.asarray(img.convert("RGB"), dtype=np.uint8)
+    else:
+        raise RuntimeError("no video decode backend (libav libs or "
+                           "ffmpeg CLI required)")
     if film_strip:
         frame = film_strip_filter(frame)
     native = _native_images()

@@ -732,6 +732,18 @@ class P2PManager:
                 or not all(isinstance(s, int) and 0 < s <= self.HASH_MAX_MSG
                            for s in sizes)
                 or sum(sizes) > self.HASH_MAX_TOTAL):
+            # drain whatever payload the declared sizes describe (bounded),
+            # like the membership refusal below — otherwise the in-flight
+            # bytes of an oversized batch hit the demux cap and the client
+            # sees a stream reset instead of this error
+            if isinstance(sizes, list):
+                declared = sum(s for s in sizes
+                               if isinstance(s, int) and s > 0)
+                for _ in range(min(declared, 512 * 1024 * 1024) // 65536):
+                    await read_exact(reader, 65536)
+                rem = min(declared, 512 * 1024 * 1024) % 65536
+                if rem:
+                    await read_exact(reader, rem)
             writer.write(json_frame({"ok": False, "error": "bad batch shape"}))
             await writer.drain()
             return

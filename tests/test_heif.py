@@ -59,3 +59,10 @@ def test_media_data_dimensions(sample_heic):
     p, _arr = sample_heic
     data = metadata.extract_media_data(str(p), "heic")
     assert data == {"dimensions": {"width": 200, "height": 160}}
+
+
+def test_dims_probe_without_decode(sample_heic):
+    p, arr = sample_heic
+    assert hn.dims(p) == (arr.shape[1], arr.shape[0])
+    with pytest.raises(hn.HeifError):
+        hn.dims(p.parent / "missing.heic")
