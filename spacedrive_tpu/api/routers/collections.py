@@ -62,13 +62,7 @@ def mount(router) -> None:
 
     @router.library_query("labels.list")
     def labels_list(node, library, _arg):
-        from ...models import Label
-
-        return [Label.decode_row(r) | {"object_count": r["object_count"]}
-                for r in library.db.query(
-            "SELECT lb.*, COUNT(lo.object_id) AS object_count FROM label lb "
-            "LEFT JOIN label_on_object lo ON lo.label_id = lb.id "
-            "GROUP BY lb.id ORDER BY lb.name")]
+        return col.list_labels(library)
 
     @router.library_query("labels.getForObject")
     def labels_for_object(node, library, object_id: int):

@@ -42,9 +42,11 @@ class ClientError(Exception):
     pass
 
 
-class _IdleTimeout(Exception):
+class _IdleTimeout(ClientError):
     """Socket read timed out at a frame BOUNDARY — pure idleness, the
-    subscription pump retries; a mid-frame timeout stays fatal."""
+    subscription pump retries; a mid-frame timeout stays fatal. Subclasses
+    ClientError so pre-pump reads (the subscription-start ack) keep their
+    existing cleanup/except behavior."""
 
 
 class ProcedureError(ClientError):

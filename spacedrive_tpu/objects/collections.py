@@ -72,6 +72,9 @@ def set_membership(library: "Library", model, collection_id: int,
         else:
             if library.db.find_one(Object, {"id": oid}) is None:
                 continue
+            if library.db.find_one(
+                    link_model, {fk: collection_id, "object_id": oid}):
+                continue  # already linked: must not count as a change
             row: dict[str, Any] = {fk: collection_id, "object_id": oid}
             if "date_created" in link_model.FIELDS:
                 row["date_created"] = utc_now()
@@ -124,12 +127,23 @@ def label_objects(library: "Library", label_id: int,
             changed += library.db.delete(
                 LabelOnObject, {"label_id": label_id, "object_id": oid})
         else:
+            if library.db.find_one(
+                    LabelOnObject, {"label_id": label_id, "object_id": oid}):
+                continue  # already labeled: not a change
             library.db.insert(LabelOnObject,
                               {"label_id": label_id, "object_id": oid,
                                "date_created": utc_now()}, or_ignore=True)
             changed += 1
     _invalidate(library, "labels.list")
     return changed
+
+
+def list_labels(library: "Library") -> list[dict[str, Any]]:
+    return [Label.decode_row(r) | {"object_count": r["object_count"]}
+            for r in library.db.query(
+        "SELECT lb.*, COUNT(lo.object_id) AS object_count FROM label lb "
+        "LEFT JOIN label_on_object lo ON lo.label_id = lb.id "
+        "GROUP BY lb.id ORDER BY lb.name")]
 
 
 def labels_for_object(library: "Library", object_id: int) -> list[dict[str, Any]]:

@@ -184,6 +184,7 @@ async function browse() {
 }
 
 function render(items) {
+  state.ephemeralPath = null;  // any view switch stops ephemeral retries
   const box = document.getElementById("content");
   box.className = "grid";
   box.innerHTML = "";
@@ -279,6 +280,7 @@ document.getElementById("search").addEventListener("keydown", async (e) => {
 });
 
 document.querySelector('[data-view="duplicates"]').onclick = async () => {
+  state.ephemeralPath = null;
   const pairs = await rspc("search.duplicates", {});
   const box = document.getElementById("content");
   box.className = ""; box.innerHTML = "";
@@ -299,6 +301,7 @@ document.querySelector('[data-view="duplicates"]').onclick = async () => {
 };
 
 document.querySelector('[data-view="overview"]').onclick = async () => {
+  state.ephemeralPath = null;
   const [stats, cats] = await Promise.all([
     rspc("libraries.statistics"), rspc("categories.list")]);
   const box = document.getElementById("content");

@@ -71,3 +71,14 @@ def test_labels_assign_and_lookup(lib_with_objects):
     assert r("labels.assign",
              {"name": "beach", "object_ids": objs[:1], "remove": True}) == 1
     assert r("labels.list", None)[0]["object_count"] == 2
+
+
+def test_membership_count_is_idempotent(lib_with_objects):
+    """Re-adding existing links reports 0 changes, not len(object_ids)."""
+    node, lib, objs = lib_with_objects
+    r = lambda k, a: node.router.resolve(k, a, library_id=lib.id)
+    made = r("albums.create", {"name": "idem"})
+    assert r("albums.addObjects", {"id": made["id"], "object_ids": objs[:2]}) == 2
+    assert r("albums.addObjects", {"id": made["id"], "object_ids": objs[:2]}) == 0
+    assert r("labels.assign", {"name": "dup", "object_ids": objs[:2]}) == 2
+    assert r("labels.assign", {"name": "dup", "object_ids": objs[:2]}) == 0
