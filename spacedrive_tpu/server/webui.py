@@ -87,6 +87,7 @@ INDEX_HTML = r"""<!doctype html>
   <h2>Views</h2>
   <div class="loc" data-view="overview">overview</div>
   <div class="loc" data-view="duplicates">near-duplicates</div>
+  <div class="loc" data-view="history">job history</div>
   <div class="loc" data-view="ephemeral">browse host path…</div>
   <h2>Tags</h2>
   <div id="tags"></div>
@@ -398,6 +399,46 @@ async function browseEphemeral(path) {
   }
   if (!entries.length) box.append(el("div", {className: "meta"}, "empty"));
 }
+
+document.querySelector('[data-view="history"]').onclick = async () => {
+  state.ephemeralPath = null;
+  const reports = await rspc("jobs.reports", {});
+  const box = document.getElementById("content");
+  box.className = ""; box.innerHTML = "";
+  document.getElementById("crumbs").textContent = "job history";
+  const table = el("table");
+  table.append(el("tr", {innerHTML:
+    "<th>job</th><th>status</th><th>tasks</th><th>started</th><th></th>"}));
+  const addRow = (r, indent) => {
+    const tr = el("tr");
+    const done = r.completed_task_count ?? 0, total = r.task_count ?? 0;
+    tr.append(
+      el("td", {style: indent ? "padding-left:24px" : ""},
+         (indent ? "↳ " : "") + (r.name || "job")),
+      el("td", {}, String(r.status ?? "")),
+      el("td", {}, `${done}/${total}`),
+      el("td", {}, String(r.date_created ?? "").slice(0, 19)));
+    const act = el("td");
+    if (["Paused", "Queued"].includes(r.status)) {
+      const resume = el("button", {}, "resume");
+      resume.onclick = async () => { await rspc("jobs.resume", r.id);
+        resume.textContent = "…"; };
+      act.append(resume);
+    }
+    tr.append(act);
+    table.append(tr);
+  };
+  for (const r of reports) {
+    addRow(r, false);
+    for (const c of r.children ?? []) addRow(c, true);
+  }
+  if (!reports.length) table.append(el("tr",
+    {innerHTML: "<td colspan=5>no job reports</td>"}));
+  const clear = el("button", {style: "margin-top:10px"}, "clear finished");
+  clear.onclick = async () => { await rspc("jobs.clearAll", {});
+    document.querySelector('[data-view="history"]').onclick(); };
+  box.append(table, clear);
+};
 
 document.querySelector('[data-view="ephemeral"]').onclick = () => {
   const path = prompt("absolute directory to browse:", "/");
