@@ -18,10 +18,13 @@ def mount(router) -> None:
     def reports(node, library, _arg):
         """All job reports, children grouped under their chain head
         (api/jobs.rs:67)."""
+        from ...jobs.report import JobStatus
+
         rows = library.db.find(JobRow, order_by="date_created DESC")
         by_parent: dict[str | None, list] = {}
         for r in rows:
             r.pop("data", None)  # serialized state stays internal
+            r["status_name"] = JobStatus.NAMES.get(r["status"], "?")
             by_parent.setdefault(r["parent_id"], []).append(r)
         out = []
         for head in by_parent.get(None, []):

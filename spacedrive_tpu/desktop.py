@@ -140,8 +140,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--no-open", action="store_true",
                         help="don't open the browser (headless/session use)")
     parser.add_argument("--auth", default=None, metavar="USER:PASSWORD",
-                        help="require basic auth (recommended on "
-                             "multi-user hosts)")
+                        help="require basic auth (recommended on multi-user "
+                             "hosts; prefer SD_DESKTOP_AUTH — argv is "
+                             "readable by other local users via /proc)")
     parser.add_argument("command", nargs="?", default="run",
                         choices=["run", "reset", "logs"])
     args = parser.parse_args(argv)
@@ -152,8 +153,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "logs":
         logs_dir(args.data_dir)
         return 0
+    # env var wins: a credential on the command line is visible to every
+    # local user via /proc/<pid>/cmdline — the very host type that needs it
+    auth = os.environ.get("SD_DESKTOP_AUTH") or args.auth
     launch(args.data_dir, port=args.port, open_browser=not args.no_open,
-           auth=args.auth)
+           auth=auth)
     return 0
 
 

@@ -228,14 +228,23 @@ def _video_thumbnail(source: Path, out: Path) -> Path | None:
     if _FFMPEG is None:
         return None
     tmp = out.with_suffix(".tmp.webp")
-    # grab a frame 10% in, like the reference's MovieDecoder seek heuristic
-    cmd = [_FFMPEG, "-y", "-loglevel", "error", "-ss", "00:00:01",
-           "-i", str(source), "-frames:v", "1",
-           "-vf", f"scale='min(512,iw)':-2", "-quality", str(WEBP_QUALITY),
-           str(tmp)]
-    subprocess.run(cmd, check=True, timeout=30, capture_output=True)
+    _cli_grab_frame(source, tmp, 512, webp_quality=WEBP_QUALITY)
     tmp.replace(out)
     return out
+
+
+def _cli_grab_frame(source: Path, out: Path, size: int,
+                    webp_quality: int | None = None) -> None:
+    """One frame via the ffmpeg CLI — the single place the grab command
+    lives (seek heuristic, scale filter, timeout) so the thumbnail and
+    bytes-helper paths can't drift apart."""
+    cmd = [_FFMPEG, "-y", "-loglevel", "error", "-ss", "00:00:01",
+           "-i", str(source), "-frames:v", "1",
+           "-vf", f"scale='min({size},iw)':-2"]
+    if webp_quality is not None:
+        cmd += ["-quality", str(webp_quality)]
+    subprocess.run(cmd + [str(out)], check=True, timeout=30,
+                   capture_output=True)
 
 
 # ---------------------------------------------------------------------------
@@ -278,25 +287,25 @@ def video_to_webp_bytes(source: str | Path, size: int = 256,
     import numpy as np
     from PIL import Image
 
+    frame = None
     native = _native_ffmpeg()
     if native is not None:
-        frame = native.decode_frame_rgb(Path(source), target_edge=size)
-    elif _FFMPEG is not None:
-        import subprocess
+        try:
+            frame = native.decode_frame_rgb(Path(source), target_edge=size)
+        except Exception as e:
+            logger.debug("native video decode failed for %s (%s); "
+                         "CLI fallback", source, e)
+    if frame is None:
+        if _FFMPEG is None:
+            raise RuntimeError("no video decode backend (libav libs or "
+                               "ffmpeg CLI required)")
         import tempfile
 
         with tempfile.TemporaryDirectory() as td:
             tmp = Path(td) / "frame.png"
-            subprocess.run(
-                [_FFMPEG, "-y", "-loglevel", "error", "-ss", "00:00:01",
-                 "-i", str(source), "-frames:v", "1",
-                 "-vf", f"scale='min({size},iw)':-2", str(tmp)],
-                check=True, timeout=30, capture_output=True)
+            _cli_grab_frame(Path(source), tmp, size)
             with Image.open(tmp) as img:
                 frame = np.asarray(img.convert("RGB"), dtype=np.uint8)
-    else:
-        raise RuntimeError("no video decode backend (libav libs or "
-                           "ffmpeg CLI required)")
     if film_strip:
         frame = film_strip_filter(frame)
     native = _native_images()

@@ -739,11 +739,20 @@ class P2PManager:
             if isinstance(sizes, list):
                 declared = sum(s for s in sizes
                                if isinstance(s, int) and s > 0)
-                for _ in range(min(declared, 512 * 1024 * 1024) // 65536):
-                    await read_exact(reader, 65536)
-                rem = min(declared, 512 * 1024 * 1024) % 65536
-                if rem:
-                    await read_exact(reader, rem)
+
+                async def _drain(total: int) -> None:
+                    for _ in range(total // 65536):
+                        await read_exact(reader, 65536)
+                    if total % 65536:
+                        await read_exact(reader, total % 65536)
+
+                try:
+                    # bounded in bytes AND time: a peer declaring a payload
+                    # it never sends must not park this coroutine forever
+                    await asyncio.wait_for(
+                        _drain(min(declared, 512 * 1024 * 1024)), 30)
+                except (asyncio.TimeoutError, asyncio.IncompleteReadError):
+                    pass
             writer.write(json_frame({"ok": False, "error": "bad batch shape"}))
             await writer.drain()
             return

@@ -415,11 +415,11 @@ document.querySelector('[data-view="history"]').onclick = async () => {
     tr.append(
       el("td", {style: indent ? "padding-left:24px" : ""},
          (indent ? "↳ " : "") + (r.name || "job")),
-      el("td", {}, String(r.status ?? "")),
+      el("td", {}, String(r.status_name ?? r.status ?? "")),
       el("td", {}, `${done}/${total}`),
       el("td", {}, String(r.date_created ?? "").slice(0, 19)));
     const act = el("td");
-    if (["Paused", "Queued"].includes(r.status)) {
+    if (["Paused", "Queued"].includes(r.status_name)) {
       const resume = el("button", {}, "resume");
       resume.onclick = async () => { await rspc("jobs.resume", r.id);
         resume.textContent = "…"; };
