@@ -50,3 +50,24 @@ def test_stale_instance_file_is_cleaned(tmp_path):
 def test_logs_command(tmp_path, capsys):
     out = desktop.logs_dir(tmp_path / "data")
     assert str(out).endswith("logs")
+
+
+def test_launch_with_auth_requires_credentials(tmp_path):
+    import base64
+    import urllib.error
+
+    inst = desktop.launch(tmp_path / "data", open_browser=False, wait=False,
+                          auth="sd:secret-pw")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(inst["url"], timeout=10)
+        assert exc.value.code == 401
+        req = urllib.request.Request(inst["url"], headers={
+            "Authorization": "Basic "
+            + base64.b64encode(b"sd:secret-pw").decode()})
+        assert urllib.request.urlopen(req, timeout=10).status == 200
+        # /health stays open (the reference server's probe exemption)
+        assert urllib.request.urlopen(
+            inst["url"] + "health", timeout=10).read() == b"OK"
+    finally:
+        desktop.shutdown(tmp_path / "data", inst["node"], inst["shell"])
