@@ -157,12 +157,24 @@ def bench_device_kernel() -> dict:
 
     xfer_t, _ = time_best(run_with_transfer, 1)
 
+    # live H2D link rate (16 MiB incompressible + honest barrier): the
+    # number that decides which engine the hybrid router SHOULD pick —
+    # recorded so the routing decision is auditable per run (see
+    # docs/architecture/tpu-backend.md, "The host→device ceiling")
+    probe = rng.integers(0, 256, 16 * 1024 * 1024, dtype=np.uint8)
+    barrier = jax.jit(lambda x: x[:8].astype(jax.numpy.uint32).sum())
+    np.asarray(barrier(jax.device_put(probe)))  # compile off the clock
+    h2d_t, _ = time_best(
+        lambda: np.asarray(barrier(jax.device_put(probe))), 2)
+    h2d_mbps = probe.nbytes / 1e6 / h2d_t
+
     gb = B * sampled_bytes / 1e9
     print(f"info: device-resident kernel {B} lanes x {sampled_bytes}B: "
           f"device {dev_t:.3f}s ({gb / dev_t:.2f} GB/s, "
           f"{B / dev_t:.0f} files-equiv/s) | +transfer {xfer_t:.3f}s "
           f"({gb / xfer_t:.2f} GB/s) | host 1-core native {host_t:.3f}s "
-          f"({gb / host_t:.2f} GB/s)", file=sys.stderr)
+          f"({gb / host_t:.2f} GB/s) | h2d link {h2d_mbps:.0f} MB/s",
+          file=sys.stderr)
     return {
         "metric": f"blake3_device_resident_GBps[{B}x56KiB]",
         "value": round(gb / dev_t, 2),
@@ -171,6 +183,7 @@ def bench_device_kernel() -> dict:
         "files_equiv_per_sec": round(B / dev_t, 1),
         "transfer_included_GBps": round(gb / xfer_t, 2),
         "host_native_GBps": round(gb / host_t, 2),
+        "h2d_MBps": round(h2d_mbps, 1),
     }
 
 

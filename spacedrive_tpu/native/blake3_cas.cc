@@ -715,6 +715,31 @@ int cas_id_for_fd(int fd, uint64_t size, char out17[17]) {
   return 0;
 }
 
+// Run fn(i) for i in [0, n) across up to n_threads workers (atomic work
+// stealing); the single-threaded path spawns nothing. The one thread-pool
+// idiom shared by the gather, hash-batch, and row-hash loops.
+template <typename F>
+void for_each_parallel(int32_t n, int32_t n_threads, F fn) {
+  if (n_threads < 1) n_threads = 1;
+  n_threads = std::min(n_threads, n);
+  if (n_threads <= 1 || n <= 1) {
+    for (int32_t i = 0; i < n; i++) fn(i);
+    return;
+  }
+  std::atomic<int32_t> next(0);
+  auto worker = [&]() {
+    for (;;) {
+      int32_t i = next.fetch_add(1);
+      if (i >= n) break;
+      fn(i);
+    }
+  };
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  for (int32_t t = 0; t < n_threads; t++) threads.emplace_back(worker);
+  for (auto& th : threads) th.join();
+}
+
 // ---- io_uring batched sample gather -------------------------------------
 //
 // The sampling pattern costs 9 syscalls per file (open, 6 preads, close)
@@ -739,10 +764,36 @@ struct Uring {
   io_uring_cqe* cqes = nullptr;
   unsigned to_submit = 0;
 
+  // The ops this gather needs; probed at init so a kernel old enough to
+  // have io_uring but not these (5.1–5.5: OPENAT/READ/CLOSE landed in 5.6)
+  // fails init and the caller keeps the synchronous path. REGISTER_PROBE
+  // itself is also 5.6+, so its absence likewise means "don't use uring".
+  static bool ops_supported(int fd) {
+    constexpr unsigned NOPS = 64;
+    alignas(io_uring_probe) uint8_t buf[sizeof(io_uring_probe) +
+                                        NOPS * sizeof(io_uring_probe_op)] = {};
+    auto* probe = reinterpret_cast<io_uring_probe*>(buf);
+    if (syscall(__NR_io_uring_register, fd, IORING_REGISTER_PROBE, probe,
+                NOPS) < 0)
+      return false;
+    for (unsigned op : {static_cast<unsigned>(IORING_OP_OPENAT),
+                        static_cast<unsigned>(IORING_OP_READ),
+                        static_cast<unsigned>(IORING_OP_CLOSE)}) {
+      if (op > probe->last_op || !(probe->ops[op].flags & IO_URING_OP_SUPPORTED))
+        return false;
+    }
+    return true;
+  }
+
   bool init(unsigned entries) {
     io_uring_params p{};
     ring_fd = static_cast<int>(syscall(__NR_io_uring_setup, entries, &p));
     if (ring_fd < 0) return false;
+    if (!ops_supported(ring_fd)) {
+      close(ring_fd);
+      ring_fd = -1;
+      return false;
+    }
     sq_entries = p.sq_entries;
     sq_ring_sz = p.sq_off.array + p.sq_entries * sizeof(unsigned);
     cq_ring_sz = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
@@ -1050,19 +1101,14 @@ void sd_cas_gather_batch(const char* const* paths, const uint64_t* sizes,
                          int64_t row_stride, int32_t* lengths) {
   if (n >= 8 && uring_gather(paths, sizes, n, out, row_stride, lengths))
     return;
-  if (n_threads < 1) n_threads = 1;
-  std::atomic<int32_t> next(0);
-  auto worker = [&]() {
-    for (;;) {
-      int32_t i = next.fetch_add(1);
-      if (i >= n) break;
+  for_each_parallel(n, n_threads, [&](int32_t i) {
       uint8_t* row = out + static_cast<int64_t>(i) * row_stride;
       lengths[i] = 0;
       uint64_t size = sizes[i];
       uint64_t msg_len = msg_len_for(size);
-      if (static_cast<int64_t>(msg_len) > row_stride) continue;
+      if (static_cast<int64_t>(msg_len) > row_stride) return;
       int fd = open(paths[i], O_RDONLY);
-      if (fd < 0) continue;
+      if (fd < 0) return;
       for (int b = 0; b < 8; b++) row[b] = static_cast<uint8_t>(size >> (8 * b));
       uint8_t* dst = row + 8;
       auto read_exact = [&](uint64_t off, uint64_t len) -> bool {
@@ -1097,17 +1143,7 @@ void sd_cas_gather_batch(const char* const* paths, const uint64_t* sizes,
         }
         lengths[i] = static_cast<int32_t>(msg_len);
       }
-    }
-  };
-  if (n_threads == 1 || n == 1) {
-    worker();
-    return;
-  }
-  std::vector<std::thread> threads;
-  int32_t spawn = std::min<int32_t>(n_threads, n);
-  threads.reserve(spawn);
-  for (int32_t t = 0; t < spawn; t++) threads.emplace_back(worker);
-  for (auto& th : threads) th.join();
+  });
 }
 
 // Batch cas_id over files. out = n rows of 17 bytes (16 hex + NUL); a row
@@ -1153,50 +1189,19 @@ void sd_cas_hash_batch(const char* const* paths, const uint64_t* sizes,
           }
           row_out[16] = '\0';
         };
-        if (hash_threads == 1) {
-          for (int32_t j = 0; j < gn; j++) hash_row(j);
-        } else {
-          std::atomic<int32_t> next_row(0);
-          auto pool_worker = [&]() {
-            for (;;) {
-              int32_t j = next_row.fetch_add(1);
-              if (j >= gn) break;
-              hash_row(j);
-            }
-          };
-          std::vector<std::thread> pool;
-          pool.reserve(hash_threads);
-          for (int32_t t = 0; t < hash_threads; t++)
-            pool.emplace_back(pool_worker);
-          for (auto& th : pool) th.join();
-        }
+        for_each_parallel(gn, hash_threads, hash_row);
       }
       if (uring_ok) return;
     }
   }
-  if (n_threads < 1) n_threads = 1;
-  std::atomic<int32_t> next(0);
-  auto worker = [&]() {
-    for (;;) {
-      int32_t i = next.fetch_add(1);
-      if (i >= n) break;
+  for_each_parallel(n, n_threads, [&](int32_t i) {
       char* row = out + static_cast<size_t>(i) * 17;
       row[0] = '\0';
       int fd = open(paths[i], O_RDONLY);
-      if (fd < 0) continue;
+      if (fd < 0) return;
       cas_id_for_fd(fd, sizes[i], row);
       close(fd);
-    }
-  };
-  if (n_threads == 1 || n == 1) {
-    worker();
-    return;
-  }
-  std::vector<std::thread> threads;
-  int32_t spawn = std::min<int32_t>(n_threads, n);
-  threads.reserve(spawn);
-  for (int32_t t = 0; t < spawn; t++) threads.emplace_back(worker);
-  for (auto& th : threads) th.join();
+  });
 }
 
 }  // extern "C"

@@ -95,3 +95,45 @@ def test_hybrid_hasher_adaptive_routing(tmp_path):
     assert hy.hash_batch(paths, sizes) == expect
     hy._device_rate = hy._cpu_rate * 10
     assert hy.hash_batch(paths, sizes) == expect
+
+
+def test_hybrid_router_provably_picks_fastest(tmp_path, monkeypatch):
+    """The router's core guarantee (tpu-backend.md, ceiling section): when
+    the device engine loses the probe, NO sampled work is dispatched to it
+    — hybrid throughput equals the best engine by construction — and when
+    it wins, stolen chunks plus the drain still cover every file."""
+    import random
+
+    from spacedrive_tpu.objects import hasher as hmod
+    from spacedrive_tpu.objects.cas import generate_cas_id
+
+    rng = random.Random(11)
+    paths, sizes = [], []
+    for i in range(30):
+        size = 150_000 + i  # all sampled-class
+        p = tmp_path / f"r{i}.bin"
+        p.write_bytes(rng.randbytes(size))
+        paths.append(str(p))
+        sizes.append(size)
+    expect = [generate_cas_id(p, s) for p, s in zip(paths, sizes)]
+
+    hy = hmod.HybridHasher()
+    device_calls = []
+
+    def spy(paths_, sizes_, idxs, out):
+        device_calls.append(list(idxs))
+        hy._cpu_into(paths_, sizes_, idxs, out)  # correct values, fake engine
+
+    monkeypatch.setattr(hy._tpu, "_hash_sampled", spy)
+
+    # device lost the probe: the sampled set must never reach the device
+    hy._cpu_rate, hy._device_rate = 1000.0, 10.0
+    assert hy.hash_batch(paths, sizes) == expect
+    assert device_calls == []
+
+    # device won the probe: it participates (only on sampled indices), and
+    # every index still resolves to the right cas_id
+    hy._cpu_rate, hy._device_rate = 10.0, 1000.0
+    assert hy.hash_batch(paths, sizes) == expect
+    stolen = {i for chunk in device_calls for i in chunk}
+    assert stolen and stolen <= set(range(len(paths)))
