@@ -319,16 +319,20 @@ def bench_thumbs() -> dict:
 def bench_sync() -> dict:
     """Two-node CRDT sync throughput (BASELINE config 5's replication
     half): emit N shared ops on instance A, pull+ingest them on B through
-    the real manager/ingester with the production 1000-op pull batches;
-    vs_baseline = speedup over the reference test's 100-op pull batch
-    (core/crates/sync tests/lib.rs:140)."""
+    the real manager/ingester with the production 1000-op pull windows
+    (batch prefetch + optimistic single-savepoint pass). vs_baseline =
+    speedup over REFERENCE-FAITHFUL ingestion: per-op arbitration queries
+    and per-op savepoints (the shape of ingest.rs:114-186's
+    receive_crdt_operation) at the reference test's 100-op pull window
+    (core/crates/sync tests/lib.rs:140) — i.e. production pipeline vs the
+    reference design on identical hardware and data."""
     import shutil
 
     from spacedrive_tpu.models import Tag
     from spacedrive_tpu.node import Node
     from spacedrive_tpu.sync.ingest import Ingester
 
-    n_ops = int(os.environ.get("SD_BENCH_SYNC_OPS", "3000"))
+    n_ops = int(os.environ.get("SD_BENCH_SYNC_OPS", "30000"))
     tmp = Path(tempfile.mkdtemp(prefix="sd_bench_sync_"))
     try:
         node_a = Node(tmp / "a", probe_accelerator=False, watch_locations=False)
@@ -351,12 +355,12 @@ def bench_sync() -> dict:
                 ops, lambda db, rows=rows: [db.insert(Tag, r) for r in rows])
         emit_t = time.perf_counter() - t0
 
-        def pull_all(batch: int) -> float:
+        def pull_all(batch: int, reference_mode: bool) -> float:
             # fresh floor each run: reset B's view by ingesting into a
             # throwaway mirror library
-            mirror = node_b.libraries.create(f"m-{batch}")
+            mirror = node_b.libraries.create(f"m-{batch}-{reference_mode}")
             mirror.add_remote_instance(lib_a.instance())
-            ingester = Ingester(mirror)
+            ingester = Ingester(mirror, reference_mode=reference_mode)
             t = time.perf_counter()
             total = 0
             while True:
@@ -369,8 +373,8 @@ def bench_sync() -> dict:
             assert total >= n_ops, (total, n_ops)
             return dt
 
-        ref_t = pull_all(100)   # the reference test's pull batch
-        prod_t = pull_all(1000)  # production batch
+        ref_t = pull_all(100, True)     # reference design: per-op, 100-op window
+        prod_t = pull_all(1000, False)  # production: prefetched optimistic pass
         rate = n_ops / prod_t
         print(f"info: sync {n_ops} shared ops: emit {emit_t:.2f}s | "
               f"ingest batch=1000 {prod_t:.2f}s ({rate:,.0f} ops/s) | "

@@ -18,6 +18,7 @@ discipline the reference keeps with MAX_WORKERS=1, job/manager.rs:31-32).
 
 from __future__ import annotations
 
+import functools
 import dataclasses
 import datetime as _dt
 import json
@@ -243,12 +244,17 @@ class Database:
 
     # -- model helpers ------------------------------------------------------
     @staticmethod
-    def _insert_sql(model: type[Model], cols: list[str], or_ignore: bool) -> str:
+    @functools.lru_cache(maxsize=512)
+    def _insert_sql_cached(table: str, cols: tuple[str, ...], or_ignore: bool) -> str:
         collist = ", ".join(f'"{c}"' for c in cols)
         return (
-            f"INSERT {'OR IGNORE ' if or_ignore else ''}INTO {model.TABLE} "
+            f"INSERT {'OR IGNORE ' if or_ignore else ''}INTO {table} "
             f"({collist}) VALUES ({', '.join('?' for _ in cols)})"
         )
+
+    @classmethod
+    def _insert_sql(cls, model: type[Model], cols: list[str], or_ignore: bool) -> str:
+        return cls._insert_sql_cached(model.TABLE, tuple(cols), or_ignore)
 
     @staticmethod
     def _where_sql(model: type[Model], where: dict[str, Any]) -> tuple[str, list[Any]]:
@@ -268,6 +274,14 @@ class Database:
         sql = self._insert_sql(model, cols, or_ignore)
         cur = self.execute(sql, [model.encode(c, row[c]) for c in cols])
         return cur.lastrowid
+
+    def insert_ignore(self, model: type[Model], row: dict[str, Any]) -> bool:
+        """INSERT OR IGNORE; True iff a row was actually inserted — the
+        one-statement half of rowcount-based upserts (sync apply hot path)."""
+        cols = [c for c in row.keys() if c in model.FIELDS]
+        sql = self._insert_sql(model, cols, True)
+        cur = self.execute(sql, [model.encode(c, row[c]) for c in cols])
+        return cur.rowcount > 0
 
     def insert_many(self, model: type[Model], rows: list[dict[str, Any]], or_ignore: bool = False) -> int:
         if not rows:

@@ -51,7 +51,9 @@ class SharedOperationRow(Model):
         "data": Field(_J),
         "instance_id": Field(_I, nullable=False, references="instance.id", on_delete="RESTRICT"),
     }
-    INDEXES = (("instance_id", "timestamp"), ("model", "record_id"))
+    #: (timestamp, id) serves get_ops' ORDER BY + LIMIT without a sort
+    INDEXES = (("instance_id", "timestamp"), ("model", "record_id"),
+               ("timestamp", "id"))
 
 
 class RelationOperationRow(Model):
@@ -66,7 +68,8 @@ class RelationOperationRow(Model):
         "data": Field(_J),
         "instance_id": Field(_I, nullable=False, references="instance.id", on_delete="RESTRICT"),
     }
-    INDEXES = (("instance_id", "timestamp"), ("relation", "item_id", "group_id"))
+    INDEXES = (("instance_id", "timestamp"),
+               ("relation", "item_id", "group_id"), ("timestamp", "id"))
 
 
 # ---- identity / stats (schema.prisma:57-127) -----------------------------

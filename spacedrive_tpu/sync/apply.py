@@ -54,11 +54,20 @@ def apply_shared(db: Database, op: SharedOp) -> None:
 
     if op.kind == CREATE:
         fields = {k: resolve_value(db, v) for k, v in (op.data or {}).items()}
-        existing = db.find_one(model, where)
-        if existing is None:
-            db.insert(model, {**where, **fields})
-        elif fields:
-            db.update(model, where, fields)
+        # rowcount-based upsert: one statement in the common (new record)
+        # case instead of find_one + insert. OR IGNORE swallows conflicts on
+        # ANY unique constraint, so when neither the insert nor the update
+        # lands the create was blocked by a foreign unique (e.g. a local
+        # file_path row with the same (location, path) but another pub_id) —
+        # surface that as ApplyError so the op is logged without effect and
+        # the divergence stays visible, as the plain-INSERT path did.
+        if not db.insert_ignore(model, {**where, **fields}):
+            updated = db.update(model, where, fields) if fields else None
+            if updated == 0 or (updated is None
+                                and db.find_one(model, where) is None):
+                raise ApplyError(
+                    f"create for {op.model} {op.record_id!r} blocked by a "
+                    "unique constraint on another record")
     elif op.kind == DELETE:
         db.delete(model, where)
     elif op.kind.startswith(UPDATE_PREFIX):
@@ -66,12 +75,14 @@ def apply_shared(db: Database, op: SharedOp) -> None:
         if field not in model.FIELDS:
             raise ApplyError(f"{op.model} has no field {field!r}")
         value = resolve_value(db, op.data)
-        if db.find_one(model, where) is None:
+        if db.update(model, where, {field: value}) == 0:
             # update for a record we never saw: materialize it (the reference
-            # applies ops idempotently; order across instances isn't guaranteed)
-            db.insert(model, {**where, field: value})
-        else:
-            db.update(model, where, {field: value})
+            # applies ops idempotently; order across instances isn't
+            # guaranteed)
+            if not db.insert_ignore(model, {**where, field: value}):
+                raise ApplyError(
+                    f"update for {op.model} {op.record_id!r} blocked by a "
+                    "unique constraint on another record")
     else:
         raise ApplyError(f"unknown shared op kind {op.kind!r}")
 

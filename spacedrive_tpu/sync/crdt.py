@@ -62,9 +62,17 @@ class CRDTOperation:
     typ: SharedOp | RelationOp
 
     def to_wire(self) -> dict[str, Any]:
+        # hand-rolled (not dataclasses.asdict): asdict deep-copies the data
+        # payload and dominates the sender side of big pull windows; wire
+        # dicts are treated as read-only by every consumer
         t = self.typ
-        body = dataclasses.asdict(t)
-        body["_t"] = "shared" if isinstance(t, SharedOp) else "relation"
+        if isinstance(t, SharedOp):
+            body = {"model": t.model, "record_id": t.record_id,
+                    "kind": t.kind, "data": t.data, "_t": "shared"}
+        else:
+            body = {"relation": t.relation, "item_id": t.item_id,
+                    "group_id": t.group_id, "kind": t.kind, "data": t.data,
+                    "_t": "relation"}
         return {"instance": self.instance, "timestamp": self.timestamp,
                 "id": self.id, "typ": body}
 

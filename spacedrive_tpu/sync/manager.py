@@ -42,19 +42,32 @@ class SyncManager:
         self.clock = HLC(self._stored_clock_floor())
         self._subscribers: list[Callable[[str], None]] = []
         self._lock = threading.Lock()
+        self._instance_ids: dict[str, int] = {}
 
     # -- identity -----------------------------------------------------------
     @property
     def instance_pub_id(self) -> str:
+        # memoized: the library's own instance pub_id is immutable, and the
+        # ingest loop consults this once per op
+        cached = self.__dict__.get("_own_pub_id")
+        if cached is not None:
+            return cached
         row = self.library.instance()
         if row is None:
             raise RuntimeError("library has no instance row")
+        self.__dict__["_own_pub_id"] = row["pub_id"]
         return row["pub_id"]
 
     def _instance_db_id(self, pub_id: str) -> int:
+        # memoized: log_ops resolves this per op and instance rows are
+        # append-only (never re-keyed), so the mapping cannot go stale
+        cached = self._instance_ids.get(pub_id)
+        if cached is not None:
+            return cached
         row = self.library.db.find_one(Instance, {"pub_id": pub_id})
         if row is None:
             raise RuntimeError(f"unknown instance {pub_id}")
+        self._instance_ids[pub_id] = row["id"]
         return row["id"]
 
     def _stored_clock_floor(self) -> int:
@@ -188,22 +201,35 @@ class SyncManager:
         return result
 
     def log_ops(self, ops: list[CRDTOperation]) -> None:
+        import json as _json
+
         db = self.library.db
+        shared_rows: list[tuple] = []
+        relation_rows: list[tuple] = []
         for op in ops:
             inst = self._instance_db_id(op.instance)
             t = op.typ
+            data = (None if t.data is None
+                    else _json.dumps(t.data, sort_keys=True))
             if isinstance(t, SharedOp):
-                db.insert(SharedOperationRow, {
-                    "id": op.id, "timestamp": op.timestamp, "model": t.model,
-                    "record_id": str(t.record_id), "kind": t.kind,
-                    "data": t.data, "instance_id": inst,
-                }, or_ignore=True)
+                shared_rows.append((op.id, op.timestamp, t.model,
+                                    str(t.record_id), t.kind, data, inst))
             else:
-                db.insert(RelationOperationRow, {
-                    "id": op.id, "timestamp": op.timestamp, "relation": t.relation,
-                    "item_id": str(t.item_id), "group_id": str(t.group_id),
-                    "kind": t.kind, "data": t.data, "instance_id": inst,
-                }, or_ignore=True)
+                relation_rows.append((op.id, op.timestamp, t.relation,
+                                      str(t.item_id), str(t.group_id),
+                                      t.kind, data, inst))
+        # one pre-encoded executemany per table: the ingest fast path logs
+        # whole pull windows at once
+        if shared_rows:
+            db.executemany(
+                "INSERT OR IGNORE INTO shared_operation "
+                "(id, timestamp, model, record_id, kind, data, instance_id) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?)", shared_rows)
+        if relation_rows:
+            db.executemany(
+                "INSERT OR IGNORE INTO relation_operation "
+                "(id, timestamp, relation, item_id, group_id, kind, data, "
+                "instance_id) VALUES (?, ?, ?, ?, ?, ?, ?, ?)", relation_rows)
 
     # -- read path ----------------------------------------------------------
     def timestamps(self) -> dict[str, int]:
@@ -243,25 +269,34 @@ class SyncManager:
         floor_sql = (f"CASE instance_id {' '.join(case_parts)} ELSE 0 END"
                      if case_parts else "0")
 
-        def fetch(model, table: str) -> list[dict[str, Any]]:
-            rows = db.query(
+        import json as _json
+
+        def fetch(table: str) -> list:
+            return db.query(
                 f"SELECT * FROM {table} WHERE timestamp > {floor_sql} "
                 f"ORDER BY timestamp, id LIMIT ?",
                 case_params + [count + 1])
-            return [model.decode_row(r) for r in rows]
 
-        ops: list[CRDTOperation] = []
-        for r in fetch(SharedOperationRow, "shared_operation"):
-            ops.append(CRDTOperation(
-                instance=inst_pub[r["instance_id"]], timestamp=r["timestamp"],
-                id=r["id"],
-                typ=SharedOp(r["model"], r["record_id"], r["kind"], r["data"])))
-        for r in fetch(RelationOperationRow, "relation_operation"):
-            ops.append(CRDTOperation(
-                instance=inst_pub[r["instance_id"]], timestamp=r["timestamp"],
-                id=r["id"],
-                typ=RelationOp(r["relation"], r["item_id"], r["group_id"],
-                               r["kind"], r["data"])))
-        ops.sort(key=lambda o: (o.timestamp, o.id))
+        # wire dicts built straight from the rows (no dataclass round-trip:
+        # this is the sender-side hot loop of big pull windows)
+        def _data(v: Any) -> Any:
+            return _json.loads(v) if isinstance(v, str) else v
+
+        ops: list[dict[str, Any]] = []
+        for r in fetch("shared_operation"):
+            ops.append({
+                "instance": inst_pub[r["instance_id"]],
+                "timestamp": r["timestamp"], "id": r["id"],
+                "typ": {"model": r["model"], "record_id": r["record_id"],
+                        "kind": r["kind"], "data": _data(r["data"]),
+                        "_t": "shared"}})
+        for r in fetch("relation_operation"):
+            ops.append({
+                "instance": inst_pub[r["instance_id"]],
+                "timestamp": r["timestamp"], "id": r["id"],
+                "typ": {"relation": r["relation"], "item_id": r["item_id"],
+                        "group_id": r["group_id"], "kind": r["kind"],
+                        "data": _data(r["data"]), "_t": "relation"}})
+        ops.sort(key=lambda o: (o["timestamp"], o["id"]))
         has_more = len(ops) > count
-        return [o.to_wire() for o in ops[:count]], has_more
+        return ops[:count], has_more

@@ -474,3 +474,44 @@ def test_absurd_timestamp_rejected_in_ingest(pair):
     assert lib_b.db.find_one(Tag, {"name": "evil"}) is None
     assert lib_b.sync.clock.last < ntp64(time.time() + 120)
     assert lib_b.sync.timestamps()[lib_a.sync.instance_pub_id] < 1 << 62
+
+
+def test_create_blocked_by_foreign_unique_stays_visible(pair):
+    """A remote Create whose row collides with a LOCAL row on a non-sync
+    unique (file_path's (location_id, materialized_path, name, extension))
+    must be logged WITHOUT effect — not silently counted as applied — and
+    must not abort the rest of the window (the both-nodes-indexed-the-same-
+    path-before-pairing case)."""
+    lib_a, lib_b = pair
+
+    # same location pub_id on both sides so the ref resolves on B
+    lib_a.db.insert(Location, {"pub_id": "locX", "name": "l", "path": "/x"})
+    loc_b = lib_b.db.insert(Location, {"pub_id": "locX", "name": "l", "path": "/x"})
+
+    # B already has a local row for the path, under its own pub_id
+    lib_b.db.insert(FilePath, {
+        "pub_id": "b-local", "location_id": loc_b,
+        "materialized_path": "/", "name": "clash", "extension": "txt",
+        "is_dir": False,
+    })
+
+    # A creates the same path under a different pub_id and emits it,
+    # followed by an unrelated op that must still apply
+    from spacedrive_tpu.sync.crdt import ref
+
+    op1 = lib_a.sync.shared_create(FilePath, "a-remote", {
+        "location_id": ref("location", "locX"),
+        "materialized_path": "/", "name": "clash", "extension": "txt",
+    })
+    op2 = lib_a.sync.shared_create(Tag, "tag-after", {"name": "after"})
+    lib_a.sync.write_ops([op1, op2], lambda db: None)
+
+    pump(lib_a, lib_b)
+
+    # the blocked create materialized nothing and B's row is untouched...
+    assert lib_b.db.find_one(FilePath, {"pub_id": "a-remote"}) is None
+    assert lib_b.db.find_one(FilePath, {"pub_id": "b-local"}) is not None
+    # ...but the op IS logged (shadow info propagates) and later ops applied
+    from spacedrive_tpu.models import SharedOperationRow
+    assert lib_b.db.find_one(SharedOperationRow, {"id": op1.id}) is not None
+    assert lib_b.db.find_one(Tag, {"pub_id": "tag-after"}) is not None
