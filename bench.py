@@ -316,6 +316,141 @@ def bench_thumbs() -> dict:
     }
 
 
+def _ensure_scan_fixture(n_files: int) -> Path:
+    """Build (once) and cache a mixed n-file tree: ~85% small text-class
+    files (0.4–4 KiB, whole-file cas messages), 10% mid (40 KiB), 5%
+    sampled-class (150 KiB > MINIMUM_FILE_SIZE). 200 directories. Matches
+    BASELINE config 2's '100k-file mixed tree' shape without media decode
+    noise (extensions stay data-class so the media stage runs but has no
+    thumbnail work — its cost is measured, its codec noise is not)."""
+    import numpy as np
+
+    root = Path(__file__).parent / ".bench_cache" / f"scan_{n_files}_v2"
+    marker = root / ".complete"
+    if marker.exists():
+        return root
+    import shutil
+
+    shutil.rmtree(root, ignore_errors=True)
+    root.mkdir(parents=True)
+    rng = np.random.default_rng(1234)
+    pool = rng.integers(0, 256, 256 * 1024 + n_files, dtype=np.uint8).tobytes()
+    n_dirs = 200
+    dirs = []
+    for d in range(n_dirs):
+        p = root / f"d{d:03d}"
+        p.mkdir()
+        dirs.append(p)
+    for i in range(n_files):
+        # slot keyed to the file's index WITHIN its directory (i % n_dirs
+        # picks the dir), so every directory carries the full size mix —
+        # i % 20 would alias with the dir assignment and concentrate each
+        # size class into dedicated directories
+        slot = (i // n_dirs) % 20
+        if slot >= 19:
+            size = 150 * 1024
+        elif slot >= 17:
+            size = 40 * 1024
+        else:
+            size = 400 + (i * 37) % 3600
+        # unique leading offset → distinct contents (no dedup collapse)
+        (dirs[i % n_dirs] / f"f{i:06d}.dat").write_bytes(pool[i : i + size])
+    marker.write_bytes(b"ok")
+    return root
+
+
+def bench_scan() -> dict:
+    """BASELINE configs 1-2: full end-to-end scan_location throughput
+    (walk → index → identify → media) over the cached 100k-file mixed tree,
+    production hybrid hasher vs the cpu backend, fresh library each run.
+    Peak RSS recorded (the jobs run in this process)."""
+    import resource
+    import shutil
+
+    from spacedrive_tpu.locations import create_location
+    from spacedrive_tpu.locations.indexer_job import IndexerJob
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.objects.file_identifier import FileIdentifierJob
+    from spacedrive_tpu.objects.media.processor import MediaProcessorJob
+
+    n_files = int(os.environ.get("SD_BENCH_SCAN_FILES", "100000"))
+    fixture = _ensure_scan_fixture(n_files)
+
+    # warm the process-wide hybrid engine off the clock: its one-time probe
+    # (XLA kernel compile + link measurement) is per-process, not per-scan,
+    # and would otherwise dominate the timed window
+    from spacedrive_tpu.objects.hasher import get_hasher
+
+    warm: list[tuple[str, int]] = []
+    for p in sorted(fixture.rglob("*.dat")):
+        size = p.stat().st_size
+        if size > 100 * 1024:  # sampled-class: what the probe measures
+            warm.append((str(p), size))
+        if len(warm) >= 24:
+            break
+    get_hasher("hybrid").hash_batch([p for p, _ in warm],
+                                    [s for _, s in warm])
+
+    # pre-read the tree so both timed passes see the same (warm) page
+    # cache — otherwise whichever hasher runs first pays the cold IO and
+    # the comparison wobbles with fixture-cache state
+    for p in fixture.rglob("*.dat"):
+        with open(p, "rb") as fh:
+            while fh.read(1 << 20):
+                pass
+
+    def one_scan(hasher: str) -> float:
+        tmp = Path(tempfile.mkdtemp(prefix=f"sd_scan_{hasher}_"))
+        try:
+            node = Node(tmp, probe_accelerator=False, watch_locations=False)
+            # the GC actors' periodic ticks (30s/60s) would land inside one
+            # engine's window and not the other's — this measures the scan
+            # pipeline, not actor scheduling luck
+            node.thumbnail_remover.stop()
+            lib = node.libraries.create(f"scan-{hasher}")
+            lib.orphan_remover.stop()
+            loc = create_location(lib, str(fixture), hasher=hasher)
+            args = {"location_id": loc["id"]}
+            t0 = time.perf_counter()
+            node.jobs.spawn(lib, [IndexerJob(dict(args)),
+                                  FileIdentifierJob(dict(args)),
+                                  MediaProcessorJob(dict(args))],
+                            action="scan_location")
+            assert node.jobs.wait_idle(3600)
+            dt = time.perf_counter() - t0
+            n_indexed = lib.db.query(
+                "SELECT count(*) c FROM file_path WHERE is_dir=0")[0]["c"]
+            n_identified = lib.db.query(
+                "SELECT count(*) c FROM file_path WHERE cas_id IS NOT NULL")[0]["c"]
+            assert n_indexed == n_files, (n_indexed, n_files)
+            assert n_identified == n_files, (n_identified, n_files)
+            node.shutdown()
+            return dt
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    # alternate engine order and keep each engine's best: single-core hosts
+    # share the core with the device tunnel daemon, so one-shot timings
+    # wobble ±15%
+    times = {"cpu": one_scan("cpu"), "hybrid": one_scan("hybrid")}
+    times["hybrid"] = min(times["hybrid"], one_scan("hybrid"))
+    times["cpu"] = min(times["cpu"], one_scan("cpu"))
+
+    peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    rate = n_files / times["hybrid"]
+    print(f"info: scan {n_files} files e2e: cpu {times['cpu']:.1f}s | "
+          f"hybrid {times['hybrid']:.1f}s ({rate:,.0f} files/s) | "
+          f"peak RSS {peak_rss_mb:.0f} MB", file=sys.stderr)
+    return {
+        "metric": f"scan_e2e_files_per_sec[{n_files}files]",
+        "value": round(rate, 1),
+        "unit": "files/sec",
+        "vs_baseline": round(times["cpu"] / times["hybrid"], 3),
+        "cpu_files_per_sec": round(n_files / times["cpu"], 1),
+        "peak_rss_mb": round(peak_rss_mb, 1),
+    }
+
+
 def bench_sync() -> dict:
     """Two-node CRDT sync throughput (BASELINE config 5's replication
     half): emit N shared ops on instance A, pull+ingest them on B through
@@ -401,6 +536,10 @@ def main() -> int:
         record = bench_device_kernel()
     elif MODE == "thumbs":
         record = bench_thumbs()
+    elif MODE == "scan":
+        record = bench_scan()
+    elif MODE == "sync":
+        record = bench_sync()
     else:  # combined (default): dedup headline + north-star identify record
         # + the device-resident kernel evidence (both identify regimes)
         # + the batched thumbnail-resize experiment
@@ -414,6 +553,18 @@ def main() -> int:
             record["extra"].append(bench_sync())
         except Exception as e:
             print(f"warn: sync bench skipped: {e}", file=sys.stderr)
+        try:
+            # own process: its peak-RSS figure must not inherit the device
+            # benches' high-water mark
+            import subprocess
+
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env={**os.environ, "SD_BENCH_MODE": "scan"},
+                capture_output=True, text=True, check=True, timeout=3600)
+            record["extra"].append(json.loads(out.stdout.strip().splitlines()[-1]))
+        except Exception as e:
+            print(f"warn: scan bench skipped: {e}", file=sys.stderr)
     print(json.dumps(record))
     return 0
 

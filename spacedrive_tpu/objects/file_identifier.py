@@ -114,10 +114,12 @@ class FileIdentifierJob(StatefulJob):
         ops = []  # CRDT ops logged atomically with the writes (write_ops semantics)
 
         with db.transaction():
-            # 1. write cas_ids
-            for row, cas in identified:
-                db.update(FilePath, {"id": row["id"]}, {"cas_id": cas})
-                if emit:
+            # 1. write cas_ids (one executemany: this loop runs for every
+            # file in the location)
+            db.executemany("UPDATE file_path SET cas_id = ? WHERE id = ?",
+                           [(cas, row["id"]) for row, cas in identified])
+            if emit:
+                for row, cas in identified:
                     ops.append(sync.shared_update(FilePath, row["pub_id"], "cas_id", cas))
 
             # 2. link to existing objects owning these cas_ids
@@ -133,11 +135,12 @@ class FileIdentifierJob(StatefulJob):
                     existing.setdefault(r["cas_id"], (r["oid"], r["opub"]))
 
             linked = 0
+            link_rows: list[tuple[int, int]] = []  # (object_id, file_path_id)
             need_object: dict[str, list[dict]] = {}
             for row, cas in identified:
                 if cas in existing:
                     oid, opub = existing[cas]
-                    db.update(FilePath, {"id": row["id"]}, {"object_id": oid})
+                    link_rows.append((oid, row["id"]))
                     if emit:
                         ops.append(sync.shared_update(
                             FilePath, row["pub_id"], "object_id", ref_obj(opub)))
@@ -152,7 +155,7 @@ class FileIdentifierJob(StatefulJob):
                                                 data["location_path"])
                 created += 1
                 for row in members:
-                    db.update(FilePath, {"id": row["id"]}, {"object_id": oid})
+                    link_rows.append((oid, row["id"]))
                     if emit:
                         ops.append(sync.shared_update(
                             FilePath, row["pub_id"], "object_id", ref_obj(opub)))
@@ -160,10 +163,12 @@ class FileIdentifierJob(StatefulJob):
                 oid, opub = self._create_object(ctx, row, emit, ops,
                                                 data["location_path"])
                 created += 1
-                db.update(FilePath, {"id": row["id"]}, {"object_id": oid})
+                link_rows.append((oid, row["id"]))
                 if emit:
                     ops.append(sync.shared_update(
                         FilePath, row["pub_id"], "object_id", ref_obj(opub)))
+            db.executemany("UPDATE file_path SET object_id = ? WHERE id = ?",
+                           link_rows)
             if emit and ops:
                 sync.log_ops(ops)
         if emit and ops:

@@ -93,9 +93,36 @@ CONFLICTING_EXTENSIONS = {
 }
 
 
+# First-byte dispatch table: scanning all ~46 signatures per file costs
+# ~90µs in the identifier's object-creation hot loop; bucketing by the
+# first signature byte cuts the candidate set to 0–3 per file. Entries
+# keep their MAGIC_SIGNATURES index so overlapping candidates (e.g. an
+# offset-257 tar signature vs an offset-0 one) are still tried in the
+# original priority order.
+def _build_sniff_table() -> tuple[dict[int, list], list]:
+    by_first: dict[int, list] = {}
+    offset_only: list = []  # first part not at offset 0: always candidates
+    for i, (kind, parts) in enumerate(MAGIC_SIGNATURES):
+        off, sig = parts[0]
+        if off == 0 and sig:
+            by_first.setdefault(sig[0], []).append((i, kind, parts))
+        else:
+            offset_only.append((i, kind, parts))
+    # merge the offset-only entries into every bucket at import time so the
+    # per-call lookup is a single dict get with no allocation or sort
+    merged = {b: sorted(entries + offset_only)
+              for b, entries in by_first.items()}
+    return merged, sorted(offset_only)
+
+
+_SNIFF_BY_FIRST, _SNIFF_DEFAULT = _build_sniff_table()
+
+
 def sniff_kind(head: bytes) -> int | None:
     """Header bytes → ObjectKind, or None when no signature matches."""
-    for kind, parts in MAGIC_SIGNATURES:
+    if not head:
+        return None
+    for _, kind, parts in _SNIFF_BY_FIRST.get(head[0], _SNIFF_DEFAULT):
         if all(head[off:off + len(sig)] == sig for off, sig in parts):
             return kind
     return None
