@@ -11,6 +11,19 @@ from __future__ import annotations
 
 from ...models import Album, Space
 from ...objects import collections as col
+from ..router import ApiError
+
+
+def _require(arg, *keys):
+    """Missing required fields are a 400-class ApiError, not a bare
+    KeyError surfacing as a 500 (matches the other routers' argument
+    handling)."""
+    if not isinstance(arg, dict):
+        raise ApiError(f"expected an object argument with {list(keys)}")
+    missing = [k for k in keys if k not in arg]
+    if missing:
+        raise ApiError(f"missing required argument field(s): {missing}")
+    return arg
 
 
 def _mount_collection(router, key: str, model) -> None:
@@ -26,11 +39,17 @@ def _mount_collection(router, key: str, model) -> None:
         if model is Album:
             extra["is_hidden"] = bool(
                 isinstance(arg, dict) and arg.get("is_hidden"))
-        name = arg["name"] if isinstance(arg, dict) else str(arg)
+        if isinstance(arg, dict):
+            name = _require(arg, "name")["name"]
+        elif isinstance(arg, str):
+            name = arg
+        else:
+            raise ApiError("expected a name string or {name: ...} object")
         return col.create_collection(library, model, name, **extra)
 
     @router.library_mutation(f"{key}.update")
     def update(node, library, arg):
+        _require(arg, "id")
         values = {k: arg.get(k) for k in ("name", "description", "is_hidden")
                   if k in model.FIELDS}
         col.update_collection(library, model, arg["id"], **values)
@@ -43,11 +62,13 @@ def _mount_collection(router, key: str, model) -> None:
 
     @router.library_mutation(f"{key}.addObjects")
     def add_objects(node, library, arg):
+        _require(arg, "id", "object_ids")
         return col.set_membership(library, model, arg["id"],
                                   arg["object_ids"])
 
     @router.library_mutation(f"{key}.removeObjects")
     def remove_objects(node, library, arg):
+        _require(arg, "id", "object_ids")
         return col.set_membership(library, model, arg["id"],
                                   arg["object_ids"], remove=True)
 
@@ -70,6 +91,7 @@ def mount(router) -> None:
 
     @router.library_mutation("labels.assign")
     def labels_assign(node, library, arg):
+        _require(arg, "name", "object_ids")
         label = col.ensure_label(library, arg["name"])
         return col.label_objects(library, label["id"], arg["object_ids"],
                                  remove=bool(arg.get("remove")))

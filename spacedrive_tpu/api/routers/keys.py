@@ -18,6 +18,16 @@ from ...crypto.keymanager import KeyManagerError
 from ..router import ApiError
 
 
+#: procedures HTTP shells refuse while basic auth is off (any local user
+#: can reach a localhost port): getKey RETURNS raw key material,
+#: backupKeystore WRITES an arbitrary server-writable path, and
+#: restoreKeystore merges attacker-known key material into the keystore.
+#: In-process consumers (client, FFI) are unaffected.
+SECRET_PROCEDURES = frozenset({
+    "keys.getKey", "keys.backupKeystore", "keys.restoreKeystore",
+})
+
+
 def _km(node):
     km = getattr(node, "key_manager", None)
     if km is None:

@@ -36,22 +36,84 @@ def _instance_file(data_dir: Path) -> Path:
     return data_dir / "desktop_instance.json"
 
 
+def _instance_alive(info: dict) -> bool:
+    """A recycled pid can impersonate a dead shell, so pid liveness alone
+    is not trusted: the recorded URL must also answer /health. An entry
+    still booting (url not yet recorded) counts as alive while its pid is."""
+    try:
+        os.kill(int(info["pid"]), 0)
+    except (OSError, ValueError, KeyError, TypeError):
+        return False
+    url = info.get("url")
+    if url is None:
+        return True  # claimed, server still starting
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(url.rstrip("/") + "/health",
+                                    timeout=2) as resp:
+            return resp.status == 200
+    except Exception:
+        return False
+
+
+def _instance_lock(data_dir: Path):
+    """flock-guarded critical section for every read-check-mutate of the
+    instance file — serializing launchers is the only way a stale-file
+    cleanup can't delete a competitor's fresh claim (plain unlink is a
+    TOCTOU)."""
+    import contextlib
+    import fcntl
+
+    @contextlib.contextmanager
+    def guard():
+        data_dir.mkdir(parents=True, exist_ok=True)
+        with open(data_dir / "desktop_instance.lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
+
+    return guard()
+
+
 def _running_instance(data_dir: Path) -> dict | None:
-    """The live instance's {pid, url}, or None. Stale files (dead pid) are
-    cleaned up rather than blocking a relaunch."""
+    """The live instance's {pid, url}, or None. Stale files (dead pid or a
+    URL that no longer answers) are cleaned up rather than blocking a
+    relaunch."""
+    with _instance_lock(data_dir):
+        return _running_instance_locked(data_dir)
+
+
+def _running_instance_locked(data_dir: Path) -> dict | None:
     f = _instance_file(data_dir)
     try:
         info = json.loads(f.read_text())
-        os.kill(int(info["pid"]), 0)  # raises when the pid is gone
-        return info
     except FileNotFoundError:
         return None
-    except (OSError, ValueError, KeyError):
-        try:
-            f.unlink()
-        except OSError:
-            pass
-        return None
+    except (OSError, ValueError):
+        info = None
+    if info is not None and _instance_alive(info):
+        return info
+    try:
+        f.unlink()
+    except OSError:
+        pass
+    return None
+
+
+def _claim_instance(data_dir: Path) -> bool:
+    """Atomically claim the single-instance slot. Returns False when a live
+    instance (or one mid-boot) holds the claim."""
+    with _instance_lock(data_dir):
+        if _running_instance_locked(data_dir) is not None:
+            return False
+        fd = os.open(str(_instance_file(data_dir)),
+                     os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
+        with os.fdopen(fd, "w") as fh:
+            json.dump({"pid": os.getpid(), "url": None}, fh)
+        return True
 
 
 def launch(data_dir: str | Path, port: int = 0, open_browser: bool = True,
@@ -64,16 +126,24 @@ def launch(data_dir: str | Path, port: int = 0, open_browser: bool = True,
     from .server.shell import Server
 
     data_dir = Path(os.path.expanduser(str(data_dir)))
-    existing = _running_instance(data_dir)
-    if existing is not None:
-        print(f"already running (pid {existing['pid']}): {existing['url']}")
-        return {"url": existing["url"], "node": None, "shell": None}
-
-    node = Node(data_dir)
-    shell = Server(node, host="127.0.0.1", port=port, auth=auth)
-    shell.start()
-    url = f"http://127.0.0.1:{shell.port}/"
     data_dir.mkdir(parents=True, exist_ok=True)
+    if not _claim_instance(data_dir):
+        existing = _running_instance(data_dir) or {}
+        print(f"already running (pid {existing.get('pid')}): "
+              f"{existing.get('url') or '(starting)'}")
+        return {"url": existing.get("url"), "node": None, "shell": None}
+
+    try:
+        node = Node(data_dir)
+        shell = Server(node, host="127.0.0.1", port=port, auth=auth)
+        shell.start()
+    except BaseException:
+        try:
+            _instance_file(data_dir).unlink()
+        except OSError:
+            pass
+        raise
+    url = f"http://127.0.0.1:{shell.port}/"
     _instance_file(data_dir).write_text(
         json.dumps({"pid": os.getpid(), "url": url}))
 

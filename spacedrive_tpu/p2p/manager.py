@@ -45,6 +45,19 @@ if TYPE_CHECKING:
 
 logger = logging.getLogger(__name__)
 
+
+async def _read_all_payload(reader: asyncio.StreamReader, sizes: list[int],
+                            collect: bool) -> list[bytes] | None:
+    """Read every declared H_HASH payload segment; ``collect=False`` drains
+    without keeping the bytes (the refusal paths). Callers wrap this in
+    asyncio.wait_for — reading a peer-declared length must always carry a
+    deadline."""
+    if collect:
+        return [await read_exact(reader, s) for s in sizes]
+    for s in sizes:
+        await read_exact(reader, s)
+    return None
+
 MAGIC = b"SDP4"  # bumped with multiplexed substreams over one session
 SPACEDROP_TIMEOUT = 60.0  # p2p_manager.rs:42-43
 HANDSHAKE_TIMEOUT = 20.0
@@ -762,13 +775,26 @@ class P2PManager:
         if not member:
             # the client writes the payload before reading the reply —
             # drain it so refused bytes don't sit in the substream buffer
-            # until teardown (and a big batch doesn't hit the demux cap)
-            for s in sizes:
-                await read_exact(reader, s)
+            # until teardown (and a big batch doesn't hit the demux cap).
+            # Same 30s bound as the bad-shape drain: a connected peer that
+            # declares sizes but never sends the bytes must not park this
+            # coroutine and its substream forever.
+            try:
+                await asyncio.wait_for(
+                    _read_all_payload(reader, sizes, collect=False), 30)
+            except (asyncio.TimeoutError, asyncio.IncompleteReadError):
+                pass
             writer.write(json_frame({"ok": False, "error": "not a member"}))
             await writer.drain()
             return
-        messages = [await read_exact(reader, s) for s in sizes]
+        try:
+            messages = await asyncio.wait_for(
+                _read_all_payload(reader, sizes, collect=True), 30)
+        except asyncio.TimeoutError:
+            writer.write(json_frame({"ok": False,
+                                     "error": "payload read timed out"}))
+            await writer.drain()
+            return
 
         from ..objects.hasher import hash_messages
 

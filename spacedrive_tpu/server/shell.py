@@ -231,6 +231,14 @@ class Server:
         return Response.json({"result": result})
 
     async def _resolve(self, key: str, arg: Any, library_id: str | None) -> Any:
+        if self.auth is None:
+            from ..api.routers.keys import SECRET_PROCEDURES
+
+            if key in SECRET_PROCEDURES:
+                raise ApiError(
+                    f"{key} returns secret material and is disabled while "
+                    "the server runs without auth — start the shell with "
+                    "credentials (--auth / SD_DESKTOP_AUTH) to enable it")
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             self._pool, lambda: self.node.router.resolve(key, arg, library_id))

@@ -82,3 +82,18 @@ def test_membership_count_is_idempotent(lib_with_objects):
     assert r("albums.addObjects", {"id": made["id"], "object_ids": objs[:2]}) == 0
     assert r("labels.assign", {"name": "dup", "object_ids": objs[:2]}) == 2
     assert r("labels.assign", {"name": "dup", "object_ids": objs[:2]}) == 0
+
+
+def test_missing_required_args_are_client_errors(lib_with_objects):
+    """Missing fields raise ApiError (HTTP 400), not a bare KeyError
+    surfacing as a 500 (ADVICE r3)."""
+    from spacedrive_tpu.api.router import ApiError
+
+    node, lib, objs = lib_with_objects
+    r = lambda k, a: node.router.resolve(k, a, library_id=lib.id)
+    for key, bad in [("albums.create", {}), ("spaces.update", {"name": "x"}),
+                     ("albums.addObjects", {"id": 1}),
+                     ("spaces.removeObjects", {"object_ids": objs}),
+                     ("labels.assign", {"name": "l"})]:
+        with pytest.raises(ApiError, match="missing required|expected an"):
+            r(key, bad)

@@ -2,6 +2,7 @@
 reset/logs commands (the Tauri shell's responsibilities minus the bundled
 webview — apps/desktop/src-tauri/src/main.rs:74-180)."""
 
+import os
 import json
 import urllib.request
 
@@ -71,3 +72,23 @@ def test_launch_with_auth_requires_credentials(tmp_path):
             inst["url"] + "health", timeout=10).read() == b"OK"
     finally:
         desktop.shutdown(tmp_path / "data", inst["node"], inst["shell"])
+
+
+def test_recycled_pid_does_not_mask_dead_instance(tmp_path):
+    """A live pid alone must not validate the instance file — the recorded
+    URL has to answer /health (recycled-pid hazard)."""
+    d = tmp_path / "data"
+    d.mkdir()
+    (d / "desktop_instance.json").write_text(json.dumps(
+        {"pid": os.getpid(), "url": "http://127.0.0.1:1/"}))  # dead URL
+    assert desktop._running_instance(d) is None
+    assert not (d / "desktop_instance.json").exists()
+
+
+def test_claim_instance_is_exclusive(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    assert desktop._claim_instance(d)
+    # still booting (url None, live pid): a second claim must fail
+    assert not desktop._claim_instance(d)
+    (d / "desktop_instance.json").unlink()

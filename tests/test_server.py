@@ -425,3 +425,52 @@ def test_webui_and_category_click_through(server_proc):
     assert "kinds" in by_name["Documents"], "categories must expose kinds"
     stats = _rspc(base, "libraries.statistics", None, lib_id)
     assert int(stats["total_object_count"]) >= 0
+
+
+def test_secret_procedures_require_auth(tmp_path):
+    """keys.getKey returns raw key material: the HTTP shell refuses it
+    while running unauthenticated (ADVICE: localhost ports are reachable
+    by every local account), and serves it once credentials are on."""
+    import base64
+
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.server.shell import Server
+
+    node = Node(tmp_path / "data", probe_accelerator=False,
+                watch_locations=False)
+    try:
+        km = node.key_manager
+        km.setup("master-pw")
+        uuid = km.add_key("test-key")
+        key_bytes = km.get_key(uuid).expose()
+
+        open_srv = Server(node, port=0)
+        open_srv.start()
+        try:
+            body = json.dumps({"arg": uuid}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{open_srv.port}/rspc/keys.getKey",
+                data=body, headers={"content-type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 400
+            assert b"without auth" in exc.value.read()
+        finally:
+            open_srv.stop()
+
+        auth_srv = Server(node, port=0, auth="u:pw")
+        auth_srv.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{auth_srv.port}/rspc/keys.getKey",
+                data=json.dumps({"arg": uuid}).encode(),
+                headers={"content-type": "application/json",
+                         "Authorization": "Basic "
+                         + base64.b64encode(b"u:pw").decode()})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert base64.b64decode(out["result"]) == key_bytes
+        finally:
+            auth_srv.stop()
+    finally:
+        node.shutdown()
