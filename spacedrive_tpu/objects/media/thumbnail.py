@@ -17,6 +17,7 @@ from __future__ import annotations
 import logging
 import math
 import shutil
+import threading
 import subprocess
 from pathlib import Path
 
@@ -368,6 +369,47 @@ def _decode_for_device(source: Path):
         return np.asarray(img, dtype=np.uint8)
 
 
+#: sticky per-process verdict on the device resize path: None = unmeasured,
+#: True = device wins, False = device loses (every later batch goes scalar).
+#: Even with the tpuThumbnails feature ON, the processor must never keep a
+#: measurably losing path: on tunneled harnesses the per-image transfer
+#: alone exceeds the whole scalar pipeline (see tpu-backend.md's ceiling
+#: section), while a local-PCIe host measures a win and keeps batching.
+#: The lock keeps concurrent first batches from probing simultaneously
+#: (interleaved device calls would distort both measurements).
+_DEVICE_VERDICT: dict = {"value": None}
+_VERDICT_LOCK = threading.Lock()
+#: batches smaller than this never decide the verdict — a 1–2 image call
+#: charges the whole dispatch overhead to one image and would latch the
+#: scalar path on hosts where normal batches win
+_VERDICT_MIN_BATCH = 4
+
+
+def _measure_device_verdict(batch_arrays, dt_device: float) -> bool:
+    """Compare the (warm) device per-image resize time against PIL doing
+    the same resize step on the same decoded arrays."""
+    import time as _time
+
+    import numpy as np
+    from PIL import Image
+
+    from ...ops.resize_jax import target_dims
+
+    sample = batch_arrays[: min(8, len(batch_arrays))]
+    t0 = _time.perf_counter()
+    for arr in sample:
+        th, tw = target_dims(arr.shape[1], arr.shape[0])
+        np.asarray(Image.fromarray(arr).resize((tw, th), Image.BILINEAR))
+    scalar_per_img = (_time.perf_counter() - t0) / len(sample)
+    device_per_img = dt_device / len(batch_arrays)
+    verdict = device_per_img <= scalar_per_img
+    logger.info("thumbnail device verdict: device %.1f ms/img vs scalar "
+                "%.1f ms/img — %s", device_per_img * 1e3, scalar_per_img * 1e3,
+                "keeping device batching" if verdict
+                else "routing to scalar for the rest of this process")
+    return verdict
+
+
 def generate_thumbnails_batched(entries, data_dir: str | Path):
     """Batch thumbnail generation: host decode → ONE device bilinear-resize
     call over the pad-and-mask batch → host WebP encode.
@@ -376,10 +418,24 @@ def generate_thumbnails_batched(entries, data_dir: str | Path):
     for every thumbnail produced. Videos and failed decodes fall back to the
     scalar path. The per-image outputs are dimension-identical to the scalar
     PIL path (same √(area) target math, target_dims).
+
+    The first (warm) device batch is timed against a scalar probe on the
+    same decoded arrays; when the device measurably loses, this and every
+    later call route through the scalar pipeline instead (sticky
+    per-process verdict) — the caller always gets its thumbnails over
+    whichever path measured fastest.
     """
     from PIL import Image
 
     from ...ops.resize_jax import resize_batch_host
+
+    if _DEVICE_VERDICT["value"] is False:
+        out_paths = {}
+        for source, cas_id, ext in entries:
+            made = generate_thumbnail(source, data_dir, cas_id, ext)
+            if made is not None:
+                out_paths[cas_id] = made
+        return out_paths
 
     out_paths: dict[str, Path] = {}
     batch_arrays = []
@@ -397,23 +453,42 @@ def generate_thumbnails_batched(entries, data_dir: str | Path):
             continue
         try:
             batch_arrays.append(_decode_for_device(Path(source)))
-            batch_meta.append((source, cas_id, out))
+            batch_meta.append((source, cas_id, out, ext))
         except Exception as e:
             logger.warning("decode failed for %s: %s", source, e)
     if not batch_arrays:
         return out_paths
 
+    import time as _time
+
     try:
-        thumbs = resize_batch_host(batch_arrays)
+        if (_DEVICE_VERDICT["value"] is None
+                and len(batch_arrays) >= _VERDICT_MIN_BATCH):
+            with _VERDICT_LOCK:
+                if _DEVICE_VERDICT["value"] is None:
+                    # measure the WARM device rate: run once for the
+                    # compile, once for the timing, score against scalar.
+                    # Either way THIS batch's device outputs are valid
+                    # (dimension-identical), so nothing is recomputed —
+                    # only future batches change route.
+                    resize_batch_host(batch_arrays)
+                    t0 = _time.perf_counter()
+                    thumbs = resize_batch_host(batch_arrays)
+                    _DEVICE_VERDICT["value"] = _measure_device_verdict(
+                        batch_arrays, _time.perf_counter() - t0)
+                else:
+                    thumbs = resize_batch_host(batch_arrays)
+        else:
+            thumbs = resize_batch_host(batch_arrays)
     except Exception as e:
         logger.warning("device resize failed (%s); scalar fallback", e)
-        for source, cas_id, _out in batch_meta:
-            made = generate_thumbnail(source, data_dir, cas_id)
+        for source, cas_id, _out, ext in batch_meta:
+            made = generate_thumbnail(source, data_dir, cas_id, ext)
             if made is not None:
                 out_paths[cas_id] = made
         return out_paths
 
-    for (_source, cas_id, out), thumb in zip(batch_meta, thumbs):
+    for (_source, cas_id, out, _ext), thumb in zip(batch_meta, thumbs):
         try:
             out.parent.mkdir(parents=True, exist_ok=True)
             tmp = out.with_suffix(".tmp.webp")

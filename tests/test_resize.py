@@ -8,6 +8,16 @@ import math
 import numpy as np
 import pytest
 
+
+@pytest.fixture(autouse=True)
+def _reset_device_verdict():
+    """The sticky per-process routing verdict must not couple tests."""
+    from spacedrive_tpu.objects.media import thumbnail as _th
+
+    _th._DEVICE_VERDICT["value"] = None
+    yield
+    _th._DEVICE_VERDICT["value"] = None
+
 jax = pytest.importorskip("jax")
 
 from spacedrive_tpu.ops.resize_jax import (  # noqa: E402
@@ -159,3 +169,40 @@ def test_processor_uses_batched_path(tmp_path, tmp_data_dir):
             assert thumbnail_path(node.data_dir, cas).exists()
     finally:
         node.shutdown()
+
+
+def test_device_verdict_routes_losing_path_to_scalar(tmp_path, monkeypatch):
+    """The sticky per-process verdict: when the measured device rate loses,
+    every batched call falls back to the scalar pipeline (and still
+    produces every thumbnail)."""
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    from spacedrive_tpu.objects.media import thumbnail as th
+
+    tree = tmp_path / "pics"
+    tree.mkdir()
+    rng = np.random.default_rng(3)
+    entries = []
+    for i in range(2):
+        arr = rng.integers(0, 256, (300, 400, 3), dtype=np.uint8)
+        p = tree / f"v{i}.png"
+        Image.fromarray(arr).save(p)
+        entries.append((str(p), f"vcas{i}", "png"))
+
+    monkeypatch.setitem(th._DEVICE_VERDICT, "value", False)
+    calls = []
+    monkeypatch.setattr(
+        th, "_measure_device_verdict",
+        lambda *a, **k: calls.append(1) or True)
+    made = th.generate_thumbnails_batched(entries, tmp_path / "data")
+    assert set(made) == {"vcas0", "vcas1"}
+    from pathlib import Path as _P
+    assert all(_P(p).exists() for p in made.values())
+    assert not calls  # sticky verdict short-circuits before any device work
+
+    # decision logic: device wins on a tiny dt, loses on a huge one
+    arrs = [rng.integers(0, 256, (300, 400, 3), dtype=np.uint8)]
+    monkeypatch.undo()
+    assert th._measure_device_verdict(arrs, dt_device=1e-9) is True
+    assert th._measure_device_verdict(arrs, dt_device=60.0) is False
