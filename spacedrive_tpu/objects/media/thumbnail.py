@@ -410,6 +410,17 @@ def _measure_device_verdict(batch_arrays, dt_device: float) -> bool:
     return verdict
 
 
+def _scalar_all(entries, data_dir: str | Path) -> dict:
+    """Scalar pipeline over [(source, cas_id, ext)]; the shared fallback of
+    every losing/failed device route."""
+    out_paths: dict = {}
+    for source, cas_id, ext in entries:
+        made = generate_thumbnail(source, data_dir, cas_id, ext)
+        if made is not None:
+            out_paths[cas_id] = made
+    return out_paths
+
+
 def generate_thumbnails_batched(entries, data_dir: str | Path):
     """Batch thumbnail generation: host decode → ONE device bilinear-resize
     call over the pad-and-mask batch → host WebP encode.
@@ -430,12 +441,7 @@ def generate_thumbnails_batched(entries, data_dir: str | Path):
     from ...ops.resize_jax import resize_batch_host
 
     if _DEVICE_VERDICT["value"] is False:
-        out_paths = {}
-        for source, cas_id, ext in entries:
-            made = generate_thumbnail(source, data_dir, cas_id, ext)
-            if made is not None:
-                out_paths[cas_id] = made
-        return out_paths
+        return _scalar_all(entries, data_dir)
 
     out_paths: dict[str, Path] = {}
     batch_arrays = []
@@ -462,10 +468,13 @@ def generate_thumbnails_batched(entries, data_dir: str | Path):
     import time as _time
 
     try:
-        if (_DEVICE_VERDICT["value"] is None
-                and len(batch_arrays) >= _VERDICT_MIN_BATCH):
+        if _DEVICE_VERDICT["value"] is None:
+            # EVERY device call synchronizes while the verdict is open —
+            # a concurrent unmeasured batch would otherwise share the
+            # device with the timed probe and distort the measurement
             with _VERDICT_LOCK:
-                if _DEVICE_VERDICT["value"] is None:
+                if (_DEVICE_VERDICT["value"] is None
+                        and len(batch_arrays) >= _VERDICT_MIN_BATCH):
                     # measure the WARM device rate: run once for the
                     # compile, once for the timing, score against scalar.
                     # Either way THIS batch's device outputs are valid
@@ -482,10 +491,8 @@ def generate_thumbnails_batched(entries, data_dir: str | Path):
             thumbs = resize_batch_host(batch_arrays)
     except Exception as e:
         logger.warning("device resize failed (%s); scalar fallback", e)
-        for source, cas_id, _out, ext in batch_meta:
-            made = generate_thumbnail(source, data_dir, cas_id, ext)
-            if made is not None:
-                out_paths[cas_id] = made
+        out_paths.update(_scalar_all(
+            [(s, c, e3) for s, c, _o, e3 in batch_meta], data_dir))
         return out_paths
 
     for (_source, cas_id, out, _ext), thumb in zip(batch_meta, thumbs):
