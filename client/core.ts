@@ -1,0 +1,473 @@
+// GENERATED FILE — do not edit.
+// Regenerate: python -m spacedrive_tpu.api.codegen
+// Contract source: spacedrive_tpu/api/types.py + the mounted router schema.
+
+
+/** Mirrors models/schema.py rows as the routers serialize them. Fields the
+ * explorer relies on are typed; rows keep an escape hatch because several
+ * routers pass DB rows through verbatim. */
+export interface Library { id: string; name: string; [key: string]: unknown }
+export interface LocationRow {
+  id: number; pub_id: string; name: string | null; path: string | null;
+  hasher: string | null; [key: string]: unknown
+}
+export interface FilePathRow {
+  id: number; pub_id: string; name: string | null; extension: string | null;
+  materialized_path: string | null; is_dir: boolean | number;
+  cas_id: string | null; object_id: number | null;
+  size_in_bytes: number | null; kind?: number | null; [key: string]: unknown
+}
+export interface ObjectRow {
+  id: number; pub_id: string; kind: number | null; favorite?: boolean | null;
+  note?: string | null; [key: string]: unknown
+}
+export interface TagRow {
+  id: number; pub_id: string; name: string | null; color: string | null;
+  [key: string]: unknown
+}
+export interface CollectionRow {
+  id: number; pub_id: string; name: string | null; member_count?: number;
+  [key: string]: unknown
+}
+export interface JobReport {
+  id: string; name: string; status: string; task_count: number;
+  completed_task_count: number; message?: string | null;
+  children?: JobReport[]; [key: string]: unknown
+}
+export interface SearchPathsResult { items: FilePathRow[]; cursor: number | null }
+export interface NodeState {
+  id: string; name: string; data_path: string; [key: string]: unknown
+}
+export interface Statistics { [key: string]: unknown }
+export interface PeerMetadata {
+  identity: string; connected: boolean; [key: string]: unknown
+}
+export interface JobProgressEvent {
+  id: string; status?: string; completed_task_count?: number;
+  message?: string; [key: string]: unknown
+}
+
+export type Procedures = {
+  queries:
+	{ key: "albums.list", input: null, result: CollectionRow[] } |
+	{ key: "albums.objects", input: number, result: FilePathRow[] } |
+	{ key: "backups.getAll", input: unknown, result: unknown } |
+	{ key: "buildInfo", input: null, result: { version: string; commit: string } } |
+	{ key: "categories.list", input: unknown, result: unknown } |
+	{ key: "files.get", input: unknown, result: unknown } |
+	{ key: "files.getEphemeralMediaData", input: unknown, result: unknown } |
+	{ key: "files.getMediaData", input: unknown, result: unknown } |
+	{ key: "files.getPath", input: unknown, result: unknown } |
+	{ key: "jobs.isActive", input: unknown, result: unknown } |
+	{ key: "jobs.reports", input: null, result: JobReport[] } |
+	{ key: "keys.getDefault", input: unknown, result: unknown } |
+	{ key: "keys.getKey", input: unknown, result: unknown } |
+	{ key: "keys.isKeyManagerUnlocking", input: unknown, result: unknown } |
+	{ key: "keys.isSetup", input: unknown, result: unknown } |
+	{ key: "keys.isUnlocked", input: unknown, result: unknown } |
+	{ key: "keys.list", input: unknown, result: unknown } |
+	{ key: "keys.listMounted", input: unknown, result: unknown } |
+	{ key: "labels.getForObject", input: number, result: Record<string, unknown>[] } |
+	{ key: "labels.list", input: null, result: Record<string, unknown>[] } |
+	{ key: "libraries.list", input: null, result: Library[] } |
+	{ key: "libraries.statistics", input: null, result: Statistics } |
+	{ key: "locations.get", input: number, result: LocationRow | null } |
+	{ key: "locations.getWithRules", input: unknown, result: unknown } |
+	{ key: "locations.indexer_rules.get", input: number, result: Record<string, unknown> | null } |
+	{ key: "locations.indexer_rules.list", input: null, result: Record<string, unknown>[] } |
+	{ key: "locations.indexer_rules.listForLocation", input: unknown, result: unknown } |
+	{ key: "locations.list", input: null, result: LocationRow[] } |
+	{ key: "nodeState", input: null, result: NodeState } |
+	{ key: "nodes.listLocations", input: unknown, result: unknown } |
+	{ key: "notifications.get", input: null, result: Record<string, unknown>[] } |
+	{ key: "p2p.identity", input: unknown, result: unknown } |
+	{ key: "p2p.nlmState", input: null, result: Record<string, unknown> } |
+	{ key: "p2p.peers", input: null, result: PeerMetadata[] } |
+	{ key: "preferences.get", input: unknown, result: unknown } |
+	{ key: "search.duplicates", input: { location_id?: number }, result: Record<string, unknown>[] } |
+	{ key: "search.ephemeralPaths", input: { path: string; withHiddenFiles?: boolean }, result: { entries: FilePathRow[] } } |
+	{ key: "search.nearDuplicates", input: unknown, result: unknown } |
+	{ key: "search.objects", input: { take?: number; tags?: number[]; kind?: number[] }, result: { items: ObjectRow[] } } |
+	{ key: "search.objectsCount", input: unknown, result: unknown } |
+	{ key: "search.paths", input: { location_id?: number; path?: string; search?: string; take?: number; cursor?: number; [key: string]: unknown }, result: SearchPathsResult } |
+	{ key: "search.pathsCount", input: unknown, result: unknown } |
+	{ key: "spaces.list", input: null, result: CollectionRow[] } |
+	{ key: "spaces.objects", input: number, result: FilePathRow[] } |
+	{ key: "sync.messages", input: null, result: Record<string, unknown>[] } |
+	{ key: "tags.get", input: number, result: TagRow | null } |
+	{ key: "tags.getForObject", input: number, result: TagRow[] } |
+	{ key: "tags.getWithObjects", input: unknown, result: unknown } |
+	{ key: "tags.list", input: null, result: TagRow[] } |
+	{ key: "volumes.list", input: null, result: Record<string, unknown>[] },
+  mutations:
+	{ key: "albums.addObjects", input: { id: number; object_ids: number[] }, result: number } |
+	{ key: "albums.create", input: { name: string; is_hidden?: boolean } | string, result: CollectionRow } |
+	{ key: "albums.delete", input: number, result: null } |
+	{ key: "albums.removeObjects", input: { id: number; object_ids: number[] }, result: number } |
+	{ key: "albums.update", input: { id: number; name?: string; is_hidden?: boolean }, result: null } |
+	{ key: "backups.backup", input: unknown, result: unknown } |
+	{ key: "backups.delete", input: unknown, result: unknown } |
+	{ key: "backups.restore", input: unknown, result: unknown } |
+	{ key: "files.copyFiles", input: unknown, result: unknown } |
+	{ key: "files.createDirectory", input: unknown, result: unknown } |
+	{ key: "files.createFile", input: unknown, result: unknown } |
+	{ key: "files.cutFiles", input: unknown, result: unknown } |
+	{ key: "files.decryptFiles", input: unknown, result: unknown } |
+	{ key: "files.deleteFiles", input: { location_id: number; file_path_ids: number[] } | Record<string, unknown>, result: string } |
+	{ key: "files.duplicateFiles", input: unknown, result: unknown } |
+	{ key: "files.encryptFiles", input: unknown, result: unknown } |
+	{ key: "files.eraseFiles", input: unknown, result: unknown } |
+	{ key: "files.removeAccessTime", input: unknown, result: unknown } |
+	{ key: "files.renameFile", input: { id: number; new_name: string }, result: null } |
+	{ key: "files.setFavorite", input: { id: number; favorite: boolean }, result: null } |
+	{ key: "files.setNote", input: { id: number; note: string | null }, result: null } |
+	{ key: "files.updateAccessTime", input: unknown, result: unknown } |
+	{ key: "jobs.cancel", input: string, result: null } |
+	{ key: "jobs.clear", input: string, result: null } |
+	{ key: "jobs.clearAll", input: null, result: null } |
+	{ key: "jobs.generateThumbsForLocation", input: unknown, result: unknown } |
+	{ key: "jobs.identifyUniqueFiles", input: unknown, result: unknown } |
+	{ key: "jobs.objectValidator", input: unknown, result: unknown } |
+	{ key: "jobs.pause", input: string, result: null } |
+	{ key: "jobs.resume", input: string, result: null } |
+	{ key: "keys.add", input: unknown, result: unknown } |
+	{ key: "keys.backupKeystore", input: unknown, result: unknown } |
+	{ key: "keys.changeMasterPassword", input: unknown, result: unknown } |
+	{ key: "keys.clearMasterPassword", input: unknown, result: unknown } |
+	{ key: "keys.deleteFromLibrary", input: unknown, result: unknown } |
+	{ key: "keys.lockKeyManager", input: unknown, result: unknown } |
+	{ key: "keys.mount", input: unknown, result: unknown } |
+	{ key: "keys.restoreKeystore", input: unknown, result: unknown } |
+	{ key: "keys.setDefault", input: unknown, result: unknown } |
+	{ key: "keys.setup", input: unknown, result: unknown } |
+	{ key: "keys.unlockKeyManager", input: unknown, result: unknown } |
+	{ key: "keys.unmount", input: unknown, result: unknown } |
+	{ key: "keys.unmountAll", input: unknown, result: unknown } |
+	{ key: "keys.updateAutomountStatus", input: unknown, result: unknown } |
+	{ key: "labels.assign", input: { name: string; object_ids: number[]; remove?: boolean }, result: number } |
+	{ key: "libraries.create", input: { name: string }, result: Library } |
+	{ key: "libraries.delete", input: string, result: null } |
+	{ key: "libraries.edit", input: { id: string; name?: string; description?: string }, result: null } |
+	{ key: "locations.addLibrary", input: unknown, result: unknown } |
+	{ key: "locations.create", input: { path: string; dry_run?: boolean; indexer_rules_ids?: number[] }, result: LocationRow | null } |
+	{ key: "locations.delete", input: number, result: null } |
+	{ key: "locations.fullRescan", input: { location_id: number }, result: string } |
+	{ key: "locations.indexer_rules.create", input: { name: string; kind: number; parameters: string[] }, result: number } |
+	{ key: "locations.indexer_rules.delete", input: number, result: null } |
+	{ key: "locations.quickRescan", input: unknown, result: unknown } |
+	{ key: "locations.relink", input: unknown, result: unknown } |
+	{ key: "locations.subPathRescan", input: unknown, result: unknown } |
+	{ key: "locations.update", input: { id: number; [key: string]: unknown }, result: null } |
+	{ key: "nodes.edit", input: { name?: string }, result: null } |
+	{ key: "notifications.dismiss", input: number, result: null } |
+	{ key: "notifications.dismissAll", input: null, result: null } |
+	{ key: "notifications.test", input: unknown, result: unknown } |
+	{ key: "notifications.testLibrary", input: unknown, result: unknown } |
+	{ key: "p2p.acceptSpacedrop", input: unknown, result: unknown } |
+	{ key: "p2p.cancelSpacedrop", input: unknown, result: unknown } |
+	{ key: "p2p.debugConnect", input: unknown, result: unknown } |
+	{ key: "p2p.pair", input: unknown, result: unknown } |
+	{ key: "p2p.pairingResponse", input: unknown, result: unknown } |
+	{ key: "p2p.spacedrop", input: unknown, result: unknown } |
+	{ key: "preferences.update", input: unknown, result: unknown } |
+	{ key: "spaces.addObjects", input: { id: number; object_ids: number[] }, result: number } |
+	{ key: "spaces.create", input: { name: string; description?: string } | string, result: CollectionRow } |
+	{ key: "spaces.delete", input: number, result: null } |
+	{ key: "spaces.removeObjects", input: { id: number; object_ids: number[] }, result: number } |
+	{ key: "spaces.update", input: { id: number; name?: string; description?: string }, result: null } |
+	{ key: "tags.assign", input: { object_ids: number[]; tag_id: number; unassign?: boolean }, result: null } |
+	{ key: "tags.create", input: { name: string; color?: string }, result: TagRow } |
+	{ key: "tags.delete", input: number, result: null } |
+	{ key: "tags.update", input: { id: number; name?: string; color?: string }, result: null } |
+	{ key: "toggleFeatureFlag", input: unknown, result: unknown },
+  subscriptions:
+	{ key: "invalidation.listen", input: unknown, result: unknown } |
+	{ key: "jobs.newThumbnail", input: unknown, result: unknown } |
+	{ key: "jobs.progress", input: null, result: JobProgressEvent } |
+	{ key: "locations.online", input: unknown, result: unknown } |
+	{ key: "notifications.listen", input: unknown, result: unknown } |
+	{ key: "p2p.events", input: null, result: Record<string, unknown> } |
+	{ key: "sync.newMessage", input: unknown, result: unknown },
+};
+
+/** Library-scoped procedures take a library_id — the client-side split of rspc.tsx:13-43. */
+export type LibraryProcedureKey =
+	"albums.addObjects" |
+	"albums.create" |
+	"albums.delete" |
+	"albums.list" |
+	"albums.objects" |
+	"albums.removeObjects" |
+	"albums.update" |
+	"categories.list" |
+	"files.copyFiles" |
+	"files.createDirectory" |
+	"files.createFile" |
+	"files.cutFiles" |
+	"files.decryptFiles" |
+	"files.deleteFiles" |
+	"files.duplicateFiles" |
+	"files.encryptFiles" |
+	"files.eraseFiles" |
+	"files.get" |
+	"files.getMediaData" |
+	"files.getPath" |
+	"files.removeAccessTime" |
+	"files.renameFile" |
+	"files.setFavorite" |
+	"files.setNote" |
+	"files.updateAccessTime" |
+	"jobs.clear" |
+	"jobs.clearAll" |
+	"jobs.generateThumbsForLocation" |
+	"jobs.identifyUniqueFiles" |
+	"jobs.newThumbnail" |
+	"jobs.objectValidator" |
+	"jobs.progress" |
+	"jobs.reports" |
+	"jobs.resume" |
+	"labels.assign" |
+	"labels.getForObject" |
+	"labels.list" |
+	"libraries.statistics" |
+	"locations.addLibrary" |
+	"locations.create" |
+	"locations.delete" |
+	"locations.fullRescan" |
+	"locations.get" |
+	"locations.getWithRules" |
+	"locations.indexer_rules.create" |
+	"locations.indexer_rules.delete" |
+	"locations.indexer_rules.get" |
+	"locations.indexer_rules.list" |
+	"locations.indexer_rules.listForLocation" |
+	"locations.list" |
+	"locations.online" |
+	"locations.quickRescan" |
+	"locations.relink" |
+	"locations.subPathRescan" |
+	"locations.update" |
+	"nodes.listLocations" |
+	"notifications.testLibrary" |
+	"preferences.get" |
+	"preferences.update" |
+	"search.duplicates" |
+	"search.nearDuplicates" |
+	"search.objects" |
+	"search.objectsCount" |
+	"search.paths" |
+	"search.pathsCount" |
+	"spaces.addObjects" |
+	"spaces.create" |
+	"spaces.delete" |
+	"spaces.list" |
+	"spaces.objects" |
+	"spaces.removeObjects" |
+	"spaces.update" |
+	"sync.messages" |
+	"sync.newMessage" |
+	"tags.assign" |
+	"tags.create" |
+	"tags.delete" |
+	"tags.get" |
+	"tags.getForObject" |
+	"tags.getWithObjects" |
+	"tags.list" |
+	"tags.update";
+export type NodeProcedureKey =
+	"backups.backup" |
+	"backups.delete" |
+	"backups.getAll" |
+	"backups.restore" |
+	"buildInfo" |
+	"files.getEphemeralMediaData" |
+	"invalidation.listen" |
+	"jobs.cancel" |
+	"jobs.isActive" |
+	"jobs.pause" |
+	"keys.add" |
+	"keys.backupKeystore" |
+	"keys.changeMasterPassword" |
+	"keys.clearMasterPassword" |
+	"keys.deleteFromLibrary" |
+	"keys.getDefault" |
+	"keys.getKey" |
+	"keys.isKeyManagerUnlocking" |
+	"keys.isSetup" |
+	"keys.isUnlocked" |
+	"keys.list" |
+	"keys.listMounted" |
+	"keys.lockKeyManager" |
+	"keys.mount" |
+	"keys.restoreKeystore" |
+	"keys.setDefault" |
+	"keys.setup" |
+	"keys.unlockKeyManager" |
+	"keys.unmount" |
+	"keys.unmountAll" |
+	"keys.updateAutomountStatus" |
+	"libraries.create" |
+	"libraries.delete" |
+	"libraries.edit" |
+	"libraries.list" |
+	"nodeState" |
+	"nodes.edit" |
+	"notifications.dismiss" |
+	"notifications.dismissAll" |
+	"notifications.get" |
+	"notifications.listen" |
+	"notifications.test" |
+	"p2p.acceptSpacedrop" |
+	"p2p.cancelSpacedrop" |
+	"p2p.debugConnect" |
+	"p2p.events" |
+	"p2p.identity" |
+	"p2p.nlmState" |
+	"p2p.pair" |
+	"p2p.pairingResponse" |
+	"p2p.peers" |
+	"p2p.spacedrop" |
+	"search.ephemeralPaths" |
+	"toggleFeatureFlag" |
+	"volumes.list";
+export type ProcedureKey = LibraryProcedureKey | NodeProcedureKey;
+
+export const procedures = {
+	"albums.addObjects": { kind: "mutation", scope: "library" },
+	"albums.create": { kind: "mutation", scope: "library" },
+	"albums.delete": { kind: "mutation", scope: "library" },
+	"albums.list": { kind: "query", scope: "library" },
+	"albums.objects": { kind: "query", scope: "library" },
+	"albums.removeObjects": { kind: "mutation", scope: "library" },
+	"albums.update": { kind: "mutation", scope: "library" },
+	"backups.backup": { kind: "mutation", scope: "node" },
+	"backups.delete": { kind: "mutation", scope: "node" },
+	"backups.getAll": { kind: "query", scope: "node" },
+	"backups.restore": { kind: "mutation", scope: "node" },
+	"buildInfo": { kind: "query", scope: "node" },
+	"categories.list": { kind: "query", scope: "library" },
+	"files.copyFiles": { kind: "mutation", scope: "library" },
+	"files.createDirectory": { kind: "mutation", scope: "library" },
+	"files.createFile": { kind: "mutation", scope: "library" },
+	"files.cutFiles": { kind: "mutation", scope: "library" },
+	"files.decryptFiles": { kind: "mutation", scope: "library" },
+	"files.deleteFiles": { kind: "mutation", scope: "library" },
+	"files.duplicateFiles": { kind: "mutation", scope: "library" },
+	"files.encryptFiles": { kind: "mutation", scope: "library" },
+	"files.eraseFiles": { kind: "mutation", scope: "library" },
+	"files.get": { kind: "query", scope: "library" },
+	"files.getEphemeralMediaData": { kind: "query", scope: "node" },
+	"files.getMediaData": { kind: "query", scope: "library" },
+	"files.getPath": { kind: "query", scope: "library" },
+	"files.removeAccessTime": { kind: "mutation", scope: "library" },
+	"files.renameFile": { kind: "mutation", scope: "library" },
+	"files.setFavorite": { kind: "mutation", scope: "library" },
+	"files.setNote": { kind: "mutation", scope: "library" },
+	"files.updateAccessTime": { kind: "mutation", scope: "library" },
+	"invalidation.listen": { kind: "subscription", scope: "node" },
+	"jobs.cancel": { kind: "mutation", scope: "node" },
+	"jobs.clear": { kind: "mutation", scope: "library" },
+	"jobs.clearAll": { kind: "mutation", scope: "library" },
+	"jobs.generateThumbsForLocation": { kind: "mutation", scope: "library" },
+	"jobs.identifyUniqueFiles": { kind: "mutation", scope: "library" },
+	"jobs.isActive": { kind: "query", scope: "node" },
+	"jobs.newThumbnail": { kind: "subscription", scope: "library" },
+	"jobs.objectValidator": { kind: "mutation", scope: "library" },
+	"jobs.pause": { kind: "mutation", scope: "node" },
+	"jobs.progress": { kind: "subscription", scope: "library" },
+	"jobs.reports": { kind: "query", scope: "library" },
+	"jobs.resume": { kind: "mutation", scope: "library" },
+	"keys.add": { kind: "mutation", scope: "node" },
+	"keys.backupKeystore": { kind: "mutation", scope: "node" },
+	"keys.changeMasterPassword": { kind: "mutation", scope: "node" },
+	"keys.clearMasterPassword": { kind: "mutation", scope: "node" },
+	"keys.deleteFromLibrary": { kind: "mutation", scope: "node" },
+	"keys.getDefault": { kind: "query", scope: "node" },
+	"keys.getKey": { kind: "query", scope: "node" },
+	"keys.isKeyManagerUnlocking": { kind: "query", scope: "node" },
+	"keys.isSetup": { kind: "query", scope: "node" },
+	"keys.isUnlocked": { kind: "query", scope: "node" },
+	"keys.list": { kind: "query", scope: "node" },
+	"keys.listMounted": { kind: "query", scope: "node" },
+	"keys.lockKeyManager": { kind: "mutation", scope: "node" },
+	"keys.mount": { kind: "mutation", scope: "node" },
+	"keys.restoreKeystore": { kind: "mutation", scope: "node" },
+	"keys.setDefault": { kind: "mutation", scope: "node" },
+	"keys.setup": { kind: "mutation", scope: "node" },
+	"keys.unlockKeyManager": { kind: "mutation", scope: "node" },
+	"keys.unmount": { kind: "mutation", scope: "node" },
+	"keys.unmountAll": { kind: "mutation", scope: "node" },
+	"keys.updateAutomountStatus": { kind: "mutation", scope: "node" },
+	"labels.assign": { kind: "mutation", scope: "library" },
+	"labels.getForObject": { kind: "query", scope: "library" },
+	"labels.list": { kind: "query", scope: "library" },
+	"libraries.create": { kind: "mutation", scope: "node" },
+	"libraries.delete": { kind: "mutation", scope: "node" },
+	"libraries.edit": { kind: "mutation", scope: "node" },
+	"libraries.list": { kind: "query", scope: "node" },
+	"libraries.statistics": { kind: "query", scope: "library" },
+	"locations.addLibrary": { kind: "mutation", scope: "library" },
+	"locations.create": { kind: "mutation", scope: "library" },
+	"locations.delete": { kind: "mutation", scope: "library" },
+	"locations.fullRescan": { kind: "mutation", scope: "library" },
+	"locations.get": { kind: "query", scope: "library" },
+	"locations.getWithRules": { kind: "query", scope: "library" },
+	"locations.indexer_rules.create": { kind: "mutation", scope: "library" },
+	"locations.indexer_rules.delete": { kind: "mutation", scope: "library" },
+	"locations.indexer_rules.get": { kind: "query", scope: "library" },
+	"locations.indexer_rules.list": { kind: "query", scope: "library" },
+	"locations.indexer_rules.listForLocation": { kind: "query", scope: "library" },
+	"locations.list": { kind: "query", scope: "library" },
+	"locations.online": { kind: "subscription", scope: "library" },
+	"locations.quickRescan": { kind: "mutation", scope: "library" },
+	"locations.relink": { kind: "mutation", scope: "library" },
+	"locations.subPathRescan": { kind: "mutation", scope: "library" },
+	"locations.update": { kind: "mutation", scope: "library" },
+	"nodeState": { kind: "query", scope: "node" },
+	"nodes.edit": { kind: "mutation", scope: "node" },
+	"nodes.listLocations": { kind: "query", scope: "library" },
+	"notifications.dismiss": { kind: "mutation", scope: "node" },
+	"notifications.dismissAll": { kind: "mutation", scope: "node" },
+	"notifications.get": { kind: "query", scope: "node" },
+	"notifications.listen": { kind: "subscription", scope: "node" },
+	"notifications.test": { kind: "mutation", scope: "node" },
+	"notifications.testLibrary": { kind: "mutation", scope: "library" },
+	"p2p.acceptSpacedrop": { kind: "mutation", scope: "node" },
+	"p2p.cancelSpacedrop": { kind: "mutation", scope: "node" },
+	"p2p.debugConnect": { kind: "mutation", scope: "node" },
+	"p2p.events": { kind: "subscription", scope: "node" },
+	"p2p.identity": { kind: "query", scope: "node" },
+	"p2p.nlmState": { kind: "query", scope: "node" },
+	"p2p.pair": { kind: "mutation", scope: "node" },
+	"p2p.pairingResponse": { kind: "mutation", scope: "node" },
+	"p2p.peers": { kind: "query", scope: "node" },
+	"p2p.spacedrop": { kind: "mutation", scope: "node" },
+	"preferences.get": { kind: "query", scope: "library" },
+	"preferences.update": { kind: "mutation", scope: "library" },
+	"search.duplicates": { kind: "query", scope: "library" },
+	"search.ephemeralPaths": { kind: "query", scope: "node" },
+	"search.nearDuplicates": { kind: "query", scope: "library" },
+	"search.objects": { kind: "query", scope: "library" },
+	"search.objectsCount": { kind: "query", scope: "library" },
+	"search.paths": { kind: "query", scope: "library" },
+	"search.pathsCount": { kind: "query", scope: "library" },
+	"spaces.addObjects": { kind: "mutation", scope: "library" },
+	"spaces.create": { kind: "mutation", scope: "library" },
+	"spaces.delete": { kind: "mutation", scope: "library" },
+	"spaces.list": { kind: "query", scope: "library" },
+	"spaces.objects": { kind: "query", scope: "library" },
+	"spaces.removeObjects": { kind: "mutation", scope: "library" },
+	"spaces.update": { kind: "mutation", scope: "library" },
+	"sync.messages": { kind: "query", scope: "library" },
+	"sync.newMessage": { kind: "subscription", scope: "library" },
+	"tags.assign": { kind: "mutation", scope: "library" },
+	"tags.create": { kind: "mutation", scope: "library" },
+	"tags.delete": { kind: "mutation", scope: "library" },
+	"tags.get": { kind: "query", scope: "library" },
+	"tags.getForObject": { kind: "query", scope: "library" },
+	"tags.getWithObjects": { kind: "query", scope: "library" },
+	"tags.list": { kind: "query", scope: "library" },
+	"tags.update": { kind: "mutation", scope: "library" },
+	"toggleFeatureFlag": { kind: "mutation", scope: "node" },
+	"volumes.list": { kind: "query", scope: "node" },
+} as const;

@@ -1,0 +1,553 @@
+// GENERATED FILE — do not edit.
+// Regenerate: python -m spacedrive_tpu.api.codegen
+// Contract source: spacedrive_tpu/api/types.py + the mounted router schema.
+window.SD_PROCEDURES = {
+ "albums.addObjects": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "albums.create": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "albums.delete": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "albums.list": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "albums.objects": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "albums.removeObjects": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "albums.update": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "backups.backup": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "backups.delete": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "backups.getAll": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "backups.restore": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "buildInfo": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "categories.list": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "files.copyFiles": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.createDirectory": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.createFile": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.cutFiles": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.decryptFiles": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.deleteFiles": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.duplicateFiles": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.encryptFiles": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.eraseFiles": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.get": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "files.getEphemeralMediaData": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "files.getMediaData": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "files.getPath": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "files.removeAccessTime": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.renameFile": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.setFavorite": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.setNote": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "files.updateAccessTime": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "invalidation.listen": {
+  "kind": "subscription",
+  "scope": "node"
+ },
+ "jobs.cancel": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "jobs.clear": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "jobs.clearAll": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "jobs.generateThumbsForLocation": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "jobs.identifyUniqueFiles": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "jobs.isActive": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "jobs.newThumbnail": {
+  "kind": "subscription",
+  "scope": "library"
+ },
+ "jobs.objectValidator": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "jobs.pause": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "jobs.progress": {
+  "kind": "subscription",
+  "scope": "library"
+ },
+ "jobs.reports": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "jobs.resume": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "keys.add": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.backupKeystore": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.changeMasterPassword": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.clearMasterPassword": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.deleteFromLibrary": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.getDefault": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "keys.getKey": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "keys.isKeyManagerUnlocking": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "keys.isSetup": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "keys.isUnlocked": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "keys.list": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "keys.listMounted": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "keys.lockKeyManager": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.mount": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.restoreKeystore": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.setDefault": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.setup": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.unlockKeyManager": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.unmount": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.unmountAll": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.updateAutomountStatus": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "labels.assign": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "labels.getForObject": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "labels.list": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "libraries.create": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "libraries.delete": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "libraries.edit": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "libraries.list": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "libraries.statistics": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "locations.addLibrary": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "locations.create": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "locations.delete": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "locations.fullRescan": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "locations.get": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "locations.getWithRules": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "locations.indexer_rules.create": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "locations.indexer_rules.delete": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "locations.indexer_rules.get": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "locations.indexer_rules.list": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "locations.indexer_rules.listForLocation": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "locations.list": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "locations.online": {
+  "kind": "subscription",
+  "scope": "library"
+ },
+ "locations.quickRescan": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "locations.relink": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "locations.subPathRescan": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "locations.update": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "nodeState": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "nodes.edit": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "nodes.listLocations": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "notifications.dismiss": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "notifications.dismissAll": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "notifications.get": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "notifications.listen": {
+  "kind": "subscription",
+  "scope": "node"
+ },
+ "notifications.test": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "notifications.testLibrary": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "p2p.acceptSpacedrop": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "p2p.cancelSpacedrop": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "p2p.debugConnect": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "p2p.events": {
+  "kind": "subscription",
+  "scope": "node"
+ },
+ "p2p.identity": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "p2p.nlmState": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "p2p.pair": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "p2p.pairingResponse": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "p2p.peers": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "p2p.spacedrop": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "preferences.get": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "preferences.update": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "search.duplicates": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "search.ephemeralPaths": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "search.nearDuplicates": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "search.objects": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "search.objectsCount": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "search.paths": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "search.pathsCount": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "spaces.addObjects": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "spaces.create": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "spaces.delete": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "spaces.list": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "spaces.objects": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "spaces.removeObjects": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "spaces.update": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "sync.messages": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "sync.newMessage": {
+  "kind": "subscription",
+  "scope": "library"
+ },
+ "tags.assign": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "tags.create": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "tags.delete": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "tags.get": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "tags.getForObject": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "tags.getWithObjects": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "tags.list": {
+  "kind": "query",
+  "scope": "library"
+ },
+ "tags.update": {
+  "kind": "mutation",
+  "scope": "library"
+ },
+ "toggleFeatureFlag": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "volumes.list": {
+  "kind": "query",
+  "scope": "node"
+ }
+};

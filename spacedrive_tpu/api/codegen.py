@@ -1,0 +1,123 @@
+"""Typed-client codegen: router schema → client/core.ts + procedures.js.
+
+The reference generates ``packages/client/src/core.ts`` by running an
+rspc/specta export test (core/src/api/mod.rs:205-212) and consumes it with
+a node/library scope split (packages/client/src/rspc.tsx:13-43). Here the
+same contract is rendered from the live router schema plus the reviewed
+type map (api/types.py):
+
+- ``client/core.ts`` — the TypeScript contract: shared row interfaces, a
+  ``Procedures`` union per kind carrying each procedure's key/input/result,
+  the node/library key-union split, and a ``procedures`` const map.
+- ``client/procedures.js`` — the runtime mirror the vanilla-JS web explorer
+  loads (<script src="/client/procedures.js">): ``window.SD_PROCEDURES``
+  with kind+scope per key. The explorer's rspc() helper refuses keys that
+  aren't in it, so the generated artifact is load-bearing, not decorative.
+
+Regenerate with ``python -m spacedrive_tpu.api.codegen`` after any router
+change; tests/test_ts_client.py fails on a stale file (golden gate).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+from .types import TS_PRELUDE, TYPES
+
+HEADER = ("// GENERATED FILE — do not edit.\n"
+          "// Regenerate: python -m spacedrive_tpu.api.codegen\n"
+          "// Contract source: spacedrive_tpu/api/types.py + the mounted "
+          "router schema.\n")
+
+
+def _entry(proc: dict[str, Any]) -> str:
+    arg, result = TYPES.get(proc["key"], ("unknown", "unknown"))
+    return (f'\t{{ key: "{proc["key"]}", input: {arg}, result: {result} }}')
+
+
+def generate_core_ts(schema: dict[str, Any]) -> str:
+    procs = schema["procedures"]
+    by_kind: dict[str, list[dict]] = {"query": [], "mutation": [],
+                                      "subscription": []}
+    for p in procs:
+        by_kind[p["kind"]].append(p)
+
+    parts = [HEADER, "", TS_PRELUDE]
+    parts.append("export type Procedures = {")
+    for kind, plural in (("query", "queries"), ("mutation", "mutations"),
+                         ("subscription", "subscriptions")):
+        entries = " |\n".join(_entry(p) for p in by_kind[kind]) or "never"
+        parts.append(f"  {plural}:\n{entries},")
+    parts.append("};")
+    parts.append("")
+
+    lib = [p["key"] for p in procs if p["scope"] == "library"]
+    node = [p["key"] for p in procs if p["scope"] != "library"]
+    parts.append("/** Library-scoped procedures take a library_id — the "
+                 "client-side split of rspc.tsx:13-43. */")
+    parts.append("export type LibraryProcedureKey =")
+    parts.append(" |\n".join(f'\t"{k}"' for k in lib) + ";")
+    parts.append("export type NodeProcedureKey =")
+    parts.append(" |\n".join(f'\t"{k}"' for k in node) + ";")
+    parts.append("export type ProcedureKey = LibraryProcedureKey | "
+                 "NodeProcedureKey;")
+    parts.append("")
+    parts.append("export const procedures = {")
+    for p in procs:
+        parts.append(f'\t"{p["key"]}": {{ kind: "{p["kind"]}", '
+                     f'scope: "{p["scope"]}" }},')
+    parts.append("} as const;")
+    return "\n".join(parts) + "\n"
+
+
+def generate_procedures_js(schema: dict[str, Any]) -> str:
+    table = {p["key"]: {"kind": p["kind"], "scope": p["scope"]}
+             for p in schema["procedures"]}
+    return (HEADER +
+            "window.SD_PROCEDURES = " +
+            json.dumps(table, indent=1, sort_keys=True) + ";\n")
+
+
+def client_dir() -> Path:
+    return Path(__file__).resolve().parents[2] / "client"
+
+
+def write_client(schema: dict[str, Any], out_dir: Path | None = None) -> list[Path]:
+    out = out_dir or client_dir()
+    out.mkdir(parents=True, exist_ok=True)
+    core = out / "core.ts"
+    procs = out / "procedures.js"
+    core.write_text(generate_core_ts(schema))
+    procs.write_text(generate_procedures_js(schema))
+    return [core, procs]
+
+
+def main() -> int:
+    import sys
+
+    schema_path = Path(__file__).resolve().parents[2] / "schema" / "api.json"
+    if "--from-snapshot" in sys.argv and schema_path.exists():
+        # opt-in fast path: the schema/api.json snapshot (refreshed by the
+        # test suite) — can lag the routers, so it is NOT the default
+        schema = json.loads(schema_path.read_text())
+    else:
+        # authoritative: mount a throwaway node and export the live schema
+        import tempfile
+
+        from ..node import Node
+
+        with tempfile.TemporaryDirectory(prefix="sd_codegen_") as tmp:
+            node = Node(tmp, probe_accelerator=False, watch_locations=False)
+            try:
+                schema = node.router.schema()
+            finally:
+                node.shutdown()
+    for path in write_client(schema):
+        print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

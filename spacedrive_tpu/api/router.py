@@ -141,4 +141,9 @@ def mount(node: "Node") -> Router:
                    categories, sync, p2p, keys, collections):
         module.mount(router)
     invalidate.validate(router)
+    # typed-client contract: every key in api/types.py must exist (the
+    # generated client/core.ts can then never name a ghost procedure)
+    from . import types as ts_types
+
+    ts_types.validate(router)
     return router

@@ -1,0 +1,178 @@
+"""TypeScript types for the API surface — the typed-client contract.
+
+The reference's contract file is ``packages/client/src/core.ts``, GENERATED
+from the Rust router by running an rspc/specta export test
+(core/src/api/mod.rs:205-212) and consumed through the library/node scope
+split in ``packages/client/src/rspc.tsx:13-43``. This framework has no
+macro-derived types, so the contract lives here as one reviewed map:
+
+- ``TS_PRELUDE``: the shared row interfaces (mirrors of models/schema.py
+  rows as the routers serialize them — coarse where a router passes rows
+  through verbatim, with an index-signature escape hatch).
+- ``TYPES``: procedure key → (arg TS type, result TS type). Keys MUST
+  exist on the mounted router — ``validate()`` runs at mount, exactly like
+  the invalidation-key registry — so this map can never drift to naming
+  procedures that don't exist. Procedures not listed here fall back to
+  ``unknown`` in the generated client (still present in the key unions and
+  the scope split, which is what the explorer consumes).
+
+``python -m spacedrive_tpu.api.codegen`` renders this into
+``client/core.ts`` (types) and ``client/procedures.js`` (runtime mirror
+the web explorer loads); tests/test_ts_client.py is the golden gate.
+"""
+
+from __future__ import annotations
+
+TS_PRELUDE = """\
+/** Mirrors models/schema.py rows as the routers serialize them. Fields the
+ * explorer relies on are typed; rows keep an escape hatch because several
+ * routers pass DB rows through verbatim. */
+export interface Library { id: string; name: string; [key: string]: unknown }
+export interface LocationRow {
+  id: number; pub_id: string; name: string | null; path: string | null;
+  hasher: string | null; [key: string]: unknown
+}
+export interface FilePathRow {
+  id: number; pub_id: string; name: string | null; extension: string | null;
+  materialized_path: string | null; is_dir: boolean | number;
+  cas_id: string | null; object_id: number | null;
+  size_in_bytes: number | null; kind?: number | null; [key: string]: unknown
+}
+export interface ObjectRow {
+  id: number; pub_id: string; kind: number | null; favorite?: boolean | null;
+  note?: string | null; [key: string]: unknown
+}
+export interface TagRow {
+  id: number; pub_id: string; name: string | null; color: string | null;
+  [key: string]: unknown
+}
+export interface CollectionRow {
+  id: number; pub_id: string; name: string | null; member_count?: number;
+  [key: string]: unknown
+}
+export interface JobReport {
+  id: string; name: string; status: string; task_count: number;
+  completed_task_count: number; message?: string | null;
+  children?: JobReport[]; [key: string]: unknown
+}
+export interface SearchPathsResult { items: FilePathRow[]; cursor: number | null }
+export interface NodeState {
+  id: string; name: string; data_path: string; [key: string]: unknown
+}
+export interface Statistics { [key: string]: unknown }
+export interface PeerMetadata {
+  identity: string; connected: boolean; [key: string]: unknown
+}
+export interface JobProgressEvent {
+  id: string; status?: string; completed_task_count?: number;
+  message?: string; [key: string]: unknown
+}
+"""
+
+#: procedure key -> (arg TS type, result TS type); unlisted keys emit
+#: ``unknown``. Keep entries alphabetical within their router block.
+TYPES: dict[str, tuple[str, str]] = {
+    # root
+    "buildInfo": ("null", "{ version: string; commit: string }"),
+    "nodeState": ("null", "NodeState"),
+    # libraries
+    "libraries.create": ("{ name: string }", "Library"),
+    "libraries.delete": ("string", "null"),
+    "libraries.edit": ("{ id: string; name?: string; description?: string }", "null"),
+    "libraries.list": ("null", "Library[]"),
+    "libraries.statistics": ("null", "Statistics"),
+    # locations
+    "locations.create": (
+        "{ path: string; dry_run?: boolean; indexer_rules_ids?: number[] }",
+        "LocationRow | null"),
+    "locations.delete": ("number", "null"),
+    "locations.fullRescan": ("{ location_id: number }", "string"),
+    "locations.get": ("number", "LocationRow | null"),
+    "locations.list": ("null", "LocationRow[]"),
+    "locations.update": ("{ id: number; [key: string]: unknown }", "null"),
+    "locations.indexer_rules.create": (
+        "{ name: string; kind: number; parameters: string[] }", "number"),
+    "locations.indexer_rules.delete": ("number", "null"),
+    "locations.indexer_rules.get": ("number", "Record<string, unknown> | null"),
+    "locations.indexer_rules.list": ("null", "Record<string, unknown>[]"),
+    # search
+    "search.ephemeralPaths": (
+        "{ path: string; withHiddenFiles?: boolean }",
+        "{ entries: FilePathRow[] }"),
+    "search.objects": (
+        "{ take?: number; tags?: number[]; kind?: number[] }",
+        "{ items: ObjectRow[] }"),
+    "search.paths": (
+        "{ location_id?: number; path?: string; search?: string; "
+        "take?: number; cursor?: number; [key: string]: unknown }",
+        "SearchPathsResult"),
+    "search.duplicates": ("{ location_id?: number }",
+                          "Record<string, unknown>[]"),
+    # jobs
+    "jobs.cancel": ("string", "null"),
+    "jobs.clear": ("string", "null"),
+    "jobs.clearAll": ("null", "null"),
+    "jobs.pause": ("string", "null"),
+    "jobs.progress": ("null", "JobProgressEvent"),
+    "jobs.reports": ("null", "JobReport[]"),
+    "jobs.resume": ("string", "null"),
+    # files
+    "files.deleteFiles": ("{ location_id: number; file_path_ids: number[] } | "
+                          "Record<string, unknown>", "string"),
+    "files.renameFile": ("{ id: number; new_name: string }", "null"),
+    "files.setFavorite": ("{ id: number; favorite: boolean }", "null"),
+    "files.setNote": ("{ id: number; note: string | null }", "null"),
+    # tags
+    "tags.assign": ("{ object_ids: number[]; tag_id: number; unassign?: boolean }",
+                    "null"),
+    "tags.create": ("{ name: string; color?: string }", "TagRow"),
+    "tags.delete": ("number", "null"),
+    "tags.get": ("number", "TagRow | null"),
+    "tags.getForObject": ("number", "TagRow[]"),
+    "tags.list": ("null", "TagRow[]"),
+    "tags.update": ("{ id: number; name?: string; color?: string }", "null"),
+    # collections
+    "albums.addObjects": ("{ id: number; object_ids: number[] }", "number"),
+    "albums.create": ("{ name: string; is_hidden?: boolean } | string",
+                      "CollectionRow"),
+    "albums.delete": ("number", "null"),
+    "albums.list": ("null", "CollectionRow[]"),
+    "albums.objects": ("number", "FilePathRow[]"),
+    "albums.removeObjects": ("{ id: number; object_ids: number[] }", "number"),
+    "albums.update": ("{ id: number; name?: string; is_hidden?: boolean }",
+                      "null"),
+    "spaces.addObjects": ("{ id: number; object_ids: number[] }", "number"),
+    "spaces.create": ("{ name: string; description?: string } | string",
+                      "CollectionRow"),
+    "spaces.delete": ("number", "null"),
+    "spaces.list": ("null", "CollectionRow[]"),
+    "spaces.objects": ("number", "FilePathRow[]"),
+    "spaces.removeObjects": ("{ id: number; object_ids: number[] }", "number"),
+    "spaces.update": ("{ id: number; name?: string; description?: string }",
+                      "null"),
+    "labels.assign": ("{ name: string; object_ids: number[]; remove?: boolean }",
+                      "number"),
+    "labels.getForObject": ("number", "Record<string, unknown>[]"),
+    "labels.list": ("null", "Record<string, unknown>[]"),
+    # volumes / nodes / notifications
+    "nodes.edit": ("{ name?: string }", "null"),
+    "notifications.dismiss": ("number", "null"),
+    "notifications.dismissAll": ("null", "null"),
+    "notifications.get": ("null", "Record<string, unknown>[]"),
+    "volumes.list": ("null", "Record<string, unknown>[]"),
+    # p2p
+    "p2p.events": ("null", "Record<string, unknown>"),
+    "p2p.nlmState": ("null", "Record<string, unknown>"),
+    "p2p.peers": ("null", "PeerMetadata[]"),
+    # sync
+    "sync.messages": ("null", "Record<string, unknown>[]"),
+}
+
+
+def validate(router) -> None:
+    """Every typed key must name a mounted procedure (mount-time gate, the
+    invalidation-registry trick: the map cannot drift ahead of the API)."""
+    unknown = sorted(set(TYPES) - set(router.procedures))
+    if unknown:
+        raise RuntimeError(
+            f"api/types.py names procedures that do not exist: {unknown}")

@@ -200,6 +200,21 @@ class Server:
             return await self._rspc_http(req, "/".join(parts[1:]))
         if parts[0] == "schema":
             return Response.json(self.node.router.schema())
+        if parts[0] == "client" and len(parts) == 2 \
+                and parts[1] in ("core.ts", "procedures.js"):
+            # the GENERATED typed-client artifacts (api/codegen.py); the
+            # explorer loads procedures.js and refuses unknown keys, so a
+            # stale artifact fails loudly rather than silently
+            from ..api.codegen import client_dir
+
+            path = client_dir() / parts[1]
+            if not path.exists():
+                raise HttpError(404, "client artifacts not generated — run "
+                                     "python -m spacedrive_tpu.api.codegen")
+            ctype = ("text/typescript" if parts[1].endswith(".ts")
+                     else "text/javascript")
+            return Response(headers={"content-type": f"{ctype}; charset=utf-8"},
+                            body=path.read_bytes())
         if parts[0] == "spacedrive":
             return await self._custom_uri(req, parts[1:])
         raise HttpError(404)

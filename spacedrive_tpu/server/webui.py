@@ -103,15 +103,25 @@ INDEX_HTML = r"""<!doctype html>
   <div class="crumbs" id="crumbs"></div>
   <div id="content" class="grid"></div>
 </main>
+<script src="/client/procedures.js"></script>
 <script>
 const state = { library: null, location: null, dir: "/", ws: null };
 const KIND_ICONS = {0:"📄",2:"📁",3:"📝",5:"🖼️",6:"🎵",7:"🎬",8:"🗜️",9:"⚙️",
                     11:"🔒",20:"💻",21:"🗃️",22:"📚",23:"🧾"};
 
 async function rspc(key, arg, libraryId) {
+  // the GENERATED client contract (client/procedures.js, from
+  // spacedrive_tpu/api/codegen.py) is load-bearing: a key missing from it
+  // means the UI and the schema drifted — fail here, not with a 404
+  if (!window.SD_PROCEDURES)
+    throw new Error("client contract not loaded — /client/procedures.js " +
+                    "missing (run python -m spacedrive_tpu.api.codegen)");
+  const meta = window.SD_PROCEDURES[key];
+  if (!meta) throw new Error(`${key}: not in the generated client contract`);
+  const lib = meta.scope === "library" ? (libraryId ?? state.library) : null;
   const r = await fetch(`/rspc/${key}`, {method:"POST",
     headers:{"content-type":"application/json"},
-    body: JSON.stringify({arg: arg ?? null, library_id: libraryId ?? state.library})});
+    body: JSON.stringify({arg: arg ?? null, library_id: lib})});
   const body = await r.json();
   if (body.error) throw new Error(`${key}: ${body.error}`);
   return body.result;
