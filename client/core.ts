@@ -135,6 +135,8 @@ export type Procedures = {
 	{ key: "keys.changeMasterPassword", input: unknown, result: unknown } |
 	{ key: "keys.clearMasterPassword", input: unknown, result: unknown } |
 	{ key: "keys.deleteFromLibrary", input: unknown, result: unknown } |
+	{ key: "keys.disableAutoUnlock", input: unknown, result: unknown } |
+	{ key: "keys.enableAutoUnlock", input: unknown, result: unknown } |
 	{ key: "keys.lockKeyManager", input: unknown, result: unknown } |
 	{ key: "keys.mount", input: unknown, result: unknown } |
 	{ key: "keys.restoreKeystore", input: unknown, result: unknown } |
@@ -290,6 +292,8 @@ export type NodeProcedureKey =
 	"keys.changeMasterPassword" |
 	"keys.clearMasterPassword" |
 	"keys.deleteFromLibrary" |
+	"keys.disableAutoUnlock" |
+	"keys.enableAutoUnlock" |
 	"keys.getDefault" |
 	"keys.getKey" |
 	"keys.isKeyManagerUnlocking" |
@@ -382,6 +386,8 @@ export const procedures = {
 	"keys.changeMasterPassword": { kind: "mutation", scope: "node" },
 	"keys.clearMasterPassword": { kind: "mutation", scope: "node" },
 	"keys.deleteFromLibrary": { kind: "mutation", scope: "node" },
+	"keys.disableAutoUnlock": { kind: "mutation", scope: "node" },
+	"keys.enableAutoUnlock": { kind: "mutation", scope: "node" },
 	"keys.getDefault": { kind: "query", scope: "node" },
 	"keys.getKey": { kind: "query", scope: "node" },
 	"keys.isKeyManagerUnlocking": { kind: "query", scope: "node" },

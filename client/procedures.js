@@ -198,6 +198,14 @@ window.SD_PROCEDURES = {
   "kind": "mutation",
   "scope": "node"
  },
+ "keys.disableAutoUnlock": {
+  "kind": "mutation",
+  "scope": "node"
+ },
+ "keys.enableAutoUnlock": {
+  "kind": "mutation",
+  "scope": "node"
+ },
  "keys.getDefault": {
   "kind": "query",
   "scope": "node"

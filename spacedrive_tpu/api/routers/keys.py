@@ -157,6 +157,20 @@ def mount(router) -> None:
     def is_unlocking(node, _arg=None):
         return False  # unlock here is synchronous; never observably mid-flight
 
+    @router.mutation("keys.enableAutoUnlock")
+    @_translate
+    def enable_auto_unlock(node, _arg=None):
+        """Park the root secret in the OS keyring (kernel user-keyring, or
+        the machine-bound encrypted file fallback) so this keystore
+        auto-unlocks across restarts; returns the backend name."""
+        return _km(node).enable_auto_unlock()
+
+    @router.mutation("keys.disableAutoUnlock")
+    @_translate
+    def disable_auto_unlock(node, _arg=None):
+        _km(node).disable_auto_unlock()
+        return True
+
     @router.mutation("keys.backupKeystore")
     @_translate
     def backup_keystore(node, path: str):

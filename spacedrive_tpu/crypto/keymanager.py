@@ -119,12 +119,7 @@ class KeyManager:
                 raise KeyManagerError("incorrect master password") from e
             # automount (updateAutomountStatus): flagged keys surface as
             # soon as the manager unlocks
-            for kid, rec in self._store["keys"].items():
-                if rec.get("automount"):
-                    try:
-                        self.mount(kid)
-                    except KeyManagerError:
-                        pass
+            self._automount()
 
     def change_master_password(self, current: str | Protected,
                                new: str | Protected) -> None:
@@ -156,6 +151,93 @@ class KeyManager:
             for key in self._mounted.values():
                 key.zeroize()
             self._mounted.clear()
+
+    # -- keyring auto-unlock -------------------------------------------------
+    def _keyring_account(self) -> str:
+        import hashlib
+
+        tag = hashlib.sha256(str(self.store_path).encode()).hexdigest()[:16]
+        return f"km-root:{tag}"
+
+    def _default_keyring(self):
+        from .keyring import default_store
+
+        return default_store(self.store_path.parent)
+
+    def _recorded_keyring(self):
+        """The backend RECORDED at enable time — disable/try must talk to
+        the store that actually holds the secret, not whatever
+        default_store() resolves to today (backend availability can flip
+        between runs: seccomp, containers)."""
+        from .keyring import FileSecretStore, KernelKeyringStore
+
+        name = self._store.get("auto_unlock")
+        if name == "kernel-keyring":
+            return KernelKeyringStore()
+        if name == "file":
+            return FileSecretStore(self.store_path.parent / "keyring.json")
+        return self._default_keyring()
+
+    def enable_auto_unlock(self, store=None) -> str:
+        """Park the root secret in an OS-backed secret store (crates/crypto
+        keys/keyring role) so this keystore auto-unlocks across process
+        restarts without the master password and with no plaintext on
+        disk. Returns the backend name."""
+        import hashlib
+
+        with self._lock:
+            root = self._require_root()
+            store = store or self._default_keyring()
+            store.set(self._keyring_account(), root.expose())
+            self._store["auto_unlock"] = store.name
+            # check value: a stale/foreign keyring entry must never be
+            # installed as the root (preimage-resistant, reveals nothing
+            # about the random 256-bit key)
+            self._store["auto_unlock_check"] = hashlib.sha256(
+                b"sd-km-check|" + root.expose()).hexdigest()
+            self._save()
+            return store.name
+
+    def disable_auto_unlock(self, store=None) -> None:
+        with self._lock:
+            store = store or self._recorded_keyring()
+            store.delete(self._keyring_account())
+            self._store.pop("auto_unlock", None)
+            self._store.pop("auto_unlock_check", None)
+            self._save()
+
+    def try_auto_unlock(self, store=None) -> bool:
+        """Unlock from the secret store when enabled; False when the store
+        has no (or a stale) secret — the password path still works."""
+        with self._lock:
+            if not self.is_setup or self.is_unlocked \
+                    or not self._store.get("auto_unlock"):
+                return False
+            import hashlib
+
+            store = store or self._recorded_keyring()
+            secret = store.get(self._keyring_account())
+            if not secret:
+                return False
+            check = hashlib.sha256(b"sd-km-check|" + secret).hexdigest()
+            if check != self._store.get("auto_unlock_check"):
+                return False  # stale/foreign entry: never install it
+            self._root = Protected(secret)
+            self._automount()
+            return True
+
+    def _automount(self) -> None:
+        import logging
+
+        for kid, rec in self._store["keys"].items():
+            if rec.get("automount"):
+                try:
+                    self.mount(kid)
+                except Exception:
+                    # one corrupt key record (truncated base64, bad AEAD
+                    # tag) must not make unlock itself fail
+                    logging.getLogger(__name__).warning(
+                        "automount failed for key %s", kid, exc_info=True)
 
     def _require_root(self) -> Protected:
         if self._root is None:

@@ -1,0 +1,159 @@
+/* App-shaped FFI host — the long-lived consumer the mobile shells are.
+ *
+ * The reference embeds the core behind handle_core_msg and runs a
+ * continuous event listener thread beside the request path
+ * (apps/mobile/modules/sd-core/core/src/lib.rs:61-117 + :119's
+ * spawn_core_event_listener). This harness is the same composition in
+ * plain C against sd_core_ffi.cc: boot, start a pump thread draining
+ * sd_core_poll_event concurrently, create a library + location over the
+ * JSON bridge, run a full scan, wait for the job chain to settle, list
+ * the indexed paths, and only then stop the pump — asserting that
+ * job_progress and invalidation events flowed WHILE requests ran.
+ *
+ * usage: sd_ffi_host <data_dir> <python_path> <tree_to_scan>
+ * exit 0 => every step round-tripped and the event flow was observed.
+ */
+#include <pthread.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+extern int sd_core_init(const char* data_dir, const char* python_path);
+extern char* sd_core_msg(const char* json);
+extern char* sd_core_poll_event(int timeout_ms);
+extern void sd_core_shutdown(void);
+extern void sd_core_free(char* s);
+
+static volatile int pump_stop = 0;
+static volatile int ev_progress = 0;
+static volatile int ev_invalidate = 0;
+static volatile int ev_other = 0;
+
+static void* event_pump(void* arg) {
+  (void)arg;
+  while (!pump_stop) {
+    char* ev = sd_core_poll_event(250);
+    if (ev && ev[0]) {
+      if (strstr(ev, "job_progress")) ev_progress++;
+      else if (strstr(ev, "invalidate")) ev_invalidate++;
+      else ev_other++;
+    }
+    sd_core_free(ev);
+  }
+  return NULL;
+}
+
+/* naive field scanners — enough for the bridge's flat JSON envelopes */
+static int extract_string(const char* json, const char* field, char* out,
+                          size_t cap) {
+  char pat[64];
+  snprintf(pat, sizeof pat, "\"%s\": \"", field);
+  const char* p = strstr(json, pat);
+  if (!p) { snprintf(pat, sizeof pat, "\"%s\":\"", field); p = strstr(json, pat); }
+  if (!p) return 0;
+  p = strchr(p + strlen(pat) - 1, '"') + 1;  /* after opening quote */
+  size_t i = 0;
+  while (p[i] && p[i] != '"' && i + 1 < cap) { out[i] = p[i]; i++; }
+  out[i] = 0;
+  return i > 0;
+}
+
+static long extract_int(const char* json, const char* field) {
+  char pat[64];
+  snprintf(pat, sizeof pat, "\"%s\":", field);
+  const char* p = strstr(json, pat);
+  if (!p) return -1;
+  p += strlen(pat);
+  while (*p == ' ') p++;
+  return strtol(p, NULL, 10);
+}
+
+static char* msgf(const char* fmt, ...) {
+  char buf[4096];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(buf, sizeof buf, fmt, ap);
+  va_end(ap);
+  return sd_core_msg(buf);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <data_dir> <python_path> <tree>\n", argv[0]);
+    return 2;
+  }
+  if (sd_core_init(argv[1], argv[2]) != 0) {
+    fprintf(stderr, "sd_core_init failed\n");
+    return 1;
+  }
+
+  pthread_t pump;
+  pthread_create(&pump, NULL, event_pump, NULL);
+
+  int rc = 1;
+  char lib_id[128] = {0};
+  char* resp = msgf("{\"id\":1,\"key\":\"libraries.create\","
+                    "\"arg\":{\"name\":\"ffi-host\"}}");
+  printf("create-lib: %s\n", resp);
+  const char* body = resp ? strstr(resp, "\"result\"") : NULL;
+  int ok = body != NULL &&
+           extract_string(body, "id", lib_id, sizeof lib_id);
+  sd_core_free(resp);
+  if (!ok) goto done;
+
+  resp = msgf("{\"id\":2,\"key\":\"locations.create\","
+              "\"arg\":{\"path\":\"%s\"},\"library_id\":\"%s\"}",
+              argv[3], lib_id);
+  printf("create-loc: %s\n", resp);
+  body = resp ? strstr(resp, "\"result\"") : NULL;
+  long loc_id = body ? extract_int(body, "id") : -1;
+  ok = body != NULL && loc_id > 0;
+  sd_core_free(resp);
+  if (!ok) goto done;
+
+  /* locations.create chained the scan (indexer -> identifier -> media);
+   * wait for the job chain to settle: reports exist and none running */
+  int settled = 0;
+  for (int i = 0; i < 300 && !settled; i++) {
+    usleep(300 * 1000);
+    resp = msgf("{\"id\":4,\"key\":\"jobs.reports\",\"arg\":null,"
+                "\"library_id\":\"%s\"}", lib_id);
+    if (resp && strstr(resp, "\"name\"") && !strstr(resp, "Running") &&
+        !strstr(resp, "Queued"))
+      settled = 1;
+    sd_core_free(resp);
+  }
+  if (!settled) { fprintf(stderr, "scan never settled\n"); goto done; }
+
+  resp = msgf("{\"id\":5,\"key\":\"search.paths\","
+              "\"arg\":{\"location_id\":%ld},\"library_id\":\"%s\"}",
+              loc_id, lib_id);
+  long n_items = 0;
+  if (resp) {
+    for (const char* p = resp; (p = strstr(p, "\"name\"")) != NULL; p++)
+      n_items++;
+  }
+  printf("paths: %ld rows\n", n_items);
+  ok = resp && n_items > 0;
+  sd_core_free(resp);
+  if (!ok) goto done;
+  rc = 0;
+
+done:
+  /* drain a beat longer so trailing completion events are observed */
+  usleep(500 * 1000);
+  pump_stop = 1;
+  pthread_join(pump, NULL);
+  printf("FFI_HOST events: progress=%d invalidate=%d other=%d\n",
+         ev_progress, ev_invalidate, ev_other);
+  if (rc == 0 && (ev_progress < 1 || ev_invalidate < 1)) {
+    fprintf(stderr, "event flow missing (progress=%d invalidate=%d)\n",
+            ev_progress, ev_invalidate);
+    rc = 1;
+  }
+  if (rc == 0) printf("FFI_HOST_OK\n");
+  sd_core_shutdown();
+  return rc;
+}

@@ -104,6 +104,14 @@ class Node:
         from .crypto.keymanager import KeyManager
 
         self.key_manager = KeyManager(self.data_dir / "keystore.json")
+        try:
+            # keyring-backed auto-unlock (crates/crypto keys/keyring role):
+            # no-op unless the user enabled it on this keystore
+            if self.key_manager.try_auto_unlock():
+                logger.info("key manager auto-unlocked from the OS keyring")
+        except Exception:
+            logger.exception("keyring auto-unlock failed; password unlock "
+                             "still available")
         from .objects.gc import ThumbnailRemoverActor
 
         self.thumbnail_remover = ThumbnailRemoverActor(self)
