@@ -89,8 +89,8 @@ export type Procedures = {
 	{ key: "search.nearDuplicates", input: unknown, result: unknown } |
 	{ key: "search.objects", input: { take?: number; tags?: number[]; kind?: number[] }, result: { items: ObjectRow[] } } |
 	{ key: "search.objectsCount", input: unknown, result: unknown } |
-	{ key: "search.paths", input: { location_id?: number; path?: string; search?: string; take?: number; cursor?: number; [key: string]: unknown }, result: SearchPathsResult } |
-	{ key: "search.pathsCount", input: unknown, result: unknown } |
+	{ key: "search.paths", input: { location_id?: number; path?: string; search?: string; take?: number; skip?: number; dirs_first?: boolean; cursor?: [unknown, number] | null; [key: string]: unknown }, result: SearchPathsResult } |
+	{ key: "search.pathsCount", input: { location_id?: number; [key: string]: unknown }, result: number } |
 	{ key: "spaces.list", input: null, result: CollectionRow[] } |
 	{ key: "spaces.objects", input: number, result: FilePathRow[] } |
 	{ key: "sync.messages", input: null, result: Record<string, unknown>[] } |
@@ -154,7 +154,7 @@ export type Procedures = {
 	{ key: "locations.create", input: { path: string; dry_run?: boolean; indexer_rules_ids?: number[] }, result: LocationRow | null } |
 	{ key: "locations.delete", input: number, result: null } |
 	{ key: "locations.fullRescan", input: { location_id: number }, result: string } |
-	{ key: "locations.indexer_rules.create", input: { name: string; kind: number; parameters: string[] }, result: number } |
+	{ key: "locations.indexer_rules.create", input: { name: string; rules: Record<string, string[]> }, result: number } |
 	{ key: "locations.indexer_rules.delete", input: number, result: null } |
 	{ key: "locations.quickRescan", input: unknown, result: unknown } |
 	{ key: "locations.relink", input: unknown, result: unknown } |

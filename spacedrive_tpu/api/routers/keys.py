@@ -20,11 +20,14 @@ from ..router import ApiError
 
 #: procedures HTTP shells refuse while basic auth is off (any local user
 #: can reach a localhost port): getKey RETURNS raw key material,
-#: backupKeystore WRITES an arbitrary server-writable path, and
-#: restoreKeystore merges attacker-known key material into the keystore.
+#: backupKeystore WRITES an arbitrary server-writable path, restoreKeystore
+#: merges attacker-known key material into the keystore, and
+#: enableAutoUnlock persists the root secret into the (weaker-than-argon2id)
+#: keyring store — a silent at-rest downgrade if triggered by a stranger.
 #: In-process consumers (client, FFI) are unaffected.
 SECRET_PROCEDURES = frozenset({
     "keys.getKey", "keys.backupKeystore", "keys.restoreKeystore",
+    "keys.enableAutoUnlock",
 })
 
 

@@ -83,17 +83,30 @@ def mount(router) -> None:
         take = min(int(arg.get("take", 100)), 500)
         expr, order_sql, desc = _order_parts(arg)
         cursor = arg.get("cursor")
+        if arg.get("dirs_first"):
+            # folders lead (the explorer's browse order); offset-mode only —
+            # the keyset cursor doesn't encode the two-level order
+            if cursor is not None:
+                raise ApiError("dirs_first cannot combine with a cursor")
+            order_sql = f"fp.is_dir DESC, {order_sql}"
         cursor_sql = ""
         if cursor is not None:
             value, last_id = cursor
             cursor_sql = f"AND {_cursor_sql(expr, desc)}"
             params = params + [value, value, last_id]
+        # `skip`: offset pagination for the explorer's windowed grid —
+        # random scroll positions need random access, which a cursor chain
+        # cannot give; cursor stays the API for sequential consumers
+        offset_sql = ""
+        if cursor is None and arg.get("skip"):
+            offset_sql = " OFFSET ?"
         rows = library.db.query(
             f"SELECT fp.*, o.pub_id AS object_pub_id, o.kind AS object_kind, "
             f"o.favorite AS favorite, o.note AS note, {expr} AS _order_val "
             f"FROM file_path fp LEFT JOIN object o ON fp.object_id = o.id "
-            f"WHERE {where} {cursor_sql} ORDER BY {order_sql} LIMIT ?",
-            params + [take + 1])
+            f"WHERE {where} {cursor_sql} ORDER BY {order_sql} LIMIT ?"
+            f"{offset_sql}",
+            params + [take + 1] + ([int(arg["skip"])] if offset_sql else []))
         items = []
         for r in rows[:take]:
             d = dict(FilePath.decode_row(r) | {

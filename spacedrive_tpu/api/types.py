@@ -91,7 +91,7 @@ TYPES: dict[str, tuple[str, str]] = {
     "locations.list": ("null", "LocationRow[]"),
     "locations.update": ("{ id: number; [key: string]: unknown }", "null"),
     "locations.indexer_rules.create": (
-        "{ name: string; kind: number; parameters: string[] }", "number"),
+        "{ name: string; rules: Record<string, string[]> }", "number"),
     "locations.indexer_rules.delete": ("number", "null"),
     "locations.indexer_rules.get": ("number", "Record<string, unknown> | null"),
     "locations.indexer_rules.list": ("null", "Record<string, unknown>[]"),
@@ -104,8 +104,12 @@ TYPES: dict[str, tuple[str, str]] = {
         "{ items: ObjectRow[] }"),
     "search.paths": (
         "{ location_id?: number; path?: string; search?: string; "
-        "take?: number; cursor?: number; [key: string]: unknown }",
+        "take?: number; skip?: number; dirs_first?: boolean; "
+        "cursor?: [unknown, number] | null; "
+        "[key: string]: unknown }",
         "SearchPathsResult"),
+    "search.pathsCount": ("{ location_id?: number; [key: string]: unknown }",
+                          "number"),
     "search.duplicates": ("{ location_id?: number }",
                           "Record<string, unknown>[]"),
     # jobs

@@ -15,11 +15,13 @@ Backends (pluggable, picked by :func:`default_store`):
 - :class:`FileSecretStore` — the portable fallback: secrets sealed with
   XChaCha20-Poly1305 under a key derived from the machine identity
   (/etc/machine-id) + uid + a fixed context string, stored 0600. This
-  keeps plaintext off the disk and binds the blob to this machine/user —
-  the honest threat model of every file-backed keyring fallback: it
-  defeats disk-image/backup exfiltration, not a root attacker on the
-  live box (neither does a Secret-Service daemon once the session is
-  unlocked).
+  keeps plaintext out of the keystore directory and binds the blob to
+  this machine/user. Honest threat model: it defeats exfiltration of the
+  data directory alone (the common backup/sync scope) — a FULL disk image
+  also contains /etc/machine-id and so defeats it, as it defeats any
+  file-backed keyring fallback; prefer the kernel keyring where
+  available, or keep auto-unlock off for at-rest protection that rests
+  on the argon2id master password.
 
 The key manager consumes this through ``enable_auto_unlock`` /
 ``try_auto_unlock`` (keymanager.py).

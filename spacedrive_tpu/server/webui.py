@@ -73,6 +73,15 @@ INDEX_HTML = r"""<!doctype html>
   table { width: 100%; border-collapse: collapse; font-size: 13px; }
   td, th { text-align: left; padding: 5px 8px; border-bottom: 1px solid #23242f; }
   #status { font-size: 11px; color: var(--dim); margin-top: auto; }
+  #content.vgrid { display: block; position: relative; overflow-y: auto;
+                   height: calc(100vh - 78px); }
+  .vcard { position: absolute; width: 142px; box-sizing: border-box; }
+  .settings h3 { font-size: 13px; margin: 18px 0 8px; color: #fff; }
+  .settings label { display: block; font-size: 12px; color: var(--dim);
+                    margin: 8px 0 2px; }
+  .settings input, .settings textarea, .settings select {
+    width: 320px; max-width: 90%; }
+  .settings textarea { height: 70px; font-family: inherit; }
 </style>
 </head>
 <body>
@@ -89,6 +98,7 @@ INDEX_HTML = r"""<!doctype html>
   <div class="loc" data-view="duplicates">near-duplicates</div>
   <div class="loc" data-view="history">job history</div>
   <div class="loc" data-view="ephemeral">browse host path…</div>
+  <div class="loc" data-view="settings">settings</div>
   <h2>Tags</h2>
   <div id="tags"></div>
   <h2>Albums</h2>
@@ -185,13 +195,87 @@ function crumbs() {
   }
 }
 
+// ---- virtualized location grid -------------------------------------------
+// A 100k-row directory must scroll with <200 live DOM nodes: #content
+// becomes the scroll viewport over a spacer sized for the full row count,
+// pages of 200 rows fetch on demand via search.paths{take, skip}, and only
+// the visible window (plus a small buffer) materializes cards.
+const VGRID = { rowH: 176, cellW: 152, page: 200, pages: new Map(),
+                pending: new Set(), total: 0, epoch: 0, filters: null,
+                spacer: null };
+
 async function browse() {
   if (state.library === null || state.location === null) return;
   state.ephemeralPath = null;  // leaving ephemeral view stops its retries
   crumbs();
-  const res = await rspc("search.paths",
-    {location_id: state.location, materialized_path: state.dir, take: 500});
-  render(res.items ?? res);
+  const epoch = ++VGRID.epoch;
+  VGRID.pages.clear(); VGRID.pending.clear();
+  VGRID.filters = {location_id: state.location,
+                   materialized_path: state.dir, dirs_first: true};
+  const total = await rspc("search.pathsCount", VGRID.filters);
+  if (epoch !== VGRID.epoch) return;  // user switched views mid-count
+  VGRID.total = total;
+  const box = document.getElementById("content");
+  box.className = "vgrid";
+  box.innerHTML = "";
+  VGRID.spacer = el("div");
+  VGRID.spacer.style.position = "relative";
+  box.append(VGRID.spacer);
+  box.onscroll = () => requestAnimationFrame(renderWindow);
+  window.onresize = () => requestAnimationFrame(renderWindow);
+  renderWindow();
+}
+
+async function ensurePage(p) {
+  if (VGRID.pages.has(p) || VGRID.pending.has(p)) return;
+  VGRID.pending.add(p);
+  const epoch = VGRID.epoch;
+  try {
+    const res = await rspc("search.paths",
+      {...VGRID.filters, take: VGRID.page, skip: p * VGRID.page});
+    if (epoch !== VGRID.epoch) return;  // view changed mid-flight
+    VGRID.pages.set(p, res.items ?? res);
+    if (VGRID.pages.size > 24) {  // bound memory: evict farthest pages
+      const keep = [...VGRID.pages.keys()].sort((a, b) =>
+        Math.abs(a - p) - Math.abs(b - p)).slice(0, 16);
+      const keepSet = new Set(keep);
+      for (const k of [...VGRID.pages.keys()])
+        if (!keepSet.has(k)) VGRID.pages.delete(k);
+    }
+    renderWindow();
+  } finally {
+    if (epoch === VGRID.epoch) VGRID.pending.delete(p);
+  }
+}
+
+function renderWindow() {
+  const box = document.getElementById("content");
+  if (box.className !== "vgrid" || !VGRID.spacer) return;
+  const cols = Math.max(1, Math.floor(box.clientWidth / VGRID.cellW));
+  const rows = Math.ceil(VGRID.total / cols);
+  VGRID.spacer.style.height = `${rows * VGRID.rowH}px`;
+  const first = Math.max(0, Math.floor(box.scrollTop / VGRID.rowH) - 2);
+  const last = Math.min(rows,
+    Math.ceil((box.scrollTop + box.clientHeight) / VGRID.rowH) + 2);
+  VGRID.spacer.innerHTML = "";
+  for (let row = first; row < last; row++) {
+    for (let col = 0; col < cols; col++) {
+      const idx = row * cols + col;
+      if (idx >= VGRID.total) break;
+      const p = Math.floor(idx / VGRID.page);
+      const pageItems = VGRID.pages.get(p);
+      if (pageItems === undefined) { ensurePage(p); continue; }
+      const it = pageItems[idx - p * VGRID.page];
+      if (!it || !it.name) continue;
+      const card = makeCard(it);
+      card.classList.add("vcard");
+      card.style.top = `${row * VGRID.rowH}px`;
+      card.style.left = `${col * VGRID.cellW}px`;
+      VGRID.spacer.append(card);
+    }
+  }
+  if (!VGRID.total)
+    VGRID.spacer.append(el("div", {className: "meta"}, "empty"));
 }
 
 function render(items) {
@@ -203,6 +287,12 @@ function render(items) {
     || (a.name ?? "").localeCompare(b.name ?? ""));
   for (const it of items) {
     if (!it.name) continue;
+    box.append(makeCard(it));
+  }
+  if (!items.length) box.append(el("div", {className: "meta"}, "empty"));
+}
+
+function makeCard(it) {
     const card = el("div", {className: "item"});
     const thumb = el("div", {className: "thumb"});
     if (it.cas_id && (it.object_kind === 5 || it.object_kind === 7)) {
@@ -270,9 +360,7 @@ function render(items) {
       else window.open(
         `/spacedrive/file/${state.library}/${it.location_id}/${it.id}`, "_blank");
     };
-    box.append(card);
-  }
-  if (!items.length) box.append(el("div", {className: "meta"}, "empty"));
+    return card;
 }
 
 function fmtSize(n) {
@@ -448,6 +536,86 @@ document.querySelector('[data-view="history"]').onclick = async () => {
   clear.onclick = async () => { await rspc("jobs.clearAll", {});
     document.querySelector('[data-view="history"]').onclick(); };
   box.append(table, clear);
+};
+
+document.querySelector('[data-view="settings"]').onclick = async () => {
+  state.ephemeralPath = null;
+  const box = document.getElementById("content");
+  box.className = "settings"; box.innerHTML = "";
+  document.getElementById("crumbs").textContent = "settings";
+
+  // ---- library edit (libraries.edit) ----
+  const libs = await rspc("libraries.list", null, null);
+  const lib = libs.find(l => l.id === state.library) || {};
+  box.append(el("h3", {}, "Library"));
+  const nameIn = el("input", {value: lib.name ?? ""});
+  const descIn = el("input", {value: lib.description ?? ""});
+  box.append(el("label", {}, "name"), nameIn,
+             el("label", {}, "description"), descIn);
+  const save = el("button", {}, "save library");
+  save.onclick = async () => {
+    await rspc("libraries.edit", {id: state.library, name: nameIn.value,
+                                  description: descIn.value}, null);
+    save.textContent = "saved ✓"; loadLibraries();
+  };
+  box.append(el("div", {}, ""), save);
+
+  // ---- indexer rules (locations.indexer_rules.*) ----
+  box.append(el("h3", {}, "Indexer rules"));
+  const table = el("table");
+  box.append(table);
+  const KINDS = {0: "accept files by glob", 1: "reject files by glob",
+                 2: "accept if child dirs present",
+                 3: "reject if child dirs present"};
+  async function refreshRules() {
+    table.innerHTML = "";
+    table.append(el("tr", {innerHTML:
+      "<th>name</th><th>rules</th><th>system</th><th></th>"}));
+    const rules = await rspc("locations.indexer_rules.list");
+    for (const r of rules) {
+      const tr = el("tr");
+      const ruleset = typeof r.rules === "string" ? JSON.parse(r.rules)
+                                                  : (r.rules ?? {});
+      const desc = Object.entries(ruleset).map(([k, v]) =>
+        `${KINDS[k] ?? k}: ${(v ?? []).join(", ")}`).join(" · ");
+      tr.append(el("td", {}, r.name), el("td", {}, desc),
+                el("td", {}, r.default ? "yes" : ""));
+      const actions = el("td");
+      if (!r.default) {
+        const del = el("button", {}, "delete");
+        del.onclick = async () => {
+          await rspc("locations.indexer_rules.delete", r.id);
+          refreshRules();
+        };
+        actions.append(del);
+      }
+      tr.append(actions);
+      table.append(tr);
+    }
+  }
+  await refreshRules();
+
+  box.append(el("h3", {}, "New rule"));
+  const rName = el("input", {placeholder: "rule name"});
+  const rKind = el("select");
+  for (const [v, label] of Object.entries(KINDS))
+    rKind.append(el("option", {value: v}, label));
+  const rParams = el("textarea",
+    {placeholder: "one glob / directory name per line"});
+  const add = el("button", {}, "create rule");
+  add.onclick = async () => {
+    const params = rParams.value.split("\n").map(s => s.trim())
+      .filter(Boolean);
+    if (!rName.value || !params.length) return;
+    await rspc("locations.indexer_rules.create",
+      {name: rName.value, rules: {[rKind.value]: params}});
+    rName.value = ""; rParams.value = "";
+    refreshRules();
+  };
+  box.append(el("label", {}, "name"), rName,
+             el("label", {}, "kind"), rKind,
+             el("label", {}, "parameters"), rParams,
+             el("div", {}, ""), add);
 };
 
 document.querySelector('[data-view="ephemeral"]').onclick = () => {
