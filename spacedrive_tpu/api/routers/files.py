@@ -9,7 +9,7 @@ from ...models import FilePath, MediaData, Object, utc_now
 from ...objects.crypto_jobs import FileDecryptorJob, FileEncryptorJob
 from ...objects.fs import (FileCopierJob, FileCutterJob, FileDeleterJob,
                            FileEraserJob, create_directory, create_file,
-                           file_path_abs, find_available_name)
+                           file_path_abs)
 from ...objects.media.metadata import extract_media_data
 from ..invalidate import invalidate_query
 from ..router import ApiError

@@ -7,7 +7,7 @@ from ...locations import (create_location, delete_location,
                           light_scan_location, scan_location)
 from ...locations.rules import (IndexerRuleSpec, rules_for_location,
                                 seed_rules)
-from ...models import IndexerRule, IndexerRulesInLocation, Location, utc_now
+from ...models import IndexerRule, IndexerRulesInLocation, Location
 from ..invalidate import invalidate_query
 from ..router import ApiError
 from ._util import filtered_subscription

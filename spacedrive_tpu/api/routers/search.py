@@ -13,6 +13,7 @@ from typing import Any
 
 from ...locations.non_indexed import walk_ephemeral
 from ...models import FilePath, Object
+from ..router import ApiError
 
 _PATH_ORDERS = {"name", "size_in_bytes", "date_created", "date_modified"}
 

@@ -17,7 +17,6 @@ headless operations a desktop shell would expose):
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 
 

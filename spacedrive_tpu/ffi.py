@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import json
 import threading
-from typing import Any
 
 _node = None
 _events = None

@@ -25,10 +25,10 @@ import hashlib
 import json
 import logging
 import time
-from typing import TYPE_CHECKING, Any, Callable, ClassVar
+from typing import TYPE_CHECKING, Any, ClassVar
 
-from .error import EarlyFinish, JobCanceled, JobError, JobPaused
-from .report import JobReport, JobStatus
+from .error import EarlyFinish, JobError
+from .report import JobReport
 
 if TYPE_CHECKING:
     from .worker import WorkerContext

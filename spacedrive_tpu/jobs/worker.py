@@ -12,12 +12,12 @@ import logging
 import queue
 import threading
 import time
-from typing import TYPE_CHECKING, Any
+from typing import TYPE_CHECKING
 
 from ..models import utc_now
 from .error import JobCanceled, JobPaused
 from .job import DynJob
-from .report import JobReport, JobStatus
+from .report import JobStatus
 
 if TYPE_CHECKING:
     from ..library import Library

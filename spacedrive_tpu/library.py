@@ -10,7 +10,6 @@ and networked-library machinery subscribe to.
 
 from __future__ import annotations
 
-import datetime as dt
 import logging
 import threading
 import uuid

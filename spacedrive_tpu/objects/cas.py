@@ -23,7 +23,6 @@ lives in ops/blake3_jax.py behind the same sample layout.
 
 from __future__ import annotations
 
-import os
 import struct
 from pathlib import Path
 from typing import BinaryIO

@@ -15,7 +15,6 @@ node.key_manager; stored-key bytes act as the keyslot password.
 from __future__ import annotations
 
 import logging
-import os
 from pathlib import Path
 from typing import Any
 

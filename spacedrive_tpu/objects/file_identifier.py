@@ -25,7 +25,6 @@ from ..jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
 from ..models import FilePath, Location, Object, utc_now
 from ..sync.crdt import ref
 from .hasher import get_hasher
-from .kind import kind_from_extension  # noqa: F401 (re-exported for callers)
 
 
 def ref_obj(pub_id: str):

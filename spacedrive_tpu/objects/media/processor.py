@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Any
 
 from ...jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
 from ...models import FilePath, Location, MediaData

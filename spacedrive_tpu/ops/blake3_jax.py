@@ -27,7 +27,6 @@ Multi-device: shard the batch axis with ``jax.sharding``; see parallel/mesh.py.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

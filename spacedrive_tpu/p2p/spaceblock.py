@@ -14,7 +14,8 @@ import os
 from pathlib import Path
 from typing import Callable
 
-from .proto import (ProtocolError, Range, SpaceblockRequest, block_msg,
+from .proto import Range  # re-exported: transfer call sites range-slice  # lint: ok
+from .proto import (ProtocolError, SpaceblockRequest, block_msg,
                     cancel_msg, read_block_msg)
 
 logger = logging.getLogger(__name__)

@@ -15,7 +15,6 @@ import base64
 import hashlib
 import io
 import logging
-import os
 import struct
 import urllib.parse
 from dataclasses import dataclass, field

@@ -149,7 +149,13 @@ async function loadLibraries() {
   const sel = document.getElementById("library");
   sel.innerHTML = "";
   for (const lib of libs) sel.append(el("option", {value: lib.id}, lib.name));
-  if (libs.length) { state.library = libs[0].id; await loadLocations(); }
+  if (libs.length) {
+    // preserve the active selection across reloads (settings save must not
+    // silently switch libraries); fall back to the first library
+    if (!libs.some(l => l.id === state.library)) state.library = libs[0].id;
+    sel.value = state.library;
+    await loadLocations();
+  }
   sel.onchange = async () => {
     state.library = sel.value;
     state.location = null;  // locations are per-library
@@ -257,6 +263,11 @@ function renderWindow() {
   const first = Math.max(0, Math.floor(box.scrollTop / VGRID.rowH) - 2);
   const last = Math.min(rows,
     Math.ceil((box.scrollTop + box.clientHeight) / VGRID.rowH) + 2);
+  // scroll fires per animation frame: rebuilding identical cards would
+  // churn the DOM and re-decode thumbnails for nothing
+  const sig = `${VGRID.epoch}:${first}:${last}:${cols}:${VGRID.pages.size}`;
+  if (sig === VGRID.lastSig) return;
+  VGRID.lastSig = sig;
   VGRID.spacer.innerHTML = "";
   for (let row = first; row < last; row++) {
     for (let col = 0; col < cols; col++) {
@@ -574,8 +585,8 @@ document.querySelector('[data-view="settings"]').onclick = async () => {
     const rules = await rspc("locations.indexer_rules.list");
     for (const r of rules) {
       const tr = el("tr");
-      const ruleset = typeof r.rules === "string" ? JSON.parse(r.rules)
-                                                  : (r.rules ?? {});
+      const raw = r.rules_per_kind ?? r.rules;  // raw IndexerRule rows
+      const ruleset = typeof raw === "string" ? JSON.parse(raw) : (raw ?? {});
       const desc = Object.entries(ruleset).map(([k, v]) =>
         `${KINDS[k] ?? k}: ${(v ?? []).join(", ")}`).join(" · ");
       tr.append(el("td", {}, r.name), el("td", {}, desc),

@@ -19,7 +19,7 @@ import logging
 import threading
 from typing import TYPE_CHECKING, Any, Callable
 
-from ..models import Instance, RelationOperationRow, SharedOperationRow
+from ..models import Instance
 from .crdt import (CREATE, DELETE, UPDATE_PREFIX, CRDTOperation, RelationOp,
                    SharedOp, new_op)
 from .hlc import HLC
