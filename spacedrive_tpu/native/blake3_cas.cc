@@ -554,6 +554,20 @@ void full_chunk_cvs(const uint8_t* data, size_t n, uint64_t counter, CV* out) {
           out[i].data());
 }
 
+// Precomputed full-chunk CVs (+ optional partial trailing chunk) -> the
+// UNFINALIZED root node; shared by the per-message path and the
+// cross-message batch hasher.
+Node reduce_cvs(CV* cvs, size_t n_full, const uint8_t* tail, size_t tail_len,
+                uint64_t tail_counter) {
+  size_t count = n_full;
+  if (tail_len) {
+    chain(chunk_node(tail, tail_len, tail_counter), cvs[n_full].data());
+    count++;
+  }
+  while (count > 2) count = reduce_level(cvs, count);
+  return parent_node(cvs[0].data(), cvs[1].data());
+}
+
 // A range of <= WINDOW_CHUNKS chunks (full chunks + an optionally partial
 // trailing one) -> the UNFINALIZED root node of its subtree. Full chunks —
 // including a full-sized final chunk — all ride the SIMD lanes; only a
@@ -566,14 +580,8 @@ Node reduce_range(const uint8_t* data, size_t len, uint64_t counter) {
   CvBuf cb;
   CV* cvs = cb.data();
   full_chunk_cvs(data, n_full, counter, cvs);
-  size_t count = n_full;
-  if (rem) {
-    chain(chunk_node(data + n_full * CHUNK_LEN, rem, counter + n_full),
-          cvs[n_full].data());
-    count++;
-  }
-  while (count > 2) count = reduce_level(cvs, count);
-  return parent_node(cvs[0].data(), cvs[1].data());
+  return reduce_cvs(cvs, n_full, data + n_full * CHUNK_LEN, rem,
+                    counter + n_full);
 }
 
 // WINDOW_CHUNKS full chunks -> the chained CV of that complete subtree.
@@ -647,8 +655,7 @@ Node tree(const uint8_t* data, size_t len, uint64_t counter) {
   return ms.finish(tail);
 }
 
-void blake3_digest(const uint8_t* data, size_t len, uint8_t out[32]) {
-  Node root = tree(data, len, 0);
+void finalize_root(const Node& root, uint8_t out[32]) {
   uint32_t words[8];
   compress(root.cv, root.block, 0, root.block_len, root.flags | ROOT, words);
   for (int i = 0; i < 8; i++) {
@@ -657,6 +664,74 @@ void blake3_digest(const uint8_t* data, size_t len, uint8_t out[32]) {
     out[4 * i + 2] = static_cast<uint8_t>(words[i] >> 16);
     out[4 * i + 3] = static_cast<uint8_t>(words[i] >> 24);
   }
+}
+
+void blake3_digest(const uint8_t* data, size_t len, uint8_t out[32]) {
+  finalize_root(tree(data, len, 0), out);
+}
+
+// Hash up to 16 INDEPENDENT messages per SIMD pass, lane = message: the
+// chunk phase iterates the chunk index across lanes (retired lanes park on
+// the zero page), so occupancy stays full regardless of per-message chunk
+// counts — the per-message path wastes lanes on every remainder group
+// (e.g. a 57-chunk cas message runs 3 full passes + one 8/16 pass).
+// Callers get the best batch occupancy by pre-sorting messages by length.
+// Messages outside the windowed range (or non-AVX-512 hosts) fall back to
+// the single-message tree.
+void blake3_digest_batch(const uint8_t* const* msgs, const size_t* lens,
+                         int32_t n, uint8_t (*out)[32]) {
+#if defined(__x86_64__)
+  if (!have_avx512()) {
+#endif
+    for (int32_t i = 0; i < n; i++) blake3_digest(msgs[i], lens[i], out[i]);
+    return;
+#if defined(__x86_64__)
+  }
+  std::vector<CvBuf> bufs(16);
+  int32_t i = 0;
+  while (i < n) {
+    int lanes = 0;
+    int32_t idx[16];
+    while (i < n && lanes < 16) {
+      size_t n_chunks = (lens[i] + CHUNK_LEN - 1) / CHUNK_LEN;
+      if (lens[i] <= CHUNK_LEN || n_chunks > WINDOW_CHUNKS) {
+        blake3_digest(msgs[i], lens[i], out[i]);
+        i++;
+        continue;
+      }
+      idx[lanes++] = i++;
+    }
+    if (lanes == 0) continue;
+    size_t full[16];
+    size_t max_full = 0;
+    for (int l = 0; l < lanes; l++) {
+      full[l] = lens[idx[l]] / CHUNK_LEN;
+      max_full = std::max(max_full, full[l]);
+    }
+    const uint8_t* ptrs[16];
+    uint64_t counters[16];
+    uint32_t cvs16[16][8];
+    for (size_t c = 0; c < max_full; c++) {
+      for (int l = 0; l < 16; l++) {
+        bool active = l < lanes && c < full[l];
+        ptrs[l] = active ? msgs[idx[l]] + c * CHUNK_LEN : ZERO_CHUNK;
+        counters[l] = active ? c : 0;
+      }
+      hash16_full_chunks(ptrs, counters, cvs16, 16);
+      for (int l = 0; l < lanes; l++)
+        if (c < full[l])
+          std::memcpy(bufs[l].data()[c].data(), cvs16[l], 32);
+    }
+    for (int l = 0; l < lanes; l++) {
+      const uint8_t* msg = msgs[idx[l]];
+      size_t len = lens[idx[l]];
+      size_t rem = len % CHUNK_LEN;
+      finalize_root(reduce_cvs(bufs[l].data(), full[l],
+                               msg + full[l] * CHUNK_LEN, rem, full[l]),
+                    out[idx[l]]);
+    }
+  }
+#endif
 }
 
 // ---- cas sampling (reference consts cas.rs:10-15) ----
@@ -1174,22 +1249,49 @@ void sd_cas_hash_batch(const char* const* paths, const uint64_t* sizes,
         uring_ok = uring_gather_ring(ring, paths + g0, sizes + g0, gn,
                                      rows.data(), stride, lens.data());
         if (!uring_ok) break;
-        auto hash_row = [&](int32_t j) {
-          char* row_out = out + static_cast<size_t>(g0 + j) * 17;
-          if (lens[j] == 0) {
-            row_out[0] = '\0';
-            return;
-          }
-          uint8_t digest[32];
-          blake3_digest(rows.data() + static_cast<int64_t>(j) * stride,
-                        static_cast<size_t>(lens[j]), digest);
+        // cross-message SIMD: sort the group's messages by length (uniform
+        // lane groups), hash 16 per pass, then write the cas hex rows
+        std::vector<int32_t> order;
+        order.reserve(gn);
+        for (int32_t j = 0; j < gn; j++) {
+          if (lens[j] == 0)
+            out[static_cast<size_t>(g0 + j) * 17] = '\0';
+          else
+            order.push_back(j);
+        }
+        std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+          return lens[a] > lens[b];
+        });
+        std::vector<const uint8_t*> mptr(order.size());
+        std::vector<size_t> mlen(order.size());
+        for (size_t k = 0; k < order.size(); k++) {
+          mptr[k] = rows.data() + static_cast<int64_t>(order[k]) * stride;
+          mlen[k] = static_cast<size_t>(lens[order[k]]);
+        }
+        std::vector<std::array<uint8_t, 32>> digests(order.size());
+        // one slice per 16-message lane group: for_each_parallel's atomic
+        // counter then load-balances the (descending-sorted, so skewed)
+        // groups dynamically across hash_threads workers
+        int32_t slices = std::max<int32_t>(
+            1, static_cast<int32_t>(order.size() + 15) / 16);
+        int32_t per = static_cast<int32_t>((order.size() + slices - 1) / slices);
+        for_each_parallel(slices, hash_threads, [&](int32_t s) {
+          int32_t a = s * per;
+          int32_t b = std::min<int32_t>(a + per,
+                                        static_cast<int32_t>(order.size()));
+          if (a < b)
+            blake3_digest_batch(
+                mptr.data() + a, mlen.data() + a, b - a,
+                reinterpret_cast<uint8_t(*)[32]>(digests[a].data()));
+        });
+        for (size_t k = 0; k < order.size(); k++) {
+          char* row_out = out + static_cast<size_t>(g0 + order[k]) * 17;
           for (int b = 0; b < 8; b++) {
-            row_out[2 * b] = HEX[digest[b] >> 4];
-            row_out[2 * b + 1] = HEX[digest[b] & 0xF];
+            row_out[2 * b] = HEX[digests[k][b] >> 4];
+            row_out[2 * b + 1] = HEX[digests[k][b] & 0xF];
           }
           row_out[16] = '\0';
-        };
-        for_each_parallel(gn, hash_threads, hash_row);
+        }
       }
       if (uring_ok) return;
     }
