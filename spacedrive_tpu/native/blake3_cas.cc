@@ -1269,12 +1269,14 @@ void sd_cas_hash_batch(const char* const* paths, const uint64_t* sizes,
           mlen[k] = static_cast<size_t>(lens[order[k]]);
         }
         std::vector<std::array<uint8_t, 32>> digests(order.size());
-        // one slice per 16-message lane group: for_each_parallel's atomic
-        // counter then load-balances the (descending-sorted, so skewed)
-        // groups dynamically across hash_threads workers
+        // one slice per 16-message lane group, ALIGNED at 16: slices must
+        // not straddle the descending length sort or a lane group mixes
+        // long and short messages and pads the short lanes to the longest
+        // (wasted SIMD passes); for_each_parallel's atomic counter
+        // load-balances the skewed groups dynamically
+        const int32_t per = 16;
         int32_t slices = std::max<int32_t>(
-            1, static_cast<int32_t>(order.size() + 15) / 16);
-        int32_t per = static_cast<int32_t>((order.size() + slices - 1) / slices);
+            1, static_cast<int32_t>(order.size() + per - 1) / per);
         for_each_parallel(slices, hash_threads, [&](int32_t s) {
           int32_t a = s * per;
           int32_t b = std::min<int32_t>(a + per,

@@ -147,25 +147,40 @@ class FileIdentifierJob(StatefulJob):
                 else:
                     need_object.setdefault(cas, []).append(row)
 
-            # 3. create one object per unique new cas_id (+ one per empty file)
-            created = 0
-            for cas, members in need_object.items():
-                oid, opub = self._create_object(ctx, members[0], emit, ops,
-                                                data["location_path"])
-                created += 1
-                for row in members:
-                    link_rows.append((oid, row["id"]))
+            # 3. create one object per unique new cas_id (+ one per empty
+            # file) — one executemany then one pub_id->id readback instead
+            # of a round-trip per object (this loop also runs for every
+            # file in the location)
+            creations: list[tuple[dict, list[dict]]] = \
+                [(members[0], members) for members in need_object.values()] \
+                + [(row, [row]) for row in empty]
+            created = len(creations)
+            if creations:
+                obj_rows = [self._object_row(rep, data["location_path"])
+                            for rep, _members in creations]
+                db.insert_many(Object, obj_rows)
+                oid_of: dict[str, int] = {}
+                for start in range(0, len(obj_rows), 500):
+                    chunk = obj_rows[start : start + 500]
+                    marks = ",".join("?" * len(chunk))
+                    for r in db.query(
+                            f"SELECT id, pub_id FROM object "
+                            f"WHERE pub_id IN ({marks})",
+                            [c["pub_id"] for c in chunk]):
+                        oid_of[r["pub_id"]] = r["id"]
+                for obj, (_rep, members) in zip(obj_rows, creations):
+                    oid, opub = oid_of[obj["pub_id"]], obj["pub_id"]
                     if emit:
-                        ops.append(sync.shared_update(
-                            FilePath, row["pub_id"], "object_id", ref_obj(opub)))
-            for row in empty:
-                oid, opub = self._create_object(ctx, row, emit, ops,
-                                                data["location_path"])
-                created += 1
-                link_rows.append((oid, row["id"]))
-                if emit:
-                    ops.append(sync.shared_update(
-                        FilePath, row["pub_id"], "object_id", ref_obj(opub)))
+                        ops.append(sync.shared_create(Object, opub, {
+                            "kind": obj["kind"],
+                            "date_created": utc_now().isoformat(),
+                        }))
+                    for row in members:
+                        link_rows.append((oid, row["id"]))
+                        if emit:
+                            ops.append(sync.shared_update(
+                                FilePath, row["pub_id"], "object_id",
+                                ref_obj(opub)))
             db.executemany("UPDATE file_path SET object_id = ? WHERE id = ?",
                            link_rows)
             if emit and ops:
@@ -180,31 +195,17 @@ class FileIdentifierJob(StatefulJob):
                                     "hash_time": hash_time},
                           errors=errors)
 
-    def _create_object(self, ctx: WorkerContext, row: dict, emit: bool,
-                       ops: list | None = None,
-                       location_path: str | None = None) -> int:
+    def _object_row(self, row: dict, location_path: str | None) -> dict:
         from .magic import resolve_kind
 
-        db = ctx.library.db
-        pub_id = str(uuid.uuid4())
         # magic-byte disambiguation for conflicting/unknown extensions
         # (file_identifier/mod.rs:75 → magic.rs)
         kind = resolve_kind(
             row.get("extension"),
             _abs_path(location_path, row) if location_path else None,
             bool(row.get("is_dir")))
-        oid = db.insert(Object, {
-            "pub_id": pub_id,
-            "kind": kind,
-            "date_created": row.get("date_created") or utc_now(),
-        })
-        sync = getattr(ctx.library, "sync", None)
-        if emit and sync is not None and ops is not None:
-            ops.append(sync.shared_create(Object, pub_id, {
-                "kind": kind,
-                "date_created": utc_now().isoformat(),
-            }))
-        return oid, pub_id
+        return {"pub_id": str(uuid.uuid4()), "kind": kind,
+                "date_created": row.get("date_created") or utc_now()}
 
     def finalize(self, ctx: WorkerContext, data: dict, run_metadata: dict):
         ctx.library.emit("invalidate_query", {"key": "search.paths"})
