@@ -1140,6 +1140,25 @@ void sd_blake3_hex(const uint8_t* data, uint64_t len, char out65[65]) {
   out65[64] = '\0';
 }
 
+// Batch full BLAKE3 over independent in-memory messages (the H_HASH
+// service's no-accelerator path): cross-message SIMD lane filling via
+// blake3_digest_batch. out = n rows of 65 (64 hex + NUL).
+void sd_blake3_hex_batch(const uint8_t* const* msgs, const uint64_t* lens,
+                         int32_t n, char* out) {
+  std::vector<size_t> sl(lens, lens + n);
+  std::vector<std::array<uint8_t, 32>> digests(std::max(n, 1));
+  blake3_digest_batch(msgs, sl.data(), n,
+                      reinterpret_cast<uint8_t(*)[32]>(digests[0].data()));
+  for (int32_t i = 0; i < n; i++) {
+    char* row = out + static_cast<size_t>(i) * 65;
+    for (int b = 0; b < 32; b++) {
+      row[2 * b] = HEX[digests[i][b] >> 4];
+      row[2 * b + 1] = HEX[digests[i][b] & 0xF];
+    }
+    row[64] = '\0';
+  }
+}
+
 // Full-file BLAKE3 (the validator's integrity_checksum — distinct from the
 // sampled cas_id, reference core/src/object/validation/hash.rs:24). mmap'd so
 // multi-GB files hash without buffering. Returns 0 on success.

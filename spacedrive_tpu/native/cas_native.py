@@ -75,6 +75,37 @@ def gather_batch(paths: list[str | Path], sizes: list[int], out, lengths,
     )
 
 
+_lib.sd_blake3_hex_batch.argtypes = [
+    ctypes.POINTER(ctypes.c_char_p),
+    ctypes.POINTER(ctypes.c_uint64),
+    ctypes.c_int32,
+    ctypes.c_char_p,
+]
+_lib.sd_blake3_hex_batch.restype = None
+
+
+def blake3_hex_batch(messages: list[bytes]) -> list[str]:
+    """Full 64-hex BLAKE3 digests for independent messages, hashed with
+    cross-message SIMD lane filling (the fast no-accelerator path of the
+    shared-hasher service)."""
+    n = len(messages)
+    if n == 0:
+        return []
+    # length-sorted lane groups: a skewed 16-lane group pads its short
+    # lanes to the longest message's chunk count (wasted SIMD passes)
+    order = sorted(range(n), key=lambda i: len(messages[i]), reverse=True)
+    bufs = (ctypes.c_char_p * n)(*[messages[i] for i in order])
+    lens = (ctypes.c_uint64 * n)(*[len(messages[i]) for i in order])
+    out = ctypes.create_string_buffer(n * 65)
+    _lib.sd_blake3_hex_batch(
+        ctypes.cast(bufs, ctypes.POINTER(ctypes.c_char_p)), lens, n, out)
+    raw = out.raw
+    result = [""] * n
+    for k, i in enumerate(order):
+        result[i] = raw[k * 65 : k * 65 + 64].decode()
+    return result
+
+
 def blake3_file_hex(path: str | Path) -> str:
     """Full-file BLAKE3 via mmap (validator integrity checksums)."""
     out = ctypes.create_string_buffer(65)

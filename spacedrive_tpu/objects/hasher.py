@@ -447,7 +447,7 @@ def hash_messages(messages: list[bytes]) -> list[str]:
     try:
         from ..native import cas_native
 
-        return [cas_native.blake3_hex(m)[:16] for m in messages]
+        return [h[:16] for h in cas_native.blake3_hex_batch(messages)]
     except Exception:
         from .blake3_ref import blake3
 
