@@ -76,8 +76,14 @@ class FileIdentifierJob(StatefulJob):
                      step_number: int) -> StepResult:
         db = ctx.library.db
         where, params = _orphan_where(data["location_id"], data.get("sub_path"))
-        rows = [FilePath.decode_row(r) for r in db.query(
-            f"SELECT * FROM file_path WHERE {where} AND id > ? ORDER BY id LIMIT ?",
+        # only the columns this step consumes, undecoded: size_in_bytes and
+        # is_dir are ints, date_created stays an ISO string (Model.encode
+        # passes strings through on re-insert) — a SELECT * + full
+        # decode_row costs ~15% of the whole identify pass at 100k files
+        rows = [dict(r) for r in db.query(
+            f"SELECT id, pub_id, name, extension, materialized_path, is_dir, "
+            f"size_in_bytes, date_created FROM file_path "
+            f"WHERE {where} AND id > ? ORDER BY id LIMIT ?",
             params + [data["cursor"], BATCH_SIZE],
         )]
         if not rows:

@@ -99,30 +99,39 @@ CONFLICTING_EXTENSIONS = {
 # keep their MAGIC_SIGNATURES index so overlapping candidates (e.g. an
 # offset-257 tar signature vs an offset-0 one) are still tried in the
 # original priority order.
-def _build_sniff_table() -> tuple[dict[int, list], list]:
+def _build_sniff_table() -> tuple[dict[int, list], dict[int, list]]:
     by_first: dict[int, list] = {}
-    offset_only: list = []  # first part not at offset 0: always candidates
+    by_offset: dict[int, list] = {}  # first part not at offset 0
     for i, (kind, parts) in enumerate(MAGIC_SIGNATURES):
         off, sig = parts[0]
         if off == 0 and sig:
             by_first.setdefault(sig[0], []).append((i, kind, parts))
         else:
-            offset_only.append((i, kind, parts))
-    # merge the offset-only entries into every bucket at import time so the
-    # per-call lookup is a single dict get with no allocation or sort
-    merged = {b: sorted(entries + offset_only)
-              for b, entries in by_first.items()}
-    return merged, sorted(offset_only)
+            # grouped by (offset, first byte): the common miss then costs
+            # one byte compare per group instead of a candidate scan
+            by_offset.setdefault(off, []).append((i, kind, parts))
+    return ({b: sorted(v) for b, v in by_first.items()},
+            {o: sorted(v) for o, v in by_offset.items()})
 
 
-_SNIFF_BY_FIRST, _SNIFF_DEFAULT = _build_sniff_table()
+_SNIFF_BY_FIRST, _SNIFF_BY_OFFSET = _build_sniff_table()
+_EMPTY: list = []
 
 
 def sniff_kind(head: bytes) -> int | None:
-    """Header bytes → ObjectKind, or None when no signature matches."""
+    """Header bytes → ObjectKind, or None when no signature matches.
+    Priority order (MAGIC_SIGNATURES index) is preserved across the
+    offset-0 bucket and the offset groups."""
     if not head:
         return None
-    for _, kind, parts in _SNIFF_BY_FIRST.get(head[0], _SNIFF_DEFAULT):
+    candidates = _SNIFF_BY_FIRST.get(head[0], _EMPTY)
+    extra: list = []
+    for off, group in _SNIFF_BY_OFFSET.items():
+        if len(head) > off and any(head[off] == g[2][0][1][0] for g in group):
+            extra = extra + group
+    if extra:
+        candidates = sorted(candidates + extra)
+    for _, kind, parts in candidates:
         if all(head[off:off + len(sig)] == sig for off, sig in parts):
             return kind
     return None
