@@ -126,18 +126,8 @@ class Model:
     # -- value encoding -----------------------------------------------------
     @classmethod
     def encode(cls, name: str, value: Any) -> Any:
-        f = cls.FIELDS[name]
-        if value is None:
-            return None
-        if f.type == "BOOLEAN":
-            return int(bool(value))
-        if f.type == "DATETIME":
-            if isinstance(value, _dt.datetime):
-                return value.astimezone(_dt.timezone.utc).isoformat()
-            return value
-        if f.type == "JSON":
-            return json.dumps(value, sort_keys=True)
-        return value
+        e = cls.encoder(name)
+        return value if e is None else e(value)
 
     @classmethod
     def decode(cls, name: str, value: Any) -> Any:
@@ -155,6 +145,26 @@ class Model:
     @classmethod
     def decode_row(cls, row: sqlite3.Row) -> dict[str, Any]:
         return {k: cls.decode(k, row[k]) for k in row.keys()}
+
+    @classmethod
+    @functools.lru_cache(maxsize=4096)
+    def _encoder_cached(cls, name: str):
+        f = cls.FIELDS[name]
+        if f.type == "BOOLEAN":
+            return lambda v: None if v is None else int(bool(v))
+        if f.type == "DATETIME":
+            return lambda v: (v.astimezone(_dt.timezone.utc).isoformat()
+                              if isinstance(v, _dt.datetime) else v)
+        if f.type == "JSON":
+            return lambda v: None if v is None else json.dumps(v, sort_keys=True)
+        return None
+
+    @classmethod
+    def encoder(cls, name: str):
+        """Per-column encode callable (cached per model+column), or None
+        for passthrough columns — the single source of encoding truth;
+        :meth:`encode` and the bulk writers both resolve through it."""
+        return cls._encoder_cached(name)
 
 
 def utc_now() -> _dt.datetime:
@@ -288,7 +298,12 @@ class Database:
             return 0
         cols = [c for c in rows[0].keys() if c in model.FIELDS]
         sql = self._insert_sql(model, cols, or_ignore)
-        self.executemany(sql, [tuple(model.encode(c, r.get(c)) for c in cols) for r in rows])
+        # per-column encoders once per call (None = passthrough) instead of
+        # a 4-branch method dispatch per value
+        encs = [(c, model.encoder(c)) for c in cols]
+        self.executemany(sql, [
+            tuple(r.get(c) if e is None else e(r.get(c)) for c, e in encs)
+            for r in rows])
         return len(rows)
 
     def update(self, model: type[Model], where: dict[str, Any], values: dict[str, Any]) -> int:
