@@ -201,7 +201,7 @@ class Server:
         if parts[0] == "schema":
             return Response.json(self.node.router.schema())
         if parts[0] == "client" and len(parts) == 2 \
-                and parts[1] in ("core.ts", "procedures.js"):
+                and parts[1] in ("core.ts", "procedures.js", "ui.css"):
             # the GENERATED typed-client artifacts (api/codegen.py); the
             # explorer loads procedures.js and refuses unknown keys, so a
             # stale artifact fails loudly rather than silently
@@ -211,8 +211,9 @@ class Server:
             if not path.exists():
                 raise HttpError(404, "client artifacts not generated — run "
                                      "python -m spacedrive_tpu.api.codegen")
-            ctype = ("text/typescript" if parts[1].endswith(".ts")
-                     else "text/javascript")
+            ctype = {"core.ts": "text/typescript",
+                     "procedures.js": "text/javascript",
+                     "ui.css": "text/css"}[parts[1]]
             return Response(headers={"content-type": f"{ctype}; charset=utf-8"},
                             body=path.read_bytes())
         if parts[0] == "spacedrive":

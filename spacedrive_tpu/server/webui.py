@@ -15,74 +15,7 @@ INDEX_HTML = r"""<!doctype html>
 <meta charset="utf-8">
 <title>spacedrive_tpu</title>
 <meta name="viewport" content="width=device-width, initial-scale=1">
-<style>
-  :root {
-    --bg: #12121a; --panel: #1a1b26; --panel2: #20212e; --text: #c8cad4;
-    --dim: #7a7d8f; --accent: #5b8cff; --ok: #3fb97f; --warn: #e0b050;
-  }
-  * { box-sizing: border-box; }
-  body { margin: 0; background: var(--bg); color: var(--text);
-         font: 14px/1.45 system-ui, sans-serif; display: flex; height: 100vh; }
-  aside { width: 230px; background: var(--panel); padding: 14px;
-          display: flex; flex-direction: column; gap: 10px; flex-shrink: 0; }
-  main { flex: 1; padding: 16px 20px; overflow-y: auto; }
-  h1 { font-size: 15px; margin: 0 0 4px; color: #fff; }
-  h2 { font-size: 12px; text-transform: uppercase; letter-spacing: .08em;
-       color: var(--dim); margin: 12px 0 6px; }
-  select, input, button {
-    background: var(--panel2); color: var(--text); border: 1px solid #2e3040;
-    border-radius: 6px; padding: 6px 8px; font: inherit; width: 100%;
-  }
-  button { cursor: pointer; width: auto; }
-  button:hover { border-color: var(--accent); }
-  .loc { padding: 6px 8px; border-radius: 6px; cursor: pointer;
-         display: flex; justify-content: space-between; }
-  .loc:hover, .loc.active { background: var(--panel2); }
-  .crumbs { color: var(--dim); margin-bottom: 10px; }
-  .crumbs a { color: var(--accent); cursor: pointer; text-decoration: none; }
-  .grid { display: grid; grid-template-columns: repeat(auto-fill, minmax(120px, 1fr));
-          gap: 10px; }
-  .item { background: var(--panel); border-radius: 8px; padding: 8px;
-          text-align: center; cursor: pointer; overflow: hidden; }
-  .item:hover { outline: 1px solid var(--accent); }
-  .thumb { height: 80px; display: flex; align-items: center;
-           justify-content: center; font-size: 34px; }
-  .thumb img { max-width: 100%; max-height: 80px; border-radius: 4px; }
-  .name { font-size: 12px; white-space: nowrap; overflow: hidden;
-          text-overflow: ellipsis; }
-  .meta { font-size: 11px; color: var(--dim); }
-  #jobs .job { padding: 6px 8px; background: var(--panel2); border-radius: 6px;
-               margin-bottom: 6px; font-size: 12px; }
-  .bar { height: 4px; background: #2e3040; border-radius: 2px; margin-top: 4px; }
-  .bar > div { height: 100%; background: var(--accent); border-radius: 2px;
-               transition: width .3s; }
-  .pill { font-size: 10px; padding: 1px 7px; border-radius: 9px;
-          background: var(--panel2); color: var(--dim); }
-  .tiles { display: grid; grid-template-columns: repeat(auto-fill, minmax(170px, 1fr));
-           gap: 10px; margin-bottom: 16px; }
-  .tile { background: var(--panel); border-radius: 8px; padding: 12px; }
-  .tile .v { font-size: 20px; color: #fff; }
-  .tile .k { font-size: 11px; color: var(--dim); text-transform: uppercase;
-             letter-spacing: .06em; }
-  .dot { display: inline-block; width: 9px; height: 9px; border-radius: 5px;
-         margin-right: 6px; background: var(--accent); }
-  .fav { position: absolute; top: 4px; right: 6px; font-size: 13px;
-         opacity: 0; cursor: pointer; }
-  .item { position: relative; }
-  .item:hover .fav, .fav.on { opacity: 1; }
-  table { width: 100%; border-collapse: collapse; font-size: 13px; }
-  td, th { text-align: left; padding: 5px 8px; border-bottom: 1px solid #23242f; }
-  #status { font-size: 11px; color: var(--dim); margin-top: auto; }
-  #content.vgrid { display: block; position: relative; overflow-y: auto;
-                   height: calc(100vh - 78px); }
-  .vcard { position: absolute; width: 142px; box-sizing: border-box; }
-  .settings h3 { font-size: 13px; margin: 18px 0 8px; color: #fff; }
-  .settings label { display: block; font-size: 12px; color: var(--dim);
-                    margin: 8px 0 2px; }
-  .settings input, .settings textarea, .settings select {
-    width: 320px; max-width: 90%; }
-  .settings textarea { height: 70px; font-family: inherit; }
-</style>
+<link rel="stylesheet" href="/client/ui.css">
 </head>
 <body>
 <aside>
