@@ -209,8 +209,10 @@ class Server:
 
             path = client_dir() / parts[1]
             if not path.exists():
-                raise HttpError(404, "client artifacts not generated — run "
-                                     "python -m spacedrive_tpu.api.codegen")
+                hint = ("run python -m spacedrive_tpu.api.codegen"
+                        if parts[1] != "ui.css"
+                        else "restore client/ui.css from the repository")
+                raise HttpError(404, f"client artifact missing — {hint}")
             ctype = {"core.ts": "text/typescript",
                      "procedures.js": "text/javascript",
                      "ui.css": "text/css"}[parts[1]]
