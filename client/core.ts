@@ -118,9 +118,9 @@ export type Procedures = {
 	{ key: "files.encryptFiles", input: unknown, result: unknown } |
 	{ key: "files.eraseFiles", input: unknown, result: unknown } |
 	{ key: "files.removeAccessTime", input: unknown, result: unknown } |
-	{ key: "files.renameFile", input: { id: number; new_name: string }, result: null } |
-	{ key: "files.setFavorite", input: { id: number; favorite: boolean }, result: null } |
-	{ key: "files.setNote", input: { id: number; note: string | null }, result: null } |
+	{ key: "files.renameFile", input: { file_path_id: number; new_name: string }, result: null } |
+	{ key: "files.setFavorite", input: { object_id: number; favorite: boolean }, result: null } |
+	{ key: "files.setNote", input: { object_id: number; note: string | null }, result: null } |
 	{ key: "files.updateAccessTime", input: unknown, result: unknown } |
 	{ key: "jobs.cancel", input: string, result: null } |
 	{ key: "jobs.clear", input: string, result: null } |

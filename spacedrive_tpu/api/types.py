@@ -123,9 +123,9 @@ TYPES: dict[str, tuple[str, str]] = {
     # files
     "files.deleteFiles": ("{ location_id: number; file_path_ids: number[] } | "
                           "Record<string, unknown>", "string"),
-    "files.renameFile": ("{ id: number; new_name: string }", "null"),
-    "files.setFavorite": ("{ id: number; favorite: boolean }", "null"),
-    "files.setNote": ("{ id: number; note: string | null }", "null"),
+    "files.renameFile": ("{ file_path_id: number; new_name: string }", "null"),
+    "files.setFavorite": ("{ object_id: number; favorite: boolean }", "null"),
+    "files.setNote": ("{ object_id: number; note: string | null }", "null"),
     # tags
     "tags.assign": ("{ object_ids: number[]; tag_id: number; unassign?: boolean }",
                     "null"),

@@ -77,6 +77,40 @@ function el(tag, attrs = {}, text = "") {
   return n;
 }
 
+function showOnboarding() {
+  const box = document.getElementById("content");
+  box.className = ""; box.innerHTML = "";
+  document.getElementById("crumbs").textContent = "welcome";
+  const card = el("div", {className: "onboard"});
+  card.append(el("h3", {}, "Create your first library"));
+  const name = el("input", {placeholder: "library name", value: "My Library"});
+  const path = el("input", {placeholder: "absolute path to index (optional)"});
+  const go = el("button", {}, "create library");
+  const err = el("div", {className: "kv"});
+  go.onclick = async () => {
+    if (!name.value || go.disabled) return;
+    go.disabled = true;  // a double-click must not create two libraries
+    try {
+      const lib = await rspc("libraries.create", {name: name.value}, null);
+      state.library = lib.id;
+      if (path.value) {
+        try {
+          await rspc("locations.create", {path: path.value}, lib.id);
+        } catch (e) {
+          err.textContent = `library created; location failed: ${e.message}`;
+        }
+      }
+      await loadLibraries();
+    } catch (e) {
+      err.textContent = String(e.message || e);
+      go.disabled = false;
+    }
+  };
+  card.append(el("label", {}, "name"), name,
+              el("label", {}, "location"), path, go, err);
+  box.append(card);
+}
+
 async function loadLibraries() {
   const libs = await rspc("libraries.list", null, null);
   const sel = document.getElementById("library");
@@ -88,6 +122,8 @@ async function loadLibraries() {
     if (!libs.some(l => l.id === state.library)) state.library = libs[0].id;
     sel.value = state.library;
     await loadLocations();
+  } else {
+    showOnboarding();  // first run: guided library + location creation
   }
   sel.onchange = async () => {
     state.library = sel.value;
@@ -301,10 +337,86 @@ function makeCard(it) {
         state.dir = `${it.materialized_path}${it.name}/`;
         browse();
       }
-      else window.open(
-        `/spacedrive/file/${state.library}/${it.location_id}/${it.id}`, "_blank");
+      else quickPreview(it);
     };
     return card;
+}
+
+// ---- quick preview (interface/app Explorer QuickPreview role) ------------
+function closePreview() {
+  const p = document.getElementById("preview");
+  if (p) p.remove();
+  document.onkeydown = null;
+}
+
+function quickPreview(it) {
+  closePreview();
+  const fileUrl =
+    `/spacedrive/file/${state.library}/${it.location_id}/${it.id}`;
+  const full = it.name + (it.extension && !it.is_dir ? "." + it.extension : "");
+  const overlay = el("div", {id: "preview"});
+  overlay.onclick = (e) => { if (e.target === overlay) closePreview(); };
+  document.onkeydown = (e) => { if (e.key === "Escape") closePreview(); };
+  const media = el("div", {className: "media"});
+  const kind = it.object_kind ?? 0;
+  const ext = (it.extension || "").toLowerCase();
+  if (kind === 5) {                         // image: the original renders
+    const img = el("img", {src: fileUrl});
+    img.onerror = () => { media.textContent = KIND_ICONS[kind] || "📄"; };
+    media.append(img);
+  } else if (kind === 7) {                  // video plays regardless; the
+    const vid = el("video", {controls: true, src: fileUrl});  // thumb only
+    if (it.cas_id)                          // supplies the poster
+      vid.poster = `/spacedrive/thumbnail/${it.cas_id.slice(0,2)}/${it.cas_id}.webp`;
+    media.append(vid);
+  } else if (kind === 6) {                  // audio
+    media.append(el("audio", {controls: true, src: fileUrl}));
+  } else if (kind === 3 || ["txt","md","json","py","ts","js","css","html",
+                            "yml","yaml","toml","csv","log"].includes(ext)) {
+    const pre = el("pre", {}, "loading…");
+    media.append(pre);
+    // fills in asynchronously AFTER the overlay is on screen (below)
+    fetch(fileUrl, {headers: {Range: "bytes=0-16383"}}).then(async (r) => {
+      pre.textContent = r.ok ? await r.text()
+                             : `read failed (${r.status}): ${await r.text()}`;
+    }).catch((e) => { pre.textContent = `unreadable: ${e}`; });
+  } else {
+    media.append(el("div", {style: "font-size:64px"},
+                    KIND_ICONS[kind] || "📄"));
+  }
+  const side = el("div", {className: "side"});
+  side.append(el("h3", {}, full));
+  // textContent only: filenames are attacker-controlled, never innerHTML
+  const kv = (k, v) => {
+    const row = el("div", {className: "kv"});
+    row.append(el("b", {}, k), document.createTextNode(" " + (v ?? "—")));
+    side.append(row);
+  };
+  kv("size", fmtSize(it.size_in_bytes));
+  kv("kind", String(kind));
+  kv("cas_id", it.cas_id ?? "—");
+  kv("path", `${it.materialized_path ?? ""}${full}`);
+  const fav = el("button", {}, it.favorite ? "★ unfavorite" : "☆ favorite");
+  fav.onclick = async () => {
+    await rspc("files.setFavorite",
+      {object_id: it.object_id, favorite: !it.favorite});
+    it.favorite = !it.favorite;
+    fav.textContent = it.favorite ? "★ unfavorite" : "☆ favorite";
+  };
+  const note = el("textarea", {placeholder: "note…", value: it.note ?? ""});
+  const saveNote = el("button", {}, "save note");
+  saveNote.onclick = async () => {
+    await rspc("files.setNote", {object_id: it.object_id, note: note.value});
+    saveNote.textContent = "saved ✓";
+  };
+  const open = el("button", {}, "open original ↗");
+  open.onclick = () => window.open(fileUrl, "_blank");
+  if (it.object_id != null) side.append(fav, note, saveNote);
+  side.append(open);
+  const panel = el("div", {className: "panel"});
+  panel.append(media, side);
+  overlay.append(panel);
+  document.body.append(overlay);
 }
 
 function fmtSize(n) {

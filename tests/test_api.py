@@ -302,5 +302,7 @@ def test_webui_virtual_grid_and_settings_markup():
     for marker in ("VGRID", "search.pathsCount", "skip: p * VGRID.page",
                    "renderWindow", 'data-view="settings"',
                    "libraries.edit", "locations.indexer_rules.create",
-                   "locations.indexer_rules.delete"):
+                   "locations.indexer_rules.delete",
+                   # quick preview + first-run onboarding (the r03 gaps)
+                   "quickPreview", "files.setNote", "showOnboarding"):
         assert marker in html, marker
