@@ -77,28 +77,41 @@ function el(tag, attrs = {}, text = "") {
   return n;
 }
 
-function showOnboarding() {
+function showOnboarding(locationOnly = false, note = "") {
+  // locationOnly: the library exists but has no locations yet (a failed or
+  // skipped first location must not dead-end the flow — this card is the
+  // only locations.create surface)
   const box = document.getElementById("content");
   box.className = ""; box.innerHTML = "";
   document.getElementById("crumbs").textContent = "welcome";
   const card = el("div", {className: "onboard"});
-  card.append(el("h3", {}, "Create your first library"));
+  card.append(el("h3", {}, locationOnly ? "Add a location"
+                                        : "Create your first library"));
   const name = el("input", {placeholder: "library name", value: "My Library"});
-  const path = el("input", {placeholder: "absolute path to index (optional)"});
-  const go = el("button", {}, "create library");
-  const err = el("div", {className: "kv"});
+  const path = el("input", {placeholder: locationOnly
+    ? "absolute path to index" : "absolute path to index (optional)"});
+  const go = el("button", {}, locationOnly ? "add location" : "create library");
+  const err = el("div", {className: "kv"}, note);
   go.onclick = async () => {
-    if (!name.value || go.disabled) return;
+    if (go.disabled || (!locationOnly && !name.value)) return;
     go.disabled = true;  // a double-click must not create two libraries
+    let locErr = "";
     try {
-      const lib = await rspc("libraries.create", {name: name.value}, null);
-      state.library = lib.id;
+      if (!locationOnly) {
+        const lib = await rspc("libraries.create", {name: name.value}, null);
+        state.library = lib.id;
+      }
       if (path.value) {
         try {
-          await rspc("locations.create", {path: path.value}, lib.id);
+          await rspc("locations.create", {path: path.value});
         } catch (e) {
-          err.textContent = `library created; location failed: ${e.message}`;
+          locErr = `location failed: ${e.message}`;
         }
+      }
+      const locs = await rspc("locations.list");
+      if (!locs.length) {
+        showOnboarding(true, locErr || "now add a location to index");
+        return;
       }
       await loadLibraries();
     } catch (e) {
@@ -106,8 +119,8 @@ function showOnboarding() {
       go.disabled = false;
     }
   };
-  card.append(el("label", {}, "name"), name,
-              el("label", {}, "location"), path, go, err);
+  if (!locationOnly) card.append(el("label", {}, "name"), name);
+  card.append(el("label", {}, "location"), path, go, err);
   box.append(card);
 }
 

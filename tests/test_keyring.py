@@ -95,3 +95,36 @@ def test_default_store_picks_a_backend(tmp_path):
     store.set("probe", b"v")
     assert store.get("probe") == b"v"
     store.delete("probe")
+
+
+def test_auto_unlock_api_round_trip(tmp_path):
+    """keys.enableAutoUnlock / disableAutoUnlock over the router, and the
+    node-boot auto-unlock path (crates/crypto keys/keyring role end-to-end)."""
+    from spacedrive_tpu.node import Node
+
+    data = tmp_path / "data"
+    node = Node(data, probe_accelerator=False, watch_locations=False)
+    try:
+        r = lambda k, a=None: node.router.resolve(k, a)
+        r("keys.setup", "master-pw")
+        kid = r("keys.add", {"name": "k1"})
+        backend = r("keys.enableAutoUnlock")
+        assert backend in ("kernel-keyring", "file")
+    finally:
+        node.shutdown()
+
+    # fresh process-equivalent: a new Node over the same data dir unlocks
+    # from the keyring without the master password
+    node2 = Node(data, probe_accelerator=False, watch_locations=False)
+    try:
+        assert node2.key_manager.is_unlocked
+        assert node2.router.resolve("keys.list")[0]["uuid"] == kid
+        node2.router.resolve("keys.disableAutoUnlock")
+    finally:
+        node2.shutdown()
+
+    node3 = Node(data, probe_accelerator=False, watch_locations=False)
+    try:
+        assert not node3.key_manager.is_unlocked
+    finally:
+        node3.shutdown()

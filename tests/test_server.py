@@ -328,9 +328,11 @@ def test_jobs_progress_over_websocket(server_proc):
                                       "arg": {"location_id": loc_id}}}})
         got_progress = False
         got_mutation_reply = False
-        deadline = time.monotonic() + 60
+        # generous: the 1-core host runs this suite beside other workloads,
+        # and a rescan's first progress event can trail by tens of seconds
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline and not (got_progress and got_mutation_reply):
-            msg = ws.recv(timeout=30)
+            msg = ws.recv(timeout=90)
             if msg is None:
                 break
             if msg["id"] == 3 and msg["result"]["type"] == "response":
