@@ -117,7 +117,16 @@ class Node:
         self.thumbnail_remover = ThumbnailRemoverActor(self)
 
         if probe_accelerator:
-            self.config.write(accelerator=_probe_accelerator())
+            accel = _probe_accelerator()
+            self.config.write(accelerator=accel)
+            if accel.get("devices"):  # backend init succeeded (any kind)
+                # the probe initialized the backend successfully: seed the
+                # in-process jax guard so jobs skip their own probe. A
+                # TIMED-OUT probe does NOT seed False — the guard's longer
+                # deadline gets its own chance before pinning CPU.
+                from .utils.jax_guard import seed
+
+                seed(True)
 
         # ordering-critical start sequence (lib.rs:126-130)
         from .jobs import register_builtin_jobs

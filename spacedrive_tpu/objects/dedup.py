@@ -100,6 +100,10 @@ def find_near_duplicates(library: "Library", location_id: int | None = None,
     ``method``: ``all_pairs`` (device O(N²K) sweep), ``banded`` (LSH
     candidate buckets + exact verify, corpus-scale), or ``auto`` (all-pairs
     up to ALL_PAIRS_LIMIT rows, banded beyond)."""
+    from ..utils.jax_guard import ensure_jax_safe
+
+    ensure_jax_safe()  # a wedged device tunnel must degrade to CPU, not
+    # park the single job worker (and every queued scan) forever
     from ..ops.minhash import K
 
     db = library.db

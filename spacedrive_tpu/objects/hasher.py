@@ -412,6 +412,13 @@ def get_hasher(name: str | None, node=None) -> HasherBackend:
         if name is not None:
             logger.warning("unknown hasher backend %r, falling back to default", name)
         name = "tpu" if _accelerator_available() else "cpu"
+    if name in ("tpu", "tpu-sharded", "hybrid"):
+        # explicitly configured device backends must not bypass the wedge
+        # guard: their first jnp op would otherwise init the (possibly
+        # dead) tunnel in-process and park the job worker forever
+        from ..utils.jax_guard import ensure_jax_safe
+
+        ensure_jax_safe()
     if name not in _instances:
         _instances[name] = _BACKENDS[name]()
     return _instances[name]
@@ -556,6 +563,10 @@ def _accelerator_available() -> bool:
     """True only for a real accelerator — jax.devices() is never empty (it
     falls back to CPU), so count checks are vacuous; inspect the platform."""
     try:
+        from ..utils.jax_guard import ensure_jax_safe
+
+        if not ensure_jax_safe():
+            return False  # process pinned to CPU: no accelerator
         import jax
 
         return any(d.platform not in ("cpu",) for d in jax.devices())

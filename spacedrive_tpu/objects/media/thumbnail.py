@@ -439,7 +439,9 @@ def generate_thumbnails_batched(entries, data_dir: str | Path):
     from PIL import Image
 
     from ...ops.resize_jax import resize_batch_host
+    from ...utils.jax_guard import ensure_jax_safe
 
+    ensure_jax_safe()  # wedged tunnel: run (and measure) on pinned CPU
     if _DEVICE_VERDICT["value"] is False:
         return _scalar_all(entries, data_dir)
 

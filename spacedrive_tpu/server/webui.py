@@ -98,8 +98,14 @@ function showOnboarding(locationOnly = false, note = "") {
     let locErr = "";
     try {
       if (!locationOnly) {
-        const lib = await rspc("libraries.create", {name: name.value}, null);
-        state.library = lib.id;
+        try {
+          const lib = await rspc("libraries.create", {name: name.value}, null);
+          state.library = lib.id;
+        } catch (e) {  // ONLY a failed create re-enables create mode — any
+          err.textContent = String(e.message || e);  // later failure must
+          go.disabled = false;                       // not duplicate the
+          return;                                    // library on retry
+        }
       }
       if (path.value) {
         try {
@@ -115,8 +121,7 @@ function showOnboarding(locationOnly = false, note = "") {
       }
       await loadLibraries();
     } catch (e) {
-      err.textContent = String(e.message || e);
-      go.disabled = false;
+      showOnboarding(true, `${locErr} ${e.message || e}`.trim());
     }
   };
   if (!locationOnly) card.append(el("label", {}, "name"), name);
@@ -135,6 +140,9 @@ async function loadLibraries() {
     if (!libs.some(l => l.id === state.library)) state.library = libs[0].id;
     sel.value = state.library;
     await loadLocations();
+    const locs = await rspc("locations.list");
+    if (!locs.length)  // a location-less library (e.g. onboarding's first
+      showOnboarding(true, "add a location to index");  // attempt failed)
   } else {
     showOnboarding();  // first run: guided library + location creation
   }

@@ -1,0 +1,99 @@
+"""Wedge-proof jax access: one guarded answer per process.
+
+The tunneled device platform plugin HANGS (not errors) when its relay
+dies, and it forces device backend init regardless of ``JAX_PLATFORMS`` —
+so an unguarded ``jax.devices()``/``device_put`` inside a job parks the
+single job worker forever and every queued scan behind it (observed live:
+a chained dedup_detector wedging the whole pipeline).
+
+``ensure_jax_safe()`` is the gate every production device touchpoint calls
+before its first jax use:
+
+- if this process is already pinned to the CPU platform (tests, bench
+  fallback), jax cannot wedge — return immediately;
+- otherwise probe backend init once in a deadline-bounded subprocess;
+- on probe failure/timeout, pin THIS process to the CPU backend (the
+  plugin honors a live ``jax.config`` update) so all later jax use runs
+  on CPU instead of hanging.
+
+Returns True when the device backend is usable, False when the process
+was pinned to CPU. Either way, jax is safe to call afterwards.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+logger = logging.getLogger(__name__)
+
+_STATE = {"checked": False, "device_ok": False}
+_LOCK = threading.Lock()
+
+#: device backend init on the healthy tunnel takes ~10-20s; a wedged relay
+#: never returns, so the probe needs real headroom without stalling a scan
+#: for minutes
+PROBE_TIMEOUT = float(os.environ.get("SD_JAX_PROBE_TIMEOUT", "75"))
+
+
+def seed(device_ok: bool) -> None:
+    """Record a definitive probe outcome obtained elsewhere (the node's
+    boot-time accelerator probe) so the first job doesn't re-pay the
+    subprocess probe. A False seed pins the process to CPU immediately."""
+    with _LOCK:
+        if _STATE["checked"]:
+            return
+        if not device_ok:
+            try:
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                logger.exception("could not pin jax to CPU")
+        _STATE.update(checked=True, device_ok=device_ok)
+
+
+def ensure_jax_safe(timeout: float | None = None) -> bool:
+    with _LOCK:
+        if _STATE["checked"]:
+            return _STATE["device_ok"]
+        ok = _probe(PROBE_TIMEOUT if timeout is None else timeout)
+        _STATE.update(checked=True, device_ok=ok)
+        return ok
+
+
+def _probe(timeout: float) -> bool:
+    try:
+        import jax
+
+        # already pinned to CPU (tests/bench fallback): cannot wedge
+        platforms = jax.config.jax_platforms
+        if platforms and set(str(platforms).split(",")) <= {"cpu"}:
+            return False
+    except Exception:
+        return False
+    if os.environ.get("SD_ASSUME_DEVICE_OK"):
+        return True
+
+    import subprocess
+    import sys
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout)
+        if probe.returncode == 0:
+            return True
+        reason = probe.stderr.decode(errors="replace")[-200:]
+    except subprocess.TimeoutExpired:
+        reason = f"backend init exceeded {timeout:.0f}s (relay wedged?)"
+    logger.warning("device backend unusable (%s); pinning this process to "
+                   "the CPU platform so jax cannot wedge", reason.strip())
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        logger.exception("could not pin jax to CPU; jax use may hang")
+    return False
