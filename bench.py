@@ -316,6 +316,22 @@ def bench_thumbs() -> dict:
     }
 
 
+def _peak_rss_mb() -> float:
+    """This process's own peak RSS. /proc VmHWM, not getrusage: on this
+    kernel ru_maxrss is INHERITED across fork+exec, so a subprocess bench
+    would report the parent's high-water mark."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) / 1024
+    except OSError:
+        pass
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+
 def bench_dedup_1m() -> dict:
     """BASELINE config 4 at its stated scale: the LSH-banded near-duplicate
     pass over >=1M objects. Signatures are computed by the real device
@@ -327,8 +343,6 @@ def bench_dedup_1m() -> dict:
     the all-pairs device sweep (the config's 'all-pairs psum reduction')
     at its measured rate over the same N — the quadratic cost LSH exists
     to avoid."""
-    import resource
-
     import jax
     import numpy as np
 
@@ -387,7 +401,7 @@ def bench_dedup_1m() -> dict:
     # projected all-pairs cost at the device sweep's measured rate
     dev_rate = float(os.environ.get("SD_BENCH_DEDUP_GCMPS", "15")) * 1e9
     allpairs_t = (n * (n - 1) / 2) * mh.K / dev_rate
-    peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    peak_rss_mb = _peak_rss_mb()
 
     print(f"info: dedup {n} objects: signatures {sig_t:.1f}s | "
           f"LSH pass {lsh_t:.1f}s ({n / lsh_t:,.0f} obj/s, "
@@ -459,7 +473,6 @@ def bench_scan() -> dict:
     (walk → index → identify → media) over the cached 100k-file mixed tree,
     production hybrid hasher vs the cpu backend, fresh library each run.
     Peak RSS recorded (the jobs run in this process)."""
-    import resource
     import shutil
 
     from spacedrive_tpu.locations import create_location
@@ -531,7 +544,7 @@ def bench_scan() -> dict:
     times["hybrid"] = min(times["hybrid"], one_scan("hybrid"))
     times["cpu"] = min(times["cpu"], one_scan("cpu"))
 
-    peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+    peak_rss_mb = _peak_rss_mb()
     rate = n_files / times["hybrid"]
     print(f"info: scan {n_files} files e2e: cpu {times['cpu']:.1f}s | "
           f"hybrid {times['hybrid']:.1f}s ({rate:,.0f} files/s) | "
@@ -633,6 +646,7 @@ def _guard_device_init() -> str:
 
     verdict = os.environ.get("SD_BENCH_DEVICE_VERDICT")  # parent already probed
     if verdict == "device":
+        _seed_package_guard(True)
         return verdict
     if verdict is None:
         try:
@@ -641,6 +655,7 @@ def _guard_device_init() -> str:
                 capture_output=True, timeout=150)
             if probe.returncode == 0:
                 os.environ["SD_BENCH_DEVICE_VERDICT"] = "device"
+                _seed_package_guard(True)
                 return "device"
         except subprocess.TimeoutExpired:
             pass
@@ -650,7 +665,19 @@ def _guard_device_init() -> str:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    _seed_package_guard(False)
     return "cpu-fallback(device unreachable)"
+
+
+def _seed_package_guard(device_ok: bool) -> None:
+    """Share the bench's probe verdict with the framework's own wedge
+    guard so warmups inside bench children don't re-probe."""
+    try:
+        from spacedrive_tpu.utils.jax_guard import seed
+
+        seed(device_ok)
+    except Exception:
+        pass
 
 
 def main() -> int:

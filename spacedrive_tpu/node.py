@@ -117,16 +117,11 @@ class Node:
         self.thumbnail_remover = ThumbnailRemoverActor(self)
 
         if probe_accelerator:
-            accel = _probe_accelerator()
-            self.config.write(accelerator=accel)
-            if accel.get("devices"):  # backend init succeeded (any kind)
-                # the probe initialized the backend successfully: seed the
-                # in-process jax guard so jobs skip their own probe. A
-                # TIMED-OUT probe does NOT seed False — the guard's longer
-                # deadline gets its own chance before pinning CPU.
-                from .utils.jax_guard import seed
-
-                seed(True)
+            # inventory only — deliberately NOT seeding the jax guard: the
+            # boot->first-job gap can be hours, and a relay that dies in
+            # between must be caught by the guard's own probe at first
+            # device touch (a boot-time success would make it vacuous)
+            self.config.write(accelerator=_probe_accelerator())
 
         # ordering-critical start sequence (lib.rs:126-130)
         from .jobs import register_builtin_jobs

@@ -34,6 +34,9 @@ SAMPLED_CHUNKS = (SAMPLED_MESSAGE_LEN + 1023) // 1024  # 57
 
 class HasherBackend(Protocol):
     name: str
+    #: True when hash_batch may touch the jax device backend — get_hasher
+    #: runs the wedge guard before instantiating such backends
+    USES_DEVICE: bool
 
     def hash_batch(self, paths: list[str | Path],
                    sizes: list[int]) -> list[str | Exception]: ...
@@ -76,6 +79,7 @@ class TpuHasher:
     """
 
     name = "tpu"
+    USES_DEVICE = True
 
     def hash_batch(self, paths: list[str | Path], sizes: list[int]) -> list[str | Exception]:
         from .cas import MINIMUM_FILE_SIZE
@@ -190,6 +194,7 @@ class HybridHasher:
     speedup without any config change."""
 
     name = "hybrid"
+    USES_DEVICE = True
 
     #: steal unit: small enough that the slower engine's last chunk can't
     #: dominate the makespan, large enough to amortize a device dispatch
@@ -412,10 +417,11 @@ def get_hasher(name: str | None, node=None) -> HasherBackend:
         if name is not None:
             logger.warning("unknown hasher backend %r, falling back to default", name)
         name = "tpu" if _accelerator_available() else "cpu"
-    if name in ("tpu", "tpu-sharded", "hybrid"):
-        # explicitly configured device backends must not bypass the wedge
-        # guard: their first jnp op would otherwise init the (possibly
-        # dead) tunnel in-process and park the job worker forever
+    if getattr(_BACKENDS[name], "USES_DEVICE", False):
+        # device-touching backends (incl. ones added via register_backend)
+        # must not bypass the wedge guard: their first jnp op would
+        # otherwise init the (possibly dead) tunnel in-process and park
+        # the job worker forever
         from ..utils.jax_guard import ensure_jax_safe
 
         ensure_jax_safe()

@@ -119,7 +119,7 @@ function showOnboarding(locationOnly = false, note = "") {
         showOnboarding(true, locErr || "now add a location to index");
         return;
       }
-      await loadLibraries();
+      await loadLibraries(true);
     } catch (e) {
       showOnboarding(true, `${locErr} ${e.message || e}`.trim());
     }
@@ -129,7 +129,7 @@ function showOnboarding(locationOnly = false, note = "") {
   box.append(card);
 }
 
-async function loadLibraries() {
+async function loadLibraries(allowOnboard = false) {
   const libs = await rspc("libraries.list", null, null);
   const sel = document.getElementById("library");
   sel.innerHTML = "";
@@ -139,11 +139,12 @@ async function loadLibraries() {
     // silently switch libraries); fall back to the first library
     if (!libs.some(l => l.id === state.library)) state.library = libs[0].id;
     sel.value = state.library;
-    await loadLocations();
-    const locs = await rspc("locations.list");
-    if (!locs.length)  // a location-less library (e.g. onboarding's first
-      showOnboarding(true, "add a location to index");  // attempt failed)
-  } else {
+    const locs = await loadLocations();
+    // only a NAVIGATING refresh may replace the current view with the
+    // onboarding card — passive sidebar refreshes (settings save) must not
+    if (allowOnboard && !locs.length)
+      showOnboarding(true, "add a location to index");
+  } else if (allowOnboard) {
     showOnboarding();  // first run: guided library + location creation
   }
   sel.onchange = async () => {
@@ -156,7 +157,7 @@ async function loadLibraries() {
   };
 }
 
-async function loadLocations() {
+async function loadLocations() {  // returns the list
   const locs = await rspc("locations.list");
   const box = document.getElementById("locations");
   box.innerHTML = "";
@@ -172,6 +173,7 @@ async function loadLocations() {
   }
   if (state.location === null) state.location = locs.length ? locs[0].id : null;
   browse();
+  return locs;
 }
 
 function crumbs() {
@@ -889,7 +891,7 @@ function connectWs() {
   };
 }
 
-loadLibraries().then(() => { connectWs(); loadTags(); loadAlbums(); loadPeers(); })
+loadLibraries(true).then(() => { connectWs(); loadTags(); loadAlbums(); loadPeers(); })
   .catch(e => {
   document.getElementById("status").textContent = e.message;
 });

@@ -18,6 +18,13 @@ before its first jax use:
 
 Returns True when the device backend is usable, False when the process
 was pinned to CPU. Either way, jax is safe to call afterwards.
+
+Scope: this is FIRST-TOUCH protection. Once a device backend is
+initialized in-process, a relay that dies later hangs the next device op
+regardless of any guard — that cannot be fixed at this layer without
+wrapping every op in a watchdog. The memoized verdict matches that
+reality: short-lived consumers (bench children) may seed it; long-lived
+nodes let the first device-touching job probe at its own moment.
 """
 
 from __future__ import annotations
