@@ -46,6 +46,10 @@ if TYPE_CHECKING:
 logger = logging.getLogger(__name__)
 
 
+#: deadline for reading a peer-declared H_HASH payload (tests shrink it)
+HASH_PAYLOAD_TIMEOUT = 30.0
+
+
 async def _read_all_payload(reader: asyncio.StreamReader, sizes: list[int],
                             collect: bool) -> list[bytes] | None:
     """Read every declared H_HASH payload segment; ``collect=False`` drains
@@ -763,7 +767,8 @@ class P2PManager:
                     # bounded in bytes AND time: a peer declaring a payload
                     # it never sends must not park this coroutine forever
                     await asyncio.wait_for(
-                        _drain(min(declared, 512 * 1024 * 1024)), 30)
+                        _drain(min(declared, 512 * 1024 * 1024)),
+                        HASH_PAYLOAD_TIMEOUT)
                 except (asyncio.TimeoutError, asyncio.IncompleteReadError):
                     pass
             writer.write(json_frame({"ok": False, "error": "bad batch shape"}))
@@ -781,7 +786,8 @@ class P2PManager:
             # coroutine and its substream forever.
             try:
                 await asyncio.wait_for(
-                    _read_all_payload(reader, sizes, collect=False), 30)
+                    _read_all_payload(reader, sizes, collect=False),
+                    HASH_PAYLOAD_TIMEOUT)
             except (asyncio.TimeoutError, asyncio.IncompleteReadError):
                 pass
             writer.write(json_frame({"ok": False, "error": "not a member"}))
@@ -789,7 +795,8 @@ class P2PManager:
             return
         try:
             messages = await asyncio.wait_for(
-                _read_all_payload(reader, sizes, collect=True), 30)
+                _read_all_payload(reader, sizes, collect=True),
+                HASH_PAYLOAD_TIMEOUT)
         except asyncio.TimeoutError:
             writer.write(json_frame({"ok": False,
                                      "error": "payload read timed out"}))

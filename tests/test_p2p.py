@@ -767,3 +767,41 @@ def test_remote_hasher_splits_wire_batches(two_nodes, tmp_path):
 
     ids = hasher.hash_batch(paths, sizes)
     assert ids == [generate_cas_id(p, s) for p, s in zip(paths, sizes)]
+
+
+def test_hash_serve_times_out_on_withheld_payload(two_nodes, monkeypatch):
+    """ADVICE r3: a connected peer that DECLARES payload sizes but never
+    sends the bytes must not park the H_HASH serve coroutine forever — the
+    member-accepted read path carries the same deadline as the refusal
+    drains, and the requester gets an error reply instead of silence."""
+    from spacedrive_tpu.p2p import manager as pm
+    from spacedrive_tpu.p2p.proto import Header, read_json
+
+    monkeypatch.setattr(pm, "HASH_PAYLOAD_TIMEOUT", 2.0)
+    a, b = two_nodes
+    a.config.write(accelerator={"kind": "tpu", "devices": 1, "mesh": [1]})
+    lib_a = a.libraries.create("stall-lib")
+    a.config.write(p2p_auto_accept_library=lib_a.id)
+    b.router.resolve("p2p.pair", {"peer_id": addr_of(a)})
+    wait_for(lambda: next((l for l in b.libraries.list() if l.id == lib_a.id),
+                          None), msg="library mirrored")
+
+    async def withhold():
+        reader, writer, _meta = await b.p2p.open_stream(
+            a.p2p.remote_identity.encode())
+        try:
+            # declare two messages, send only half of the first, then stall
+            writer.write(Header.hash_batch([1024, 2048]).to_bytes())
+            writer.write(b"x" * 500)
+            await writer.drain()
+            reply = await asyncio.wait_for(read_json(reader), 20)
+            return reply
+        finally:
+            writer.close()
+
+    t0 = time.monotonic()
+    reply = b.p2p.run_coro(withhold(), timeout=30)
+    elapsed = time.monotonic() - t0
+    assert reply.get("ok") is False, reply
+    assert "timed out" in reply.get("error", ""), reply
+    assert elapsed < 15, f"serve path stalled {elapsed:.1f}s"
