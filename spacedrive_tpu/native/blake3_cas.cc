@@ -632,7 +632,11 @@ struct MergeStack {
   }
 };
 
-Node tree(const uint8_t* data, size_t len, uint64_t counter) {
+// ``evict(window_index)`` runs after each completed window — the mmap'd
+// file path uses it to drop hashed pages; in-memory callers pass nothing.
+template <typename Evict>
+Node tree_windowed(const uint8_t* data, size_t len, uint64_t counter,
+                   Evict evict) {
   if (len <= CHUNK_LEN) return chunk_node(data, len, counter);
   size_t n_chunks = (len + CHUNK_LEN - 1) / CHUNK_LEN;
   if (n_chunks <= WINDOW_CHUNKS) return reduce_range(data, len, counter);
@@ -648,11 +652,16 @@ Node tree(const uint8_t* data, size_t len, uint64_t counter) {
     window_root(data + w * WINDOW_CHUNKS * CHUNK_LEN,
                 counter + w * WINDOW_CHUNKS, cv);
     ms.push_cv(cv);
+    evict(w);
   }
   size_t off = n_windows * WINDOW_CHUNKS * CHUNK_LEN;
   Node tail = reduce_range(data + off, len - off,
                            counter + n_windows * WINDOW_CHUNKS);
   return ms.finish(tail);
+}
+
+Node tree(const uint8_t* data, size_t len, uint64_t counter) {
+  return tree_windowed(data, len, counter, [](size_t) {});
 }
 
 void finalize_root(const Node& root, uint8_t out[32]) {
@@ -1172,9 +1181,20 @@ int sd_blake3_file_hex(const char* path, char out65[65]) {
     void* p = mmap(nullptr, static_cast<size_t>(size), PROT_READ, MAP_PRIVATE, fd, 0);
     if (p == MAP_FAILED) { close(fd); return 1; }
     data = static_cast<const uint8_t*>(p);
+    madvise(p, static_cast<size_t>(size), MADV_SEQUENTIAL);
   }
   uint8_t digest[32];
-  blake3_digest(data, static_cast<size_t>(size), digest);
+  size_t len = static_cast<size_t>(size);
+  // per-window eviction: the merge stack is O(log n), but neither the
+  // mapping's resident pages (madvise) nor the kernel page cache
+  // (posix_fadvise) drop on their own — a 500 GB validator pass must not
+  // carry a 500 GB RSS or churn the host's whole page cache
+  constexpr size_t WB = WINDOW_CHUNKS * CHUNK_LEN;
+  finalize_root(tree_windowed(data, len, 0, [&](size_t w) {
+    madvise(const_cast<uint8_t*>(data) + w * WB, WB, MADV_DONTNEED);
+    posix_fadvise(fd, static_cast<off_t>(w * WB),
+                  static_cast<off_t>(WB), POSIX_FADV_DONTNEED);
+  }), digest);
   if (data) munmap(const_cast<uint8_t*>(data), static_cast<size_t>(size));
   close(fd);
   for (int i = 0; i < 32; i++) {
