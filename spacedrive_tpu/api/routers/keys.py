@@ -23,11 +23,14 @@ from ..router import ApiError
 #: backupKeystore WRITES an arbitrary server-writable path, restoreKeystore
 #: merges attacker-known key material into the keystore, and
 #: enableAutoUnlock persists the root secret into the (weaker-than-argon2id)
-#: keyring store — a silent at-rest downgrade if triggered by a stranger.
+#: keyring store — a silent at-rest downgrade if triggered by a stranger —
+#: and disableAutoUnlock deletes the keyring-held root secret, a
+#: feature-tamper that silently strips auto-unlock (availability, not
+#: leakage, but still keystore security state a stranger shouldn't flip).
 #: In-process consumers (client, FFI) are unaffected.
 SECRET_PROCEDURES = frozenset({
     "keys.getKey", "keys.backupKeystore", "keys.restoreKeystore",
-    "keys.enableAutoUnlock",
+    "keys.enableAutoUnlock", "keys.disableAutoUnlock",
 })
 
 

@@ -27,6 +27,7 @@ import shutil
 import signal
 import sys
 import threading
+import time
 from pathlib import Path
 
 DEFAULT_DATA_DIR = "~/.local/share/spacedrive_tpu"
@@ -39,22 +40,43 @@ def _instance_file(data_dir: Path) -> Path:
 def _instance_alive(info: dict) -> bool:
     """A recycled pid can impersonate a dead shell, so pid liveness alone
     is not trusted: the recorded URL must also answer /health. An entry
-    still booting (url not yet recorded) counts as alive while its pid is."""
+    still booting (url not yet recorded) counts as alive while its pid is.
+
+    A live node mid-scan on a loaded single-core host can miss a short
+    health deadline, and declaring it dead would let a concurrent launch
+    unlink its claim and boot a second Node over the same data dir — the
+    exact hazard single-instancing exists to prevent. So the probe is
+    generous (10s) and retried once, and an unresponsive-but-live pid is
+    only declared dead when /proc says it isn't our shell (pid recycled)."""
     try:
-        os.kill(int(info["pid"]), 0)
+        pid = int(info["pid"])
+        os.kill(pid, 0)
     except (OSError, ValueError, KeyError, TypeError):
         return False
     url = info.get("url")
     if url is None:
         return True  # claimed, server still starting
-    try:
-        import urllib.request
+    import urllib.request
 
-        with urllib.request.urlopen(url.rstrip("/") + "/health",
-                                    timeout=2) as resp:
-            return resp.status == 200
-    except Exception:
-        return False
+    for attempt in range(2):
+        if attempt:
+            time.sleep(1.0)
+        try:
+            with urllib.request.urlopen(url.rstrip("/") + "/health",
+                                        timeout=10) as resp:
+                if resp.status == 200:
+                    return True
+        except Exception:
+            pass
+    # Unresponsive but the pid is alive. Distinguish "busy shell" from
+    # "recycled pid" via the process image; when /proc can't tell us,
+    # err on the side of alive (a blocked launch beats a split brain).
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read().decode("utf-8", "replace")
+        return ("spacedrive" in cmdline) or ("desktop" in cmdline)
+    except OSError:
+        return True
 
 
 def _instance_lock(data_dir: Path):

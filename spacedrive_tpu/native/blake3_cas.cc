@@ -1268,6 +1268,12 @@ void sd_cas_hash_batch(const char* const* paths, const uint64_t* sizes,
   // for a cache-sized group of files with io_uring, then hash the rows
   // (threaded when the host has cores to spare) — ~4 submit syscalls per
   // 128 files instead of 9 syscalls per file.
+  //
+  // done = first index the uring path did NOT complete: a mid-batch ring
+  // failure falls through to the synchronous loop for the *remaining*
+  // files only, instead of re-opening and re-hashing groups whose rows
+  // are already final.
+  int32_t done = 0;
   if (n >= 8 && !uring_disabled()) {
     Uring ring;
     if (ring.init(1024)) {
@@ -1287,7 +1293,7 @@ void sd_cas_hash_batch(const char* const* paths, const uint64_t* sizes,
         int32_t gn = std::min(group, n - g0);
         uring_ok = uring_gather_ring(ring, paths + g0, sizes + g0, gn,
                                      rows.data(), stride, lens.data());
-        if (!uring_ok) break;
+        if (!uring_ok) break;  // this group unwritten: done stays at g0
         // cross-message SIMD: sort the group's messages by length (uniform
         // lane groups), hash 16 per pass, then write the cas hex rows
         std::vector<int32_t> order;
@@ -1333,11 +1339,13 @@ void sd_cas_hash_batch(const char* const* paths, const uint64_t* sizes,
           }
           row_out[16] = '\0';
         }
+        done = g0 + gn;
       }
       if (uring_ok) return;
     }
   }
-  for_each_parallel(n, n_threads, [&](int32_t i) {
+  for_each_parallel(n - done, n_threads, [&](int32_t j) {
+      int32_t i = done + j;
       char* row = out + static_cast<size_t>(i) * 17;
       row[0] = '\0';
       int fd = open(paths[i], O_RDONLY);

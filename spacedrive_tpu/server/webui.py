@@ -200,7 +200,7 @@ function crumbs() {
 // the visible window (plus a small buffer) materializes cards.
 const VGRID = { rowH: 176, cellW: 152, page: 200, pages: new Map(),
                 pending: new Set(), total: 0, epoch: 0, filters: null,
-                spacer: null };
+                spacer: null, fetchSeq: 0 };
 
 async function browse() {
   if (state.library === null || state.location === null) return;
@@ -233,6 +233,7 @@ async function ensurePage(p) {
       {...VGRID.filters, take: VGRID.page, skip: p * VGRID.page});
     if (epoch !== VGRID.epoch) return;  // view changed mid-flight
     VGRID.pages.set(p, res.items ?? res);
+    VGRID.fetchSeq++;  // loaded-page state changed (set or evict below)
     if (VGRID.pages.size > 24) {  // bound memory: evict farthest pages
       const keep = [...VGRID.pages.keys()].sort((a, b) =>
         Math.abs(a - p) - Math.abs(b - p)).slice(0, 16);
@@ -256,8 +257,11 @@ function renderWindow() {
   const last = Math.min(rows,
     Math.ceil((box.scrollTop + box.clientHeight) / VGRID.rowH) + 2);
   // scroll fires per animation frame: rebuilding identical cards would
-  // churn the DOM and re-decode thumbnails for nothing
-  const sig = `${VGRID.epoch}:${first}:${last}:${cols}:${VGRID.pages.size}`;
+  // churn the DOM and re-decode thumbnails for nothing. fetchSeq (not
+  // pages.size) keys the loaded-page state: after eviction cycles two
+  // different page *sets* can share a size, and a size-keyed memo would
+  // skip a freshly fetched page and leave holes until the next scroll.
+  const sig = `${VGRID.epoch}:${first}:${last}:${cols}:${VGRID.fetchSeq}`;
   if (sig === VGRID.lastSig) return;
   VGRID.lastSig = sig;
   VGRID.spacer.innerHTML = "";

@@ -43,6 +43,30 @@ _LOCK = threading.Lock()
 #: for minutes
 PROBE_TIMEOUT = float(os.environ.get("SD_JAX_PROBE_TIMEOUT", "75"))
 
+#: the tunnel's loopback relay listens on these local ports; when the
+#: relay process is dead every connect is REFUSED instantly, which turns
+#: "is the device reachable at all" into a sub-second check instead of a
+#: 75s subprocess deadline (observed: the round-4 relay death mode is
+#: no-listener, not accept-and-hang)
+RELAY_PORTS = (8082, 8083, 8087, 8092)
+
+
+def relay_listening(timeout_s: float = 1.5) -> bool:
+    """True when any relay port accepts a TCP connect — the relay process
+    is alive (the far side may still be wedged; only the full backend
+    probe proves end-to-end health). False means no listener: the device
+    is certainly unreachable and the slow probe can be skipped."""
+    import socket
+
+    for port in RELAY_PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=timeout_s):
+                return True
+        except OSError:
+            continue
+    return False
+
 
 def seed(device_ok: bool) -> None:
     """Record a definitive probe outcome obtained elsewhere (the node's
@@ -82,6 +106,17 @@ def _probe(timeout: float) -> bool:
         return False
     if os.environ.get("SD_ASSUME_DEVICE_OK"):
         return True
+    if not relay_listening():
+        logger.warning("relay ports refused — device unreachable; pinning "
+                       "this process to the CPU platform (fast-path, no "
+                       "%.0fs probe paid)", timeout)
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            logger.exception("could not pin jax to CPU; jax use may hang")
+        return False
 
     import subprocess
     import sys

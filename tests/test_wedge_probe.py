@@ -1,0 +1,105 @@
+"""Relay-liveness fast path: a dead relay refuses its loopback ports
+instantly, so the guard (and the bench) must answer "device unreachable"
+in sub-second time instead of paying the 75-150s subprocess deadline.
+
+Round-5 addition per the round-4 verdict: BENCH_r04 quietly annotated
+dead-relay runs; the probe layer now distinguishes no-listener (instant)
+from accept-and-hang (bounded probe), and bench marks the record loudly.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from spacedrive_tpu.utils import jax_guard
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _refused_port() -> int:
+    """A port that nothing listens on (bind-then-close)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_relay_listening_false_on_refused_port(monkeypatch):
+    monkeypatch.setattr(jax_guard, "RELAY_PORTS", (_refused_port(),))
+    t0 = time.perf_counter()
+    assert jax_guard.relay_listening() is False
+    assert time.perf_counter() - t0 < 2.0  # refusal is instant, not a timeout
+
+
+def test_relay_listening_true_on_listener(monkeypatch):
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        monkeypatch.setattr(jax_guard, "RELAY_PORTS",
+                            (_refused_port(), srv.getsockname()[1]))
+        assert jax_guard.relay_listening() is True
+    finally:
+        srv.close()
+
+
+def test_bench_guard_emits_loud_marker_when_relay_dead():
+    """End-to-end through bench.py's guard in a subprocess: zero recovery
+    window + unreachable relay must produce the top-level device_numbers
+    marker, fast (the sync mode is the cheapest device-free mode, but the
+    guard itself is what's under test)."""
+    code = (
+        "import os, sys, json\n"
+        "sys.path.insert(0, %r)\n"
+        "os.environ['SD_BENCH_RELAY_WAIT'] = '0'\n"
+        "import spacedrive_tpu.utils.jax_guard as g\n"
+        "g.RELAY_PORTS = (1,)  # port 1: nothing listens, instant refusal\n"
+        "import bench\n"
+        "platform = bench._guard_device_init()\n"
+        "print(json.dumps({'platform': platform}))\n" % str(REPO)
+    )
+    t0 = time.perf_counter()
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=120, cwd=str(REPO))
+    assert out.returncode == 0, out.stderr[-2000:]
+    verdict = json.loads(out.stdout.strip().splitlines()[-1])
+    # port 1 refused => no subprocess probe => well under the 150s deadline
+    assert verdict["platform"].startswith("cpu-fallback")
+    assert "FAILED PRECONDITION" in out.stderr
+    assert time.perf_counter() - t0 < 60
+
+
+def test_guard_probe_skips_subprocess_when_no_listener(monkeypatch):
+    import importlib
+
+    g = importlib.reload(jax_guard)
+    monkeypatch.setattr(g, "RELAY_PORTS", (_refused_port(),))
+    monkeypatch.setenv("SD_ASSUME_DEVICE_OK", "")
+    monkeypatch.delenv("SD_ASSUME_DEVICE_OK", raising=False)
+
+    # pretend this process is NOT pinned to cpu so _probe reaches the
+    # relay check (conftest pins cpu; fake the platforms read)
+    class FakeCfg:
+        jax_platforms = "axon"
+
+        @staticmethod
+        def update(k, v):
+            FakeCfg.updated = (k, v)
+
+    import types
+
+    fake_jax = types.SimpleNamespace(config=FakeCfg)
+    monkeypatch.setitem(sys.modules, "jax", fake_jax)
+    ran = []
+    real_run = subprocess.run
+    monkeypatch.setattr(subprocess, "run",
+                        lambda *a, **k: ran.append(a) or real_run(*a, **k))
+    t0 = time.perf_counter()
+    assert g._probe(timeout=75) is False
+    assert time.perf_counter() - t0 < 5.0
+    assert ran == []  # fast path: no subprocess probe paid
+    assert FakeCfg.updated == ("jax_platforms", "cpu")
