@@ -1,0 +1,25 @@
+"""sdlint: the multi-pass static-analysis framework gating this tree.
+
+Public surface:
+- :func:`spacedrive_tpu.analysis.engine.main` — the CLI
+  (``python -m spacedrive_tpu.analysis``);
+- :class:`PassManager` / :class:`FileContext` / :class:`AnalysisPass` /
+  :class:`Finding` — the framework, for tests and new passes;
+- the baseline ratchet helpers (:func:`load_baseline`, :func:`ratchet`,
+  :func:`save_baseline`).
+
+See docs/static-analysis.md for the pass list, waiver syntax, and the
+baseline workflow.
+"""
+
+from .engine import (AnalysisPass, FileContext, Finding, PassManager,
+                     build_manager, default_baseline_path, default_root,
+                     load_baseline, main, ratchet, save_baseline)
+from .passes import REGISTRY, all_passes
+
+__all__ = [
+    "AnalysisPass", "FileContext", "Finding", "PassManager",
+    "build_manager", "default_baseline_path", "default_root",
+    "load_baseline", "main", "ratchet", "save_baseline",
+    "REGISTRY", "all_passes",
+]

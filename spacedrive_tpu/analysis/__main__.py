@@ -1,0 +1,6 @@
+"""``python -m spacedrive_tpu.analysis`` — run the ratcheted analysis."""
+
+from .engine import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,319 @@
+"""sdlint pass-manager: one parse per file, pluggable visitor passes,
+per-line waivers, and a checked-in baseline ratchet.
+
+Grown from ``utils/lint.py`` (the 135-line stdlib AST gate) into the
+rigor layer the wedge postmortems of rounds 4-5 demanded: the single
+most damaging production failure mode here is *conventional* — an
+unguarded jax touchpoint that parks the lone job worker forever — and
+conventions only hold when a test enforces them. The image ships no
+external linters, so the framework is pure stdlib ``ast``.
+
+Architecture
+------------
+- :class:`FileContext` parses each source file ONCE and hands every pass
+  the same tree plus helpers (lines, scope, lazy parent map, waivers).
+- :class:`AnalysisPass` is the plugin protocol: ``id`` + ``run(ctx)``
+  yielding :class:`Finding` rows. Passes live in ``analysis/passes/``.
+- :class:`PassManager` walks a tree, runs the registered passes, and
+  drops findings waived on their own line:
+  ``# lint: ok`` waives every pass; ``# lint: ok(pass-id, ...)`` waives
+  only the named ones.
+- The baseline ratchet (``analysis/baseline.txt``): pre-existing
+  findings are keyed by ``relpath::pass-id::message`` (no line numbers,
+  so unrelated edits don't churn the file) and allowed as a multiset;
+  anything beyond the baseline is NEW and fails the run. Fixing an old
+  finding leaves a stale entry — shrink the file with
+  ``--update-baseline`` — so the debt only ratchets down.
+
+Run: ``python -m spacedrive_tpu.analysis`` (exit 0 = no new findings).
+See docs/static-analysis.md for the pass list and workflow.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import Counter
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Iterator
+
+#: matches both the blanket waiver ``# lint: ok`` and the scoped form
+#: ``# lint: ok(pass-id, other-pass)``
+WAIVER_RE = re.compile(r"#\s*lint:\s*ok(?:\s*\(([^)]*)\))?")
+
+#: directory parts never scanned (build output, bench fixture cache)
+SKIP_PARTS = ("_build", ".bench_cache", "__pycache__")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One defect reported by one pass, pinned to a source line."""
+
+    path: str       #: path as scanned (printable, clickable)
+    relpath: str    #: posix path relative to the scan root (baseline key)
+    lineno: int
+    pass_id: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.lineno}: [{self.pass_id}] {self.message}"
+
+    @property
+    def baseline_key(self) -> str:
+        # no lineno: baselined findings must survive unrelated edits above
+        # them, or the ratchet would churn on every refactor
+        return f"{self.relpath}::{self.pass_id}::{self.message}"
+
+
+class FileContext:
+    """Everything a pass needs about one file, parsed exactly once."""
+
+    def __init__(self, path: Path, relpath: str, source: str,
+                 tree: ast.Module) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._parents: dict[ast.AST, ast.AST] | None = None
+
+    @classmethod
+    def parse(cls, path: Path, root: Path | None = None) -> "FileContext":
+        """Parse ``path``; raises SyntaxError (the manager converts it to a
+        ``syntax`` finding so one broken file can't mask the rest)."""
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+        try:
+            relpath = path.resolve().relative_to(
+                (root or path.parent).resolve()).as_posix()
+        except ValueError:
+            relpath = path.name
+        return cls(path, relpath, source, tree)
+
+    # -- scoping -------------------------------------------------------------
+    @property
+    def top_dir(self) -> str:
+        """First directory component under the scan root ('' for files at
+        the root itself) — how passes scope to production subsystems."""
+        return self.relpath.split("/")[0] if "/" in self.relpath else ""
+
+    def in_dirs(self, *dirs: str) -> bool:
+        return self.top_dir in dirs
+
+    # -- structure helpers ---------------------------------------------------
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        """Lazy parent map over the shared tree (built once, all passes)."""
+        if self._parents is None:
+            self._parents = {}
+            for outer in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(outer):
+                    self._parents[child] = outer
+        return self._parents.get(node)
+
+    def finding(self, lineno: int, pass_id: str, message: str) -> Finding:
+        return Finding(str(self.path), self.relpath, lineno, pass_id, message)
+
+    # -- waivers -------------------------------------------------------------
+    def waived(self, lineno: int, pass_id: str) -> bool:
+        if not (0 < lineno <= len(self.lines)):
+            return False
+        m = WAIVER_RE.search(self.lines[lineno - 1])
+        if m is None:
+            return False
+        scoped = m.group(1)
+        if scoped is None:
+            return True  # blanket ``# lint: ok``
+        return pass_id in {p.strip() for p in scoped.split(",") if p.strip()}
+
+
+class AnalysisPass:
+    """Plugin protocol: subclass, set ``id``, yield findings from run()."""
+
+    id: str = ""
+    description: str = ""
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.numpy.zeros' for a Name/Attribute chain, else None. The shared
+    call-classification helper every pass uses."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class PassManager:
+    """Run registered passes over a file or tree; apply waivers."""
+
+    def __init__(self, passes: Iterable[AnalysisPass], root: Path) -> None:
+        self.passes = list(passes)
+        self.root = root
+
+    def check_file(self, path: Path) -> list[Finding]:
+        try:
+            ctx = FileContext.parse(path, self.root)
+        except SyntaxError as e:
+            relpath = path.name
+            try:
+                relpath = path.resolve().relative_to(
+                    self.root.resolve()).as_posix()
+            except ValueError:
+                pass
+            return [Finding(str(path), relpath, e.lineno or 0, "syntax",
+                            f"syntax error: {e.msg}")]
+        findings: list[Finding] = []
+        for ap in self.passes:
+            for f in ap.run(ctx):
+                if not ctx.waived(f.lineno, f.pass_id):
+                    findings.append(f)
+        findings.sort(key=lambda f: (f.lineno, f.pass_id, f.message))
+        return findings
+
+    def check_tree(self) -> list[Finding]:
+        findings: list[Finding] = []
+        for path in sorted(self.root.rglob("*.py")):
+            if any(part in SKIP_PARTS for part in path.parts):
+                continue
+            findings.extend(self.check_file(path))
+        return findings
+
+
+# -- baseline ratchet ---------------------------------------------------------
+
+def load_baseline(path: Path) -> Counter:
+    """Baseline file → multiset of finding keys. Missing file = empty."""
+    counts: Counter = Counter()
+    try:
+        text = path.read_text()
+    except OSError:
+        return counts
+    for line in text.splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            counts[line] += 1
+    return counts
+
+
+def save_baseline(path: Path, findings: Iterable[Finding]) -> None:
+    keys = sorted(f.baseline_key for f in findings)
+    header = ("# sdlint baseline — pre-existing findings the ratchet "
+              "tolerates.\n"
+              "# One `relpath::pass-id::message` per line; new findings "
+              "beyond this\n"
+              "# multiset fail the run. Regenerate (only to SHRINK it) "
+              "with:\n"
+              "#   python -m spacedrive_tpu.analysis --update-baseline\n")
+    path.write_text(header + "".join(k + "\n" for k in keys))
+
+
+def ratchet(findings: list[Finding],
+            baseline: Counter) -> tuple[list[Finding], Counter]:
+    """Split findings into (new, stale-baseline-entries). A finding is NEW
+    when its key occurs more times than the baseline allows."""
+    budget = Counter(baseline)
+    new: list[Finding] = []
+    for f in findings:
+        if budget[f.baseline_key] > 0:
+            budget[f.baseline_key] -= 1
+        else:
+            new.append(f)
+    stale = +budget  # entries the tree no longer produces: shrinkable debt
+    return new, stale
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def default_root() -> Path:
+    """The spacedrive_tpu package directory (what the suite gates)."""
+    return Path(__file__).resolve().parents[1]
+
+
+def default_baseline_path() -> Path:
+    return Path(__file__).resolve().parent / "baseline.txt"
+
+
+def build_manager(root: Path,
+                  pass_ids: list[str] | None = None) -> PassManager:
+    from .passes import all_passes
+
+    passes = all_passes()
+    if pass_ids:
+        known = {p.id for p in passes}
+        unknown = [pid for pid in pass_ids if pid not in known]
+        if unknown:
+            raise SystemExit(f"unknown pass id(s): {', '.join(unknown)} "
+                             f"(known: {', '.join(sorted(known))})")
+        passes = [p for p in passes if p.id in pass_ids]
+    return PassManager(passes, root)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m spacedrive_tpu.analysis",
+        description="sdlint: multi-pass static analysis with a baseline "
+                    "ratchet (exit 0 = no findings beyond the baseline)")
+    parser.add_argument("root", nargs="?", default=None,
+                        help="tree to scan (default: the spacedrive_tpu "
+                             "package)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: analysis/baseline.txt)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding; exit 1 if any")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to the current findings "
+                             "(use only to shrink debt or adopt a new pass)")
+    parser.add_argument("--passes", default=None,
+                        help="comma-separated pass ids to run (default: all)")
+    parser.add_argument("--list-passes", action="store_true")
+    args = parser.parse_args(argv)
+
+    from .passes import all_passes
+
+    if args.list_passes:
+        for ap in all_passes():
+            print(f"{ap.id:22s} {ap.description}")
+        return 0
+
+    root = Path(args.root) if args.root else default_root()
+    baseline_path = (Path(args.baseline) if args.baseline
+                     else default_baseline_path())
+    pass_ids = ([p.strip() for p in args.passes.split(",") if p.strip()]
+                if args.passes else None)
+    manager = build_manager(root, pass_ids)
+    findings = manager.check_tree()
+
+    if args.update_baseline:
+        save_baseline(baseline_path, findings)
+        print(f"baseline rewritten: {len(findings)} finding(s) -> "
+              f"{baseline_path}")
+        return 0
+
+    if args.no_baseline:
+        for f in findings:
+            print(f.render())
+        print(f"{len(findings)} finding(s)")
+        return 1 if findings else 0
+
+    new, stale = ratchet(findings, load_baseline(baseline_path))
+    for f in new:
+        print(f.render())
+    print(f"{len(findings)} finding(s): {len(new)} new, "
+          f"{len(findings) - len(new)} baselined, "
+          f"{sum(stale.values())} stale baseline entr"
+          f"{'y' if sum(stale.values()) == 1 else 'ies'}")
+    if stale:
+        print("stale baseline entries (fixed findings — shrink with "
+              "--update-baseline):")
+        for key in sorted(stale):
+            print(f"  {key}")
+    return 1 if new else 0

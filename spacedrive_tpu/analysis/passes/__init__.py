@@ -1,0 +1,33 @@
+"""Pass registry: all built-in analysis passes in execution order.
+
+Adding a pass = write a module with an :class:`AnalysisPass` subclass,
+import it here, append to the tuple, run ``--update-baseline`` if the
+tree has pre-existing findings. See docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+from ..engine import AnalysisPass
+from .async_blocking import AsyncBlockingPass
+from .jax_wedge import JaxWedgePass
+from .legacy import BareExceptPass, DuplicateDefPass, UnusedImportPass
+from .lock_discipline import LockDisciplinePass
+from .resource_leak import ResourceLeakPass
+from .swallowed import SwallowedExceptionPass
+
+REGISTRY: tuple[type[AnalysisPass], ...] = (
+    # legacy hygiene gates (formerly utils/lint.py)
+    UnusedImportPass,
+    BareExceptPass,
+    DuplicateDefPass,
+    # the five liveness/concurrency invariants
+    JaxWedgePass,
+    AsyncBlockingPass,
+    LockDisciplinePass,
+    ResourceLeakPass,
+    SwallowedExceptionPass,
+)
+
+
+def all_passes() -> list[AnalysisPass]:
+    return [cls() for cls in REGISTRY]

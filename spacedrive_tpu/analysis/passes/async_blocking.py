@@ -1,0 +1,94 @@
+"""async-blocking: no synchronous stalls on the event loops.
+
+The api/, server/, and p2p/ subsystems run single asyncio loops; one
+blocking call inside an ``async def`` freezes every connection, pairing
+handshake, and transfer sharing that loop — the async flavor of the same
+liveness failure the jax wedge guard exists for.
+
+Flagged inside ``async def`` bodies in those subsystems:
+- ``subprocess.run/call/check_call/check_output``;
+- ``time.sleep`` (asyncio.sleep exists for a reason);
+- ``socket.create_connection`` (blocking connect+DNS);
+- any ``requests.*`` call (the whole library is synchronous);
+- ``Path.read_bytes/read_text/write_bytes/write_text``-shaped attribute
+  calls (unbounded disk IO on the loop);
+- unbounded ``.result()`` / ``.join()`` — zero-argument calls that can
+  wait forever (``await``ing a future or a bounded timeout is fine;
+  ``str.join`` always takes an argument, so it never matches).
+
+Nested *sync* ``def``s inside an async body are NOT scanned: that is the
+``run_in_executor`` idiom (p2p/manager.py's ``_lookup``), where blocking
+work is exactly what belongs there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+
+ASYNC_DIRS = ("api", "server", "p2p")
+
+BLOCKING_DOTTED = {
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "time.sleep", "socket.create_connection",
+}
+
+BLOCKING_METHODS = {"read_bytes", "read_text", "write_bytes", "write_text"}
+
+UNBOUNDED_METHODS = {"result", "join"}
+
+
+class AsyncBlockingPass(AnalysisPass):
+    id = "async-blocking"
+    description = ("blocking calls inside async def bodies in api/, "
+                   "server/, p2p/")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dirs(*ASYNC_DIRS):
+            return
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._scan_async(ctx, node, findings)
+        # ast.walk finds nested async defs too; scanning is scoped to each
+        # function's own body, so nothing double-reports
+        yield from findings
+
+    def _scan_async(self, ctx: FileContext, func: ast.AsyncFunctionDef,
+                    findings: list[Finding]) -> None:
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # sync helpers run in executors; nested async defs
+                # are scanned as their own functions by run()
+            if isinstance(node, ast.Call):
+                reason = self._blocking_reason(node)
+                if reason is not None:
+                    findings.append(ctx.finding(
+                        node.lineno, self.id,
+                        f"blocking call {reason} inside "
+                        f"'async def {func.name}' — use the asyncio "
+                        "equivalent or run_in_executor"))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in func.body:
+            visit(stmt)
+
+    def _blocking_reason(self, call: ast.Call) -> str | None:
+        d = dotted_name(call.func)
+        if d is not None:
+            if d in BLOCKING_DOTTED:
+                return f"{d}()"
+            if d.split(".")[0] == "requests":
+                return f"{d}() (requests is synchronous)"
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in BLOCKING_METHODS:
+                return f".{attr}()"
+            if (attr in UNBOUNDED_METHODS and not call.args
+                    and not call.keywords):
+                return f"unbounded .{attr}()"
+        return None

@@ -1,0 +1,112 @@
+"""The original ``utils/lint.py`` defect classes as engine passes.
+
+Message text is kept byte-identical to the old linter so the
+``python -m spacedrive_tpu.utils.lint`` shim (and its tests) see the
+same output through the new engine.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding
+
+_IDENT = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def _used_names(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+
+    def add_annotation_strings(node: ast.AST | None) -> None:
+        # quoted annotations ("Library") reference names the AST only sees
+        # as string constants — count their identifiers as used
+        for sub in ast.walk(node) if node is not None else ():
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                used.update(_IDENT.findall(sub.value))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            add_annotation_strings(node.returns)
+            for arg in (node.args.args + node.args.posonlyargs
+                        + node.args.kwonlyargs
+                        + ([node.args.vararg] if node.args.vararg else [])
+                        + ([node.args.kwarg] if node.args.kwarg else [])):
+                add_annotation_strings(arg.annotation)
+        elif isinstance(node, ast.AnnAssign):
+            add_annotation_strings(node.annotation)
+    return used
+
+
+class UnusedImportPass(AnalysisPass):
+    id = "unused-import"
+    description = "imports never referenced (package __init__ re-exports ok)"
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        used = _used_names(ctx.tree)
+        exported: set[str] = set()
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "__all__"
+                    and isinstance(node.value, (ast.List, ast.Tuple))):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        exported.add(elt.value)
+        if ctx.path.name == "__init__.py":  # packages re-export by importing
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            if getattr(node, "module", None) == "__future__":
+                continue
+            for alias in node.names:
+                name = (alias.asname or alias.name).split(".")[0]
+                if alias.name == "*":
+                    continue
+                if name in used or name in exported:
+                    continue
+                yield ctx.finding(
+                    node.lineno, self.id,
+                    f"unused import '{alias.asname or alias.name}'")
+
+
+class BareExceptPass(AnalysisPass):
+    id = "bare-except"
+    description = "bare 'except:' clauses (catch Exception or narrower)"
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield ctx.finding(node.lineno, self.id,
+                                  "bare 'except:' (catch Exception or "
+                                  "narrower)")
+
+
+class DuplicateDefPass(AnalysisPass):
+    id = "duplicate-def"
+    description = "duplicate top-level definitions (silent shadowing)"
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        seen: dict[str, int] = {}
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                if node.name in seen:
+                    yield ctx.finding(
+                        node.lineno, self.id,
+                        f"duplicate top-level definition '{node.name}' "
+                        f"(first at line {seen[node.name]})")
+                seen.setdefault(node.name, node.lineno)
+
+
+LEGACY_PASSES = (UnusedImportPass, BareExceptPass, DuplicateDefPass)

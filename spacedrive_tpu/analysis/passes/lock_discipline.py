@@ -1,0 +1,138 @@
+"""lock-discipline: module-level mutable state honors its sibling lock.
+
+The ``_STATE`` idiom (utils/jax_guard.py, objects/media/thumbnail.py): a
+module-level dict/list/set guarded by a module-level ``threading.Lock``.
+The idiom only works when *every* mutation happens under ``with <lock>:``
+— one bare mutation and the memoized verdict / probe dedup it protects
+can race (two concurrent first-touch probes, a torn check-then-set).
+
+This pass fires only in modules that define BOTH a module-level lock and
+module-level mutable literal state, and flags mutations of that state
+(subscript stores/deletes, augmented assigns, and mutating method calls
+like ``.update``/``.add``/``.append``) that are not lexically inside a
+``with`` block naming one of the module's locks. Module-top-level
+mutations (single-threaded import time) are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+
+MUTATOR_METHODS = {
+    "add", "append", "extend", "insert", "update", "setdefault",
+    "pop", "popitem", "clear", "remove", "discard",
+}
+
+MUTABLE_FACTORIES = {"dict", "list", "set", "defaultdict", "deque",
+                     "Counter", "OrderedDict"}
+
+
+def _module_assignments(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(lock names, mutable state names) assigned at module level."""
+    locks: set[str] = set()
+    mutables: set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name, value = stmt.targets[0].id, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and stmt.value is not None:
+            name, value = stmt.target.id, stmt.value
+        else:
+            continue
+        if isinstance(value, ast.Call):
+            d = dotted_name(value.func)
+            if d is not None:
+                leaf = d.split(".")[-1]
+                if leaf in ("Lock", "RLock"):
+                    locks.add(name)
+                elif leaf in MUTABLE_FACTORIES:
+                    mutables.add(name)
+        elif isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                ast.DictComp, ast.ListComp, ast.SetComp)):
+            mutables.add(name)
+    return locks, mutables
+
+
+class LockDisciplinePass(AnalysisPass):
+    id = "lock-discipline"
+    description = ("module-level mutable state mutated outside its "
+                   "sibling lock's with-block")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        locks, mutables = _module_assignments(ctx.tree)
+        if not locks or not mutables:
+            return
+        findings: list[Finding] = []
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                self._visit(ctx, stmt, locks, mutables, lock_depth=0,
+                            findings=findings)
+        yield from findings
+
+    def _visit(self, ctx: FileContext, node: ast.AST, locks: set[str],
+               mutables: set[str], lock_depth: int,
+               findings: list[Finding]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # deferred execution: a function DEFINED under `with lock:`
+            # runs later, when the lock is long released — its body gets
+            # no credit for the definition site's lock depth
+            lock_depth = 0
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            if any(self._is_lock_expr(item.context_expr, locks)
+                   for item in node.items):
+                lock_depth += 1
+        else:
+            target = self._mutation_target(node, mutables)
+            if target is not None and lock_depth == 0:
+                findings.append(ctx.finding(
+                    node.lineno, self.id,
+                    f"module state '{target}' mutated outside "
+                    f"'with <{'/'.join(sorted(locks))}>:' — the sibling "
+                    "lock exists precisely for this state"))
+        for child in ast.iter_child_nodes(node):
+            self._visit(ctx, child, locks, mutables, lock_depth, findings)
+
+    def _is_lock_expr(self, expr: ast.AST, locks: set[str]) -> bool:
+        d = dotted_name(expr)
+        return d is not None and d.split(".")[-1] in locks
+
+    def _mutation_target(self, node: ast.AST,
+                         mutables: set[str]) -> str | None:
+        def sub_root(target: ast.AST) -> str | None:
+            if isinstance(target, ast.Subscript) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id in mutables:
+                return target.value.id
+            return None
+
+        if isinstance(node, (ast.Assign,)):
+            for target in node.targets:
+                root = sub_root(target)
+                if root is not None:
+                    return root
+        elif isinstance(node, ast.AugAssign):
+            root = sub_root(node.target)
+            if root is not None:
+                return root
+            if isinstance(node.target, ast.Name) \
+                    and node.target.id in mutables:
+                return node.target.id
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                root = sub_root(target)
+                if root is not None:
+                    return root
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in MUTATOR_METHODS \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in mutables:
+            return node.func.value.id
+        return None

@@ -1,0 +1,120 @@
+"""resource-leak: ``open()``/``socket.socket()`` results must be owned.
+
+A leaked file handle is a slow failure (fd exhaustion after hours of
+scanning); a leaked socket can hold a port. The rule: a resource
+acquired in a function is fine when it is context-managed, ``.close()``d,
+or its ownership visibly escapes the function. Everything else is a
+leak on at least the exception path.
+
+Escape forms accepted (conservative — this pass prefers silence over
+false positives):
+- ``with name:`` / ``with closing(name):`` context management;
+- ``name.close()`` anywhere in the function (including finally blocks);
+- ``return name`` / ``yield name`` (caller owns it now);
+- ``name`` passed as an argument to any call (``os.fdopen(fd)``,
+  ``loop.create_datagram_endpoint(sock=sock)`` — the callee owns it);
+- ``name`` stored anywhere (``self._sock = name``, ``d[k] = name``) or
+  aliased to another variable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+
+ACQUIRERS = {"open": "open", "socket.socket": "socket.socket",
+             "io.open": "io.open"}
+
+
+class ResourceLeakPass(AnalysisPass):
+    id = "resource-leak"
+    description = ("open()/socket.socket() results neither context-managed "
+                   "nor closed nor escaping")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scan(ctx, node)
+
+    def _acquisitions(self, func: ast.AST) -> list[tuple[str, int, str]]:
+        """(var name, lineno, what) for resource-constructor assignments in
+        this function's own body (nested defs get their own scan)."""
+        out: list[tuple[str, int, str]] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                d = dotted_name(node.value.func)
+                if d in ACQUIRERS:
+                    out.append((node.targets[0].id, node.lineno,
+                                ACQUIRERS[d]))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in getattr(func, "body", []):
+            visit(stmt)
+        return out
+
+    def _scan(self, ctx: FileContext, func: ast.AST) -> Iterator[Finding]:
+        acquired = self._acquisitions(func)
+        if not acquired:
+            return
+        for name, lineno, what in acquired:
+            if not self._owned(func, name):
+                yield ctx.finding(
+                    lineno, self.id,
+                    f"'{name}' from {what}() is neither context-managed, "
+                    f"closed, nor handed off in '{getattr(func, 'name', '?')}'"
+                    " — a raise before close() leaks the descriptor")
+
+    def _owned(self, func: ast.AST, name: str) -> bool:
+        """True when the resource is context-managed, closed, or escapes.
+        Scans the WHOLE function subtree including nested defs: a closure
+        closing over the resource may be its legitimate closer."""
+
+        # one parent map for the whole function; every direct_ref probe
+        # below shares it instead of re-walking its subtree
+        parents: dict[ast.AST, ast.AST] = {}
+        for outer in ast.walk(func):
+            for child in ast.iter_child_nodes(outer):
+                parents[child] = outer
+
+        def direct_ref(node: ast.AST) -> bool:
+            """A bare reference to ``name`` inside ``node`` — one whose
+            value is handed somewhere, NOT an attribute/method use on it
+            (``fh.read()`` consumes the handle; it doesn't transfer it)."""
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id == name \
+                        and not isinstance(parents.get(sub), ast.Attribute):
+                    return True
+            return False
+
+        for node in ast.walk(func):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                if any(direct_ref(item.context_expr) for item in node.items):
+                    return True
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "close" \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == name:
+                    return True
+                if any(direct_ref(arg) for arg in node.args) \
+                        or any(direct_ref(kw.value) for kw in node.keywords):
+                    return True
+            elif isinstance(node, (ast.Return, ast.Yield)):
+                if node.value is not None and direct_ref(node.value):
+                    return True
+            elif isinstance(node, ast.Assign):
+                # aliasing or storing (self.x = name, d[k] = name, y = name)
+                if direct_ref(node.value) and not (
+                        isinstance(node.value, ast.Call)
+                        and dotted_name(node.value.func) in ACQUIRERS):
+                    return True
+        return False

@@ -1,0 +1,62 @@
+"""swallowed-exception: job-pipeline code must not eat crashes silently.
+
+In the job subsystems (jobs/, objects/, locations/) a broad handler
+whose body is only ``pass``/``continue`` converts a crash into a report
+that *looks* complete — the worker moves on, the step's work silently
+never happened, and the wedge shows up later as unexplained missing
+rows instead of an error the operator can act on. Rounds 4-5 showed
+liveness bugs hide exactly here.
+
+Flagged: ``except:``, ``except Exception:``, ``except BaseException:``
+(alone or in a tuple) whose body contains nothing but ``pass`` or
+``continue``, inside any function in the job-pipeline directories.
+Handlers that log, set a fallback, append an error, or re-raise are
+fine — so is a deliberate swallow waived with
+``# lint: ok(swallowed-exception)`` and a comment saying why.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding
+
+JOB_DIRS = ("jobs", "objects", "locations")
+
+BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    if isinstance(handler.type, ast.Name):
+        return handler.type.id in BROAD
+    if isinstance(handler.type, ast.Tuple):
+        return any(isinstance(elt, ast.Name) and elt.id in BROAD
+                   for elt in handler.type.elts)
+    return False
+
+
+class SwallowedExceptionPass(AnalysisPass):
+    id = "swallowed-exception"
+    description = ("broad except handlers whose body is only "
+                   "pass/continue in job-pipeline code")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dirs(*JOB_DIRS):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if not all(isinstance(s, (ast.Pass, ast.Continue))
+                       for s in node.body):
+                continue
+            yield ctx.finding(
+                node.lineno, self.id,
+                "broad exception swallowed (body is only pass/continue) — "
+                "a silent swallow turns a crash into a wedged or "
+                "silently-incomplete job report; log it, narrow it, or "
+                "waive with a reason")

@@ -37,25 +37,75 @@ def _instance_file(data_dir: Path) -> Path:
     return data_dir / "desktop_instance.json"
 
 
+def _proc_start_time(pid: int) -> int | None:
+    """Kernel start time (clock ticks since boot, /proc/<pid>/stat field
+    22) — constant for a process's whole life and different for any
+    process that later recycles the pid, which makes (pid, starttime) a
+    unique process identity cmdline substrings can never be."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode("ascii", "replace")
+        # comm (field 2) may contain spaces/parens; fields resume after
+        # the LAST ')'
+        fields = stat.rsplit(")", 1)[1].split()
+        return int(fields[19])  # starttime is field 22 (1-based)
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _proc_argv(pid: int) -> list[str] | None:
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            raw = f.read()
+        return raw.decode("utf-8", "replace").split("\0")[:-1] or None
+    except OSError:
+        return None
+
+
 def _instance_alive(info: dict) -> bool:
     """A recycled pid can impersonate a dead shell, so pid liveness alone
     is not trusted: the recorded URL must also answer /health. An entry
-    still booting (url not yet recorded) counts as alive while its pid is.
+    still booting (url not yet recorded) counts as alive while its pid —
+    verified by start time — is.
 
     A live node mid-scan on a loaded single-core host can miss a short
     health deadline, and declaring it dead would let a concurrent launch
     unlink its claim and boot a second Node over the same data dir — the
     exact hazard single-instancing exists to prevent. So the probe is
     generous (10s) and retried once, and an unresponsive-but-live pid is
-    only declared dead when /proc says it isn't our shell (pid recycled)."""
+    only kept when its /proc start time (recorded at claim time) proves
+    it is the same process that claimed — a substring match on a
+    recycled pid's cmdline proves nothing and is gone."""
     try:
         pid = int(info["pid"])
         os.kill(pid, 0)
     except (OSError, ValueError, KeyError, TypeError):
         return False
+
+    def same_process() -> bool:
+        recorded = info.get("starttime")
+        if recorded is not None:
+            actual = _proc_start_time(pid)
+            if actual is None:
+                # /proc answered at claim time but not now: cannot
+                # DISPROVE identity — err alive (a blocked launch beats
+                # booting a second Node over the same data dir)
+                return True
+            return int(recorded) == actual
+        argv = info.get("argv")  # claim written where /proc had no stat
+        if argv:
+            actual_argv = _proc_argv(pid)
+            if actual_argv is None:
+                return True  # no /proc on this host: err alive
+            return actual_argv == argv
+        # nothing recorded that can prove identity: a live pid with a
+        # dead/absent URL is indistinguishable from a recycled pid —
+        # treat the claim as stale (the health probe already failed)
+        return False
+
     url = info.get("url")
     if url is None:
-        return True  # claimed, server still starting
+        return same_process()  # claimed, server still starting
     import urllib.request
 
     for attempt in range(2):
@@ -68,15 +118,9 @@ def _instance_alive(info: dict) -> bool:
                     return True
         except Exception:
             pass
-    # Unresponsive but the pid is alive. Distinguish "busy shell" from
-    # "recycled pid" via the process image; when /proc can't tell us,
-    # err on the side of alive (a blocked launch beats a split brain).
-    try:
-        with open(f"/proc/{pid}/cmdline", "rb") as f:
-            cmdline = f.read().decode("utf-8", "replace")
-        return ("spacedrive" in cmdline) or ("desktop" in cmdline)
-    except OSError:
-        return True
+    # Unresponsive but the pid is alive: busy shell vs recycled pid,
+    # decided by process identity, not cmdline substrings.
+    return same_process()
 
 
 def _instance_lock(data_dir: Path):
@@ -125,6 +169,16 @@ def _running_instance_locked(data_dir: Path) -> dict | None:
     return None
 
 
+def _claim_payload(url: str | None) -> dict:
+    """The instance record: pid plus the identity proof (/proc start time,
+    argv fallback) that lets a later launcher tell THIS process apart
+    from whatever recycles its pid after a crash."""
+    pid = os.getpid()
+    return {"pid": pid, "url": url,
+            "starttime": _proc_start_time(pid),
+            "argv": _proc_argv(pid) or sys.argv}
+
+
 def _claim_instance(data_dir: Path) -> bool:
     """Atomically claim the single-instance slot. Returns False when a live
     instance (or one mid-boot) holds the claim."""
@@ -134,7 +188,7 @@ def _claim_instance(data_dir: Path) -> bool:
         fd = os.open(str(_instance_file(data_dir)),
                      os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
         with os.fdopen(fd, "w") as fh:
-            json.dump({"pid": os.getpid(), "url": None}, fh)
+            json.dump(_claim_payload(None), fh)
         return True
 
 
@@ -166,8 +220,7 @@ def launch(data_dir: str | Path, port: int = 0, open_browser: bool = True,
             pass
         raise
     url = f"http://127.0.0.1:{shell.port}/"
-    _instance_file(data_dir).write_text(
-        json.dumps({"pid": os.getpid(), "url": url}))
+    _instance_file(data_dir).write_text(json.dumps(_claim_payload(url)))
 
     if open_browser:
         import webbrowser

@@ -99,7 +99,11 @@ def _extract_image(path: str) -> dict[str, Any] | None:
                                         hasattr(value, "__float__")
                                         else str(value))
             except Exception:
-                pass
+                # the file still gets base metadata; only the EXIF sub-IFD
+                # (exposure/aperture/ISO) is skipped — but say so, or a
+                # corrupt IFD looks like a camera that wrote no EXIF at all
+                logger.debug("unreadable EXIF sub-IFD in %s", path,
+                             exc_info=True)
             if camera:
                 out["camera_data"] = camera
             gps = exif.get_ifd(0x8825) if hasattr(exif, "get_ifd") else None

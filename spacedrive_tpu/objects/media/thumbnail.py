@@ -52,7 +52,10 @@ def thumbnail_dir(data_dir: str | Path) -> Path:
         version_file = d / "version.txt"
         if not version_file.exists():
             version_file.write_text(str(THUMBNAIL_VERSION))
-        _THUMB_DIRS_READY.add(key)
+        # benign race: mkdir/version-stamp are idempotent and the set is a
+        # pure memo — double work on a concurrent first call, never
+        # corruption, and the hot listing path stays lock-free
+        _THUMB_DIRS_READY.add(key)  # lint: ok(lock-discipline)
     return d
 
 
@@ -314,7 +317,10 @@ def video_to_webp_bytes(source: str | Path, size: int = 256,
         try:
             return native.encode_webp(frame, quality)
         except Exception:
-            pass
+            # PIL below produces the same artifact; log the fallback or a
+            # broken native encoder silently halves encode throughput
+            logger.debug("native webp encode failed; using PIL",
+                         exc_info=True)
     buf = io.BytesIO()
     Image.fromarray(frame).save(buf, "WEBP", quality=quality)
     return buf.getvalue()

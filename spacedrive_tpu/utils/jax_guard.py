@@ -48,7 +48,38 @@ PROBE_TIMEOUT = float(os.environ.get("SD_JAX_PROBE_TIMEOUT", "75"))
 #: "is the device reachable at all" into a sub-second check instead of a
 #: 75s subprocess deadline (observed: the round-4 relay death mode is
 #: no-listener, not accept-and-hang)
-RELAY_PORTS = (8082, 8083, 8087, 8092)
+_DEFAULT_RELAY_PORTS = (8082, 8083, 8087, 8092)
+
+
+def _relay_ports_from_env(raw: str | None) -> tuple[int, ...]:
+    """``SD_RELAY_PORTS=8082,8083`` overrides the hardcoded tuple (parsed
+    at import, like SD_JAX_PROBE_TIMEOUT above) so a relay deployed on
+    different ports degrades to the slow-but-correct subprocess probe
+    instead of a false instant "no listener → pin to CPU" verdict."""
+    if not raw:
+        return _DEFAULT_RELAY_PORTS
+    ports: list[int] = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            port = int(part)
+        except ValueError:
+            logger.warning("SD_RELAY_PORTS: ignoring non-integer %r", part)
+            continue
+        if 0 < port < 65536:
+            ports.append(port)
+        else:
+            logger.warning("SD_RELAY_PORTS: ignoring out-of-range %d", port)
+    if not ports:
+        logger.warning("SD_RELAY_PORTS=%r has no usable ports; keeping "
+                       "defaults %s", raw, _DEFAULT_RELAY_PORTS)
+        return _DEFAULT_RELAY_PORTS
+    return tuple(ports)
+
+
+RELAY_PORTS = _relay_ports_from_env(os.environ.get("SD_RELAY_PORTS"))
 
 
 def relay_listening(timeout_s: float = 1.5) -> bool:

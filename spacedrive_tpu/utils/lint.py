@@ -1,123 +1,43 @@
-"""Static-rigor gate (SURVEY §5.2): a stdlib AST linter the test suite runs.
+"""Static-rigor gate — THIN SHIM over ``spacedrive_tpu.analysis``.
 
-The reference's rigor layer is clippy + rustc's own analysis; this image
-ships no Python linters, so the gate is built from ``ast``: syntax (via
-compile), unused imports, duplicate top-level definitions, and bare
-``except:`` clauses — the defect classes that actually bite a long-lived
-codebase. ``# lint: ok`` on the offending line waives a finding (the
-escape hatch for deliberate re-exports and probe-style excepts).
+The 135-line stdlib AST linter that lived here grew into the multi-pass
+framework in ``spacedrive_tpu/analysis/`` (pass manager, per-pass
+waivers, baseline ratchet, and the jax wedge-safety / async-hygiene /
+concurrency passes). This module keeps the original entry points —
+``check_file``/``check_tree``/``python -m spacedrive_tpu.utils.lint`` —
+running the ORIGINAL defect classes (unused imports, bare excepts,
+duplicate top-level defs, syntax errors) with the original message
+format, so existing callers and tests see identical behavior.
 
-Run: ``python -m spacedrive_tpu.utils.lint`` (or via tests/test_lint.py).
+For the full pass list run ``python -m spacedrive_tpu.analysis``.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 WAIVER = "# lint: ok"
 
 
-import re as _re
+def _manager(root: Path):
+    from ..analysis.engine import PassManager
+    from ..analysis.passes.legacy import LEGACY_PASSES
 
-_IDENT = _re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
-
-
-def _used_names(tree: ast.AST) -> set[str]:
-    used: set[str] = set()
-
-    def add_annotation_strings(node: ast.AST | None) -> None:
-        # quoted annotations ("Library") reference names the AST only sees
-        # as string constants — count their identifiers as used
-        for sub in ast.walk(node) if node is not None else ():
-            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
-                used.update(_IDENT.findall(sub.value))
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            base = node
-            while isinstance(base, ast.Attribute):
-                base = base.value
-            if isinstance(base, ast.Name):
-                used.add(base.id)
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            add_annotation_strings(node.returns)
-            for arg in (node.args.args + node.args.posonlyargs
-                        + node.args.kwonlyargs
-                        + ([node.args.vararg] if node.args.vararg else [])
-                        + ([node.args.kwarg] if node.args.kwarg else [])):
-                add_annotation_strings(arg.annotation)
-        elif isinstance(node, ast.AnnAssign):
-            add_annotation_strings(node.annotation)
-    return used
+    return PassManager([cls() for cls in LEGACY_PASSES], root)
 
 
 def check_file(path: Path) -> list[str]:
-    src = path.read_text()
-    lines = src.splitlines()
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-
-    def waived(lineno: int) -> bool:
-        return 0 < lineno <= len(lines) and WAIVER in lines[lineno - 1]
-
-    problems: list[str] = []
-    used = _used_names(tree)
-    # module __all__ / docstring re-export patterns count as use
-    exported: set[str] = set()
-    for node in tree.body:
-        if (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)
-                and node.targets[0].id == "__all__"
-                and isinstance(node.value, (ast.List, ast.Tuple))):
-            for elt in node.value.elts:
-                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
-                    exported.add(elt.value)
-
-    is_package_init = path.name == "__init__.py"
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            if getattr(node, "module", None) == "__future__":
-                continue
-            for alias in node.names:
-                name = (alias.asname or alias.name).split(".")[0]
-                if alias.name == "*" or waived(node.lineno):
-                    continue
-                if name in used or name in exported:
-                    continue
-                if is_package_init:  # packages re-export by importing
-                    continue
-                problems.append(f"{path}:{node.lineno}: unused import "
-                                f"'{alias.asname or alias.name}'")
-        elif isinstance(node, ast.ExceptHandler) and node.type is None:
-            if not waived(node.lineno):
-                problems.append(f"{path}:{node.lineno}: bare 'except:' "
-                                "(catch Exception or narrower)")
-
-    # duplicate top-level defs shadow silently
-    seen: dict[str, int] = {}
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            if node.name in seen and not waived(node.lineno):
-                problems.append(
-                    f"{path}:{node.lineno}: duplicate top-level definition "
-                    f"'{node.name}' (first at line {seen[node.name]})")
-            seen.setdefault(node.name, node.lineno)
-    return problems
+    path = Path(path)
+    findings = _manager(path.parent).check_file(path)
+    return [f"{f.path}:{f.lineno}: {f.message}" for f in findings]
 
 
 def check_tree(root: Path) -> list[str]:
     problems: list[str] = []
-    for path in sorted(root.rglob("*.py")):
-        if "_build" in path.parts or ".bench_cache" in path.parts:
-            continue
-        problems.extend(check_file(path))
+    manager = _manager(root)
+    for f in manager.check_tree():
+        problems.append(f"{f.path}:{f.lineno}: {f.message}")
     return problems
 
 

@@ -85,6 +85,39 @@ def test_recycled_pid_does_not_mask_dead_instance(tmp_path):
     assert not (d / "desktop_instance.json").exists()
 
 
+def test_starttime_identity_tells_recycled_pid_from_busy_shell(tmp_path):
+    """The recorded /proc start time is the identity proof: same pid +
+    wrong starttime (recycled) is dead even mid-boot; same pid + right
+    starttime survives an unanswered health probe (busy shell)."""
+    d = tmp_path / "data"
+    d.mkdir()
+    me = os.getpid()
+    real_start = desktop._proc_start_time(me)
+    assert real_start is not None
+
+    # recycled: live pid, mid-boot claim (url None), but a start time that
+    # can't be ours — the claim is stale
+    (d / "desktop_instance.json").write_text(json.dumps(
+        {"pid": me, "url": None, "starttime": real_start + 12345}))
+    assert desktop._running_instance(d) is None
+
+    # busy shell: health probe fails (dead URL) but identity matches —
+    # the instance is kept, not stomped by a concurrent launcher
+    info = {"pid": me, "url": "http://127.0.0.1:1/",
+            "starttime": real_start}
+    assert desktop._instance_alive(info) is True
+
+
+def test_claim_records_identity_proof(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    assert desktop._claim_instance(d)
+    info = json.loads((d / "desktop_instance.json").read_text())
+    assert info["starttime"] == desktop._proc_start_time(os.getpid())
+    assert info["argv"]
+    (d / "desktop_instance.json").unlink()
+
+
 def test_claim_instance_is_exclusive(tmp_path):
     d = tmp_path / "data"
     d.mkdir()

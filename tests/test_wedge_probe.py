@@ -8,6 +8,7 @@ from accept-and-hang (bounded probe), and bench marks the record loudly.
 """
 
 import json
+import os
 import socket
 import subprocess
 import sys
@@ -47,11 +48,24 @@ def test_relay_listening_true_on_listener(monkeypatch):
         srv.close()
 
 
+def _bench_env() -> dict:
+    """Subprocess env with every bench verdict/assumption variable popped —
+    a shell that previously ran bench.py exports SD_BENCH_DEVICE_VERDICT
+    (and SD_ASSUME_DEVICE_OK short-circuits the probe), either of which
+    would make the cpu-fallback assertions below fail spuriously."""
+    env = dict(os.environ)
+    for key in ("SD_BENCH_DEVICE_VERDICT", "SD_BENCH_DEVICE_REASON",
+                "SD_ASSUME_DEVICE_OK"):
+        env.pop(key, None)
+    return env
+
+
 def test_bench_guard_emits_loud_marker_when_relay_dead():
     """End-to-end through bench.py's guard in a subprocess: zero recovery
     window + unreachable relay must produce the top-level device_numbers
-    marker, fast (the sync mode is the cheapest device-free mode, but the
-    guard itself is what's under test)."""
+    marker naming the relay-refused failure mode, fast (the sync mode is
+    the cheapest device-free mode, but the guard itself is what's under
+    test)."""
     code = (
         "import os, sys, json\n"
         "sys.path.insert(0, %r)\n"
@@ -60,17 +74,46 @@ def test_bench_guard_emits_loud_marker_when_relay_dead():
         "g.RELAY_PORTS = (1,)  # port 1: nothing listens, instant refusal\n"
         "import bench\n"
         "platform = bench._guard_device_init()\n"
-        "print(json.dumps({'platform': platform}))\n" % str(REPO)
+        "print(json.dumps({'platform': platform,\n"
+        "                  'reason': os.environ.get("
+        "'SD_BENCH_DEVICE_REASON')}))\n" % str(REPO)
     )
     t0 = time.perf_counter()
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                         text=True, timeout=120, cwd=str(REPO))
+                         text=True, timeout=120, cwd=str(REPO),
+                         env=_bench_env())
     assert out.returncode == 0, out.stderr[-2000:]
     verdict = json.loads(out.stdout.strip().splitlines()[-1])
     # port 1 refused => no subprocess probe => well under the 150s deadline
     assert verdict["platform"].startswith("cpu-fallback")
+    # the marker names the diagnosed mode, not a hardcoded string
+    assert verdict["reason"].startswith("relay-refused")
+    assert "relay-refused" in verdict["platform"]
     assert "FAILED PRECONDITION" in out.stderr
     assert time.perf_counter() - t0 < 60
+
+
+def test_relay_ports_env_override():
+    """SD_RELAY_PORTS=8082,8083 replaces the hardcoded tuple at import;
+    junk entries are dropped; junk-only values keep the defaults."""
+    code = (
+        "import os, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "os.environ['SD_RELAY_PORTS'] = '8082, 9999,nope,0'\n"
+        "from spacedrive_tpu.utils import jax_guard\n"
+        "print(jax_guard.RELAY_PORTS)\n" % str(REPO)
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=60, env=_bench_env())
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip().splitlines()[-1] == "(8082, 9999)"
+
+    from spacedrive_tpu.utils.jax_guard import (_DEFAULT_RELAY_PORTS,
+                                                _relay_ports_from_env)
+
+    assert _relay_ports_from_env(None) == _DEFAULT_RELAY_PORTS
+    assert _relay_ports_from_env("junk,,") == _DEFAULT_RELAY_PORTS
+    assert _relay_ports_from_env("8083") == (8083,)
 
 
 def test_guard_probe_skips_subprocess_when_no_listener(monkeypatch):
