@@ -116,9 +116,10 @@ def bench_device_kernel() -> dict:
     import numpy as np
 
     from spacedrive_tpu.native import cas_native
+    from spacedrive_tpu.ops import roofline
     from spacedrive_tpu.ops.blake3_jax import (BLOCKS_PER_CHUNK, CHUNK_LEN,
                                                blake3_batch_rows,
-                                               digests_to_hex)
+                                               digests_to_hex, resolve_kernel)
 
     # 8192 lanes amortize the tunnel's fixed dispatch overhead (~65ms —
     # measured: 512 lanes 0.065s, 2048 lanes 0.068s, 8192 lanes 0.046s
@@ -169,9 +170,15 @@ def bench_device_kernel() -> dict:
     h2d_mbps = probe.nbytes / 1e6 / h2d_t
 
     gb = B * sampled_bytes / 1e9
-    print(f"info: device-resident kernel {B} lanes x {sampled_bytes}B: "
-          f"device {dev_t:.3f}s ({gb / dev_t:.2f} GB/s, "
-          f"{B / dev_t:.0f} files-equiv/s) | +transfer {xfer_t:.3f}s "
+    # roofline/MFU accounting (ops/roofline.py): achieved payload bytes/s ×
+    # 12.5 u32 ops/byte against the chip's peak u32 ops/s — kernel progress
+    # expressed against hardware peak, not just the 1-core CPU baseline
+    kernel = resolve_kernel()
+    mfu = roofline.mfu(gb * 1e9 / dev_t)
+    print(f"info: device-resident kernel[{kernel}] {B} lanes x "
+          f"{sampled_bytes}B: device {dev_t:.3f}s ({gb / dev_t:.2f} GB/s, "
+          f"{B / dev_t:.0f} files-equiv/s, MFU {mfu:.1%}) | "
+          f"+transfer {xfer_t:.3f}s "
           f"({gb / xfer_t:.2f} GB/s) | host 1-core native {host_t:.3f}s "
           f"({gb / host_t:.2f} GB/s) | h2d link {h2d_mbps:.0f} MB/s",
           file=sys.stderr)
@@ -180,6 +187,10 @@ def bench_device_kernel() -> dict:
         "value": round(gb / dev_t, 2),
         "unit": "GB/sec",
         "vs_baseline": round(host_t / dev_t, 2),
+        "kernel": kernel,
+        "mfu": round(mfu, 4),
+        "ops_per_byte": roofline.OPS_PER_BYTE,
+        "peak_u32_ops_per_sec": roofline.peak_u32_ops(),
         "files_equiv_per_sec": round(B / dev_t, 1),
         "transfer_included_GBps": round(gb / xfer_t, 2),
         "host_native_GBps": round(gb / host_t, 2),
@@ -284,11 +295,21 @@ def bench_thumbs() -> dict:
     out = run_full()  # compile both; correctness gate vs PIL
     ref = np.asarray(imgs[0].resize((tw, th), Image.BILINEAR), dtype=np.float32)
     got = out[0, :th, :tw].astype(np.float32)
-    mae = float(np.abs(ref - got).mean())
-    if mae > 4.0:  # filters differ slightly at edges; catastrophic != small
+    # error bound vs PIL, per channel plus the worst single pixel — a bare
+    # batch-mean can hide a localized divergence (one bad tile averages
+    # away); the bound is what preview-media.md documents and gates
+    err = np.abs(ref - got)
+    mae_per_channel = [float(x) for x in err.mean(axis=(0, 1))]
+    max_abs_err = float(err.max())
+    mae = float(err.mean())
+    if mae > 4.0 or max_abs_err > 48.0:
+        # mean gate: filters differ slightly at edges; max gate: no single
+        # pixel may diverge by more than ~19% of full scale (see
+        # docs/architecture/preview-media.md, "Filter choice and tolerance").
         # raise (not sys.exit): combined mode treats thumbs as additive
         # evidence and must still print the headline record
-        raise RuntimeError(f"device resize diverges from PIL (MAE {mae:.1f})")
+        raise RuntimeError(f"device resize diverges from PIL "
+                           f"(MAE {mae:.1f}, max {max_abs_err:.0f})")
     run_kernel()
     kern_t, _ = time_best(run_kernel, REPEATS)
     full_t, _ = time_best(run_full, 1)
@@ -302,8 +323,9 @@ def bench_thumbs() -> dict:
     print(f"info: thumbs {n}x{w_in}x{h_in}: kernel {kern_t:.3f}s "
           f"({n / kern_t:.1f} img/s, {mpx / kern_t:.0f} MPx/s) | "
           f"+readback {full_t:.3f}s | +transfer {xfer_t:.3f}s | "
-          f"PIL {pil_t:.3f}s ({n / pil_t:.1f} img/s) | MAE vs PIL {mae:.2f}",
-          file=sys.stderr)
+          f"PIL {pil_t:.3f}s ({n / pil_t:.1f} img/s) | "
+          f"MAE/chan vs PIL {['%.2f' % c for c in mae_per_channel]} "
+          f"max |err| {max_abs_err:.1f}", file=sys.stderr)
     return {
         "metric": f"thumbnail_resize_images_per_sec[{n}x{w_in}x{h_in}]",
         "value": round(n / kern_t, 1),
@@ -312,7 +334,8 @@ def bench_thumbs() -> dict:
         "readback_included_images_per_sec": round(n / full_t, 1),
         "transfer_included_images_per_sec": round(n / xfer_t, 1),
         "pil_images_per_sec": round(n / pil_t, 1),
-        "mae_vs_pil": round(mae, 2),
+        "mae_vs_pil_per_channel": [round(c, 3) for c in mae_per_channel],
+        "max_abs_err_vs_pil": round(max_abs_err, 1),
     }
 
 
@@ -719,6 +742,17 @@ def main() -> int:
     # inherit the parent's verdict via SD_BENCH_DEVICE_VERDICT so the
     # probe cost is paid once per combined run
     platform = _guard_device_init()
+    # opportunistic recapture: the combined suite runs for many minutes on
+    # the CPU fallback — keep watching the relay in the background and, if
+    # it recovers mid-run, measure the device suite after all (one shot,
+    # writes BENCH_device_opportunistic.json). Children skip it: only the
+    # top-level run should own the watcher.
+    watcher = None
+    if (platform != "device" and MODE == "combined"
+            and not os.environ.get("SD_BENCH_NO_RECAPTURE")):
+        from spacedrive_tpu.utils.recapture import RelayRecaptureWatcher
+
+        watcher = RelayRecaptureWatcher().start()
     if MODE == "dedup":
         record = bench_dedup()
     elif MODE == "identify":
@@ -760,6 +794,23 @@ def main() -> int:
                     json.loads(out.stdout.strip().splitlines()[-1]))
             except Exception as e:
                 print(f"warn: {sub_mode} bench skipped: {e}", file=sys.stderr)
+    if watcher is not None:
+        watcher.stop()  # instant while idle-polling; 5s grace otherwise
+        if watcher.capturing:
+            # a capture in flight IS the prize — wait it out (bounded by
+            # the suite subprocess's own 1800s timeout) rather than
+            # orphaning the measurement because the CPU benches happened
+            # to finish first
+            print("info: opportunistic device capture in flight — waiting "
+                  "for it before exiting", file=sys.stderr)
+            watcher.stop(timeout=1860.0)
+            if watcher.capturing:
+                print("warn: opportunistic device capture still running "
+                      "at exit; record abandoned", file=sys.stderr)
+        if watcher.recovered:
+            record["device_recapture"] = str(watcher.out_path)
+            print(f"info: relay recovered mid-run — device suite captured "
+                  f"to {watcher.out_path}", file=sys.stderr)
     if platform != "device":
         record["platform"] = platform
         # unmissable: the device metrics in this record are fallback

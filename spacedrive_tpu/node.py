@@ -116,12 +116,38 @@ class Node:
 
         self.thumbnail_remover = ThumbnailRemoverActor(self)
 
+        accel = None
         if probe_accelerator:
             # inventory only — deliberately NOT seeding the jax guard: the
             # boot->first-job gap can be hours, and a relay that dies in
             # between must be caught by the guard's own probe at first
             # device touch (a boot-time success would make it vacuous)
-            self.config.write(accelerator=_probe_accelerator())
+            accel = _probe_accelerator()
+            self.config.write(accelerator=accel)
+
+        # opportunistic device recapture (utils/recapture.py): a node booted
+        # against a dead relay is the best vantage point for an eventual
+        # recovery — poll liveness and, on the first recovery, run the
+        # device bench suite once and persist the record. Opt-in: a watcher
+        # thread per Node would be noise in tests and embedded hosts.
+        self.relay_recapture = None
+        if os.environ.get("SD_OPPORTUNISTIC_BENCH"):
+            if accel is not None:
+                want_watcher = not accel.get("devices")
+            else:
+                # probe disabled: persisted config is stale by definition (a
+                # previous boot's relay state) — gate on the sub-second live
+                # relay check instead. A listening relay needs no recapture;
+                # a dead one is exactly the scenario the watcher exists for.
+                from .utils.jax_guard import relay_listening
+
+                want_watcher = not relay_listening()
+            if want_watcher:
+                from .utils.recapture import RelayRecaptureWatcher
+
+                self.relay_recapture = RelayRecaptureWatcher().start()
+                logger.info("no accelerator at boot; watching for relay "
+                            "recovery (SD_OPPORTUNISTIC_BENCH)")
 
         # ordering-critical start sequence (lib.rs:126-130)
         from .jobs import register_builtin_jobs
@@ -179,6 +205,8 @@ class Node:
         """Graceful: checkpoint all jobs, stop watchers, close DBs
         (Node::shutdown, lib.rs:196)."""
         self.jobs.shutdown()
+        if self.relay_recapture is not None:
+            self.relay_recapture.stop()
         if self.locations is not None:
             self.locations.stop()
         if self.p2p is not None:

@@ -12,6 +12,12 @@ The TPU backend batches sampled messages into shape buckets:
 - a handful of small-file chunk-capacity buckets (1/4/16/32/64/101 chunks) to
   bound zero-padding waste while keeping the compiled-shape count constant.
 
+The device compression kernel under every batched path here (row pipeline,
+small-file buckets, sharded variants) is selected by ``SD_BLAKE3_KERNEL=
+xla|pallas`` — resolved per call inside ops/blake3_jax's entry points, so
+the hashers need no plumbing and a process switches kernels without
+re-instantiating backends (each choice jit-caches separately).
+
 Per-file IO errors come back as Exception entries; callers route them into
 job errors instead of aborting the batch.
 """

@@ -22,11 +22,24 @@ XLA/TPU rather than translated from any CPU implementation:
 Everything is uint32 add/xor/rotate — pure VPU work; the rounds/permutation
 schedule is unrolled (static), only the lanes are data.
 
+Two interchangeable compression kernels sit under this orchestration,
+selected per call (or via ``SD_BLAKE3_KERNEL=pallas|xla``, default xla):
+
+- ``xla``: the graph-compiled :func:`compress` below (rounds as a 7-step
+  ``lax.scan`` — small HLO, XLA schedules everything);
+- ``pallas``: the hand-tiled register-resident kernel in blake3_pallas.py
+  (8×128 u32 lane tiles, rounds unrolled, permutation baked into the
+  schedule). Byte-identical outputs — tests prove both against the
+  objects/blake3_ref.py oracle, in Pallas interpret mode on CPU.
+
 Multi-device: shard the batch axis with ``jax.sharding``; see parallel/mesh.py.
 """
 
 from __future__ import annotations
 
+import functools
+import logging
+import os
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +61,33 @@ from ..objects.blake3_ref import (  # noqa: E402
 BLOCKS_PER_CHUNK = CHUNK_LEN // BLOCK_LEN
 
 _u32 = jnp.uint32
+
+logger = logging.getLogger(__name__)
+
+#: the two compression kernels behind the orchestration (module docstring)
+KERNELS = ("xla", "pallas")
+
+
+def resolve_kernel(kernel: str | None = None) -> str:
+    """Explicit argument wins; else ``SD_BLAKE3_KERNEL``; else ``xla``.
+    Resolved per call (never memoized) so subprocess tests stay hermetic —
+    each jit cache entry is keyed by the resolved name."""
+    if kernel is None:
+        kernel = os.environ.get("SD_BLAKE3_KERNEL", "").strip().lower() or "xla"
+    if kernel not in KERNELS:
+        logger.warning("unknown SD_BLAKE3_KERNEL=%r; using xla", kernel)
+        kernel = "xla"
+    return kernel
+
+
+def _compress_fn(kernel: str):
+    """The compression primitive for a resolved kernel name. The pallas
+    module imports lazily so xla-only processes never touch it."""
+    if kernel == "pallas":
+        from .blake3_pallas import compress_pallas
+
+        return compress_pallas
+    return compress
 
 
 def _rotr(x: jax.Array, n: int) -> jax.Array:
@@ -117,14 +157,22 @@ def _iv_lanes(shape) -> list[jax.Array]:
     return [jnp.full(shape, w, _u32) for w in IV]
 
 
-@jax.jit
-def blake3_batch(words: jax.Array, lengths: jax.Array) -> jax.Array:
+def blake3_batch(words: jax.Array, lengths: jax.Array,
+                 kernel: str | None = None) -> jax.Array:
     """Hash B zero-padded messages.
 
     ``words``: (16 blocks, 16 words, C chunks, B) uint32, little-endian packed
     (see :func:`pack_messages`); ``lengths``: (B,) int32 true byte lengths,
     each <= C*1024. Returns (8, B) digest words — 32 bytes LE per lane.
+    ``kernel`` picks the compression primitive (:func:`resolve_kernel`).
     """
+    return _blake3_batch_impl(words, lengths, kernel=resolve_kernel(kernel))
+
+
+@functools.partial(jax.jit, static_argnames=("kernel",))
+def _blake3_batch_impl(words: jax.Array, lengths: jax.Array, *,
+                       kernel: str = "xla") -> jax.Array:
+    compress_k = _compress_fn(kernel)
     _, _, C, B = words.shape
     lengths = lengths.astype(jnp.int32)
     n_chunks = jnp.maximum(1, (lengths + (CHUNK_LEN - 1)) // CHUNK_LEN)  # (B,)
@@ -141,15 +189,15 @@ def blake3_batch(words: jax.Array, lengths: jax.Array) -> jax.Array:
             jnp.where(j == 0, _u32(CHUNK_START), _u32(0))
             | jnp.where(j == n_blocks - 1, _u32(CHUNK_END), _u32(0))
         )
-        out = compress(cv, [m[w] for w in range(16)],
-                       jnp.broadcast_to(chunk_idx, (C, B)), block_len, flags)
+        out = compress_k(cv, [m[w] for w in range(16)],
+                         jnp.broadcast_to(chunk_idx, (C, B)), block_len, flags)
         keep = j < n_blocks  # (C, B)
         return [jnp.where(keep, out[w], cv[w]) for w in range(8)], None
 
     cvs, _ = lax.scan(block_body, _iv_lanes((C, B)), (jnp.arange(BLOCKS_PER_CHUNK), words))
 
     # ---- single-chunk lanes: rerun chunk 0 with ROOT on each lane's final block
-    single_root = _single_chunk_root(words[:, :, 0, :], lengths)  # (8, B)
+    single_root = _single_chunk_root(words[:, :, 0, :], lengths, kernel)  # (8, B)
 
     # ---- phase 2: log-depth merkle merge (adjacent pairing == BLAKE3 tree).
     # One fixed-shape lax.scan over levels — NOT an unrolled width-shrinking
@@ -174,7 +222,7 @@ def blake3_batch(words: jax.Array, lengths: jax.Array) -> jax.Array:
             has_right = (2 * pair_idx + 1) < remaining[None, :]  # (half, B)
             is_root_pair = (pair_idx == 0) & (remaining[None, :] == 2)
             flags = jnp.where(is_root_pair, _u32(PARENT | ROOT), _u32(PARENT))
-            parent = compress(
+            parent = compress_k(
                 _iv_lanes((half, B)),
                 [left[w] for w in range(8)] + [right[w] for w in range(8)],
                 zero, zero + _u32(BLOCK_LEN), flags,
@@ -198,10 +246,12 @@ def blake3_batch(words: jax.Array, lengths: jax.Array) -> jax.Array:
     return jnp.stack(digest)
 
 
-def _single_chunk_root(words0: jax.Array, lengths: jax.Array) -> list[jax.Array]:
+def _single_chunk_root(words0: jax.Array, lengths: jax.Array,
+                       kernel: str = "xla") -> list[jax.Array]:
     """Digest for lanes whose whole message fits one chunk. ``words0``:
     (16, 16, B). One compression per block: non-final blocks chain the CV,
     each lane's final block takes CHUNK_END|ROOT and emits the digest."""
+    compress_k = _compress_fn(kernel)
     B = words0.shape[-1]
     chunk_len = jnp.clip(lengths, 0, CHUNK_LEN)
     n_blocks = jnp.maximum(1, (chunk_len + (BLOCK_LEN - 1)) // BLOCK_LEN)  # (B,)
@@ -215,7 +265,7 @@ def _single_chunk_root(words0: jax.Array, lengths: jax.Array) -> list[jax.Array]
         flags = jnp.where(j == 0, _u32(CHUNK_START), _u32(0)) | jnp.where(
             is_final, _u32(CHUNK_END | ROOT), _u32(0)
         )
-        out = compress(cv, [m[w] for w in range(16)], zero, block_len, flags)
+        out = compress_k(cv, [m[w] for w in range(16)], zero, block_len, flags)
         # chain only through non-final blocks (a non-final block is always full)
         new_cv = [jnp.where(j < n_blocks - 1, out[w], cv[w]) for w in range(8)]
         new_digest = [jnp.where(is_final, out[w], digest[w]) for w in range(8)]
@@ -226,17 +276,23 @@ def _single_chunk_root(words0: jax.Array, lengths: jax.Array) -> list[jax.Array]
     return digest
 
 
-@jax.jit
-def blake3_batch_rows(rows: jax.Array, lengths: jax.Array) -> jax.Array:
+def blake3_batch_rows(rows: jax.Array, lengths: jax.Array,
+                      kernel: str | None = None) -> jax.Array:
     """Row-major entry: ``rows`` is (B, C*256) uint32 — each row one message
     in natural byte order (the layout the native gather writes). The
     (block, word, chunk, batch) permutation the scan wants happens ON DEVICE,
     where a 120MB transpose is ~free, instead of in a host numpy transpose
     that used to dominate the pipeline profile."""
+    return _blake3_batch_rows_impl(rows, lengths, kernel=resolve_kernel(kernel))
+
+
+@functools.partial(jax.jit, static_argnames=("kernel",))
+def _blake3_batch_rows_impl(rows: jax.Array, lengths: jax.Array, *,
+                            kernel: str = "xla") -> jax.Array:
     B, W = rows.shape
     C = W // (BLOCKS_PER_CHUNK * 16)
     words = rows.reshape(B, C, BLOCKS_PER_CHUNK, 16).transpose(2, 3, 1, 0)
-    return blake3_batch(words, lengths)
+    return _blake3_batch_impl(words, lengths, kernel=kernel)
 
 
 # --------------------------------------------------------------------------
@@ -281,7 +337,8 @@ def _pad_to_tier(n: int) -> int:
     return -(-n // BATCH_TIERS[-1]) * BATCH_TIERS[-1]
 
 
-def blake3_batch_hex(messages: list[bytes], max_chunks: int | None = None) -> list[str]:
+def blake3_batch_hex(messages: list[bytes], max_chunks: int | None = None,
+                     kernel: str | None = None) -> list[str]:
     """Convenience one-shot: pack → device hash → hex digests. Pads the batch
     to a size tier (empty-message lanes) to bound compiled-shape count."""
     if not messages:
@@ -292,5 +349,6 @@ def blake3_batch_hex(messages: list[bytes], max_chunks: int | None = None) -> li
     B = len(messages)
     padded = messages + [b""] * (_pad_to_tier(B) - B)
     words, lengths = pack_messages(padded, max_chunks)
-    out = digests_to_hex(np.asarray(blake3_batch(jnp.asarray(words), jnp.asarray(lengths))))
+    out = digests_to_hex(np.asarray(
+        blake3_batch(jnp.asarray(words), jnp.asarray(lengths), kernel=kernel)))
     return out[:B]

@@ -1,0 +1,156 @@
+"""Hand-tiled Pallas BLAKE3 compression kernel — the register-resident path.
+
+The XLA kernel (blake3_jax.compress) expresses one compression as a 7-step
+``lax.scan`` whose body gathers the permuted message each round; XLA is then
+free to spill the 16 state words and 16 message words between rounds, and on
+TPU the scan carry round-trips through VMEM every step. This kernel removes
+both degrees of freedom, the way SIMD BLAKE3 implementations win on CPUs
+(keep rounds in registers, saturate vector lanes — arxiv 2508.05797):
+
+- **8×128 u32 lane tiles.** Lanes (independent compressions: chunk×batch in
+  phase 1, parent pairs in phase 2) are flattened and tiled to the VPU's
+  native (8, 128) uint32 shape; each grid step owns ``TILE_ROWS`` sublane
+  rows so the working set (16 state + 16 message words × tile) stays far
+  under VMEM.
+- **Rounds unrolled in registers.** The 7 rounds are unrolled inside the
+  kernel body — ~800 straight-line VPU ops per tile with no loop carry, so
+  Mosaic keeps the 32 live words in vector registers across rounds.
+- **Permutation baked into the schedule.** Instead of permuting the message
+  arrays between rounds, ``MSG_SCHEDULE[r]`` precomputes which original word
+  each G-slot reads in round ``r`` — the permutation costs zero data
+  movement (the same trick as the reference implementation's compile-time
+  round schedule).
+
+The chunk-chaining and merkle-merge orchestration stays in blake3_jax —
+this module only replaces the compression primitive, selected per call via
+``SD_BLAKE3_KERNEL=pallas`` (see blake3_jax.resolve_kernel). On non-TPU
+backends the kernel runs in Pallas interpret mode (pure-JAX evaluation), so
+byte-identical parity against the objects/blake3_ref.py oracle is provable
+on CPU while the device relay is down.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..objects.blake3_ref import IV, MSG_PERMUTATION
+
+_u32 = jnp.uint32
+
+#: sublane rows per grid step; 8×128 is the VPU's native u32 tile, and 8
+#: rows (1024 lanes) keeps per-tile VMEM (33 × 4 KiB blocks ≈ 132 KiB)
+#: comfortably double-bufferable
+TILE_ROWS = 8
+LANES = 128
+_TILE = TILE_ROWS * LANES
+
+
+def _schedule() -> tuple[tuple[int, ...], ...]:
+    """Per-round message word order: round r, slot s reads original word
+    ``schedule[r][s]``. Baking the permutation here means the kernel never
+    moves message data between rounds."""
+    rounds = [tuple(range(16))]
+    for _ in range(6):
+        prev = rounds[-1]
+        rounds.append(tuple(prev[p] for p in MSG_PERMUTATION))
+    return tuple(rounds)
+
+
+MSG_SCHEDULE = _schedule()
+
+
+def _rotr(x: jax.Array, n: int) -> jax.Array:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _g(v: list[jax.Array], a: int, b: int, c: int, d: int,
+       mx: jax.Array, my: jax.Array) -> None:
+    v[a] = v[a] + v[b] + mx
+    v[d] = _rotr(v[d] ^ v[a], 16)
+    v[c] = v[c] + v[d]
+    v[b] = _rotr(v[b] ^ v[c], 12)
+    v[a] = v[a] + v[b] + my
+    v[d] = _rotr(v[d] ^ v[a], 8)
+    v[c] = v[c] + v[d]
+    v[b] = _rotr(v[b] ^ v[c], 7)
+
+
+def _compress_kernel(cv_ref, m_ref, ctr_ref, blen_ref, flags_ref, out_ref):
+    """One tile of compressions: every array is (TILE_ROWS, 128) u32 lanes;
+    cv/m/out carry a leading word axis. Fully unrolled — no scan carry."""
+    v = [cv_ref[i] for i in range(8)]
+    v += [jnp.full((TILE_ROWS, LANES), w, _u32) for w in IV[:4]]
+    v += [ctr_ref[...], jnp.zeros((TILE_ROWS, LANES), _u32),
+          blen_ref[...], flags_ref[...]]
+    m = [m_ref[i] for i in range(16)]
+    for r in range(7):
+        s = MSG_SCHEDULE[r]
+        _g(v, 0, 4, 8, 12, m[s[0]], m[s[1]])
+        _g(v, 1, 5, 9, 13, m[s[2]], m[s[3]])
+        _g(v, 2, 6, 10, 14, m[s[4]], m[s[5]])
+        _g(v, 3, 7, 11, 15, m[s[6]], m[s[7]])
+        _g(v, 0, 5, 10, 15, m[s[8]], m[s[9]])
+        _g(v, 1, 6, 11, 12, m[s[10]], m[s[11]])
+        _g(v, 2, 7, 8, 13, m[s[12]], m[s[13]])
+        _g(v, 3, 4, 9, 14, m[s[14]], m[s[15]])
+    for i in range(8):
+        out_ref[i] = v[i] ^ v[i + 8]
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret (pure-JAX) evaluation: forced by SD_PALLAS_INTERPRET,
+    else on whenever the default backend isn't a real TPU. Read at trace
+    time — each jit cache entry captures the mode it was traced under."""
+    forced = os.environ.get("SD_PALLAS_INTERPRET", "").strip()
+    if forced:
+        return forced not in ("0", "false", "no")
+    return jax.default_backend() != "tpu"
+
+
+def compress_pallas(cv, m, counter, block_len, flags):
+    """Drop-in for blake3_jax.compress: same contract (list-of-8 cv, 16
+    message words as list or stacked array, broadcastable counter/len/flags;
+    returns the 8 output words at the broadcast lane shape).
+
+    Lanes are flattened, zero-padded up to a whole number of 8×128 tiles
+    (padding lanes compute garbage nobody reads), and the grid walks tiles.
+    """
+    if isinstance(m, (list, tuple)):
+        m = jnp.stack([jnp.asarray(w) for w in m])
+    lane_shape = jnp.broadcast_shapes(
+        cv[0].shape, m.shape[1:], jnp.shape(counter),
+        jnp.shape(block_len), jnp.shape(flags))
+    n = int(np.prod(lane_shape, dtype=np.int64)) if lane_shape else 1
+    padded = max(_TILE, -(-n // _TILE) * _TILE)
+    rows = padded // LANES
+
+    def lanes(x):
+        flat = jnp.broadcast_to(jnp.asarray(x).astype(_u32),
+                                lane_shape).reshape(n)
+        if padded != n:
+            flat = jnp.pad(flat, (0, padded - n))
+        return flat.reshape(rows, LANES)
+
+    cvf = jnp.stack([lanes(w) for w in cv])                       # (8, R, 128)
+    mf = jnp.stack([lanes(m[i]) for i in range(16)])              # (16, R, 128)
+    word3 = lambda nw: pl.BlockSpec(                              # noqa: E731
+        (nw, TILE_ROWS, LANES), lambda i: (0, i, 0),
+        memory_space=pltpu.VMEM)
+    lane2 = pl.BlockSpec((TILE_ROWS, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _compress_kernel,
+        grid=(rows // TILE_ROWS,),
+        in_specs=[word3(8), word3(16), lane2, lane2, lane2],
+        out_specs=word3(8),
+        out_shape=jax.ShapeDtypeStruct((8, rows, LANES), _u32),
+        interpret=interpret_mode(),
+    )(cvf, mf, lanes(counter), lanes(block_len), lanes(flags))
+    out = out.reshape(8, padded)[:, :n]
+    return [out[i].reshape(lane_shape) for i in range(8)]

@@ -1,0 +1,70 @@
+"""u32-VPU roofline model for the BLAKE3 kernel — MFU accounting.
+
+Kernel progress has so far been expressed against a 1-core CPU baseline
+(``vs_baseline`` in the bench), which says nothing about how much of the
+*chip* a kernel uses. This module pins the arithmetic-intensity model and
+the hardware peak so the bench can report MFU (model-flop-utilization, here
+model-op-utilization of the u32 VPU) per run.
+
+Ops/byte — the 12.5 model
+-------------------------
+One BLAKE3 compression processes a 64-byte block with 7 rounds × 8 G
+functions. Each G is 14 u32 VPU ops (6 adds, 4 xors, 4 rotates — a rotate
+is one VPU op on TPU, as on any machine with a hardware rotate/funnel
+shift), plus the 8 output-feedforward xors:
+
+    7 × 8 × 14 + 8 = 792 ≈ 800 ops / 64 B = 12.5 ops/byte
+
+Parent (merkle) compressions add ~1/16 on top (one parent per 1 KiB chunk
+pair); the model deliberately excludes them — the figure tracks *payload*
+bytes, so MFU is a slight underestimate, never flattered.
+
+Peak u32 ops/s
+--------------
+The VPU is an 8×128 lane grid with 4 ALUs per lane slot. At the ~940 MHz
+clock of a v4-class core that is
+
+    8 × 128 × 4 × 0.94e9 ≈ 3.85e12 u32 ops/s per core.
+
+Override with ``SD_TPU_PEAK_U32_OPS`` when the harness chip differs (the
+tunneled harness does not expose its chip generation; the default keeps
+MFU comparable across rounds until it does). The derived roofline for this
+model: peak_bytes/s = peak_ops/s ÷ 12.5 ≈ 308 GB/s device-resident — see
+docs/architecture/tpu-backend.md ("Roofline and MFU").
+"""
+
+from __future__ import annotations
+
+import os
+
+#: u32 VPU ops per payload byte (derivation above; rotate = 1 op)
+OPS_PER_BYTE = 12.5
+
+#: default per-core peak, v4-class VPU (8×128 lanes × 4 ALUs × 0.94 GHz)
+DEFAULT_PEAK_U32_OPS = 8 * 128 * 4 * 0.94e9  # ≈ 3.85e12
+
+
+def peak_u32_ops() -> float:
+    """Chip peak u32 ops/s — ``SD_TPU_PEAK_U32_OPS`` overrides the default
+    (read per call so bench subprocesses stay hermetic)."""
+    raw = os.environ.get("SD_TPU_PEAK_U32_OPS", "").strip()
+    if raw:
+        try:
+            value = float(raw)
+            if value > 0:
+                return value
+        except ValueError:
+            pass
+    return DEFAULT_PEAK_U32_OPS
+
+
+def roofline_bytes_per_sec() -> float:
+    """The compute roofline for BLAKE3 payload bytes: peak ÷ ops/byte."""
+    return peak_u32_ops() / OPS_PER_BYTE
+
+
+def mfu(bytes_per_sec: float) -> float:
+    """Achieved fraction of the u32 roofline for a measured payload rate."""
+    if bytes_per_sec <= 0:
+        return 0.0
+    return bytes_per_sec * OPS_PER_BYTE / peak_u32_ops()

@@ -23,6 +23,11 @@ shardings, let XLA insert the collectives. No hand-written NCCL-style p2p.
 
 Multi-host: `init_multihost()` wraps `jax.distributed.initialize`; the same
 mesh code then spans hosts with DCN between slices.
+
+Kernel selection: the sharded entry points trace `ops/blake3_jax` wrappers,
+so `SD_BLAKE3_KERNEL` (xla|pallas) is captured at FIRST trace per mesh (the
+lru_caches below memoize the jitted step) — set it before the first sharded
+call, the way dryrun_multichip's subprocess harness does.
 """
 
 from __future__ import annotations

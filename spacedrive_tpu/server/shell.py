@@ -216,8 +216,12 @@ class Server:
             ctype = {"core.ts": "text/typescript",
                      "procedures.js": "text/javascript",
                      "ui.css": "text/css"}[parts[1]]
+            # artifact reads follow the shell's off-loop rule: a cold-cache
+            # read (or a stalled mount) must not stall the accept loop
+            body = await asyncio.get_running_loop().run_in_executor(
+                self._pool, path.read_bytes)
             return Response(headers={"content-type": f"{ctype}; charset=utf-8"},
-                            body=path.read_bytes())
+                            body=body)
         if parts[0] == "spacedrive":
             return await self._custom_uri(req, parts[1:])
         raise HttpError(404)

@@ -1,0 +1,137 @@
+"""Opportunistic device recapture: stop gating measurement on bench timing.
+
+The relay has died mid-round twice, and every kernel built since has gone
+unmeasured because the only thing that ever ran the device suite was a
+human-triggered bench that happened to start while the relay was up. This
+module inverts that: a daemon thread polls ``jax_guard.relay_listening()``
+(a sub-second TCP check) and, on the FIRST recovery it observes, runs the
+device bench suite in a fresh subprocess and writes the record to
+``BENCH_device_opportunistic.json`` — so a relay that comes back at 3am
+still produces device numbers for the round.
+
+One-shot by design: the prize is *a* measurement, not a monitor. The
+subprocess matters — this process may already be pinned to the CPU platform
+(jax_guard) or hold a dead backend; a fresh interpreter probes and inits
+cleanly. Consumers:
+
+- ``bench.py``: starts a watcher when its device probe fails, so a relay
+  recovering mid-run (the combined suite runs for many minutes) is caught.
+- ``node.Node``: starts a watcher at boot when ``SD_OPPORTUNISTIC_BENCH``
+  is set and the accelerator probe came up empty — long-lived nodes are the
+  best vantage point for an eventual recovery.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+#: where the opportunistic record lands (next to the other BENCH_*.json)
+DEFAULT_OUT = Path(__file__).resolve().parents[2] / "BENCH_device_opportunistic.json"
+
+#: poll cadence; SD_OPPORTUNISTIC_INTERVAL overrides (tests use ~0.05s)
+DEFAULT_INTERVAL = 30.0
+
+
+def poll_interval() -> float:
+    raw = os.environ.get("SD_OPPORTUNISTIC_INTERVAL", "").strip()
+    try:
+        return max(0.01, float(raw)) if raw else DEFAULT_INTERVAL
+    except ValueError:
+        return DEFAULT_INTERVAL
+
+
+def run_device_suite(timeout: float = 1800.0) -> dict:
+    """Run the device-resident kernel bench in a fresh subprocess and return
+    its JSON record. Scrubs the parent's probe verdict (this process decided
+    'cpu' before the relay recovered — the child must re-probe) and caps the
+    recovery-wait (the relay is listening, so a long window is pointless)."""
+    env = dict(os.environ)
+    for key in ("SD_BENCH_DEVICE_VERDICT", "SD_BENCH_DEVICE_REASON"):
+        env.pop(key, None)
+    env["SD_BENCH_MODE"] = "device_kernel"
+    env.setdefault("SD_BENCH_RELAY_WAIT", "30")
+    bench = Path(__file__).resolve().parents[2] / "bench.py"
+    proc = subprocess.run([sys.executable, str(bench)], env=env,
+                          capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"device suite exited {proc.returncode}: {proc.stderr[-500:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+class RelayRecaptureWatcher:
+    """Poll relay liveness; on first recovery run ``on_recover`` once and
+    persist its record. Thread-safe start/stop; safe to stop before, during
+    or after recovery."""
+
+    def __init__(self, on_recover: Callable[[], dict] | None = None,
+                 interval: float | None = None,
+                 out_path: str | Path | None = None) -> None:
+        self.on_recover = on_recover or run_device_suite
+        self.interval = poll_interval() if interval is None else interval
+        self.out_path = Path(out_path) if out_path else DEFAULT_OUT
+        self.recovered = False
+        #: True while the one-shot capture (bench subprocess) is running —
+        #: owners consult this at shutdown to wait for an in-flight
+        #: measurement instead of abandoning it (the whole point of the
+        #: watcher) when the daemon thread would die with the process
+        self.capturing = False
+        self.record: dict | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "RelayRecaptureWatcher":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="sd-relay-recapture")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def _run(self) -> None:
+        from .jax_guard import relay_listening
+
+        while not self._stop.is_set():
+            alive = False
+            try:
+                alive = relay_listening()
+            except Exception:
+                logger.exception("relay liveness poll failed")
+            if alive:
+                self._recapture()
+                return
+            self._stop.wait(self.interval)
+
+    def _recapture(self) -> None:
+        logger.info("relay recovered — running opportunistic device suite")
+        self.capturing = True
+        try:
+            record = dict(self.on_recover() or {})
+        except Exception:
+            logger.exception("opportunistic device suite failed; the relay "
+                             "may have died again mid-measurement")
+            return
+        finally:
+            self.capturing = False
+        record.setdefault("captured_unix", round(time.time(), 1))
+        record.setdefault("trigger", "opportunistic-relay-recapture")
+        try:
+            self.out_path.write_text(json.dumps(record) + "\n")
+        except OSError:
+            logger.exception("could not write %s", self.out_path)
+        self.record = record
+        self.recovered = True
+        logger.info("opportunistic device record written to %s", self.out_path)

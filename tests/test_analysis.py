@@ -324,6 +324,20 @@ def test_cli_exits_zero_on_tree():
     assert main([]) == 0
 
 
+def test_module_run_exits_zero_as_tier1_gate():
+    """`python -m spacedrive_tpu.analysis` exactly as the driver runs it —
+    a subprocess wrapper so the ratchet (including argparse/entrypoint
+    wiring, not just main()) cannot silently regress outside the suite."""
+    import subprocess
+    import sys
+
+    repo = Path(__file__).resolve().parent.parent
+    proc = subprocess.run([sys.executable, "-m", "spacedrive_tpu.analysis"],
+                          cwd=str(repo), capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
 def test_cli_update_baseline_and_passes_filter(tmp_path, capsys):
     from spacedrive_tpu.analysis import main
 

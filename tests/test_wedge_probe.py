@@ -52,10 +52,13 @@ def _bench_env() -> dict:
     """Subprocess env with every bench verdict/assumption variable popped —
     a shell that previously ran bench.py exports SD_BENCH_DEVICE_VERDICT
     (and SD_ASSUME_DEVICE_OK short-circuits the probe), either of which
-    would make the cpu-fallback assertions below fail spuriously."""
+    would make the cpu-fallback assertions below fail spuriously.
+    SD_BLAKE3_KERNEL is scrubbed too: kernel selection must stay hermetic —
+    a shell that exported it (e.g. a pallas bench run) must not leak the
+    choice into subprocess assertions."""
     env = dict(os.environ)
     for key in ("SD_BENCH_DEVICE_VERDICT", "SD_BENCH_DEVICE_REASON",
-                "SD_ASSUME_DEVICE_OK"):
+                "SD_ASSUME_DEVICE_OK", "SD_BLAKE3_KERNEL"):
         env.pop(key, None)
     return env
 
