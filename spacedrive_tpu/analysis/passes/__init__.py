@@ -12,6 +12,7 @@ from .async_blocking import AsyncBlockingPass
 from .jax_wedge import JaxWedgePass
 from .legacy import BareExceptPass, DuplicateDefPass, UnusedImportPass
 from .lock_discipline import LockDisciplinePass
+from .pipeline_ordering import PipelineOrderingPass
 from .resource_leak import ResourceLeakPass
 from .swallowed import SwallowedExceptionPass
 
@@ -20,12 +21,13 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     UnusedImportPass,
     BareExceptPass,
     DuplicateDefPass,
-    # the five liveness/concurrency invariants
+    # the liveness/concurrency invariants
     JaxWedgePass,
     AsyncBlockingPass,
     LockDisciplinePass,
     ResourceLeakPass,
     SwallowedExceptionPass,
+    PipelineOrderingPass,
 )
 
 

@@ -1,0 +1,75 @@
+"""pipeline-ordering: DB writes in streaming-pipeline stages must go
+through the committer.
+
+The streaming executor (pipeline/executor.py) runs ``pipeline_page`` on the
+prefetch thread and ``pipeline_process`` on the dispatch thread; only
+``pipeline_commit`` runs on the job thread in strict batch order. A DB write
+from a prefetch/dispatch callable would race the committer and break the
+invariant the whole design rests on — commits (and the CRDT ops inside
+them) are ordered exactly like the sequential step loop, so pause/resume
+checkpoints and sync op-logs stay byte-identical.
+
+Mechanics: inside any function named ``pipeline_page`` or
+``pipeline_process`` (the executor's stage-naming convention, including
+nested helpers defined within them), flag
+
+- any ``.transaction(...)`` call — transactions belong to the committer;
+- write-surface calls (execute/executemany/insert/insert_ignore/
+  insert_many/update/upsert/delete) whose receiver is a DB handle (a name
+  chain ending in ``db``), so dict ``.update()`` and friends don't trip it.
+
+Reads (``db.query`` / ``db.find*``) are allowed anywhere — paging is the
+prefetcher's whole job.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+
+STAGE_NAMES = ("pipeline_page", "pipeline_process")
+
+WRITE_ATTRS = {"execute", "executemany", "insert", "insert_ignore",
+               "insert_many", "update", "upsert", "delete"}
+
+
+def _is_db_receiver(chain: str) -> bool:
+    """'db', 'self.db', 'ctx.library.db', … — the handle naming idiom."""
+    head = chain.rsplit(".", 1)[0] if "." in chain else ""
+    last = head.rsplit(".", 1)[-1] if head else ""
+    return last == "db" or last == "database"
+
+
+class PipelineOrderingPass(AnalysisPass):
+    id = "pipeline-ordering"
+    description = ("DB transactions/writes inside pipeline_page/"
+                   "pipeline_process stages (commits belong to the "
+                   "committer)")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in STAGE_NAMES:
+                continue
+            stage = node.name.removeprefix("pipeline_")
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call) \
+                        or not isinstance(call.func, ast.Attribute):
+                    continue
+                chain = dotted_name(call.func)
+                if chain is None:
+                    continue
+                attr = call.func.attr
+                if attr == "transaction":
+                    yield ctx.finding(
+                        call.lineno, self.id,
+                        f"'{chain}()' in pipeline {stage} stage — "
+                        f"transactions belong to pipeline_commit")
+                elif attr in WRITE_ATTRS and _is_db_receiver(chain):
+                    yield ctx.finding(
+                        call.lineno, self.id,
+                        f"DB write '{chain}()' in pipeline {stage} stage — "
+                        f"route it through pipeline_commit")

@@ -18,7 +18,13 @@ from __future__ import annotations
 import enum
 from typing import BinaryIO
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # dependency-gated: encrypt/decrypt raise at USE time
+    class AESGCM:  # type: ignore[no-redef]
+        def __init__(self, *_a: object, **_k: object) -> None:
+            raise RuntimeError(
+                "AEAD crypto requires the 'cryptography' package")
 
 from .primitives import AEAD_TAG_LEN, BLOCK_LEN, Protected, generate_nonce
 from .xchacha import XChaCha20Poly1305

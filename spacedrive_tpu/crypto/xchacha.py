@@ -15,7 +15,13 @@ from __future__ import annotations
 
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # dependency-gated: encrypt/decrypt raise at USE time
+    class ChaCha20Poly1305:  # type: ignore[no-redef]
+        def __init__(self, *_a: object, **_k: object) -> None:
+            raise RuntimeError(
+                "AEAD crypto requires the 'cryptography' package")
 
 _MASK = 0xFFFFFFFF
 _CONSTANTS = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)  # "expand 32-byte k"

@@ -27,5 +27,15 @@ def register_builtin_jobs() -> None:
     reference's name→type dispatch macro lists all types statically,
     job/manager.rs:376-401; this is the import-time equivalent)."""
     from ..locations import indexer_job  # noqa: F401
-    from ..objects import crypto_jobs, dedup, file_identifier, fs, validator  # noqa: F401
+    from ..objects import dedup, file_identifier, fs, validator  # noqa: F401
     from ..objects.media import processor  # noqa: F401
+    try:
+        from ..objects import crypto_jobs  # noqa: F401
+    except ImportError as e:
+        # dependency-gated (no ``cryptography``): the node still scans and
+        # syncs; a checkpointed encrypt/decrypt job on such an image cold-
+        # resumes as Canceled, which is the honest outcome
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "crypto jobs unavailable (%s); encrypt/decrypt not registered", e)

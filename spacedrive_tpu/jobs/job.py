@@ -61,6 +61,11 @@ class StatefulJob:
 
     NAME: ClassVar[str] = ""
     IS_BATCHED: ClassVar[bool] = False
+    #: dispatch lane (jobs/manager.py): each lane runs at most one job, so a
+    #: media-lane job can overlap the default lane's scan work without
+    #: breaking the single-writer discipline (writes still serialize on the
+    #: DB connection lock; the overlap is decode/IO/compute)
+    LANE: ClassVar[str] = "default"
     #: init_args keys REDACTED from every persisted checkpoint (job table
     #: rows live in the unencrypted library DB — a plaintext password in a
     #: report would defeat the encryption job that stored it). A job
@@ -91,6 +96,12 @@ class StatefulJob:
                  run_metadata: dict[str, Any]) -> dict[str, Any] | None:
         """Returns final metadata for the report."""
         return run_metadata or None
+
+    def pipeline_spec(self) -> Any | None:
+        """Batched jobs return a :class:`~spacedrive_tpu.pipeline.PipelineSpec`
+        to run their steps through the streaming executor (prefetch/dispatch/
+        commit overlapped); ``None`` keeps the sequential step loop."""
+        return None
 
     # registration for name→type dispatch at cold resume (manager.rs:376-401)
     def __init_subclass__(cls, **kw: Any) -> None:
@@ -203,6 +214,18 @@ class DynJob:
                          message=f"{self.job.NAME}: {len(state.steps)} steps")
             logger.debug("job %s init phase took %.3fs", self.job.NAME, time.perf_counter() - t0)
             ctx.check_commands(self)  # a pause during init checkpoints cleanly
+
+        spec = self.job.pipeline_spec()
+        if spec is not None:
+            from ..pipeline import PipelineExecutor, pipeline_enabled
+
+            if not pipeline_enabled():
+                spec = None
+        if spec is not None:
+            # streaming path: same step/checkpoint semantics, stages
+            # overlapped (pipeline/executor.py); commits stay ordered so the
+            # serialized state below is indistinguishable from sequential
+            PipelineExecutor(spec, ctx, self, errors).run()
 
         while state.step_number < len(state.steps):
             ctx.check_commands(self)

@@ -1,10 +1,15 @@
 """Jobs manager: ingest/dispatch queue with single-writer discipline.
 
-Equivalent of core/src/job/manager.rs. MAX_WORKERS stays 1 for the same reason
-as the reference ("db is single threaded, nerd", manager.rs:31-32): the library
-DB has one writer, and the parallelism that matters — batched hashing — happens
-*inside* a step on the TPU, not across jobs. Dedup by job hash (:109-114),
-queue overflow persisted as Queued reports (:162-177), chained-job completion
+Equivalent of core/src/job/manager.rs. MAX_WORKERS stays 1 *per lane* for the
+same reason as the reference ("db is single threaded, nerd", manager.rs:31-32):
+the library DB has one writer, and the parallelism that matters — batched
+hashing — happens *inside* a step on the TPU, not across jobs. Lanes
+(StatefulJob.LANE) are the one sanctioned cross-job overlap: the media lane
+runs thumbnail decode/encode (file I/O + compute, no sync ops) concurrently
+with the default lane's scan chain, so media processing for identified
+prefixes starts while the identifier is still hashing — DB writes still
+serialize on the connection lock. Dedup by job hash (:109-114), queue
+overflow persisted as Queued reports (:162-177), chained-job completion
 (:180-205), and cold resume of Paused/Running/Queued reports at startup
 (:269-319).
 """
@@ -66,6 +71,19 @@ class Jobs:
         self.ingest(library, head)
         return head.id
 
+    def _lane_load(self, lane: str) -> int:
+        """Running workers in ``lane`` (callers hold the lock)."""
+        return sum(1 for w in self._running.values()
+                   if w.dyn_job.job.LANE == lane)
+
+    def _pop_dispatchable(self) -> tuple["Library", DynJob] | None:
+        """First queued job whose lane has capacity (callers hold the lock)."""
+        for i, (lib, queued) in enumerate(self._queue):
+            if self._lane_load(queued.job.LANE) < MAX_WORKERS:
+                del self._queue[i]
+                return lib, queued
+        return None
+
     def ingest(self, library: "Library", dyn_job: DynJob) -> None:
         with self._lock:
             if self._shutting_down:
@@ -79,7 +97,7 @@ class Jobs:
                 if queued.hash() == new_hash:
                     raise JobAlreadyRunning(
                         f"job {dyn_job.job.NAME} already queued (hash {new_hash[:8]})")
-            if len(self._running) < MAX_WORKERS:
+            if self._lane_load(dyn_job.job.LANE) < MAX_WORKERS:
                 self._dispatch(library, dyn_job)
             else:
                 dyn_job.report.status = JobStatus.QUEUED
@@ -100,11 +118,14 @@ class Jobs:
                         self.ingest(library, next_job)
                     except JobAlreadyRunning as e:
                         logger.warning("chained job dropped: %s", e)
-                # refill any remaining capacity from the queue (the chained job
-                # may have been dropped by dedup, or may itself have queued)
-                while self._queue and len(self._running) < MAX_WORKERS:
-                    lib, queued = self._queue.popleft()
-                    self._dispatch(lib, queued)
+                # refill any remaining lane capacity from the queue (the
+                # chained job may have been dropped by dedup, or may itself
+                # have queued)
+                while True:
+                    entry = self._pop_dispatchable()
+                    if entry is None:
+                        break
+                    self._dispatch(*entry)
             if not self._running:
                 self._idle.set()
 
@@ -160,9 +181,9 @@ class Jobs:
             with self._lock:
                 if not self._running and not self._queue:
                     return True
-                if self._queue and len(self._running) < MAX_WORKERS:
-                    lib, queued = self._queue.popleft()
-                    self._dispatch(lib, queued)
+                entry = self._pop_dispatchable()
+                if entry is not None:
+                    self._dispatch(*entry)
 
     def shutdown(self, timeout: float = 30.0) -> None:
         """Graceful: every running job checkpoints (WorkerCommand::Shutdown →

@@ -28,6 +28,9 @@ class LocationsActor:
         self._lock = threading.Lock()
         self._online: set[tuple[str, int]] = set()  # (library_id, location_id)
         self._watchers: dict[tuple[str, int], object] = {}
+        #: media warm-start dedup: (library_id, location_id, prefix) already
+        #: handed to the media lane this process
+        self._warm_started: set[tuple[str, int, str]] = set()
         node.libraries.subscribe(self._on_library_event)
 
     def _on_library_event(self, event: str, library: "Library") -> None:
@@ -61,6 +64,35 @@ class LocationsActor:
     def online_ids(self, library_id: str) -> list[int]:
         with self._lock:
             return sorted(loc for lib, loc in self._online if lib == library_id)
+
+    def media_warm_start(self, library: "Library", location_id: int,
+                         prefixes: set[str]) -> None:
+        """Start media processing for freshly identified prefixes instead of
+        waiting for the whole identify job: spawns one media-lane
+        MediaProcessorJob per new prefix (jobs/manager.py lanes), which runs
+        concurrently with the default-lane scan chain. Best-effort — dedup
+        by prefix per process, JobAlreadyRunning swallowed — because the
+        chained whole-location media job sweeps up anything missed."""
+        from ..jobs.error import JobAlreadyRunning
+        from ..objects.media.processor import MediaProcessorJob
+
+        jobs = getattr(self.node, "jobs", None)
+        if jobs is None:
+            return
+        for prefix in sorted(prefixes):
+            key = (library.id, location_id, prefix)
+            with self._lock:
+                if key in self._warm_started:
+                    continue
+                self._warm_started.add(key)
+            try:
+                jobs.spawn(library, [MediaProcessorJob(
+                    {"location_id": location_id, "sub_path": prefix})],
+                    action="media_warm_start")
+            except JobAlreadyRunning:
+                pass
+            except Exception:
+                logger.exception("media warm-start failed for %s", prefix)
 
     def _start_watcher(self, library: "Library", location_id: int) -> None:
         if not getattr(self.node, "watch_locations", True):

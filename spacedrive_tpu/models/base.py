@@ -193,8 +193,14 @@ class Database:
         self.models = list(models)
         self._lock = threading.RLock()
         # autocommit mode; transactions are managed explicitly by _Txn so a
-        # single connection can serve both one-shot writes and atomic batches
-        self._conn = sqlite3.connect(self.path, check_same_thread=False, isolation_level=None)
+        # single connection can serve both one-shot writes and atomic batches.
+        # cached_statements: the sync-ingest hot loop cycles through dozens of
+        # IN(...) shapes per window (one per chunk size × table) plus the
+        # apply/log statements — the sqlite3 default of 128 thrashes at
+        # production pull windows, re-preparing statements per batch
+        self._conn = sqlite3.connect(self.path, check_same_thread=False,
+                                     isolation_level=None,
+                                     cached_statements=512)
         self._conn.row_factory = sqlite3.Row
         self._txn_depth = 0
         self._conn.execute("PRAGMA journal_mode=WAL")

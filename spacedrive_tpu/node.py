@@ -101,17 +101,26 @@ class Node:
         self.libraries = Libraries(self.data_dir, node=self)
         self.locations = None  # attached by locations layer
         self.p2p = None  # attached by p2p layer
-        from .crypto.keymanager import KeyManager
-
-        self.key_manager = KeyManager(self.data_dir / "keystore.json")
         try:
-            # keyring-backed auto-unlock (crates/crypto keys/keyring role):
-            # no-op unless the user enabled it on this keystore
-            if self.key_manager.try_auto_unlock():
-                logger.info("key manager auto-unlocked from the OS keyring")
-        except Exception:
-            logger.exception("keyring auto-unlock failed; password unlock "
-                             "still available")
+            from .crypto.keymanager import KeyManager
+
+            self.key_manager = KeyManager(self.data_dir / "keystore.json")
+        except ImportError as e:
+            # dependency-gated (no ``cryptography`` in the image): the node
+            # runs scans/sync/media without a key manager; crypto jobs and
+            # key routes fail at use instead of wedging boot
+            logger.warning("crypto stack unavailable (%s); key manager "
+                           "disabled", e)
+            self.key_manager = None
+        if self.key_manager is not None:
+            try:
+                # keyring-backed auto-unlock (crates/crypto keys/keyring
+                # role): no-op unless the user enabled it on this keystore
+                if self.key_manager.try_auto_unlock():
+                    logger.info("key manager auto-unlocked from the OS keyring")
+            except Exception:
+                logger.exception("keyring auto-unlock failed; password unlock "
+                                 "still available")
         from .objects.gc import ThumbnailRemoverActor
 
         self.thumbnail_remover = ThumbnailRemoverActor(self)

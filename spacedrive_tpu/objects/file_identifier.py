@@ -12,6 +12,17 @@ files per step with per-file tokio tasks; here a step gathers sampled messages
 for BATCH_SIZE files and hashes them in one fused device call via the
 location's hasher backend. Within-batch duplicates collapse to one object
 (the reference creates one object per path and converges on later scans).
+
+Each step is split into the three streaming-pipeline stages
+(pipeline/executor.py): ``pipeline_page`` (cursor SELECT + sample-message
+gather, read-only), ``pipeline_process`` (the hash batch), and
+``pipeline_commit`` (the transaction + CRDT ops + cursor advance). The
+sequential path runs the same three callables back-to-back, so pipelined and
+sequential runs produce byte-identical DB state and op order
+(tests/test_pipeline.py). The committer also warm-starts media processing:
+prefixes whose identified rows carry thumbnailable extensions are handed to
+LocationsActor.media_warm_start, which spawns media-lane jobs that overlap
+the rest of the identify run instead of waiting for it to finish.
 """
 
 from __future__ import annotations
@@ -24,7 +35,20 @@ from typing import Any
 from ..jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
 from ..models import FilePath, Location, Object, utc_now
 from ..sync.crdt import ref
-from .hasher import get_hasher
+from .cas import read_sampled_batch_fast as read_sampled_batch
+from .hasher import HybridHasher, get_hasher
+
+_THUMBABLE_EXTS: list = []
+
+
+def _thumbable_exts() -> set[str]:
+    """Memoized thumbnailable-extension set (media/processor.py) — consulted
+    once per committed batch on the scan hot path."""
+    if not _THUMBABLE_EXTS:
+        from .media.processor import _thumbable_extensions
+
+        _THUMBABLE_EXTS.append(_thumbable_extensions())
+    return _THUMBABLE_EXTS[0]
 
 
 def ref_obj(pub_id: str):
@@ -68,30 +92,52 @@ class FileIdentifierJob(StatefulJob):
                 # production scan never takes a known-losing path on hosts
                 # where transfers are slow (the bench measures both regimes)
                 "hasher": location.get("hasher") or "hybrid", "cursor": 0,
-                "sub_path": self.init_args.get("sub_path")}
+                "sub_path": self.init_args.get("sub_path"),
+                "preview_media":
+                    location.get("generate_preview_media") is not False}
         return data, steps, {"total_orphan_paths": count, "created_objects": 0,
                              "linked_objects": 0, "hash_time": 0.0}
 
+    def pipeline_spec(self):
+        from ..pipeline import PipelineSpec
+
+        return PipelineSpec(page=self.pipeline_page,
+                            process=self.pipeline_process,
+                            commit=self.pipeline_commit)
+
     def execute_step(self, ctx: WorkerContext, data: dict, step: dict,
                      step_number: int) -> StepResult:
+        # the sequential path IS the pipeline, stages run back-to-back —
+        # one implementation, two schedules
+        scratch = {"cursor": data["cursor"]}
+        batch = self.pipeline_page(ctx, data, scratch)
+        if batch is None:
+            return StepResult()
+        return self.pipeline_commit(ctx, data,
+                                    self.pipeline_process(ctx, data, batch))
+
+    # -- stage 1: prefetch (DB reads + file I/O only) ------------------------
+    def pipeline_page(self, ctx: WorkerContext, data: dict,
+                      scratch: dict) -> dict | None:
         db = ctx.library.db
+        cursor = scratch.get("cursor", data["cursor"])
         where, params = _orphan_where(data["location_id"], data.get("sub_path"))
         # only the columns this step consumes, undecoded: size_in_bytes and
         # is_dir are ints, date_created stays an ISO string (Model.encode
         # passes strings through on re-insert) — a SELECT * + full
-        # decode_row costs ~15% of the whole identify pass at 100k files
+        # decode_row costs ~15% of the whole identify pass at 100k files.
+        # The speculative cursor rides in ``scratch``: rows at id <= cursor
+        # are untouched by later commits, so speculative pages see exactly
+        # the row sets the sequential loop would
         rows = [dict(r) for r in db.query(
             f"SELECT id, pub_id, name, extension, materialized_path, is_dir, "
             f"size_in_bytes, date_created FROM file_path "
             f"WHERE {where} AND id > ? ORDER BY id LIMIT ?",
-            params + [data["cursor"], BATCH_SIZE],
+            params + [cursor, BATCH_SIZE],
         )]
         if not rows:
-            return StepResult()
-        data["cursor"] = rows[-1]["id"]
-
-        location_path = data["location_path"]
-        errors: list[str] = []
+            return None
+        scratch["cursor"] = rows[-1]["id"]
 
         hashable, empty = [], []
         for row in rows:
@@ -100,15 +146,65 @@ class FileIdentifierJob(StatefulJob):
             else:
                 empty.append(row)  # "We can't do shit with empty files"
 
+        location_path = data["location_path"]
         t0 = time.perf_counter()
+        messages = read_sampled_batch(
+            [_abs_path(location_path, r) for r in hashable],
+            [r["size_in_bytes"] for r in hashable])
+        # the cas message is size_le_8 ‖ header ‖ … — its head IS the file's
+        # first bytes, so magic-byte kind resolution rides the gather for
+        # free instead of re-opening every file on the commit thread (the
+        # single hottest commit cost at 100k files: one open+read per object)
+        from .magic import HEADER_LEN
+
+        for row, msg in zip(hashable, messages):
+            row["_kind_head"] = (None if isinstance(msg, Exception)
+                                 else bytes(msg[8:8 + HEADER_LEN]))
+        for row in empty:
+            row["_kind_head"] = b""  # what _read_head returns for empty files
+        return {"cursor": rows[-1]["id"], "hashable": hashable, "empty": empty,
+                "messages": messages, "gather_s": time.perf_counter() - t0}
+
+    # -- stage 2: dispatch (device/CPU compute) ------------------------------
+    def pipeline_process(self, ctx: WorkerContext, data: dict,
+                         batch: dict) -> dict:
+        from .cas import MINIMUM_FILE_SIZE
+
         hasher = get_hasher(data.get("hasher"), node=ctx.node)
-        paths = [_abs_path(location_path, r) for r in hashable]
-        sizes = [r["size_in_bytes"] for r in hashable]
-        cas_results = hasher.hash_batch(paths, sizes)
-        hash_time = time.perf_counter() - t0
+        hashable = batch["hashable"]
+        t0 = time.perf_counter()
+        #: _probe_rates needs k = sampled//2 >= 8 files per engine slice —
+        #: below that the fused call can't conclude a probe, so re-reading
+        #: the files it would do is pure waste (the gather already ran)
+        probe_worthy = sum(1 for r in hashable
+                           if r["size_in_bytes"] > MINIMUM_FILE_SIZE) >= 16
+        if getattr(hasher, "_cpu_rate", None) is None \
+                and isinstance(hasher, HybridHasher) \
+                and hasher._cpu._fast is not None and probe_worthy:
+            # unprobed hybrid: run this batch through the fused path so the
+            # engine probe happens (the gather above left the page cache
+            # warm); later batches take the gathered route with the verdict
+            location_path = data["location_path"]
+            cas_results = hasher.hash_batch(
+                [_abs_path(location_path, r) for r in hashable],
+                [r["size_in_bytes"] for r in hashable])
+        else:
+            cas_results = hasher.hash_gathered(batch["messages"])
+        batch["cas_results"] = cas_results
+        batch["hash_s"] = time.perf_counter() - t0
+        batch["messages"] = None  # the gather buffers are dead weight now
+        return batch
+
+    # -- stage 3: commit (the only stage that writes) ------------------------
+    def pipeline_commit(self, ctx: WorkerContext, data: dict,
+                        batch: dict) -> StepResult:
+        db = ctx.library.db
+        location_path = data["location_path"]
+        hashable, empty = batch["hashable"], batch["empty"]
+        errors: list[str] = []
 
         identified: list[tuple[dict, str]] = []
-        for row, cas in zip(hashable, cas_results):
+        for row, cas in zip(hashable, batch["cas_results"]):
             if isinstance(cas, Exception):
                 errors.append(f"{_abs_path(location_path, row)}: {cas!r}")
             else:
@@ -193,23 +289,51 @@ class FileIdentifierJob(StatefulJob):
                 sync.log_ops(ops)
         if emit and ops:
             sync.created()
+        # the checkpoint cursor advances ONLY here, after the transaction
+        # committed — a pause/crash resumes at the last committed batch
+        data["cursor"] = batch["cursor"]
 
+        self._media_warm_start(ctx, data, identified)
         ctx.progress(message=f"identified {len(identified)} files "
                              f"({created} new objects, {linked} linked)")
         return StepResult(metadata={"created_objects": created,
                                     "linked_objects": linked,
-                                    "hash_time": hash_time},
+                                    "hash_time": batch["hash_s"],
+                                    "gather_s": batch["gather_s"]},
                           errors=errors)
+
+    def _media_warm_start(self, ctx: WorkerContext, data: dict,
+                          identified: list[tuple[dict, str]]) -> None:
+        """Hand freshly identified thumbnailable prefixes to the locations
+        actor so media-lane jobs start while this job is still hashing the
+        rest of the location. Best-effort: the chained whole-location media
+        job still sweeps up stragglers (existing thumbnails are skipped)."""
+        node = getattr(ctx, "node", None)
+        actor = getattr(node, "locations", None)
+        if actor is None or not data.get("preview_media", True):
+            return
+        exts = _thumbable_exts()
+        prefixes = set()
+        for row, _cas in identified:
+            if (row.get("extension") or "").lower() in exts:
+                mp = (row.get("materialized_path") or "/").strip("/")
+                if mp:
+                    prefixes.add(mp.split("/")[0])
+        if prefixes:
+            actor.media_warm_start(ctx.library, data["location_id"], prefixes)
 
     def _object_row(self, row: dict, location_path: str | None) -> dict:
         from .magic import resolve_kind
 
         # magic-byte disambiguation for conflicting/unknown extensions
-        # (file_identifier/mod.rs:75 → magic.rs)
+        # (file_identifier/mod.rs:75 → magic.rs); the head bytes came with
+        # the gather (``_kind_head``) so this never touches the disk — the
+        # path fallback only fires for rows that skipped the page stage
         kind = resolve_kind(
             row.get("extension"),
             _abs_path(location_path, row) if location_path else None,
-            bool(row.get("is_dir")))
+            bool(row.get("is_dir")),
+            head=row.get("_kind_head"))
         return {"pub_id": str(uuid.uuid4()), "kind": kind,
                 "date_created": row.get("date_created") or utc_now()}
 

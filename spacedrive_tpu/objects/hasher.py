@@ -47,6 +47,9 @@ class HasherBackend(Protocol):
     def hash_batch(self, paths: list[str | Path],
                    sizes: list[int]) -> list[str | Exception]: ...
 
+    def hash_gathered(self,
+                      messages: list[bytes | Exception]) -> list[str | Exception]: ...
+
 
 class CpuHasher:
     """Scalar reference path; byte-exact oracle (objects/cas.py). The native
@@ -67,6 +70,13 @@ class CpuHasher:
             except (OSError, EOFError) as e:
                 out.append(e)
         return out
+
+    def hash_gathered(self,
+                      messages: list[bytes | Exception]) -> list[str | Exception]:
+        """Hash pre-gathered cas messages (the pipelined prefetcher already
+        did the file I/O): native C++ BLAKE3 batch, python oracle fallback.
+        Exception entries (gather failures) pass through in place."""
+        return _hash_gathered_messages(messages, _native_hex_batch())
 
 
 #: files per device sub-batch in the pipelined sampled path
@@ -169,6 +179,14 @@ class TpuHasher:
 
         return blake3_batch_hex(msgs, max_chunks=cap)
 
+    def hash_gathered(self,
+                      messages: list[bytes | Exception]) -> list[str | Exception]:
+        """Pre-gathered messages through the device bucket path (sampled
+        57-chunk messages land in the 64-chunk bucket; same digests as the
+        fused row pipeline, the message is identical either way)."""
+        return _hash_gathered_messages(
+            messages, lambda msgs: _bucketed_hash(msgs, self._hash_bucket))
+
     # hooks the sharded variant overrides
     def _pad_lanes(self, n: int) -> int:
         return n
@@ -220,6 +238,37 @@ class HybridHasher:
                                    [sizes[i] for i in idxs])
         for i, r in zip(idxs, res):
             out[i] = r
+
+    def hash_gathered(self,
+                      messages: list[bytes | Exception]) -> list[str | Exception]:
+        """Gathered-message route inherits the engine verdict from the last
+        ``hash_batch`` probe; an unprobed process routes native — the safe
+        default on wire-limited rigs (the pipelined identifier runs its
+        first batch through ``hash_batch`` precisely so the probe happens).
+        With no native lib there is nothing to race — mirror hash_batch's
+        routing to the device path, never the python oracle."""
+        if self._cpu._fast is None:
+            return self._tpu.hash_gathered(messages)
+        if not (self._cpu_rate is not None and self._device_rate is not None
+                and self._device_rate > self._cpu_rate):
+            return self._cpu.hash_gathered(messages)
+        # device won the probe: mirror hash_batch's small/sampled split —
+        # short messages stay on native CPU (IO-bound work whose varied
+        # lengths would fan the device path across many bucket shapes);
+        # sampled-class messages take the device
+        big = [i for i, m in enumerate(messages)
+               if not isinstance(m, Exception) and len(m) >= SAMPLED_MESSAGE_LEN]
+        if not big:
+            return self._cpu.hash_gathered(messages)
+        big_set = set(big)
+        rest = [i for i in range(len(messages)) if i not in big_set]
+        out: list[str | Exception] = [None] * len(messages)  # type: ignore[list-item]
+        for idxs, backend in ((big, self._tpu), (rest, self._cpu)):
+            if idxs:
+                for i, r in zip(idxs, backend.hash_gathered(
+                        [messages[i] for i in idxs])):
+                    out[i] = r
+        return out
 
     def _probe_rates(self, paths, sizes, sampled: list[int],
                      out: list) -> list[int] | None:
@@ -436,6 +485,45 @@ def get_hasher(name: str | None, node=None) -> HasherBackend:
     return _instances[name]
 
 
+def _native_hex_batch():
+    """The C++ ``blake3_hex_batch`` entry point, or None (probe memoized —
+    a failed import involves a g++ attempt and must not re-run per batch)."""
+    if not _NATIVE_HEX:
+        try:
+            from ..native import cas_native
+
+            _NATIVE_HEX.append(cas_native.blake3_hex_batch)
+        except Exception:
+            _NATIVE_HEX.append(None)
+    return _NATIVE_HEX[0]
+
+
+_NATIVE_HEX: list = []
+
+
+def _hash_gathered_messages(messages: list[bytes | Exception],
+                            hex_batch) -> list[str | Exception]:
+    """Shared gathered-message driver: Exception entries pass through in
+    place, ok messages go through ``hex_batch(list[bytes]) -> list[hex]``
+    (or the python oracle when it is None); cas_ids are the 16-hex prefix."""
+    out: list[str | Exception] = [None] * len(messages)  # type: ignore[list-item]
+    ok = [j for j, m in enumerate(messages) if not isinstance(m, Exception)]
+    for j, m in enumerate(messages):
+        if isinstance(m, Exception):
+            out[j] = m
+    if not ok:
+        return out
+    if hex_batch is not None:
+        hexes = hex_batch([messages[j] for j in ok])
+    else:
+        from .blake3_ref import blake3
+
+        hexes = [blake3(messages[j]).hex() for j in ok]
+    for j, h in zip(ok, hexes):
+        out[j] = h[:16]
+    return out
+
+
 def _bucketed_hash(messages: list[bytes], hash_bucket) -> list[str]:
     """Bucket variable-size cas messages by chunk count and hash each
     bucket through ``hash_bucket(msgs, cap)``; returns 16-hex cas_ids in
@@ -529,8 +617,13 @@ class RemoteHasher:
 
     def hash_batch(self, paths: list[str | Path],
                    sizes: list[int]) -> list[str | Exception]:
-        out: list[str | Exception | None] = [None] * len(paths)
-        messages = read_sampled_batch(paths, sizes)
+        return self.hash_gathered(read_sampled_batch(paths, sizes))
+
+    def hash_gathered(self,
+                      messages: list[bytes | Exception]) -> list[str | Exception]:
+        """The natural fit for the pipelined gather: this backend always
+        worked on cas messages (only samples travel, never whole files)."""
+        out: list[str | Exception | None] = [None] * len(messages)
         todo: list[int] = []
         for i, msg in enumerate(messages):
             if isinstance(msg, Exception):
@@ -560,8 +653,7 @@ class RemoteHasher:
 
         if failed:
             local = get_hasher("hybrid")
-            results = local.hash_batch([paths[i] for i in failed],
-                                       [sizes[i] for i in failed])
+            results = local.hash_gathered([messages[i] for i in failed])
             for i, r in zip(failed, results):
                 out[i] = r
         return out  # type: ignore[return-value]

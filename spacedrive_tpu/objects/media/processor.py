@@ -1,8 +1,22 @@
 """MediaProcessorJob: thumbnails + media metadata, chained after identify.
 
-Mirrors core/src/object/media/media_processor/job.rs — BATCH_SIZE = 10
-(:34); per entry: thumbnail into the sharded cache + EXIF rows; emits
-``new_thumbnail`` CoreEvents as previews land.
+Mirrors core/src/object/media/media_processor/job.rs — but the reference's
+``BATCH_SIZE = 10`` (:34) is a scalar-CPU tuning; here the step is the
+device batch (256 entries), sized so the batched resize amortizes one
+dispatch per step and the pipelined stages have real work to overlap.
+Thumbnails always route through ``generate_thumbnails_batched``, which
+carries the get_hasher-style engine verdict internally (device resize when
+it measures faster, the scalar PIL path otherwise — on CPU fallback that
+means PIL, never a losing jax resize).
+
+Runs in the **media lane** (jobs/manager.py): decode/encode and EXIF
+extraction are file I/O + compute with no sync ops, so media jobs overlap
+the default lane's scan chain — LocationsActor.media_warm_start spawns one
+per identified prefix while the identifier is still hashing.
+
+Step execution is split into the streaming-pipeline stages: ``pipeline_page``
+(row fetch, read-only), ``pipeline_process`` (decode → resize → encode +
+EXIF), ``pipeline_commit`` (MediaData upserts + ``new_thumbnail`` events).
 """
 
 from __future__ import annotations
@@ -18,11 +32,13 @@ from .thumbnail import (can_generate_thumbnail, generate_thumbnail,
 
 logger = logging.getLogger(__name__)
 
-BATCH_SIZE = 10
+BATCH_SIZE = 256
 
 
 class MediaProcessorJob(StatefulJob):
     NAME = "media_processor"
+    IS_BATCHED = True
+    LANE = "media"
 
     def init(self, ctx: WorkerContext):
         db = ctx.library.db
@@ -55,70 +71,109 @@ class MediaProcessorJob(StatefulJob):
         return data, steps, {"thumbnails_created": 0, "media_data_extracted": 0,
                              "media_time": 0.0}
 
+    def pipeline_spec(self):
+        from ...pipeline import PipelineSpec
+
+        return PipelineSpec(page=self.pipeline_page,
+                            process=self.pipeline_process,
+                            commit=self.pipeline_commit)
+
     def execute_step(self, ctx: WorkerContext, data: dict, step: dict,
                      step_number: int) -> StepResult:
-        from ...config import BackendFeature
+        scratch = {"steps": [step], "step_index": 0}
+        batch = self.pipeline_page(ctx, data, scratch)
+        if batch is None:
+            return StepResult()
+        return self.pipeline_commit(ctx, data,
+                                    self.pipeline_process(ctx, data, batch))
+
+    # -- stage 1: prefetch (row fetch, read-only) ----------------------------
+    def pipeline_page(self, ctx: WorkerContext, data: dict,
+                      scratch: dict) -> dict | None:
         from ..file_identifier import _abs_path
 
+        i = scratch.get("step_index", 0)
+        steps = scratch.get("steps") or []
+        if i >= len(steps):
+            return None
+        scratch["step_index"] = i + 1
         db = ctx.library.db
-        node = ctx.library.node
-        data_dir = node.data_dir if node else "."
-        use_device = (node is not None
-                      and node.config.has_feature(BackendFeature.TPU_THUMBNAILS))
-        errors: list[str] = []
-        thumbs = 0
-        extracted = 0
-        t0 = time.perf_counter()
 
         entries = []  # (row, path, ext)
-        for fp_id in step["ids"]:
+        for fp_id in steps[i]["ids"]:
             row = db.find_one(FilePath, {"id": fp_id})
             if row is None or not row.get("cas_id"):
                 continue
             entries.append((row, _abs_path(data["location_path"], row),
                             (row.get("extension") or "").lower()))
+        return {"entries": entries}
 
+    # -- stage 2: dispatch (decode → resize → encode + EXIF, no DB) ----------
+    def pipeline_process(self, ctx: WorkerContext, data: dict,
+                         batch: dict) -> dict:
+        from ...config import BackendFeature
+
+        node = ctx.library.node
+        data_dir = node.data_dir if node else "."
+        errors: list[str] = []
+        t0 = time.perf_counter()
+        entries = batch["entries"]
+
+        # the step IS the device batch: routed resize calls per step
+        # (generate_thumbnails_batched chunks to RESIZE_SUB_BATCH and falls
+        # back to scalar PIL when the device path loses or is absent). The
+        # tpuThumbnails feature stays the operator opt-in for device resize:
+        # off → the scalar pipeline, exactly the pre-lane behavior
+        allow_device = (node is not None
+                        and node.config.has_feature(BackendFeature.TPU_THUMBNAILS))
         made: dict[str, object] = {}
-        if use_device:
-            # the step IS the device batch: one resize call per 10 entries
-            try:
-                made = generate_thumbnails_batched(
-                    [(path, row["cas_id"], ext)
-                     for row, path, ext in entries if can_generate_thumbnail(ext)],
-                    data_dir)
-            except Exception as e:
-                errors.append(f"batched thumbnails: {e!r}")
-                use_device = False
+        try:
+            made = generate_thumbnails_batched(
+                [(path, row["cas_id"], ext)
+                 for row, path, ext in entries if can_generate_thumbnail(ext)],
+                data_dir, allow_device=allow_device)
+        except Exception as e:
+            errors.append(f"batched thumbnails: {e!r}")
 
+        thumbed: list[str] = []  # cas_ids with a fresh/preserved thumbnail
+        media_rows: list[tuple[int, dict]] = []  # (object_id, media fields)
+        extracted = 0
         for row, path, ext in entries:
             try:
                 if can_generate_thumbnail(ext):
-                    if use_device:
-                        out = made.get(row["cas_id"])
+                    out = made.get(row["cas_id"])
+                    if out is None:
+                        # batch skipped it (decode/encode failed): scalar
+                        # retry, and the failure goes on record
+                        out = generate_thumbnail(path, data_dir,
+                                                 row["cas_id"], ext)
                         if out is None:
-                            # device batch skipped it (decode/encode failed):
-                            # scalar retry, and the failure goes on record
-                            out = generate_thumbnail(path, data_dir,
-                                                     row["cas_id"], ext)
-                            if out is None:
-                                errors.append(f"{path}: thumbnail failed "
-                                              f"(device batch + scalar retry)")
-                    else:
-                        out = generate_thumbnail(path, data_dir, row["cas_id"], ext)
+                            errors.append(f"{path}: thumbnail failed "
+                                          f"(batched + scalar retry)")
                     if out is not None:
-                        thumbs += 1
-                        ctx.library.emit("new_thumbnail", {"cas_id": row["cas_id"]})
+                        thumbed.append(row["cas_id"])
                 media = extract_media_data(path, ext)
                 if media and row.get("object_id"):
-                    db.upsert(MediaData, {"object_id": row["object_id"]},
-                              media, media)
+                    media_rows.append((row["object_id"], media))
                     extracted += 1
             except Exception as e:
                 errors.append(f"{path}: {e!r}")
-        return StepResult(metadata={"thumbnails_created": thumbs,
-                                    "media_data_extracted": extracted,
-                                    "media_time": time.perf_counter() - t0},
-                          errors=errors)
+        return {"thumbed": thumbed, "media_rows": media_rows,
+                "extracted": extracted, "errors": errors,
+                "media_time": time.perf_counter() - t0}
+
+    # -- stage 3: commit (MediaData upserts + events) ------------------------
+    def pipeline_commit(self, ctx: WorkerContext, data: dict,
+                        batch: dict) -> StepResult:
+        db = ctx.library.db
+        for object_id, media in batch["media_rows"]:
+            db.upsert(MediaData, {"object_id": object_id}, media, media)
+        for cas_id in batch["thumbed"]:
+            ctx.library.emit("new_thumbnail", {"cas_id": cas_id})
+        return StepResult(metadata={"thumbnails_created": len(batch["thumbed"]),
+                                    "media_data_extracted": batch["extracted"],
+                                    "media_time": batch["media_time"]},
+                          errors=batch["errors"])
 
     def finalize(self, ctx: WorkerContext, data: dict, run_metadata: dict):
         ctx.library.emit("invalidate_query", {"key": "search.paths"})

@@ -427,33 +427,117 @@ def _scalar_all(entries, data_dir: str | Path) -> dict:
     return out_paths
 
 
-def generate_thumbnails_batched(entries, data_dir: str | Path):
-    """Batch thumbnail generation: host decode → ONE device bilinear-resize
-    call over the pad-and-mask batch → host WebP encode.
+def _device_resize_allowed() -> bool:
+    """get_hasher-style routing gate for the batched resize: the sticky
+    verdict when measured, else False outright on hosts with no accelerator
+    platform — a jnp resize on pinned CPU loses to PIL by an order of
+    magnitude (0.11× in BENCH_r05), so the fallback must never even decode
+    for the device."""
+    if _DEVICE_VERDICT["value"] is not None:
+        return _DEVICE_VERDICT["value"]
+    from ...objects.hasher import _accelerator_available
+
+    if not _accelerator_available():
+        with _VERDICT_LOCK:
+            if _DEVICE_VERDICT["value"] is None:
+                logger.info("thumbnail routing: no accelerator platform — "
+                            "scalar PIL path for this process")
+                _DEVICE_VERDICT["value"] = False
+        return _DEVICE_VERDICT["value"]
+    return True  # real accelerator, unmeasured: let the warm batch decide
+
+
+def _pil_resize_all(arrays) -> list:
+    """Scalar resize of decoded RGB arrays, dimension-identical to the
+    device kernel (same target_dims math)."""
+    import numpy as np
+    from PIL import Image
+
+    from ...ops.resize_jax import target_dims
+
+    out = []
+    for arr in arrays:
+        th, tw = target_dims(arr.shape[1], arr.shape[0])
+        if (th, tw) == (arr.shape[0], arr.shape[1]):
+            out.append(arr)
+        else:
+            out.append(np.asarray(
+                Image.fromarray(arr).resize((tw, th), Image.BILINEAR)))
+    return out
+
+
+def resize_images(arrays) -> list:
+    """Routed batch resize over decoded RGB arrays — the one seam both the
+    media processor and bench.py measure. Device kernel when the sticky
+    verdict allows (first warm batch is timed against PIL on the same
+    arrays); scalar PIL otherwise. Raises only if the device path dies
+    mid-call — callers fall back to the full scalar pipeline then."""
+    import time as _time
+
+    from ...ops.resize_jax import resize_batch_host
+
+    if not _device_resize_allowed():
+        return _pil_resize_all(arrays)
+    if _DEVICE_VERDICT["value"] is None:
+        # EVERY device call synchronizes while the verdict is open — a
+        # concurrent unmeasured batch would otherwise share the device with
+        # the timed probe and distort the measurement
+        with _VERDICT_LOCK:
+            if (_DEVICE_VERDICT["value"] is None
+                    and len(arrays) >= _VERDICT_MIN_BATCH):
+                # measure the WARM device rate: run once for the compile,
+                # once for the timing, score against scalar. Either way THIS
+                # batch's device outputs are valid (dimension-identical), so
+                # nothing is recomputed — only future batches change route.
+                resize_batch_host(arrays)
+                t0 = _time.perf_counter()
+                thumbs = resize_batch_host(arrays)
+                _DEVICE_VERDICT["value"] = _measure_device_verdict(
+                    arrays, _time.perf_counter() - t0)
+                return thumbs
+            if _DEVICE_VERDICT["value"] is False:
+                return _pil_resize_all(arrays)
+            return resize_batch_host(arrays)
+    return resize_batch_host(arrays)
+
+
+#: images decoded+resized+encoded per device call: bounds the pad-and-mask
+#: batch (resize_batch_host pads every lane to the batch max and rounds the
+#: count to a power of two — 256 lanes of 1024² would be ~0.8 GB of uint8
+#: before the kernel's float intermediates) AND the decoded-array working
+#: set, while still amortizing one dispatch over dozens of images
+RESIZE_SUB_BATCH = 32
+
+
+def generate_thumbnails_batched(entries, data_dir: str | Path,
+                                allow_device: bool = True):
+    """Batch thumbnail generation: host decode → routed bilinear-resize in
+    RESIZE_SUB_BATCH chunks → host WebP encode.
 
     ``entries``: [(source_path, cas_id, extension)]; returns {cas_id: Path}
     for every thumbnail produced. Videos and failed decodes fall back to the
     scalar path. The per-image outputs are dimension-identical to the scalar
     PIL path (same √(area) target math, target_dims).
 
-    The first (warm) device batch is timed against a scalar probe on the
-    same decoded arrays; when the device measurably loses, this and every
-    later call route through the scalar pipeline instead (sticky
-    per-process verdict) — the caller always gets its thumbnails over
-    whichever path measured fastest.
+    Routing is get_hasher-style hybrid (``resize_images``): no accelerator →
+    scalar PIL outright; with one, the first (warm) device batch is timed
+    against a scalar probe on the same decoded arrays and the sticky
+    per-process verdict routes every later call — the caller always gets its
+    thumbnails over whichever path measured fastest. ``allow_device=False``
+    (the tpuThumbnails feature left off) skips the device unconditionally.
     """
     from PIL import Image
 
-    from ...ops.resize_jax import resize_batch_host
     from ...utils.jax_guard import ensure_jax_safe
 
+    if not allow_device:
+        return _scalar_all(entries, data_dir)
     ensure_jax_safe()  # wedged tunnel: run (and measure) on pinned CPU
-    if _DEVICE_VERDICT["value"] is False:
+    if not _device_resize_allowed():
         return _scalar_all(entries, data_dir)
 
     out_paths: dict[str, Path] = {}
-    batch_arrays = []
-    batch_meta = []  # (cas_id, out_path)
+    todo = []  # (source, cas_id, out_path, ext) still needing a thumbnail
     for source, cas_id, ext in entries:
         out = thumbnail_path(data_dir, cas_id)
         if out.exists():
@@ -465,51 +549,34 @@ def generate_thumbnails_batched(entries, data_dir: str | Path):
             if made is not None:
                 out_paths[cas_id] = made
             continue
+        todo.append((source, cas_id, out, ext))
+
+    for start in range(0, len(todo), RESIZE_SUB_BATCH):
+        sub = todo[start : start + RESIZE_SUB_BATCH]
+        batch_arrays = []
+        batch_meta = []
+        for source, cas_id, out, ext in sub:
+            try:
+                batch_arrays.append(_decode_for_device(Path(source)))
+                batch_meta.append((source, cas_id, out, ext))
+            except Exception as e:
+                logger.warning("decode failed for %s: %s", source, e)
+        if not batch_arrays:
+            continue
         try:
-            batch_arrays.append(_decode_for_device(Path(source)))
-            batch_meta.append((source, cas_id, out, ext))
+            thumbs = resize_images(batch_arrays)
         except Exception as e:
-            logger.warning("decode failed for %s: %s", source, e)
-    if not batch_arrays:
-        return out_paths
-
-    import time as _time
-
-    try:
-        if _DEVICE_VERDICT["value"] is None:
-            # EVERY device call synchronizes while the verdict is open —
-            # a concurrent unmeasured batch would otherwise share the
-            # device with the timed probe and distort the measurement
-            with _VERDICT_LOCK:
-                if (_DEVICE_VERDICT["value"] is None
-                        and len(batch_arrays) >= _VERDICT_MIN_BATCH):
-                    # measure the WARM device rate: run once for the
-                    # compile, once for the timing, score against scalar.
-                    # Either way THIS batch's device outputs are valid
-                    # (dimension-identical), so nothing is recomputed —
-                    # only future batches change route.
-                    resize_batch_host(batch_arrays)
-                    t0 = _time.perf_counter()
-                    thumbs = resize_batch_host(batch_arrays)
-                    _DEVICE_VERDICT["value"] = _measure_device_verdict(
-                        batch_arrays, _time.perf_counter() - t0)
-                else:
-                    thumbs = resize_batch_host(batch_arrays)
-        else:
-            thumbs = resize_batch_host(batch_arrays)
-    except Exception as e:
-        logger.warning("device resize failed (%s); scalar fallback", e)
-        out_paths.update(_scalar_all(
-            [(s, c, e3) for s, c, _o, e3 in batch_meta], data_dir))
-        return out_paths
-
-    for (_source, cas_id, out, _ext), thumb in zip(batch_meta, thumbs):
-        try:
-            out.parent.mkdir(parents=True, exist_ok=True)
-            tmp = out.with_suffix(".tmp.webp")
-            _save_webp(Image.fromarray(thumb), tmp)
-            tmp.replace(out)
-            out_paths[cas_id] = out
-        except Exception as e:
-            logger.warning("thumbnail encode failed for %s: %s", cas_id, e)
+            logger.warning("device resize failed (%s); scalar fallback", e)
+            out_paths.update(_scalar_all(
+                [(s, c, e3) for s, c, _o, e3 in batch_meta], data_dir))
+            continue
+        for (_source, cas_id, out, _ext), thumb in zip(batch_meta, thumbs):
+            try:
+                out.parent.mkdir(parents=True, exist_ok=True)
+                tmp = out.with_suffix(".tmp.webp")
+                _save_webp(Image.fromarray(thumb), tmp)
+                tmp.replace(out)
+                out_paths[cas_id] = out
+            except Exception as e:
+                logger.warning("thumbnail encode failed for %s: %s", cas_id, e)
     return out_paths

@@ -8,9 +8,12 @@ are used for real challenge-response stream authentication instead
 (manager.py handshake).
 
 Keys ride on ``cryptography``'s ed25519 (the environment's libsodium-class
-primitive); the wire/DB encoding is urlsafe base64 of the raw 32-byte seed or
-public key, tagged ``I:`` (we hold the private key) or ``R:`` (peer's public
-key only).
+primitive) when the package is present; otherwise the RFC 8032 reference
+implementation (``ed25519_ref``) takes over with identical bytes on the
+wire — images without ``cryptography`` must not wedge every import of the
+p2p package (library creation mints an identity). The wire/DB encoding is
+urlsafe base64 of the raw 32-byte seed or public key, tagged ``I:`` (we
+hold the private key) or ``R:`` (peer's public key only).
 """
 
 from __future__ import annotations
@@ -18,15 +21,68 @@ from __future__ import annotations
 import base64
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey, Ed25519PublicKey)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey, Ed25519PublicKey)
 
-_RAW = serialization.Encoding.Raw
-_RAW_PUB = serialization.PublicFormat.Raw
-_RAW_PRIV = serialization.PrivateFormat.Raw
-_NOENC = serialization.NoEncryption()
+    _RAW = serialization.Encoding.Raw
+    _RAW_PUB = serialization.PublicFormat.Raw
+    _RAW_PRIV = serialization.PrivateFormat.Raw
+    _NOENC = serialization.NoEncryption()
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # dependency-gated: pure-Python RFC 8032 fallback
+    from . import ed25519_ref as _ref
+
+    HAVE_CRYPTOGRAPHY = False
+
+    class InvalidSignature(Exception):  # type: ignore[no-redef]
+        pass
+
+    class Ed25519PublicKey:  # type: ignore[no-redef]
+        def __init__(self, raw: bytes) -> None:
+            if len(raw) != 32:  # parity with cryptography's parse-time check
+                raise ValueError("ed25519 public key must be 32 bytes")
+            self._raw = raw
+
+        @classmethod
+        def from_public_bytes(cls, raw: bytes) -> "Ed25519PublicKey":
+            return cls(raw)
+
+        def public_bytes(self, *_: object) -> bytes:
+            return self._raw
+
+        def verify(self, signature: bytes, message: bytes) -> None:
+            if not _ref.verify(self._raw, signature, message):
+                raise InvalidSignature()
+
+    class Ed25519PrivateKey:  # type: ignore[no-redef]
+        def __init__(self, seed: bytes) -> None:
+            if len(seed) != 32:  # a short/corrupt seed must fail loudly,
+                # not silently derive a DIFFERENT keypair than the stored
+                # identity (cryptography raises here too)
+                raise ValueError("ed25519 private key must be 32 bytes")
+            self._seed = seed
+
+        @classmethod
+        def generate(cls) -> "Ed25519PrivateKey":
+            return cls(_ref.generate_seed())
+
+        @classmethod
+        def from_private_bytes(cls, seed: bytes) -> "Ed25519PrivateKey":
+            return cls(seed)
+
+        def private_bytes(self, *_: object) -> bytes:
+            return self._seed
+
+        def sign(self, message: bytes) -> bytes:
+            return _ref.sign(self._seed, message)
+
+        def public_key(self) -> Ed25519PublicKey:
+            return Ed25519PublicKey(_ref.public_key(self._seed))
+
+    _RAW = _RAW_PUB = _RAW_PRIV = _NOENC = None
 
 
 def _b64e(raw: bytes) -> str:

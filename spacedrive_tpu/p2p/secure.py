@@ -43,12 +43,38 @@ record to bound buffering; spaceblock's large blocks simply span records.
 from __future__ import annotations
 
 import asyncio
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey)
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:
+    # Dependency-gated (image without ``cryptography``): importing the p2p
+    # package must not explode — library creation only needs identity.py,
+    # which has a pure-Python fallback. Session crypto has none (X25519 +
+    # ChaCha20Poly1305 are not reimplemented here), so every entry point
+    # below raises at USE time and Node._start_p2p's existing try/except
+    # keeps the node running offline.
+    HAVE_CRYPTOGRAPHY = False
+
+    class InvalidTag(Exception):  # type: ignore[no-redef]
+        pass
+
+    class _Unavailable:
+        def __init__(self, *_a: object, **_k: object) -> None:
+            raise RuntimeError(
+                "p2p session crypto requires the 'cryptography' package")
+
+        generate = classmethod(lambda cls: cls())
+        from_public_bytes = classmethod(lambda cls, _raw: cls())
+
+    X25519PrivateKey = X25519PublicKey = ChaCha20Poly1305 = HKDF = _Unavailable  # type: ignore[misc]
+    hashes = None  # type: ignore[assignment]
 
 from .proto import ProtocolError
 

@@ -1,0 +1,227 @@
+"""Bounded-queue streaming executor for batched jobs.
+
+Thread layout (one executor per pipelined job run):
+
+    prefetcher ──pages──▶ dispatcher ──results──▶ committer (job thread)
+
+Both queues are bounded (``SD_PIPELINE_DEPTH``), so a slow committer
+backpressures the dispatcher and a slow dispatcher backpressures the
+prefetcher — memory stays O(depth × batch) no matter how far the stages
+drift apart. The committer is the job's own worker thread: it polls the
+command channel between commits exactly like the sequential step loop, so
+Pause/Cancel/Shutdown land at a committed-batch boundary and the serialized
+checkpoint only ever reflects committed work.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from ..jobs.job import DynJob
+    from ..jobs.worker import WorkerContext
+    from .spec import PipelineSpec
+
+logger = logging.getLogger(__name__)
+
+#: poll quantum for queue waits — also bounds pause latency, like the
+#: sequential loop's between-steps command check cadence
+_POLL_S = 0.05
+
+_DONE = object()
+
+
+class _StageFailure:
+    """An exception captured on a stage thread, re-raised by the committer
+    (sequential parity: a raised step exception is fatal to the job)."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException) -> None:
+        self.exc = exc
+
+
+def pipeline_enabled() -> bool:
+    """Streaming execution is the default for jobs that opt in;
+    ``SD_PIPELINE=0`` forces every job back onto the sequential step loop
+    (the equivalence baseline)."""
+    return os.environ.get("SD_PIPELINE", "1").lower() not in ("0", "false", "off")
+
+
+def pipeline_depth() -> int:
+    """Bounded-queue depth between stages (``SD_PIPELINE_DEPTH``, min 1)."""
+    try:
+        return max(1, int(os.environ.get("SD_PIPELINE_DEPTH", "2")))
+    except ValueError:
+        return 2
+
+
+class PipelineExecutor:
+    """Drive one pipelined job run; mutates the job's ``JobState`` exactly
+    like the sequential loop in ``DynJob.run`` would."""
+
+    def __init__(self, spec: "PipelineSpec", ctx: "WorkerContext",
+                 dyn_job: "DynJob", errors: list[str]) -> None:
+        self.spec = spec
+        self.ctx = ctx
+        self.dyn_job = dyn_job
+        self.state = dyn_job.state
+        self.errors = errors
+        depth = spec.depth or pipeline_depth()
+        self._pages: queue.Queue[Any] = queue.Queue(maxsize=depth)
+        self._results: queue.Queue[Any] = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        # per-stage wall time; each attribute is written by exactly one thread
+        self._page_s = 0.0
+        self._hash_s = 0.0
+        self._commit_s = 0.0
+        self._batches = 0
+
+    # -- bounded put/get that never deadlock a drain -------------------------
+    def _put(self, q: queue.Queue, item: Any) -> bool:
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=_POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _put_nowait_or_drop(self, q: queue.Queue, item: Any) -> None:
+        """Best-effort forward of a failure marker: make room if needed (the
+        committer only cares that it eventually sees the failure)."""
+        while True:
+            try:
+                q.put_nowait(item)
+                return
+            except queue.Full:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+
+    # -- stage threads -------------------------------------------------------
+    def _prefetch_loop(self, budget: int) -> None:
+        scratch: dict[str, Any] = {
+            "step_index": self.state.step_number,
+            "steps": self.state.steps,
+        }
+        try:
+            while budget > 0 and not self._stop.is_set():
+                t0 = time.perf_counter()
+                payload = self.spec.page(self.ctx, self.state.data, scratch)
+                self._page_s += time.perf_counter() - t0
+                if payload is None:
+                    break
+                budget -= 1
+                if not self._put(self._pages, payload):
+                    return  # draining
+            self._put(self._pages, _DONE)
+        except BaseException as e:  # noqa: BLE001 — forwarded, fatal
+            self._put_nowait_or_drop(self._pages, _StageFailure(e))
+
+    def _dispatch_loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    item = self._pages.get(timeout=_POLL_S)
+                except queue.Empty:
+                    continue
+                if item is _DONE or isinstance(item, _StageFailure):
+                    self._put(self._results, item)
+                    return
+                t0 = time.perf_counter()
+                result = self.spec.process(self.ctx, self.state.data, item)
+                self._hash_s += time.perf_counter() - t0
+                if not self._put(self._results, result):
+                    return  # draining
+        except BaseException as e:  # noqa: BLE001 — forwarded, fatal
+            self._put_nowait_or_drop(self._results, _StageFailure(e))
+
+    # -- the committer (job thread) ------------------------------------------
+    def run(self) -> None:
+        from ..jobs.error import JobError
+        from ..jobs.job import merge_metadata
+
+        state = self.state
+        wall0 = time.perf_counter()
+        budget = len(state.steps) - state.step_number
+        if budget <= 0:
+            return
+        threads = [
+            threading.Thread(target=self._prefetch_loop, args=(budget,),
+                             daemon=True, name="pipeline-prefetch"),
+            threading.Thread(target=self._dispatch_loop,
+                             daemon=True, name="pipeline-dispatch"),
+        ]
+        for t in threads:
+            t.start()
+        try:
+            while True:
+                # between-commits command poll: JobPaused serializes the
+                # state as of the last committed batch, nothing speculative
+                self.ctx.check_commands(self.dyn_job)
+                try:
+                    item = self._results.get(timeout=_POLL_S)
+                except queue.Empty:
+                    continue
+                if item is _DONE:
+                    break
+                if isinstance(item, _StageFailure):
+                    raise item.exc
+                t0 = time.perf_counter()
+                result = self.spec.commit(self.ctx, state.data, item)
+                self._commit_s += time.perf_counter() - t0
+                self._batches += 1
+                if result.more_steps:
+                    raise JobError(
+                        f"{self.dyn_job.job.NAME}: pipelined jobs cannot "
+                        f"append steps mid-run")
+                if result.metadata:
+                    merge_metadata(state.run_metadata, result.metadata)
+                self.errors.extend(result.errors)
+                state.step_number += 1
+                self.ctx.progress(completed_task_count=state.step_number)
+        finally:
+            self._stop.set()
+            # unblock producers stuck on a full queue, then join
+            for q in (self._pages, self._results):
+                while True:
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        break
+            for t in threads:
+                t.join(timeout=10.0)
+                if t.is_alive():
+                    # a stage stuck in a hung device/IO call (the wedged-
+                    # tunnel failure mode) — it will exit at its next queue
+                    # op, but until then a resumed run shares the device
+                    # with it; the operator needs the signal
+                    logger.warning(
+                        "pipeline %s: %s still running after drain timeout "
+                        "(stuck stage call?); its result will be discarded",
+                        self.dyn_job.job.NAME, t.name)
+
+        # pages ran dry before the estimated step count (rows shrank since
+        # init, exactly like sequential steps whose SELECT comes back empty):
+        # fast-forward to the sequential loop's terminal step_number
+        if state.step_number < len(state.steps):
+            state.step_number = len(state.steps)
+            self.ctx.progress(completed_task_count=state.step_number)
+        merge_metadata(state.run_metadata, {
+            "pipeline_page_s": self._page_s,
+            "pipeline_hash_s": self._hash_s,
+            "pipeline_commit_s": self._commit_s,
+            "pipeline_wall_s": time.perf_counter() - wall0,
+            "pipeline_batches": self._batches,
+        })
+        logger.debug(
+            "pipeline %s: %d batches, page %.3fs | hash %.3fs | commit %.3fs "
+            "| wall %.3fs", self.dyn_job.job.NAME, self._batches, self._page_s,
+            self._hash_s, self._commit_s, time.perf_counter() - wall0)

@@ -1,0 +1,38 @@
+"""The contract a batched job hands the streaming executor.
+
+Stage callables follow the ``pipeline_page`` / ``pipeline_process`` /
+``pipeline_commit`` naming convention — the ``pipeline-ordering`` sdlint
+pass keys off those names to enforce that prefetch/dispatch stages never
+write the DB (all commits go through the committer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class PipelineSpec:
+    """Three stage callables + an optional queue-depth override.
+
+    ``page(ctx, data, scratch) -> payload | None``
+        Prefetch thread. Pages the next batch of rows (DB *reads* only) and
+        gathers its sample messages (file I/O). ``scratch`` is a pipeline-
+        local dict (NOT checkpointed) seeded with ``step_index``/``steps``;
+        page keeps its speculative cursor there, never in ``data``. Returns
+        ``None`` when the job is out of work.
+
+    ``process(ctx, data, payload) -> payload``
+        Dispatch thread. Device/CPU compute over the gathered batch. May
+        mutate and return the payload.
+
+    ``commit(ctx, data, payload) -> StepResult``
+        Job thread, strict batch order, the only stage that may write the
+        DB (and the only place the checkpoint cursor in ``data`` advances).
+    """
+
+    page: Callable[..., Any]
+    process: Callable[..., Any]
+    commit: Callable[..., Any]
+    depth: int | None = None

@@ -246,6 +246,39 @@ def test_swallowed_exception_scoped_to_job_dirs(tmp_path):
         "        pass\n"), "swallowed-exception") == []
 
 
+# -- pass 6: pipeline-ordering ------------------------------------------------
+
+def test_pipeline_ordering_flags_writes_in_prefetch_stages(tmp_path):
+    """Transactions/writes in pipeline_page/pipeline_process are flagged;
+    reads there and writes in pipeline_commit are not; non-DB .update()
+    receivers (dicts) don't trip it."""
+    bad = run_on(tmp_path, "objects/bad.py", (
+        "class J:\n"
+        "    def pipeline_page(self, ctx, data, scratch):\n"
+        "        rows = ctx.library.db.query('SELECT 1')\n"
+        "        with ctx.library.db.transaction():\n"
+        "            ctx.library.db.update(None, {}, {})\n"
+        "    def pipeline_process(self, ctx, data, batch):\n"
+        "        data['x'] = 1\n"
+        "        scratch = {}\n"
+        "        scratch.update({'a': 1})\n"
+        "        ctx.library.db.insert_many(None, [])\n"
+        "    def pipeline_commit(self, ctx, data, batch):\n"
+        "        with ctx.library.db.transaction():\n"
+        "            ctx.library.db.executemany('U', [])\n"),
+        "pipeline-ordering")
+    assert [f.lineno for f in bad] == [4, 5, 10]
+    assert "page" in bad[0].message and "process" in bad[2].message
+
+
+def test_pipeline_ordering_silent_outside_stage_functions(tmp_path):
+    assert run_on(tmp_path, "objects/fine.py", (
+        "def execute_step(ctx, data, step, n):\n"
+        "    with ctx.library.db.transaction():\n"
+        "        ctx.library.db.insert_many(None, [])\n"),
+        "pipeline-ordering") == []
+
+
 # -- waivers ------------------------------------------------------------------
 
 def test_scoped_waiver_silences_only_named_pass(tmp_path):

@@ -1,0 +1,225 @@
+"""Streaming-pipeline equivalence gates (ISSUE 3 tentpole).
+
+The whole design rests on one promise: the pipelined executor reorders
+*work*, never *effects*. Pipelined vs sequential FileIdentifierJob over the
+same fixture tree must produce identical ``file_path.cas_id``/``object``
+rows AND an identical CRDT op order; a pause mid-pipeline must resume to the
+same terminal state with nothing lost or duplicated.
+"""
+
+import json
+import time
+
+import pytest
+
+from spacedrive_tpu.jobs import JobStatus
+from spacedrive_tpu.models import FilePath, JobRow, Location
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.objects import file_identifier as fi
+
+
+@pytest.fixture()
+def fixture_tree(tmp_path):
+    """Deterministic mixed tree: small whole-file cas messages, sampled-class
+    files, duplicates (same + cross directory), and empties."""
+    import random
+
+    rng = random.Random(42)
+    root = tmp_path / "tree"
+    dup_small = rng.randbytes(3000)
+    dup_big = rng.randbytes(160_000)
+    for d in range(4):
+        p = root / f"d{d}"
+        p.mkdir(parents=True)
+        for i in range(20):
+            if i == 0:
+                body = dup_small          # cross-dir duplicate
+            elif i == 1:
+                body = dup_big            # sampled-class duplicate
+            elif i == 2:
+                body = b""                # empty
+            elif i % 7 == 0:
+                body = rng.randbytes(150_000 + d * 64 + i)  # sampled
+            else:
+                body = rng.randbytes(400 + d * 100 + i * 17)
+            (p / f"f{i:02d}.dat").write_bytes(body)
+    return root
+
+
+def _decoded(blob):
+    """JobRow fields arrive as dict (decoded), str, or bytes depending on
+    the access path; normalize to a dict."""
+    if isinstance(blob, dict):
+        return blob
+    if isinstance(blob, (bytes, bytearray)):
+        blob = blob.decode()
+    return json.loads(blob)
+
+
+def _seed_library(data_dir, tree, name):
+    """Node + library + location + DETERMINISTIC file_path rows (fixed
+    pub_ids, sorted insert order) so batch boundaries and op order are
+    comparable across runs — the indexer's scandir order is not."""
+    node = Node(data_dir, probe_accelerator=False, watch_locations=False)
+    lib = node.libraries.create(name)
+    lib.sync.emit_messages = True
+    loc_id = lib.db.insert(Location, {
+        "pub_id": f"loc-{name}", "name": name, "path": str(tree),
+        "date_created": "2026-01-01T00:00:00+00:00",
+        "instance_id": lib.instance_id, "hasher": "cpu",
+    })
+    rows = []
+    for i, f in enumerate(sorted(tree.rglob("*.dat"))):
+        rel = f.relative_to(tree)
+        rows.append({
+            "pub_id": f"fp-{i:04d}", "location_id": loc_id,
+            "materialized_path": f"/{rel.parent}/" if str(rel.parent) != "." else "/",
+            "name": f.stem, "extension": f.suffix.lstrip("."), "is_dir": 0,
+            "size_in_bytes": f.stat().st_size,
+            "date_created": "2026-01-01T00:00:00+00:00",
+        })
+    lib.db.insert_many(FilePath, rows)
+    return node, lib, loc_id
+
+
+def _identify(node, lib, loc_id, timeout=180.0):
+    jid = node.jobs.spawn(lib, [fi.FileIdentifierJob({"location_id": loc_id})])
+    assert node.jobs.wait_idle(timeout)
+    return jid
+
+
+def _snapshot(lib):
+    """(path→cas, path→(object kind, member paths), op fingerprints).
+
+    Object pub_ids are random per run; fingerprints map them to the sorted
+    member path-set so two runs compare structurally. ``date_created`` in
+    object creates is wall clock — the key is kept, the value dropped.
+    """
+    members: dict[str, list[str]] = {}
+    kind_of: dict[str, int] = {}
+    path_cas: dict[str, object] = {}
+    path_obj: dict[str, object] = {}
+    for r in lib.db.query(
+            "SELECT fp.pub_id pid, fp.cas_id cas, o.pub_id opub, o.kind kind "
+            "FROM file_path fp LEFT JOIN object o ON fp.object_id = o.id "
+            "WHERE fp.is_dir = 0 ORDER BY fp.id"):
+        path_cas[r["pid"]] = r["cas"]
+        if r["opub"] is not None:
+            members.setdefault(r["opub"], []).append(r["pid"])
+            kind_of[r["opub"]] = r["kind"]
+
+    def map_obj(opub):
+        return ("object", tuple(sorted(members.get(opub, []))),
+                kind_of.get(opub))
+
+    for pid, _ in list(path_cas.items()):
+        pass
+    for r in lib.db.query(
+            "SELECT fp.pub_id pid, o.pub_id opub FROM file_path fp "
+            "JOIN object o ON fp.object_id = o.id"):
+        path_obj[r["pid"]] = map_obj(r["opub"])
+
+    ops = []
+    for r in lib.db.query(
+            "SELECT model, record_id, kind, data FROM shared_operation "
+            "ORDER BY rowid"):
+        record = r["record_id"]
+        data = json.loads(r["data"]) if r["data"] else None
+        if r["model"] == "object":
+            record = map_obj(record)
+            if r["kind"] == "c" and isinstance(data, dict):
+                data = {k: ("<ts>" if k == "date_created" else v)
+                        for k, v in data.items()}
+        if isinstance(data, dict) and "__ref__" in data:
+            table, pub = data["__ref__"]
+            data = {"__ref__": [table, map_obj(pub) if table == "object" else pub]}
+        ops.append((r["model"], record, r["kind"], repr(data)))
+    return path_cas, path_obj, ops
+
+
+def test_pipelined_identify_equivalent_to_sequential(tmp_path, fixture_tree,
+                                                     monkeypatch):
+    monkeypatch.setattr(fi, "BATCH_SIZE", 16)  # several batches in flight
+
+    monkeypatch.setenv("SD_PIPELINE", "0")
+    node_a, lib_a, loc_a = _seed_library(tmp_path / "seq", fixture_tree, "seq")
+    _identify(node_a, lib_a, loc_a)
+    seq = _snapshot(lib_a)
+    node_a.shutdown()
+
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    node_b, lib_b, loc_b = _seed_library(tmp_path / "pipe", fixture_tree, "pipe")
+    jid = _identify(node_b, lib_b, loc_b)
+    pipe = _snapshot(lib_b)
+    meta = _decoded(lib_b.db.find_one(JobRow, {"id": jid})["metadata"])
+    node_b.shutdown()
+
+    assert pipe[0] == seq[0], "cas_id rows diverge"
+    assert pipe[1] == seq[1], "object linkage diverges"
+    assert pipe[2] == seq[2], "CRDT op order diverges"
+    # the pipelined run really went through the streaming executor
+    assert meta["pipeline_batches"] == 5  # ceil(80/16)
+    assert meta["pipeline_wall_s"] > 0
+
+
+def test_pause_mid_pipeline_resumes_to_identical_state(tmp_path, fixture_tree,
+                                                       monkeypatch):
+    # IDENTICAL batch size both runs: op order legitimately depends on batch
+    # boundaries (per-batch cas updates then object creates), and the claim
+    # under test is pipelined == sequential at the same boundaries
+    monkeypatch.setattr(fi, "BATCH_SIZE", 8)
+    monkeypatch.setenv("SD_PIPELINE", "0")
+    node_a, lib_a, loc_a = _seed_library(tmp_path / "ref", fixture_tree, "ref")
+    _identify(node_a, lib_a, loc_a)
+    reference = _snapshot(lib_a)
+    node_a.shutdown()
+
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    slow_gather = fi.read_sampled_batch
+
+    def gather_with_drag(paths, sizes):
+        time.sleep(0.12)  # stretch the run so the pause lands mid-pipeline
+        return slow_gather(paths, sizes)
+
+    monkeypatch.setattr(fi, "read_sampled_batch", gather_with_drag)
+    node, lib, loc_id = _seed_library(tmp_path / "pause", fixture_tree, "pause")
+    jid = node.jobs.spawn(lib, [fi.FileIdentifierJob({"location_id": loc_id})])
+
+    def identified():
+        return lib.db.query("SELECT count(*) c FROM file_path "
+                            "WHERE cas_id IS NOT NULL")[0]["c"]
+
+    deadline = time.monotonic() + 30
+    while identified() < 8 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert node.jobs.pause(jid)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        row = lib.db.find_one(JobRow, {"id": jid})
+        if row and row["status"] == JobStatus.PAUSED:
+            break
+        time.sleep(0.02)
+    row = lib.db.find_one(JobRow, {"id": jid})
+    assert row["status"] == JobStatus.PAUSED
+    mid = identified()
+    assert 0 < mid < 78, mid  # genuinely mid-run (80 files, 2 empty)
+    # the checkpoint cursor reflects only committed batches: a multiple of
+    # the batch size worth of rows, never a torn batch
+    state = _decoded(row["data"])
+    committed = state["step_number"]
+    assert committed * 8 >= mid
+
+    monkeypatch.setattr(fi, "read_sampled_batch", slow_gather)  # full speed
+    assert node.jobs.resume(lib, jid)
+    assert node.jobs.wait_idle(180)
+    assert lib.db.find_one(JobRow, {"id": jid})["status"] == JobStatus.COMPLETED
+    resumed = _snapshot(lib)
+    node.shutdown()
+
+    assert resumed[0] == reference[0], "cas_id rows diverge after resume"
+    assert resumed[1] == reference[1], "object linkage diverges after resume"
+    # every cas update happened exactly once across pause/resume
+    cas_updates = [op for op in resumed[2] if op[2] == "u:cas_id"]
+    assert len(cas_updates) == len([op for op in reference[2]
+                                    if op[2] == "u:cas_id"])
+    assert resumed[2] == reference[2], "CRDT op order diverges after resume"
