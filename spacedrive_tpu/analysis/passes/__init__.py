@@ -14,6 +14,7 @@ from .legacy import BareExceptPass, DuplicateDefPass, UnusedImportPass
 from .lock_discipline import LockDisciplinePass
 from .pipeline_ordering import PipelineOrderingPass
 from .resource_leak import ResourceLeakPass
+from .retry_discipline import RetryDisciplinePass
 from .swallowed import SwallowedExceptionPass
 
 REGISTRY: tuple[type[AnalysisPass], ...] = (
@@ -28,6 +29,7 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     ResourceLeakPass,
     SwallowedExceptionPass,
     PipelineOrderingPass,
+    RetryDisciplinePass,
 )
 
 

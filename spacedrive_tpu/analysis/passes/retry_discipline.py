@@ -1,0 +1,73 @@
+"""retry-discipline: transient-failure retries go through utils/retry.py.
+
+The resilience layer (docs/architecture/robustness.md) funnels every
+retry-with-backoff through :func:`spacedrive_tpu.utils.retry.retry_call`
+— jittered, budgeted, pause/cancel-aware. An ad-hoc ``time.sleep`` inside
+a loop that also catches exceptions is the classic hand-rolled retry:
+un-jittered (thundering herds), unbudgeted (a dead dependency stalls the
+lane forever), and deaf to Pause/Cancel (the worker sleeps out the
+backoff instead of unwinding within one poll interval).
+
+Mechanics: inside production subsystems (jobs|objects|sync|p2p), flag any
+``while``/``for`` loop whose body contains BOTH
+
+- a ``try`` statement with at least one ``except`` handler, and
+- a ``time.sleep(...)`` call (any alias chain ending in ``time.sleep`` /
+  ``_time.sleep``),
+
+attributed to the sleep call's line. Pure poll loops (sleep, no except)
+and pure drain loops (except, no sleep) stay silent — the combination is
+what marks a retry. ``utils/retry.py`` itself lives outside the scoped
+dirs, so the one sanctioned backoff loop is structurally exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+
+SCOPED_DIRS = ("jobs", "objects", "sync", "p2p")
+
+SLEEP_CHAINS = ("time.sleep", "_time.sleep")
+
+
+def _sleep_calls(loop: ast.While | ast.For) -> list[ast.Call]:
+    out = []
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            chain = dotted_name(node.func)
+            if chain in SLEEP_CHAINS:
+                out.append(node)
+    return out
+
+
+def _has_handler(loop: ast.While | ast.For) -> bool:
+    return any(isinstance(node, ast.Try) and node.handlers
+               for node in ast.walk(loop))
+
+
+class RetryDisciplinePass(AnalysisPass):
+    id = "retry-discipline"
+    description = ("ad-hoc sleep-in-loop retry patterns in jobs|objects|"
+                   "sync|p2p (use utils/retry.retry_call)")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dirs(*SCOPED_DIRS):
+            return
+        seen: set[int] = set()  # nested loops walk shared bodies once
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            if not _has_handler(node):
+                continue
+            for call in _sleep_calls(node):
+                if call.lineno in seen:
+                    continue
+                seen.add(call.lineno)
+                yield ctx.finding(
+                    call.lineno, self.id,
+                    "sleep-in-loop retry: hand-rolled backoff is "
+                    "un-jittered, unbudgeted, and ignores Pause/Cancel — "
+                    "use utils/retry.retry_call")

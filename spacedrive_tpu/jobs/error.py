@@ -17,8 +17,11 @@ class JobPaused(Exception):  # JobError::Paused(state, signal)
         self.state_blob = state_blob
         self.from_shutdown = from_shutdown
         # soft step errors accumulated before the pause; persisted so a
-        # resumed run still ends CompletedWithErrors (job/mod.rs:834-841)
-        self.errors = errors or []
+        # resumed run still ends CompletedWithErrors (job/mod.rs:834-841).
+        # List IDENTITY is kept (no `or []` collapse of an empty list): the
+        # pipeline drain appends its leaked-stage soft error while this
+        # exception is already in flight, and the worker must see it.
+        self.errors = errors if errors is not None else []
 
 
 class JobCanceled(Exception):  # JobError::Canceled

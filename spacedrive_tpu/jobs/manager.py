@@ -218,10 +218,33 @@ class Jobs:
                 self.ingest(library, dyn_job)
                 revived += 1
             except Exception as e:
-                logger.warning("cold resume failed for %s (%s): %s; marking Canceled",
+                # a checkpoint that cannot be revived is a FAILURE the user
+                # must see (lost scan progress), not a silent Canceled: keep
+                # the diagnostic in errors_text and push a notification.
+                # The job is NOT re-queued — the corrupt blob would fail
+                # identically forever.
+                logger.warning("cold resume failed for %s (%s): %s; marking Failed",
                                report.name, report.id[:8], e)
-                report.status = JobStatus.CANCELED
+                report.status = JobStatus.FAILED
+                # APPEND: the checkpoint deliberately persisted the paused
+                # run's soft errors (quarantined files etc.) — the user
+                # still needs them after the resume failure
+                failure = f"cold resume failed: {e!r}"
+                report.errors_text = (f"{report.errors_text}\n\n{failure}"
+                                      if report.errors_text else failure)
                 report.upsert(library.db)
+                try:
+                    from ..notifications import emit_library_notification
+
+                    emit_library_notification(library, {
+                        "kind": "job_cold_resume_failed",
+                        "job_name": report.name,
+                        "job_id": report.id,
+                        "error": str(e),
+                    })
+                except Exception:
+                    logger.exception("cold-resume failure notification "
+                                     "could not be emitted")
         return revived
 
     def _load_children(self, library: "Library", parent_id: str) -> list[DynJob]:

@@ -22,10 +22,14 @@ import functools
 import dataclasses
 import datetime as _dt
 import json
+import os
 import sqlite3
 import threading
 from pathlib import Path
 from typing import Any, ClassVar, Iterable
+
+from .. import faults
+from ..utils.retry import RetryPolicy, is_sqlite_busy, retry_call
 
 
 # --------------------------------------------------------------------------
@@ -371,17 +375,44 @@ class Database:
                 self.update(model, where, update)
 
 
+#: SQLITE_BUSY retry for transaction BEGIN/COMMIT: bounded and fast (the
+#: backoff runs while the connection RLock is held, so the budget stays
+#: small — lock convoys resolve in milliseconds; anything longer escalates
+#: to the caller's own policy, e.g. the pipeline committer's cancel-aware
+#: retry). SD_TXN_RETRY_ATTEMPTS=1 disables the inner retry (chaos tests
+#: use it to force escalation).
+TXN_RETRY = RetryPolicy(
+    attempts=max(1, int(os.environ.get("SD_TXN_RETRY_ATTEMPTS", "6"))),
+    base_s=0.005, max_s=0.25, multiplier=2.0, jitter=0.5, budget_s=2.0)
+
+
 class _Txn:
-    """Re-entrant transaction scope: nested uses join the outer transaction."""
+    """Re-entrant transaction scope: nested uses join the outer transaction.
+
+    BEGIN and COMMIT retry SQLITE_BUSY under :data:`TXN_RETRY` (another
+    process holding the file lock is transient by definition); ROLLBACK is
+    never retried — it either succeeds or the connection is gone. The
+    ``commit`` fault seam sits inside the retried region so injected busy
+    storms exercise exactly the production path.
+    """
 
     def __init__(self, db: Database) -> None:
         self.db = db
+
+    def _begin(self) -> None:
+        faults.inject("commit", key="begin")
+        self.db._conn.execute("BEGIN IMMEDIATE")
+
+    def _commit(self) -> None:
+        faults.inject("commit", key="commit")
+        self.db._conn.execute("COMMIT")
 
     def __enter__(self) -> Database:
         self.db._lock.acquire()
         try:
             if self.db._txn_depth == 0:
-                self.db._conn.execute("BEGIN IMMEDIATE")
+                retry_call(self._begin, policy=TXN_RETRY,
+                           classify=is_sqlite_busy, label="txn-begin")
             self.db._txn_depth += 1
         except BaseException:
             self.db._lock.release()
@@ -392,6 +423,21 @@ class _Txn:
         try:
             self.db._txn_depth -= 1
             if self.db._txn_depth == 0:
-                self.db._conn.execute("COMMIT" if exc_type is None else "ROLLBACK")
+                if exc_type is None:
+                    try:
+                        retry_call(self._commit, policy=TXN_RETRY,
+                                   classify=is_sqlite_busy,
+                                   label="txn-commit")
+                    except BaseException:
+                        # a COMMIT that stayed busy past the budget leaves
+                        # the transaction open: roll it back so the
+                        # connection is reusable, then surface the failure
+                        try:
+                            self.db._conn.execute("ROLLBACK")
+                        except sqlite3.Error:
+                            pass
+                        raise
+                else:
+                    self.db._conn.execute("ROLLBACK")
         finally:
             self.db._lock.release()

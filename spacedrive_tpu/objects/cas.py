@@ -27,6 +27,8 @@ import struct
 from pathlib import Path
 from typing import BinaryIO
 
+from .. import faults
+from ..utils.retry import RetryPolicy, is_transient_io, retry_call
 from .blake3_ref import blake3
 
 SAMPLE_COUNT = 4
@@ -106,19 +108,34 @@ def generate_cas_id_from_bytes(data: bytes, size: int | None = None) -> str:
     return blake3(cas_message_from_bytes(data, size)).hex()[:16]
 
 
+#: per-file gather retry: EINTR/EIO-class read errors are transient (flaky
+#: media, interrupted syscalls) — re-read a couple of times before the file
+#: quarantines; vanished/permission-denied/truncated raise through untouched
+GATHER_RETRY = RetryPolicy(attempts=3, base_s=0.01, max_s=0.1, budget_s=1.0)
+
+
+def _read_one_sampled(path: str | Path, size: int) -> bytes:
+    faults.inject("gather", key=str(path))
+    with open(path, "rb", buffering=0) as fh:
+        return cas_message_from_file(fh, size)
+
+
 def read_sampled_batch(paths: list[str | Path], sizes: list[int]) -> list[bytes | Exception]:
     """Gather stage for the batched backends: one message per file, hash order.
 
     Per-file errors (deleted/shrunk files mid-scan) are returned in place as
     the Exception instance rather than aborting the batch — callers route them
     into JobRunErrors (the reference accumulates per-step errors instead of
-    failing the job, job/mod.rs:834-841).
+    failing the job, job/mod.rs:834-841). Transient read errors (EINTR/EIO)
+    retry under GATHER_RETRY before they count as a per-file failure.
     """
     out: list[bytes | Exception] = []
     for path, size in zip(paths, sizes):
         try:
-            with open(path, "rb", buffering=0) as fh:
-                out.append(cas_message_from_file(fh, size))
+            out.append(retry_call(
+                lambda p=path, s=size: _read_one_sampled(p, s),
+                policy=GATHER_RETRY, classify=is_transient_io,
+                label="cas-gather"))
         except (OSError, EOFError) as e:
             out.append(e)
     return out
@@ -133,6 +150,11 @@ def read_sampled_batch_fast(paths: list[str | Path],
     errors come back as OSError entries like the python path."""
     if not paths:
         return []
+    # an armed gather fault plan needs per-file seam hits; the fused native
+    # call is one opaque batch — route through the python path so injected
+    # per-file faults (and their retries) keep exact semantics
+    if faults.seam_armed("gather"):
+        return read_sampled_batch(paths, sizes)
     try:
         import numpy as np
 
@@ -151,7 +173,11 @@ def read_sampled_batch_fast(paths: list[str | Path],
     out: list[bytes | Exception] = []
     for i, path in enumerate(paths):
         if lengths[i] == 0 and msg_lens[i] != 8:
-            out.append(OSError(f"cas gather failed for {path}"))
+            # degradation ladder, rung one: the fused gather reports only
+            # pass/fail per row — re-read the failed file on the python
+            # path (with its transient retry) to either recover it or get
+            # the real errno for the quarantine record
+            out.append(read_sampled_batch([path], [sizes[i]])[0])
         else:
             out.append(bytes(rows[i, : lengths[i]]))
     return out

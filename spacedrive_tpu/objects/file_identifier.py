@@ -32,6 +32,7 @@ import time
 import uuid
 from typing import Any
 
+from .. import faults
 from ..jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
 from ..models import FilePath, Location, Object, utc_now
 from ..sync.crdt import ref
@@ -96,7 +97,8 @@ class FileIdentifierJob(StatefulJob):
                 "preview_media":
                     location.get("generate_preview_media") is not False}
         return data, steps, {"total_orphan_paths": count, "created_objects": 0,
-                             "linked_objects": 0, "hash_time": 0.0}
+                             "linked_objects": 0, "hash_time": 0.0,
+                             "quarantined_files": 0, "recovered_batches": 0}
 
     def pipeline_spec(self):
         from ..pipeline import PipelineSpec
@@ -178,18 +180,35 @@ class FileIdentifierJob(StatefulJob):
         #: the files it would do is pure waste (the gather already ran)
         probe_worthy = sum(1 for r in hashable
                            if r["size_in_bytes"] > MINIMUM_FILE_SIZE) >= 16
-        if getattr(hasher, "_cpu_rate", None) is None \
-                and isinstance(hasher, HybridHasher) \
-                and hasher._cpu._fast is not None and probe_worthy:
-            # unprobed hybrid: run this batch through the fused path so the
-            # engine probe happens (the gather above left the page cache
-            # warm); later batches take the gathered route with the verdict
-            location_path = data["location_path"]
-            cas_results = hasher.hash_batch(
-                [_abs_path(location_path, r) for r in hashable],
-                [r["size_in_bytes"] for r in hashable])
-        else:
-            cas_results = hasher.hash_gathered(batch["messages"])
+        try:
+            faults.inject("hash")
+            if getattr(hasher, "_cpu_rate", None) is None \
+                    and isinstance(hasher, HybridHasher) \
+                    and hasher._cpu._fast is not None and probe_worthy:
+                # unprobed hybrid: run this batch through the fused path so
+                # the engine probe happens (the gather above left the page
+                # cache warm); later batches take the gathered route with
+                # the verdict
+                location_path = data["location_path"]
+                cas_results = hasher.hash_batch(
+                    [_abs_path(location_path, r) for r in hashable],
+                    [r["size_in_bytes"] for r in hashable])
+            else:
+                cas_results = hasher.hash_gathered(batch["messages"])
+        except Exception as e:  # noqa: BLE001 — degradation ladder below
+            # mid-batch hasher failure (device wedge, dying backend): this
+            # batch re-dispatches on the native CPU path over the already-
+            # gathered messages (byte-identical cas_ids), the hybrid verdict
+            # flips so later batches skip the dead engine, and the pipeline
+            # keeps moving. A CPU-path failure here raises through to stage
+            # supervision — there is no rung below the oracle.
+            logger.exception("hash dispatch failed mid-batch; re-dispatching "
+                             "batch on the native CPU path")
+            degrade = getattr(hasher, "degrade_device", None)
+            if degrade is not None:
+                degrade(repr(e))
+            cas_results = get_hasher("cpu").hash_gathered(batch["messages"])
+            batch["recovered_error"] = repr(e)
         batch["cas_results"] = cas_results
         batch["hash_s"] = time.perf_counter() - t0
         batch["messages"] = None  # the gather buffers are dead weight now
@@ -203,12 +222,22 @@ class FileIdentifierJob(StatefulJob):
         hashable, empty = batch["hashable"], batch["empty"]
         errors: list[str] = []
 
+        # per-item quarantine: vanished/permission-denied/truncated files
+        # (post-retry) are excluded from this batch's writes and recorded as
+        # soft errors — the scan completes COMPLETED_WITH_ERRORS instead of
+        # dying, and the next scan retries them as still-orphan paths
         identified: list[tuple[dict, str]] = []
+        quarantined = 0
         for row, cas in zip(hashable, batch["cas_results"]):
             if isinstance(cas, Exception):
-                errors.append(f"{_abs_path(location_path, row)}: {cas!r}")
+                errors.append(
+                    f"quarantined {_abs_path(location_path, row)}: {cas!r}")
+                quarantined += 1
             else:
                 identified.append((row, cas))
+        if batch.get("recovered_error"):
+            errors.append(f"hash batch recovered on native CPU path after: "
+                          f"{batch['recovered_error']}")
 
         sync = getattr(ctx.library, "sync", None)
         emit = sync is not None and getattr(sync, "emit_messages", False)
@@ -287,19 +316,36 @@ class FileIdentifierJob(StatefulJob):
                            link_rows)
             if emit and ops:
                 sync.log_ops(ops)
-        if emit and ops:
-            sync.created()
         # the checkpoint cursor advances ONLY here, after the transaction
         # committed — a pause/crash resumes at the last committed batch
         data["cursor"] = batch["cursor"]
 
-        self._media_warm_start(ctx, data, identified)
-        ctx.progress(message=f"identified {len(identified)} files "
-                             f"({created} new objects, {linked} linked)")
+        # everything below is BEST-EFFORT tail work: the batch is durable,
+        # so nothing past this point may raise — the committer's retry
+        # (pipeline/executor.COMMIT_RETRY) assumes an exception out of
+        # pipeline_commit means the transaction did NOT land, and a re-run
+        # here would re-log every CRDT op of the batch
+        if emit and ops:
+            try:
+                sync.created()
+            except Exception:
+                logger.exception("sync.created broadcast failed (peers "
+                                 "will pull on their next round)")
+        try:
+            self._media_warm_start(ctx, data, identified)
+            ctx.progress(message=f"identified {len(identified)} files "
+                                 f"({created} new objects, {linked} linked)")
+        except Exception:
+            logger.exception("post-commit warm-start/progress failed "
+                             "(batch is committed; continuing)")
         return StepResult(metadata={"created_objects": created,
                                     "linked_objects": linked,
                                     "hash_time": batch["hash_s"],
-                                    "gather_s": batch["gather_s"]},
+                                    "gather_s": batch["gather_s"],
+                                    "quarantined_files": quarantined,
+                                    "recovered_batches":
+                                        1 if batch.get("recovered_error")
+                                        else 0},
                           errors=errors)
 
     def _media_warm_start(self, ctx: WorkerContext, data: dict,

@@ -232,6 +232,16 @@ class HybridHasher:
         self._cpu_rate: float | None = None
         self._device_rate: float | None = None
 
+    def degrade_device(self, reason: str = "") -> None:
+        """Flip the engine verdict to native CPU after a mid-batch device
+        failure (wedge, dead tunnel): later batches stop touching the
+        device path until :func:`reset_device_verdicts` re-arms the probe
+        (the relay recapture watcher calls it on recovery)."""
+        self._cpu_rate = self._cpu_rate or 1.0
+        self._device_rate = 0.0
+        logger.warning("hybrid hasher degraded to native CPU%s",
+                       f": {reason}" if reason else "")
+
     def _cpu_into(self, paths, sizes, idxs: list[int], out: list) -> None:
         """Native-CPU hash ``idxs`` and scatter results into ``out``."""
         res = self._cpu.hash_batch([paths[i] for i in idxs],
@@ -264,10 +274,24 @@ class HybridHasher:
         rest = [i for i in range(len(messages)) if i not in big_set]
         out: list[str | Exception] = [None] * len(messages)  # type: ignore[list-item]
         for idxs, backend in ((big, self._tpu), (rest, self._cpu)):
-            if idxs:
-                for i, r in zip(idxs, backend.hash_gathered(
-                        [messages[i] for i in idxs])):
-                    out[i] = r
+            if not idxs:
+                continue
+            sub = [messages[i] for i in idxs]
+            if backend is self._tpu:
+                try:
+                    res = backend.hash_gathered(sub)
+                except Exception as e:  # noqa: BLE001 — device died mid-batch
+                    # the degradation ladder: finish THIS batch natively
+                    # (byte-identical digests) and flip the verdict so
+                    # later batches don't re-wedge
+                    logger.exception("hybrid device path failed mid-batch; "
+                                     "re-dispatching on native CPU")
+                    self.degrade_device(repr(e))
+                    res = self._cpu.hash_gathered(sub)
+            else:
+                res = backend.hash_gathered(sub)
+            for i, r in zip(idxs, res):
+                out[i] = r
         return out
 
     def _probe_rates(self, paths, sizes, sampled: list[int],
@@ -457,6 +481,20 @@ _BACKENDS: dict[str, Callable[[], HasherBackend]] = {
 }
 
 _instances: dict[str, HasherBackend] = {}
+
+
+def reset_device_verdicts() -> None:
+    """Re-arm the hybrid engine probes after a device recovery (called by
+    the relay recapture watcher): a hasher degraded to native CPU by a
+    mid-batch wedge re-measures both engines on its next batch instead of
+    staying pinned to the loser forever. Snapshot the registry first: this
+    runs on the recapture watcher thread while job threads may be inserting
+    backends via get_hasher."""
+    for backend in list(_instances.values()):
+        if isinstance(backend, HybridHasher):
+            backend._cpu_rate = backend._device_rate = None
+            logger.info("hybrid hasher verdict reset — will re-probe "
+                        "engines on the next batch")
 
 
 def get_hasher(name: str | None, node=None) -> HasherBackend:

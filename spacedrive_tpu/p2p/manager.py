@@ -813,6 +813,11 @@ class P2PManager:
     async def request_hash_batch(self, peer_id: str,
                                  messages: list[bytes]) -> list[str]:
         """Ship cas messages to a peer's hasher; returns cas_ids in order."""
+        from .. import faults
+
+        # chaos seam for outbound peer requests (raising kinds only — a
+        # ``hang`` rule here would stall the shared event loop)
+        faults.inject("p2p_send", key=peer_id)
         reader, writer, _meta = await self.open_stream(peer_id)
         try:
             writer.write(Header.hash_batch([len(m) for m in messages]).to_bytes())

@@ -22,6 +22,8 @@ import threading
 import time
 from typing import TYPE_CHECKING, Any
 
+from ..utils.retry import RetryPolicy, is_device_wedge, is_transient, retry_call
+
 if TYPE_CHECKING:
     from ..jobs.job import DynJob
     from ..jobs.worker import WorkerContext
@@ -33,7 +35,27 @@ logger = logging.getLogger(__name__)
 #: sequential loop's between-steps command check cadence
 _POLL_S = 0.05
 
+#: the committer's own retry over ``spec.commit``: patient (it sits ABOVE
+#: the _Txn-level busy retry, catching what escalates past that budget) and
+#: cancel-aware — the backoff polls the command channel, so Pause/Cancel
+#: unwinds within one poll interval. The retried batch never half-applies
+#: because of the PipelineSpec commit contract (spec.py): durable effects
+#: are transactional-or-idempotent and post-durable tail work is
+#: best-effort/non-raising, so an exception out of ``spec.commit`` means
+#: nothing durable happened for this batch.
+COMMIT_RETRY = RetryPolicy(attempts=4, base_s=0.25, max_s=2.0,
+                           multiplier=2.0, jitter=0.5, budget_s=15.0)
+
 _DONE = object()
+
+
+def drain_timeout() -> float:
+    """Per-join bound when draining stage threads (``SD_PIPELINE_DRAIN_S``);
+    a stage stuck in a hung device/IO call must not strand a pausing job."""
+    try:
+        return max(0.1, float(os.environ.get("SD_PIPELINE_DRAIN_S", "10")))
+    except ValueError:
+        return 10.0
 
 
 class _StageFailure:
@@ -145,7 +167,7 @@ class PipelineExecutor:
 
     # -- the committer (job thread) ------------------------------------------
     def run(self) -> None:
-        from ..jobs.error import JobError
+        from ..jobs.error import JobError, JobPaused
         from ..jobs.job import merge_metadata
 
         state = self.state
@@ -173,9 +195,31 @@ class PipelineExecutor:
                 if item is _DONE:
                     break
                 if isinstance(item, _StageFailure):
-                    raise item.exc
+                    # stage supervision: a prefetch/dispatch thread that
+                    # crashed on a TRANSIENT class (flaky IO, device wedge,
+                    # injected chaos) drains to an ordered checkpoint-pause
+                    # — the serialized state reflects only committed
+                    # batches, so resume re-runs the lost work exactly.
+                    # Deterministic failures stay fatal (a poisoned-input
+                    # pause would resume into the same crash forever).
+                    exc = item.exc
+                    if is_transient(exc) or is_device_wedge(exc):
+                        self.errors.append(
+                            f"pipeline stage failed transiently; checkpoint-"
+                            f"paused at batch {self._batches}: {exc!r}")
+                        logger.warning(
+                            "pipeline %s: transient stage failure, pausing "
+                            "at committed batch %d: %r",
+                            self.dyn_job.job.NAME, self._batches, exc)
+                        raise JobPaused(self.dyn_job.serialize_state(),
+                                        errors=self.errors)
+                    raise exc
                 t0 = time.perf_counter()
-                result = self.spec.commit(self.ctx, state.data, item)
+                result = retry_call(
+                    lambda: self.spec.commit(self.ctx, state.data, item),
+                    policy=COMMIT_RETRY, classify=is_transient,
+                    cancel_check=lambda: self.ctx.check_commands(self.dyn_job),
+                    label=f"{self.dyn_job.job.NAME}-commit")
                 self._commit_s += time.perf_counter() - t0
                 self._batches += 1
                 if result.more_steps:
@@ -196,17 +240,31 @@ class PipelineExecutor:
                         q.get_nowait()
                     except queue.Empty:
                         break
+            drain_s = drain_timeout()
             for t in threads:
-                t.join(timeout=10.0)
+                t.join(timeout=drain_s)
+                if not t.is_alive():
+                    continue
+                # a stage stuck in a hung device/IO call (the wedged-tunnel
+                # failure mode): escalate to one bounded hard-join, then
+                # give the thread up — it is a daemon, its result is
+                # discarded, and the leak becomes a REPORT soft error (not
+                # just a log line) so a stuck gather cannot silently strand
+                # a paused job; a resumed run shares the device with it
+                # until it dies, which the operator must be able to see
+                logger.warning(
+                    "pipeline %s: %s still running after %.1fs drain "
+                    "timeout (stuck stage call?); hard-joining once more",
+                    self.dyn_job.job.NAME, t.name, drain_s)
+                t.join(timeout=drain_s)
                 if t.is_alive():
-                    # a stage stuck in a hung device/IO call (the wedged-
-                    # tunnel failure mode) — it will exit at its next queue
-                    # op, but until then a resumed run shares the device
-                    # with it; the operator needs the signal
-                    logger.warning(
-                        "pipeline %s: %s still running after drain timeout "
-                        "(stuck stage call?); its result will be discarded",
-                        self.dyn_job.job.NAME, t.name)
+                    msg = (f"pipeline stage thread {t.name} leaked: still "
+                           f"running {2 * drain_s:.1f}s after drain "
+                           f"(stuck in a hung gather/device call); its "
+                           f"result is discarded")
+                    logger.error("pipeline %s: %s", self.dyn_job.job.NAME,
+                                 msg)
+                    self.errors.append(msg)
 
         # pages ran dry before the estimated step count (rows shrank since
         # init, exactly like sequential steps whose SELECT comes back empty):

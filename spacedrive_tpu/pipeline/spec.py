@@ -30,6 +30,12 @@ class PipelineSpec:
     ``commit(ctx, data, payload) -> StepResult``
         Job thread, strict batch order, the only stage that may write the
         DB (and the only place the checkpoint cursor in ``data`` advances).
+        RETRY CONTRACT: the committer re-invokes ``commit`` on transient
+        failures (executor.COMMIT_RETRY), so durable effects must be
+        transactional-or-idempotent and anything AFTER the durable point
+        must be best-effort (caught and logged, never raised) — an
+        exception escaping ``commit`` asserts that nothing durable
+        happened for this batch.
     """
 
     page: Callable[..., Any]

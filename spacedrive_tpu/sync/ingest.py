@@ -35,6 +35,7 @@ import queue
 import threading
 from typing import TYPE_CHECKING, Any, Callable
 
+from .. import faults
 from ..models import Instance, RelationOperationRow, SharedOperationRow
 from .apply import ApplyError, apply_relation, apply_shared, model_for
 from .crdt import CREATE, DELETE, UPDATE_PREFIX, CRDTOperation, RelationOp, SharedOp
@@ -215,6 +216,9 @@ class Ingester:
     def _apply_shared_convergent(self, op: CRDTOperation) -> bool:
         """Apply ``op``'s effect given the record's logged history; returns
         whether anything was materialized."""
+        # chaos seam: a crash here during the optimistic pass must roll the
+        # batch savepoint back and re-run carefully with per-op isolation
+        faults.inject("sync_apply", key=op.id)
         db = self.library.db
         t: SharedOp = op.typ
         history = self._history(t)
@@ -281,6 +285,7 @@ class Ingester:
     def _apply_relation_convergent(self, op: CRDTOperation) -> bool:
         """Relations are link rows (little data, no partial-delete
         reconstruction needed): tombstone-aware kind matrix."""
+        faults.inject("sync_apply", key=op.id)
         db = self.library.db
         t: RelationOp = op.typ
         key = (t.relation, str(t.item_id), str(t.group_id))

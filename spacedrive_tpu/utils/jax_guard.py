@@ -99,6 +99,30 @@ def relay_listening(timeout_s: float = 1.5) -> bool:
     return False
 
 
+def _relay_up_with_retry() -> bool:
+    """``relay_listening()`` with a short flap-absorbing retry: a relay
+    mid-restart (or an accept queue briefly overflowing) refuses a single
+    connect, and one refused probe must not pin a long-lived node to CPU.
+    Only a probe that stays refused across the whole jittered window
+    counts as down."""
+    from .. import faults
+    from .retry import RetryPolicy, is_relay_flap, retry_call
+
+    def probe() -> None:
+        faults.inject("relay_probe")
+        if not relay_listening():
+            raise ConnectionRefusedError("relay ports refused")
+
+    try:
+        retry_call(probe,
+                   policy=RetryPolicy(attempts=3, base_s=0.1, max_s=0.4,
+                                      jitter=0.5, budget_s=2.0),
+                   classify=is_relay_flap, label="relay-probe")
+        return True
+    except ConnectionError:
+        return False
+
+
 def seed(device_ok: bool) -> None:
     """Record a definitive probe outcome obtained elsewhere (the node's
     boot-time accelerator probe) so the first job doesn't re-pay the
@@ -137,7 +161,7 @@ def _probe(timeout: float) -> bool:
         return False
     if os.environ.get("SD_ASSUME_DEVICE_OK"):
         return True
-    if not relay_listening():
+    if not _relay_up_with_retry():
         logger.warning("relay ports refused — device unreachable; pinning "
                        "this process to the CPU platform (fast-path, no "
                        "%.0fs probe paid)", timeout)

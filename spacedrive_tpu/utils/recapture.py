@@ -117,6 +117,15 @@ class RelayRecaptureWatcher:
 
     def _recapture(self) -> None:
         logger.info("relay recovered — running opportunistic device suite")
+        # the device is back: hybrid hashers that a mid-batch wedge degraded
+        # to native CPU re-probe both engines on their next batch (the
+        # restore half of the degradation ladder, robustness.md)
+        try:
+            from ..objects.hasher import reset_device_verdicts
+
+            reset_device_verdicts()
+        except Exception:
+            logger.exception("could not reset hybrid hasher verdicts")
         self.capturing = True
         try:
             record = dict(self.on_recover() or {})

@@ -279,6 +279,55 @@ def test_pipeline_ordering_silent_outside_stage_functions(tmp_path):
         "pipeline-ordering") == []
 
 
+# -- pass 10: retry-discipline -------------------------------------------------
+
+def test_retry_discipline_flags_sleep_in_retry_loop(tmp_path):
+    """The hand-rolled retry shape — a loop with both an except handler and
+    a time.sleep — is flagged once, at the sleep."""
+    bad = run_on(tmp_path, "objects/bad.py", (
+        "import time\n"
+        "def fetch():\n"
+        "    for attempt in range(5):\n"
+        "        try:\n"
+        "            return do()\n"
+        "        except OSError:\n"
+        "            time.sleep(2 ** attempt)\n"), "retry-discipline")
+    assert len(bad) == 1 and bad[0].lineno == 7
+    assert "utils/retry" in bad[0].message
+
+
+def test_retry_discipline_allows_poll_and_drain_loops(tmp_path):
+    # pure poll loop: sleep, no except
+    assert run_on(tmp_path, "jobs/poll.py", (
+        "import time\n"
+        "def wait():\n"
+        "    while not ready():\n"
+        "        time.sleep(0.05)\n"), "retry-discipline") == []
+    # pure drain loop: except, no sleep
+    assert run_on(tmp_path, "sync/drain.py", (
+        "def drain(q):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            q.get_nowait()\n"
+        "        except Exception:\n"
+        "            return\n"), "retry-discipline") == []
+
+
+def test_retry_discipline_scoped_to_production_dirs(tmp_path):
+    """utils/ (where retry_call's own backoff loop lives) and other
+    out-of-scope dirs stay silent."""
+    src = (
+        "import time\n"
+        "def retry():\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return do()\n"
+        "        except OSError:\n"
+        "            time.sleep(1)\n")
+    assert run_on(tmp_path, "utils/retry.py", src, "retry-discipline") == []
+    assert run_on(tmp_path, "server/x.py", src, "retry-discipline") == []
+
+
 # -- waivers ------------------------------------------------------------------
 
 def test_scoped_waiver_silences_only_named_pass(tmp_path):

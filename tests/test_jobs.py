@@ -197,7 +197,10 @@ def test_shutdown_checkpoints_then_cold_resume_finishes(library):
     assert [s for t, s in EXECUTED if t == "s2"] == [0, 1]
 
 
-def test_cold_resume_cancels_unknown_job(library):
+def test_cold_resume_fails_unknown_job_loudly(library):
+    """An unresumable report is a FAILURE the user can see (errors_text +
+    notification) — not a silent Canceled (tests/test_faults.py covers the
+    corrupt-blob variant and the notification payload)."""
     from spacedrive_tpu.jobs import JobReport
 
     report = JobReport.new("does_not_exist")
@@ -206,7 +209,9 @@ def test_cold_resume_cancels_unknown_job(library):
     report.create(library.db)
     jobs = Jobs()
     assert jobs.cold_resume(library) == 0
-    assert report_of(library, report.id)["status"] == JobStatus.CANCELED
+    row = report_of(library, report.id)
+    assert row["status"] == JobStatus.FAILED
+    assert "cold resume failed" in row["errors_text"]
 
 
 def test_full_scan_pipeline_cold_resumes_across_processes(tmp_path):
