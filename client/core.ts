@@ -98,6 +98,8 @@ export type Procedures = {
 	{ key: "tags.getForObject", input: number, result: TagRow[] } |
 	{ key: "tags.getWithObjects", input: unknown, result: unknown } |
 	{ key: "tags.list", input: null, result: TagRow[] } |
+	{ key: "telemetry.jobTrace", input: string | { job_id: string }, result: Record<string, unknown> | null } |
+	{ key: "telemetry.snapshot", input: null, result: Record<string, unknown> } |
 	{ key: "volumes.list", input: null, result: Record<string, unknown>[] },
   mutations:
 	{ key: "albums.addObjects", input: { id: number; object_ids: number[] }, result: number } |
@@ -332,6 +334,8 @@ export type NodeProcedureKey =
 	"p2p.peers" |
 	"p2p.spacedrop" |
 	"search.ephemeralPaths" |
+	"telemetry.jobTrace" |
+	"telemetry.snapshot" |
 	"toggleFeatureFlag" |
 	"volumes.list";
 export type ProcedureKey = LibraryProcedureKey | NodeProcedureKey;
@@ -474,6 +478,8 @@ export const procedures = {
 	"tags.getWithObjects": { kind: "query", scope: "library" },
 	"tags.list": { kind: "query", scope: "library" },
 	"tags.update": { kind: "mutation", scope: "library" },
+	"telemetry.jobTrace": { kind: "query", scope: "node" },
+	"telemetry.snapshot": { kind: "query", scope: "node" },
 	"toggleFeatureFlag": { kind: "mutation", scope: "node" },
 	"volumes.list": { kind: "query", scope: "node" },
 } as const;

@@ -550,6 +550,14 @@ window.SD_PROCEDURES = {
   "kind": "mutation",
   "scope": "library"
  },
+ "telemetry.jobTrace": {
+  "kind": "query",
+  "scope": "node"
+ },
+ "telemetry.snapshot": {
+  "kind": "query",
+  "scope": "node"
+ },
  "toggleFeatureFlag": {
   "kind": "mutation",
   "scope": "node"

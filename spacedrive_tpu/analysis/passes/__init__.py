@@ -16,6 +16,7 @@ from .pipeline_ordering import PipelineOrderingPass
 from .resource_leak import ResourceLeakPass
 from .retry_discipline import RetryDisciplinePass
 from .swallowed import SwallowedExceptionPass
+from .telemetry_discipline import TelemetryDisciplinePass
 
 REGISTRY: tuple[type[AnalysisPass], ...] = (
     # legacy hygiene gates (formerly utils/lint.py)
@@ -30,6 +31,7 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     SwallowedExceptionPass,
     PipelineOrderingPass,
     RetryDisciplinePass,
+    TelemetryDisciplinePass,
 )
 
 

@@ -1,0 +1,166 @@
+"""telemetry-discipline: ad-hoc timing goes through spans; metric names
+match the vocabulary.
+
+Two rules, scoped to the production subsystems
+(jobs|objects|pipeline|sync|p2p):
+
+1. **No hand-rolled stage timing into report/metric dicts.** A
+   ``time.time()``/``time.perf_counter()`` delta stored into a dict —
+   ``batch["gather_s"] = time.perf_counter() - t0`` or
+   ``{"media_time": time.perf_counter() - t0}`` — is exactly the
+   bench-only instrumentation ISSUE 5 replaced: it cannot appear in the
+   job trace, cannot be scraped, and silently drifts from the span data
+   the report now reads. Wrap the timed section in
+   ``telemetry.span(...)`` and store ``sp.duration_s`` instead.
+   (Deltas used for log lines, rate math, or local variables stay
+   legal — only dict stores are flagged, because dicts are how timings
+   reach reports and metrics.)
+
+2. **Metric names match ``^sd_[a-z0-9_]+$``.** Any
+   ``*.counter("name", ...)`` / ``*.gauge(...)`` / ``*.histogram(...)``
+   call whose first argument is a string literal outside the vocabulary
+   is flagged — the registry would reject it at runtime, but only on the
+   first code path that reaches it; the pass fails the tree at commit
+   time instead.
+
+Mechanics for rule 1: within each file, names bound by a plain
+``name = time.perf_counter()`` / ``time.time()`` assignment are timer
+names; a ``BinOp`` subtraction with a timer call or timer name as an
+operand is a *delta*; a delta is flagged when it (or an expression
+containing it) is assigned to a Subscript target, augmented-assigned to
+one, or appears as a value in a dict literal.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+
+SCOPED_DIRS = ("jobs", "objects", "pipeline", "sync", "p2p")
+
+#: call chains that produce a wall-clock timestamp (rule 1)
+TIME_CHAINS = frozenset({
+    "time.time", "time.perf_counter",
+    "_time.time", "_time.perf_counter",
+    "perf_counter",  # from time import perf_counter
+})
+
+#: method names that declare/resolve a metric family (rule 2)
+METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+
+METRIC_NAME_RE = re.compile(r"^sd_[a-z0-9_]+$")
+
+
+def _is_time_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) in TIME_CHAINS)
+
+
+def _timer_names(tree: ast.Module) -> set[str]:
+    """Names bound (anywhere in the file) by ``name = time.perf_counter()``
+    — coarse but effective: a name that EVER holds a timestamp makes any
+    subtraction against it a timing delta."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_time_call(node.value):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    names.add(target.id)
+    return names
+
+
+#: value-preserving wrappers a stored delta commonly hides in
+#: (``d["x"] = round(now - t0, 3)`` is still hand-rolled report timing)
+_TRANSPARENT_CALLS = frozenset({"round", "min", "max", "abs", "float"})
+
+
+def _walk_no_calls(node: ast.AST):
+    """Walk ``node`` without descending into Call arguments — EXCEPT
+    value-preserving wrappers (round/min/max/abs/float), which pass the
+    delta through to the store. A delta passed into any other function
+    (``score(now - t0)``) is that callee's business — only a delta that
+    IS the stored value (possibly wrapped in arithmetic or a transparent
+    call) marks hand-rolled report timing."""
+    yield node
+    if isinstance(node, ast.Call):
+        if not (isinstance(node.func, ast.Name)
+                and node.func.id in _TRANSPARENT_CALLS):
+            return
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_no_calls(child)
+
+
+def _contains_delta(node: ast.AST, timers: set[str]) -> ast.BinOp | None:
+    """First Sub BinOp under ``node`` (outside call args) with a
+    timestamp operand."""
+    for sub in _walk_no_calls(node):
+        if not (isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Sub)):
+            continue
+        for operand in (sub.left, sub.right):
+            if _is_time_call(operand):
+                return sub
+            if isinstance(operand, ast.Name) and operand.id in timers:
+                return sub
+    return None
+
+
+class TelemetryDisciplinePass(AnalysisPass):
+    id = "telemetry-discipline"
+    description = ("perf_counter/time.time deltas stored into report/metric "
+                   "dicts (use telemetry.span), and metric names outside "
+                   "^sd_[a-z0-9_]+$ in jobs|objects|pipeline|sync|p2p")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dirs(*SCOPED_DIRS):
+            return
+        timers = _timer_names(ctx.tree)
+
+        for node in ast.walk(ctx.tree):
+            # rule 1a: d["k"] = <delta> / d["k"] += <delta>
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                if any(isinstance(t, ast.Subscript) for t in targets):
+                    delta = _contains_delta(node.value, timers)
+                    if delta is not None:
+                        yield ctx.finding(
+                            delta.lineno, self.id,
+                            "timing delta stored into a dict: route the "
+                            "measurement through telemetry.span and store "
+                            "span.duration_s")
+            # rule 1b: {"k": <delta>} dict literals (report/metadata shapes)
+            elif isinstance(node, ast.Dict):
+                for value in node.values:
+                    if value is None:
+                        continue  # **splat
+                    delta = _contains_delta(value, timers)
+                    if delta is not None:
+                        yield ctx.finding(
+                            delta.lineno, self.id,
+                            "timing delta in a dict literal: route the "
+                            "measurement through telemetry.span and store "
+                            "span.duration_s")
+            # rule 2: metric-name vocabulary at declaration sites
+            elif isinstance(node, ast.Call):
+                chain = dotted_name(node.func)
+                if chain is None:
+                    continue
+                method = chain.rsplit(".", 1)[-1]
+                if method not in METRIC_FACTORIES or "." not in chain:
+                    # bare counter()/gauge() names are too generic to
+                    # attribute (collections.Counter locals etc.); the
+                    # codebase declares via <module>.counter(...)
+                    continue
+                if not node.args:
+                    continue
+                first = node.args[0]
+                if isinstance(first, ast.Constant) \
+                        and isinstance(first.value, str) \
+                        and not METRIC_NAME_RE.match(first.value):
+                    yield ctx.finding(
+                        node.lineno, self.id,
+                        f"metric name {first.value!r} must match "
+                        f"{METRIC_NAME_RE.pattern}")

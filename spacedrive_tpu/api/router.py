@@ -133,12 +133,12 @@ def mount(node: "Node") -> Router:
     from .routers import (backups, categories, collections, files, jobs,
                           keys, libraries, locations, nodes, notifications,
                           p2p, preferences, root, search, sync, tags,
-                          volumes)
+                          telemetry, volumes)
 
     router = Router(node)
     for module in (root, libraries, locations, search, files, jobs, tags,
                    volumes, nodes, notifications, preferences, backups,
-                   categories, sync, p2p, keys, collections):
+                   categories, sync, p2p, keys, collections, telemetry):
         module.mount(router)
     invalidate.validate(router)
     # typed-client contract: every key in api/types.py must exist (the

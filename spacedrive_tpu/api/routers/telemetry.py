@@ -1,0 +1,31 @@
+"""telemetry.* procedures (ISSUE 5): the rspc view of the unified
+registry and the per-job trace trees.
+
+- ``telemetry.snapshot`` — metrics + recent events + recent trace
+  summaries in one JSON document (what ``python -m
+  spacedrive_tpu.telemetry`` pretty-prints).
+- ``telemetry.jobTrace`` — the nested span tree of one job run (in-memory
+  ring first, then the exported JSONL under ``<data_dir>/logs/traces/``),
+  or null when nothing was recorded (``SD_TELEMETRY=off`` runs).
+"""
+
+from __future__ import annotations
+
+from ... import telemetry
+from ..router import ApiError
+
+
+def mount(router) -> None:
+    @router.query("telemetry.snapshot")
+    def snapshot(node, _arg):
+        """Full telemetry state of this node process."""
+        return telemetry.snapshot()
+
+    @router.query("telemetry.jobTrace")
+    def job_trace(node, arg):
+        """Span tree for a job id (arg: the id string, or
+        {"job_id": ...}); null when no trace was recorded."""
+        job_id = arg.get("job_id") if isinstance(arg, dict) else arg
+        if not job_id or not isinstance(job_id, str):
+            raise ApiError("telemetry.jobTrace needs a job id")
+        return telemetry.job_trace(job_id, data_dir=node.data_dir)

@@ -170,6 +170,10 @@ TYPES: dict[str, tuple[str, str]] = {
     "p2p.peers": ("null", "PeerMetadata[]"),
     # sync
     "sync.messages": ("null", "Record<string, unknown>[]"),
+    # telemetry
+    "telemetry.jobTrace": ("string | { job_id: string }",
+                           "Record<string, unknown> | null"),
+    "telemetry.snapshot": ("null", "Record<string, unknown>"),
 }
 
 

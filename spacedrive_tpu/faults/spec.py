@@ -38,6 +38,14 @@ from dataclasses import dataclass, field
 from random import Random
 from typing import Callable
 
+from .. import telemetry
+
+#: every fired rule is visible to operators, not only to chaos benches:
+#: ``sd_faults_fired_total{seam,kind}`` on the unified registry
+_FIRED_TOTAL = telemetry.counter(
+    "sd_faults_fired_total", "injected faults fired, per seam:kind",
+    labels=("seam", "kind"))
+
 
 class FaultInjected(RuntimeError):
     """Generic injected crash (kind ``crash``) — classified transient
@@ -187,6 +195,7 @@ class FaultPlan:
                     break
         if fired_rule is None:
             return
+        _FIRED_TOTAL.inc(seam=fired_rule.seam, kind=fired_rule.kind)
         if fired_rule.kind == "hang":
             # the "never returns" failure mode (wedged tunnel, dead NFS):
             # block far past any drain deadline; daemon stage threads die

@@ -27,6 +27,7 @@ import logging
 import time
 from typing import TYPE_CHECKING, Any, ClassVar
 
+from .. import telemetry
 from .error import EarlyFinish, JobError
 from .report import JobReport
 
@@ -34,6 +35,10 @@ if TYPE_CHECKING:
     from .worker import WorkerContext
 
 logger = logging.getLogger(__name__)
+
+_STEP_SECONDS = telemetry.histogram(
+    "sd_job_step_seconds", "sequential step latency per job",
+    labels=("job",))
 
 JOB_REGISTRY: dict[str, type["StatefulJob"]] = {}
 
@@ -194,15 +199,20 @@ class DynJob:
         """
         state = self.state
         run_t0 = time.perf_counter()  # per-phase timing (job/mod.rs:591,798,858)
+        #: True when this run continues a checkpoint (pause/cold resume) —
+        #: whole-job rate gauges must not divide accumulated totals by
+        #: only this run's elapsed time
+        self.was_resumed = state.data is not None
         errors: list[str] = list(filter(None, (self.report.errors_text or "").split("\n\n")))
         # expose to the pause path: JobPaused must carry these so they survive
         # the checkpoint (a resume re-reads them from report.errors_text)
         self._soft_errors = errors
 
+        trace = getattr(self, "trace", None)
         if state.data is None:  # fresh run (not a resume)
-            t0 = time.perf_counter()
             try:
-                data, steps, meta = self.job.init(ctx)
+                with telemetry.span(trace, "job.init") as init_sp:
+                    data, steps, meta = self.job.init(ctx)
             except EarlyFinish as e:
                 logger.info("job %s early finish: %s", self.job.NAME, e)
                 return self.job.finalize(ctx, {}, {}), errors
@@ -212,7 +222,8 @@ class DynJob:
             state.step_number = 0
             ctx.progress(task_count=len(state.steps),
                          message=f"{self.job.NAME}: {len(state.steps)} steps")
-            logger.debug("job %s init phase took %.3fs", self.job.NAME, time.perf_counter() - t0)
+            logger.debug("job %s init phase took %.3fs", self.job.NAME,
+                         init_sp.duration_s)
             ctx.check_commands(self)  # a pause during init checkpoints cleanly
 
         spec = self.job.pipeline_spec()
@@ -230,9 +241,12 @@ class DynJob:
         while state.step_number < len(state.steps):
             ctx.check_commands(self)
             step = state.steps[state.step_number]
-            t0 = time.perf_counter()
             try:
-                result = self.job.execute_step(ctx, state.data, step, state.step_number)
+                with telemetry.span(trace, "job.step",
+                                    step=state.step_number) as step_sp:
+                    result = self.job.execute_step(ctx, state.data, step,
+                                                   state.step_number)
+                _STEP_SECONDS.observe(step_sp.duration_s, job=self.job.NAME)
             except EarlyFinish:
                 break
             # a raised exception is fatal (reference: a step Err fails the job);
@@ -247,7 +261,8 @@ class DynJob:
             state.step_number += 1
             ctx.progress(completed_task_count=state.step_number)
             logger.debug("job %s step %d finished in %.3fs",
-                         self.job.NAME, state.step_number - 1, time.perf_counter() - t0)
+                         self.job.NAME, state.step_number - 1,
+                         step_sp.duration_s)
 
         metadata = self.job.finalize(ctx, state.data or {}, state.run_metadata)
         logger.info("Total job run time %.3fs (%s, %d steps)",

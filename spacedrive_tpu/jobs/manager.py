@@ -18,9 +18,11 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from typing import TYPE_CHECKING
 
+from .. import telemetry
 from ..models import JobRow
 from .error import JobAlreadyRunning
 from .job import DynJob, StatefulJob
@@ -33,6 +35,11 @@ if TYPE_CHECKING:
 logger = logging.getLogger(__name__)
 
 MAX_WORKERS = 1
+
+_RUNNING = telemetry.gauge("sd_jobs_running", "running workers per lane",
+                           labels=("lane",))
+_QUEUED = telemetry.gauge("sd_jobs_queued",
+                          "jobs waiting for lane capacity")
 
 
 class Jobs:
@@ -76,6 +83,11 @@ class Jobs:
         return sum(1 for w in self._running.values()
                    if w.dyn_job.job.LANE == lane)
 
+    def _update_occupancy(self, lane: str) -> None:
+        """Lane-occupancy + queue-depth gauges (callers hold the lock)."""
+        _RUNNING.set(self._lane_load(lane), lane=lane)
+        _QUEUED.set(len(self._queue))
+
     def _pop_dispatchable(self) -> tuple["Library", DynJob] | None:
         """First queued job whose lane has capacity (callers hold the lock)."""
         for i, (lib, queued) in enumerate(self._queue):
@@ -85,6 +97,9 @@ class Jobs:
         return None
 
     def ingest(self, library: "Library", dyn_job: DynJob) -> None:
+        # queue-wait accounting: the worker observes dispatch latency from
+        # this stamp (immediately dispatched jobs record ~0)
+        dyn_job._queued_at_monotonic = time.monotonic()
         with self._lock:
             if self._shutting_down:
                 raise JobAlreadyRunning("job system is shutting down")
@@ -103,6 +118,7 @@ class Jobs:
                 dyn_job.report.status = JobStatus.QUEUED
                 dyn_job.report.upsert(library.db)
                 self._queue.append((library, dyn_job))
+                self._update_occupancy(dyn_job.job.LANE)
                 logger.debug("job %s queued (%d in queue)",
                              dyn_job.job.NAME, len(self._queue))
 
@@ -112,6 +128,7 @@ class Jobs:
         job or pops the queue (manager.rs:180-205)."""
         with self._lock:
             self._running.pop(worker.report.id, None)
+            self._update_occupancy(worker.dyn_job.job.LANE)
             if not self._shutting_down:
                 if next_job is not None:
                     try:
@@ -133,6 +150,7 @@ class Jobs:
         worker = Worker(self, library, dyn_job)
         self._running[dyn_job.id] = worker
         self._idle.clear()
+        self._update_occupancy(dyn_job.job.LANE)
         logger.info("dispatching job %s (%s)", dyn_job.job.NAME, dyn_job.id[:8])
         worker.start()
 
@@ -154,6 +172,7 @@ class Jobs:
                         del self._queue[i]
                         queued.report.status = JobStatus.CANCELED
                         queued.report.upsert(lib.db)
+                        self._update_occupancy(queued.job.LANE)
                         return True
                 return False
         worker.send_command(WorkerCommand.CANCEL)

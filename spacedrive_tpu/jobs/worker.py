@@ -14,6 +14,7 @@ import threading
 import time
 from typing import TYPE_CHECKING
 
+from .. import telemetry
 from ..models import utc_now
 from .error import JobCanceled, JobPaused
 from .job import DynJob
@@ -26,6 +27,13 @@ if TYPE_CHECKING:
 logger = logging.getLogger(__name__)
 
 PROGRESS_THROTTLE_S = 0.05
+
+_QUEUE_WAIT = telemetry.histogram(
+    "sd_job_queue_wait_seconds", "dispatch-queue wait per job",
+    labels=("lane",))
+_COMPLETED = telemetry.counter(
+    "sd_jobs_completed_total", "finished jobs by name and status",
+    labels=("job", "status"))
 
 
 class WorkerCommand:
@@ -42,6 +50,9 @@ class WorkerContext:
         self._worker = worker
         self.library = worker.library
         self.node = worker.library.node if worker.library else None
+        #: the job's telemetry trace (None with SD_TELEMETRY=off) — job code
+        #: opens child spans with ``telemetry.span(ctx.trace, ...)``
+        self.trace = getattr(worker, "trace", None)
 
     def progress(self, completed_task_count: int | None = None,
                  task_count: int | None = None, message: str | None = None) -> None:
@@ -70,6 +81,7 @@ class Worker:
         self._thread: threading.Thread | None = None
         self._started_at = 0.0
         self._last_progress_emit = 0.0
+        self.trace = None  # opened at _do_work start
 
     # -- control ------------------------------------------------------------
     def start(self) -> None:
@@ -124,6 +136,20 @@ class Worker:
         r.date_started = utc_now()
         r.upsert(self.library.db)
         self._started_at = time.monotonic()
+        queued_at = getattr(self.dyn_job, "_queued_at_monotonic", None)
+        if queued_at is not None:
+            _QUEUE_WAIT.observe(max(0.0, self._started_at - queued_at),
+                                lane=self.dyn_job.job.LANE)
+        # the job's trace: root span = the whole run; pipeline stages and
+        # job code nest under it. trace_id == report id so jobTrace(job_id)
+        # resolves directly. resume=True: an in-process pause left the
+        # trace open in the ring, and the resumed run continues it so the
+        # final tree's span sums match the report's accumulated metadata.
+        self.trace = telemetry.start_trace(
+            f"job.{r.name}", trace_id=r.id, resume=True,
+            job=r.name, job_id=r.id, lane=self.dyn_job.job.LANE,
+            library_id=self.library.id if self.library else None)
+        self.dyn_job.trace = self.trace
         ctx = WorkerContext(self)
         run_time = 0.0
         next_job: DynJob | None = None
@@ -157,11 +183,42 @@ class Worker:
             r.date_completed = utc_now()
             self._cancel_children()
         finally:
+            self._finish_telemetry()
             r.upsert(self.library.db)
             self._emit_progress()
             logger.info("job %s -> %s (total run time %.3fs)",
                         r.name, JobStatus.NAMES[r.status], run_time)
             self.manager.complete(self.library, self, next_job)
+
+    def _finish_telemetry(self) -> None:
+        """Close the trace, export its JSONL under the node data dir, and
+        attach the summarized span totals to the report's metadata (paused
+        jobs keep their trace in the ring only — metadata is reserved for
+        the final run)."""
+        r = self.report
+        # count TERMINAL exits only — a pause is not a completion, and a
+        # paused-then-resumed job must not count twice
+        if r.status in JobStatus.FINISHED:
+            _COMPLETED.inc(job=r.name,
+                           status=JobStatus.NAMES.get(r.status,
+                                                      str(r.status)))
+        if self.trace is None:
+            return
+        if r.status not in JobStatus.FINISHED:
+            # paused: the trace stays OPEN in the ring — an in-process
+            # resume continues it (start_trace resume=True), and only the
+            # terminal run finishes, exports, and summarizes the complete
+            # tree (so span sums reconcile with the job's accumulated
+            # metadata even across a pause)
+            return
+        try:
+            node = self.library.node if self.library else None
+            summary = telemetry.finish_trace(
+                self.trace, export_dir=node.data_dir if node else None)
+            if summary:
+                r.metadata = {**(r.metadata or {}), "trace": summary}
+        except Exception:
+            logger.exception("trace finalization failed for job %s", r.id)
 
     def _pause_children(self, _blob: bytes) -> None:
         """Persist queued-next chain as Paused reports (job/mod.rs:917-951)."""

@@ -28,16 +28,25 @@ the rest of the identify run instead of waiting for it to finish.
 from __future__ import annotations
 
 import logging
-import time
 import uuid
 from typing import Any
 
-from .. import faults
+from .. import faults, telemetry
 from ..jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
 from ..models import FilePath, Location, Object, utc_now
 from ..sync.crdt import ref
 from .cas import read_sampled_batch_fast as read_sampled_batch
 from .hasher import HybridHasher, get_hasher
+
+_QUARANTINED = telemetry.counter(
+    "sd_quarantined_files_total",
+    "per-item failures quarantined by the identifier")
+_RECOVERED = telemetry.counter(
+    "sd_recovered_batches_total",
+    "hash batches re-dispatched on the CPU ladder after a device failure")
+_SCAN_RATE = telemetry.gauge(
+    "sd_scan_files_per_sec",
+    "files/s of the most recent completed identify pass")
 
 _THUMBABLE_EXTS: list = []
 
@@ -149,10 +158,17 @@ class FileIdentifierJob(StatefulJob):
                 empty.append(row)  # "We can't do shit with empty files"
 
         location_path = data["location_path"]
-        t0 = time.perf_counter()
-        messages = read_sampled_batch(
-            [_abs_path(location_path, r) for r in hashable],
-            [r["size_in_bytes"] for r in hashable])
+        # ad-hoc timing goes through spans (telemetry-discipline): the
+        # gather duration lands in the report via the span, nests under
+        # pipeline.page in the job trace, and still measures when
+        # telemetry is off (bare-timer degradation)
+        with telemetry.span(getattr(ctx, "trace", None), "identifier.gather",
+                            files=len(hashable)) as gather_sp:
+            messages = read_sampled_batch(
+                [_abs_path(location_path, r) for r in hashable],
+                [r["size_in_bytes"] for r in hashable])
+            gather_sp.set(bytes=sum(len(m) for m in messages
+                                    if not isinstance(m, Exception)))
         # the cas message is size_le_8 ‖ header ‖ … — its head IS the file's
         # first bytes, so magic-byte kind resolution rides the gather for
         # free instead of re-opening every file on the commit thread (the
@@ -165,7 +181,7 @@ class FileIdentifierJob(StatefulJob):
         for row in empty:
             row["_kind_head"] = b""  # what _read_head returns for empty files
         return {"cursor": rows[-1]["id"], "hashable": hashable, "empty": empty,
-                "messages": messages, "gather_s": time.perf_counter() - t0}
+                "messages": messages, "gather_s": gather_sp.duration_s}
 
     # -- stage 2: dispatch (device/CPU compute) ------------------------------
     def pipeline_process(self, ctx: WorkerContext, data: dict,
@@ -174,43 +190,48 @@ class FileIdentifierJob(StatefulJob):
 
         hasher = get_hasher(data.get("hasher"), node=ctx.node)
         hashable = batch["hashable"]
-        t0 = time.perf_counter()
         #: _probe_rates needs k = sampled//2 >= 8 files per engine slice —
         #: below that the fused call can't conclude a probe, so re-reading
         #: the files it would do is pure waste (the gather already ran)
         probe_worthy = sum(1 for r in hashable
                            if r["size_in_bytes"] > MINIMUM_FILE_SIZE) >= 16
-        try:
-            faults.inject("hash")
-            if getattr(hasher, "_cpu_rate", None) is None \
-                    and isinstance(hasher, HybridHasher) \
-                    and hasher._cpu._fast is not None and probe_worthy:
-                # unprobed hybrid: run this batch through the fused path so
-                # the engine probe happens (the gather above left the page
-                # cache warm); later batches take the gathered route with
-                # the verdict
-                location_path = data["location_path"]
-                cas_results = hasher.hash_batch(
-                    [_abs_path(location_path, r) for r in hashable],
-                    [r["size_in_bytes"] for r in hashable])
-            else:
-                cas_results = hasher.hash_gathered(batch["messages"])
-        except Exception as e:  # noqa: BLE001 — degradation ladder below
-            # mid-batch hasher failure (device wedge, dying backend): this
-            # batch re-dispatches on the native CPU path over the already-
-            # gathered messages (byte-identical cas_ids), the hybrid verdict
-            # flips so later batches skip the dead engine, and the pipeline
-            # keeps moving. A CPU-path failure here raises through to stage
-            # supervision — there is no rung below the oracle.
-            logger.exception("hash dispatch failed mid-batch; re-dispatching "
-                             "batch on the native CPU path")
-            degrade = getattr(hasher, "degrade_device", None)
-            if degrade is not None:
-                degrade(repr(e))
-            cas_results = get_hasher("cpu").hash_gathered(batch["messages"])
-            batch["recovered_error"] = repr(e)
+        with telemetry.span(getattr(ctx, "trace", None), "identifier.hash",
+                            files=len(hashable)) as hash_sp:
+            try:
+                faults.inject("hash")
+                if getattr(hasher, "_cpu_rate", None) is None \
+                        and isinstance(hasher, HybridHasher) \
+                        and hasher._cpu._fast is not None and probe_worthy:
+                    # unprobed hybrid: run this batch through the fused path
+                    # so the engine probe happens (the gather above left the
+                    # page cache warm); later batches take the gathered
+                    # route with the verdict
+                    location_path = data["location_path"]
+                    cas_results = hasher.hash_batch(
+                        [_abs_path(location_path, r) for r in hashable],
+                        [r["size_in_bytes"] for r in hashable])
+                else:
+                    cas_results = hasher.hash_gathered(batch["messages"])
+            except Exception as e:  # noqa: BLE001 — degradation ladder below
+                # mid-batch hasher failure (device wedge, dying backend):
+                # this batch re-dispatches on the native CPU path over the
+                # already-gathered messages (byte-identical cas_ids), the
+                # hybrid verdict flips so later batches skip the dead
+                # engine, and the pipeline keeps moving. A CPU-path failure
+                # here raises through to stage supervision — there is no
+                # rung below the oracle.
+                logger.exception("hash dispatch failed mid-batch; "
+                                 "re-dispatching batch on the native CPU "
+                                 "path")
+                degrade = getattr(hasher, "degrade_device", None)
+                if degrade is not None:
+                    degrade(repr(e))
+                cas_results = get_hasher("cpu").hash_gathered(
+                    batch["messages"])
+                batch["recovered_error"] = repr(e)
+                _RECOVERED.inc()
         batch["cas_results"] = cas_results
-        batch["hash_s"] = time.perf_counter() - t0
+        batch["hash_s"] = hash_sp.duration_s
         batch["messages"] = None  # the gather buffers are dead weight now
         return batch
 
@@ -235,6 +256,8 @@ class FileIdentifierJob(StatefulJob):
                 quarantined += 1
             else:
                 identified.append((row, cas))
+        if quarantined:
+            _QUARANTINED.inc(quarantined)
         if batch.get("recovered_error"):
             errors.append(f"hash batch recovered on native CPU path after: "
                           f"{batch['recovered_error']}")
@@ -386,6 +409,21 @@ class FileIdentifierJob(StatefulJob):
     def finalize(self, ctx: WorkerContext, data: dict, run_metadata: dict):
         ctx.library.emit("invalidate_query", {"key": "search.paths"})
         ctx.library.emit("invalidate_query", {"key": "search.objects"})
+        # the operator's headline number: identify throughput of the pass
+        # that just finished (elapsed read off the job's root span).
+        # Un-resumed passes only: a CROSS-PROCESS resume starts a fresh
+        # trace whose elapsed covers just the final run, and dividing the
+        # checkpoint-accumulated file total by it would inflate the gauge
+        # (an in-process resume continues the original trace, but the gate
+        # keys on the checkpoint either way — conservative, never bogus)
+        trace = getattr(ctx, "trace", None)
+        total = run_metadata.get("total_orphan_paths") or 0
+        dyn = getattr(getattr(ctx, "_worker", None), "dyn_job", None)
+        resumed = dyn is not None and getattr(dyn, "was_resumed", False)
+        if trace is not None and total and not resumed:
+            elapsed = trace.elapsed_s()
+            if elapsed > 0:
+                _SCAN_RATE.set(round(total / elapsed, 1))
         logger.info("file_identifier finished: %s", run_metadata)
         return run_metadata
 

@@ -24,11 +24,16 @@ job errors instead of aborting the batch.
 
 from __future__ import annotations
 
+import functools
 import logging
+import threading
+import time
 from pathlib import Path
 from typing import Callable, Protocol
 
-from .cas import SAMPLED_MESSAGE_LEN, generate_cas_id, read_sampled_batch
+from .. import telemetry
+from .cas import (MINIMUM_FILE_SIZE, SAMPLED_MESSAGE_LEN, generate_cas_id,
+                  read_sampled_batch)
 
 logger = logging.getLogger(__name__)
 
@@ -36,6 +41,123 @@ logger = logging.getLogger(__name__)
 #: largest whole-file message (100KiB + 8B size prefix)
 SMALL_BUCKETS = (1, 4, 16, 32, 64, 101)
 SAMPLED_CHUNKS = (SAMPLED_MESSAGE_LEN + 1023) // 1024  # 57
+
+
+# -- dispatch telemetry --------------------------------------------------------
+# Per-batch accounting on the unified registry: batches/files/payload-bytes
+# per backend, plus the live files-per-sec / bytes-per-sec / MFU gauges the
+# roofline model turns the last batch into. The decorators guard with a
+# thread-local "outermost" flag so composed backends (hybrid → cpu/tpu,
+# remote → hybrid fallback) count each batch exactly once, attributed to
+# the entry-point backend.
+
+_HASH_BATCHES = telemetry.counter(
+    "sd_hash_batches_total", "hash batches dispatched per backend",
+    labels=("backend",))
+_HASH_FILES = telemetry.counter(
+    "sd_hash_files_total", "files hashed per backend", labels=("backend",))
+_HASH_BYTES = telemetry.counter(
+    "sd_hash_bytes_total", "cas-message payload bytes hashed per backend",
+    labels=("backend",))
+_HASH_SECONDS = telemetry.histogram(
+    "sd_hash_batch_seconds", "hash batch latency per backend",
+    labels=("backend",))
+_HASH_RATE = telemetry.gauge(
+    "sd_hash_files_per_sec", "files/s of the last hash batch")
+_HASH_BPS = telemetry.gauge(
+    "sd_hash_bytes_per_sec", "payload bytes/s of the last hash batch")
+_HASH_MFU = telemetry.gauge(
+    "sd_hash_mfu",
+    "u32-VPU model-op-utilization of the last hash batch "
+    "(ops/roofline.py model)")
+
+class _OutermostGuard:
+    """Process-wide outermost-call tracker (not thread-local: the
+    hybrid's work-stealing branch runs the leaf backends on helper
+    THREADS, and those sub-batches must still attribute to the one
+    hybrid batch). Concurrent independent batches undercount to one —
+    acceptable: the jobs manager runs one identify at a time per lane."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._depth = 0
+
+    def enter(self) -> bool:
+        with self._lock:
+            self._depth += 1
+            return self._depth == 1
+
+    def leave(self) -> None:
+        with self._lock:
+            self._depth -= 1
+
+
+_HASH_OUTERMOST = _OutermostGuard()
+
+
+def _message_len(size: int) -> int:
+    """Bytes of the cas message actually hashed for a file of ``size``
+    (sampled layout caps at SAMPLED_MESSAGE_LEN; whole-file below)."""
+    if size > MINIMUM_FILE_SIZE:
+        return SAMPLED_MESSAGE_LEN
+    return size + 8  # size-prefix + whole file
+
+
+def _record_hash(backend: str, files: int, nbytes: int, seconds: float) -> None:
+    _HASH_BATCHES.inc(backend=backend)
+    _HASH_FILES.inc(files, backend=backend)
+    _HASH_BYTES.inc(nbytes, backend=backend)
+    _HASH_SECONDS.observe(seconds, backend=backend)
+    if seconds > 0:
+        from ..ops import roofline
+
+        bps = nbytes / seconds
+        _HASH_RATE.set(round(files / seconds, 1))
+        _HASH_BPS.set(round(bps, 1))
+        _HASH_MFU.set(round(roofline.mfu(bps), 6))
+
+
+def _instrumented(bytes_of: Callable[[tuple], int]):
+    """Wrap a ``hash_batch``/``hash_gathered`` method with outermost-only
+    per-batch accounting; ``bytes_of(args)`` computes the payload size."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            # keyword invocations stay valid against the HasherBackend
+            # protocol; they just skip the accounting (bytes_of reads
+            # positional slots — every production call site is positional)
+            if not telemetry.enabled() or kwargs:
+                return fn(self, *args, **kwargs)
+            outermost = _HASH_OUTERMOST.enter()
+            t0 = time.perf_counter()
+            try:
+                result = fn(self, *args)
+            finally:
+                _HASH_OUTERMOST.leave()
+            # record only COMPLETED batches: an aborted batch (device
+            # wedge mid-call) hashed nothing — counting it would inflate
+            # files/bytes and let the CPU re-dispatch double-count
+            if outermost:
+                _record_hash(self.name, len(args[0]), bytes_of(args),
+                             time.perf_counter() - t0)
+            return result
+        return wrapper
+    return deco
+
+
+def _paths_bytes(args: tuple) -> int:
+    _paths, sizes = args
+    return sum(_message_len(s) for s in sizes)
+
+
+def _messages_bytes(args: tuple) -> int:
+    (messages,) = args
+    return sum(len(m) for m in messages if not isinstance(m, Exception))
+
+
+_count_hash_batch = _instrumented(_paths_bytes)
+_count_hash_gathered = _instrumented(_messages_bytes)
 
 
 class HasherBackend(Protocol):
@@ -60,6 +182,7 @@ class CpuHasher:
     def __init__(self) -> None:
         self._fast = _load_native_hasher()
 
+    @_count_hash_batch
     def hash_batch(self, paths: list[str | Path], sizes: list[int]) -> list[str | Exception]:
         if self._fast is not None:
             return self._fast(paths, sizes)
@@ -71,6 +194,7 @@ class CpuHasher:
                 out.append(e)
         return out
 
+    @_count_hash_gathered
     def hash_gathered(self,
                       messages: list[bytes | Exception]) -> list[str | Exception]:
         """Hash pre-gathered cas messages (the pipelined prefetcher already
@@ -97,6 +221,7 @@ class TpuHasher:
     name = "tpu"
     USES_DEVICE = True
 
+    @_count_hash_batch
     def hash_batch(self, paths: list[str | Path], sizes: list[int]) -> list[str | Exception]:
         from .cas import MINIMUM_FILE_SIZE
 
@@ -179,6 +304,7 @@ class TpuHasher:
 
         return blake3_batch_hex(msgs, max_chunks=cap)
 
+    @_count_hash_gathered
     def hash_gathered(self,
                       messages: list[bytes | Exception]) -> list[str | Exception]:
         """Pre-gathered messages through the device bucket path (sampled
@@ -249,6 +375,7 @@ class HybridHasher:
         for i, r in zip(idxs, res):
             out[i] = r
 
+    @_count_hash_gathered
     def hash_gathered(self,
                       messages: list[bytes | Exception]) -> list[str | Exception]:
         """Gathered-message route inherits the engine verdict from the last
@@ -350,6 +477,7 @@ class HybridHasher:
                     else "routing to native CPU")
         return rest
 
+    @_count_hash_batch
     def hash_batch(self, paths: list[str | Path], sizes: list[int]) -> list[str | Exception]:
         import queue as _q
         import threading
@@ -653,10 +781,12 @@ class RemoteHasher:
             batches.append(cur)
         return batches
 
+    @_count_hash_batch
     def hash_batch(self, paths: list[str | Path],
                    sizes: list[int]) -> list[str | Exception]:
         return self.hash_gathered(read_sampled_batch(paths, sizes))
 
+    @_count_hash_gathered
     def hash_gathered(self,
                       messages: list[bytes | Exception]) -> list[str | Exception]:
         """The natural fit for the pipelined gather: this backend always

@@ -22,8 +22,8 @@ EXIF), ``pipeline_commit`` (MediaData upserts + ``new_thumbnail`` events).
 from __future__ import annotations
 
 import logging
-import time
 
+from ... import telemetry
 from ...jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
 from ...models import FilePath, Location, MediaData
 from .metadata import extract_media_data
@@ -116,51 +116,53 @@ class MediaProcessorJob(StatefulJob):
         node = ctx.library.node
         data_dir = node.data_dir if node else "."
         errors: list[str] = []
-        t0 = time.perf_counter()
         entries = batch["entries"]
 
-        # the step IS the device batch: routed resize calls per step
-        # (generate_thumbnails_batched chunks to RESIZE_SUB_BATCH and falls
-        # back to scalar PIL when the device path loses or is absent). The
-        # tpuThumbnails feature stays the operator opt-in for device resize:
-        # off → the scalar pipeline, exactly the pre-lane behavior
-        allow_device = (node is not None
-                        and node.config.has_feature(BackendFeature.TPU_THUMBNAILS))
-        made: dict[str, object] = {}
-        try:
-            made = generate_thumbnails_batched(
-                [(path, row["cas_id"], ext)
-                 for row, path, ext in entries if can_generate_thumbnail(ext)],
-                data_dir, allow_device=allow_device)
-        except Exception as e:
-            errors.append(f"batched thumbnails: {e!r}")
-
-        thumbed: list[str] = []  # cas_ids with a fresh/preserved thumbnail
-        media_rows: list[tuple[int, dict]] = []  # (object_id, media fields)
-        extracted = 0
-        for row, path, ext in entries:
+        with telemetry.span(getattr(ctx, "trace", None), "media.process",
+                            entries=len(entries)) as media_sp:
+            # the step IS the device batch: routed resize calls per step
+            # (generate_thumbnails_batched chunks to RESIZE_SUB_BATCH and
+            # falls back to scalar PIL when the device path loses or is
+            # absent). The tpuThumbnails feature stays the operator opt-in
+            # for device resize: off → the scalar pipeline, exactly the
+            # pre-lane behavior
+            allow_device = (node is not None and node.config.has_feature(
+                BackendFeature.TPU_THUMBNAILS))
+            made: dict[str, object] = {}
             try:
-                if can_generate_thumbnail(ext):
-                    out = made.get(row["cas_id"])
-                    if out is None:
-                        # batch skipped it (decode/encode failed): scalar
-                        # retry, and the failure goes on record
-                        out = generate_thumbnail(path, data_dir,
-                                                 row["cas_id"], ext)
-                        if out is None:
-                            errors.append(f"{path}: thumbnail failed "
-                                          f"(batched + scalar retry)")
-                    if out is not None:
-                        thumbed.append(row["cas_id"])
-                media = extract_media_data(path, ext)
-                if media and row.get("object_id"):
-                    media_rows.append((row["object_id"], media))
-                    extracted += 1
+                made = generate_thumbnails_batched(
+                    [(path, row["cas_id"], ext) for row, path, ext in entries
+                     if can_generate_thumbnail(ext)],
+                    data_dir, allow_device=allow_device)
             except Exception as e:
-                errors.append(f"{path}: {e!r}")
+                errors.append(f"batched thumbnails: {e!r}")
+
+            thumbed: list[str] = []  # cas_ids with a fresh thumbnail
+            media_rows: list[tuple[int, dict]] = []  # (object_id, fields)
+            extracted = 0
+            for row, path, ext in entries:
+                try:
+                    if can_generate_thumbnail(ext):
+                        out = made.get(row["cas_id"])
+                        if out is None:
+                            # batch skipped it (decode/encode failed):
+                            # scalar retry, and the failure goes on record
+                            out = generate_thumbnail(path, data_dir,
+                                                     row["cas_id"], ext)
+                            if out is None:
+                                errors.append(f"{path}: thumbnail failed "
+                                              f"(batched + scalar retry)")
+                        if out is not None:
+                            thumbed.append(row["cas_id"])
+                    media = extract_media_data(path, ext)
+                    if media and row.get("object_id"):
+                        media_rows.append((row["object_id"], media))
+                        extracted += 1
+                except Exception as e:
+                    errors.append(f"{path}: {e!r}")
         return {"thumbed": thumbed, "media_rows": media_rows,
                 "extracted": extracted, "errors": errors,
-                "media_time": time.perf_counter() - t0}
+                "media_time": media_sp.duration_s}
 
     # -- stage 3: commit (MediaData upserts + events) ------------------------
     def pipeline_commit(self, ctx: WorkerContext, data: dict,

@@ -39,11 +39,18 @@ from .proto import (Header, H_FILE, H_HASH, H_PAIR, H_PING, H_SPACEDROP,
 from .secure import (SecureReader, SecureWriter, derive_session_keys,
                      gen_ephemeral, transcript)
 from .spaceblock import receive_file, send_file
+from .. import telemetry
 
 if TYPE_CHECKING:
     from ..node import Node
 
 logger = logging.getLogger(__name__)
+
+_HASH_REQS = telemetry.counter(
+    "sd_p2p_hash_requests_total", "outbound remote-hasher batches")
+_HASH_REQ_BYTES = telemetry.counter(
+    "sd_p2p_hash_bytes_total",
+    "cas-message bytes shipped to remote hashers")
 
 
 #: deadline for reading a peer-declared H_HASH payload (tests shrink it)
@@ -830,6 +837,11 @@ class P2PManager:
             ids = reply["ids"]
             if len(ids) != len(messages):
                 raise ProtocolError("hash batch reply count mismatch")
+            # counted only after the peer answered: an offline peer (local
+            # fallback takes the batch) must not inflate "bytes shipped"
+            if telemetry.enabled():
+                _HASH_REQS.inc()
+                _HASH_REQ_BYTES.inc(sum(len(m) for m in messages))
             return [str(i) for i in ids]
         finally:
             writer.close()

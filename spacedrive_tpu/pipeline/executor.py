@@ -22,6 +22,7 @@ import threading
 import time
 from typing import TYPE_CHECKING, Any
 
+from .. import telemetry
 from ..utils.retry import RetryPolicy, is_device_wedge, is_transient, retry_call
 
 if TYPE_CHECKING:
@@ -30,6 +31,24 @@ if TYPE_CHECKING:
     from .spec import PipelineSpec
 
 logger = logging.getLogger(__name__)
+
+#: per-stage accounting on the unified registry: busy = executing the
+#: stage callable, blocked = waiting on a full downstream queue
+#: (backpressure), idle = waiting on an empty upstream queue. busy time is
+#: read straight off the stage spans, so /metrics, the jobTrace tree and
+#: the report's pipeline_*_s metadata can never disagree.
+_BUSY = telemetry.counter(
+    "sd_pipeline_stage_busy_seconds",
+    "time each pipeline stage spent executing its callable",
+    labels=("stage",))
+_BLOCKED = telemetry.counter(
+    "sd_pipeline_stage_blocked_seconds",
+    "time each stage spent blocked on a full downstream queue "
+    "(backpressure)", labels=("stage",))
+_IDLE = telemetry.counter(
+    "sd_pipeline_stage_idle_seconds",
+    "time each stage spent waiting on an empty upstream queue",
+    labels=("stage",))
 
 #: poll quantum for queue waits — also bounds pause latency, like the
 #: sequential loop's between-steps command check cadence
@@ -98,7 +117,12 @@ class PipelineExecutor:
         self._pages: queue.Queue[Any] = queue.Queue(maxsize=depth)
         self._results: queue.Queue[Any] = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
-        # per-stage wall time; each attribute is written by exactly one thread
+        #: the job's trace (set by the worker; None with telemetry off) —
+        #: stage spans pin the run() wall span as their parent
+        self.trace = getattr(dyn_job, "trace", None)
+        self._wall_sp = None
+        # per-stage wall time, read off the stage spans; each attribute is
+        # written by exactly one thread
         self._page_s = 0.0
         self._hash_s = 0.0
         self._commit_s = 0.0
@@ -135,13 +159,19 @@ class PipelineExecutor:
         }
         try:
             while budget > 0 and not self._stop.is_set():
-                t0 = time.perf_counter()
-                payload = self.spec.page(self.ctx, self.state.data, scratch)
-                self._page_s += time.perf_counter() - t0
+                with telemetry.span(self.trace, "pipeline.page",
+                                    parent=self._wall_sp) as sp:
+                    payload = self.spec.page(self.ctx, self.state.data,
+                                             scratch)
+                self._page_s += sp.duration_s
+                _BUSY.inc(sp.duration_s, stage="page")
                 if payload is None:
                     break
                 budget -= 1
-                if not self._put(self._pages, payload):
+                t0 = time.perf_counter()
+                ok = self._put(self._pages, payload)
+                _BLOCKED.inc(time.perf_counter() - t0, stage="page")
+                if not ok:
                     return  # draining
             self._put(self._pages, _DONE)
         except BaseException as e:  # noqa: BLE001 — forwarded, fatal
@@ -151,16 +181,24 @@ class PipelineExecutor:
         try:
             while not self._stop.is_set():
                 try:
+                    t0 = time.perf_counter()
                     item = self._pages.get(timeout=_POLL_S)
                 except queue.Empty:
+                    _IDLE.inc(time.perf_counter() - t0, stage="hash")
                     continue
                 if item is _DONE or isinstance(item, _StageFailure):
                     self._put(self._results, item)
                     return
+                with telemetry.span(self.trace, "pipeline.hash",
+                                    parent=self._wall_sp) as sp:
+                    result = self.spec.process(self.ctx, self.state.data,
+                                               item)
+                self._hash_s += sp.duration_s
+                _BUSY.inc(sp.duration_s, stage="hash")
                 t0 = time.perf_counter()
-                result = self.spec.process(self.ctx, self.state.data, item)
-                self._hash_s += time.perf_counter() - t0
-                if not self._put(self._results, result):
+                ok = self._put(self._results, result)
+                _BLOCKED.inc(time.perf_counter() - t0, stage="hash")
+                if not ok:
                     return  # draining
         except BaseException as e:  # noqa: BLE001 — forwarded, fatal
             self._put_nowait_or_drop(self._results, _StageFailure(e))
@@ -171,10 +209,17 @@ class PipelineExecutor:
         from ..jobs.job import merge_metadata
 
         state = self.state
-        wall0 = time.perf_counter()
         budget = len(state.steps) - state.step_number
         if budget <= 0:
             return
+        # the wall-clock span the stage spans nest under; its duration IS
+        # pipeline_wall_s (metadata reads span data, not a parallel clock).
+        # Entered BEFORE the stage threads start so its span_id exists for
+        # their explicit-parent pins.
+        wall_sp = telemetry.span(self.trace, "pipeline.run",
+                                 job=self.dyn_job.job.NAME)
+        wall_sp.__enter__()
+        self._wall_sp = wall_sp
         threads = [
             threading.Thread(target=self._prefetch_loop, args=(budget,),
                              daemon=True, name="pipeline-prefetch"),
@@ -189,8 +234,10 @@ class PipelineExecutor:
                 # state as of the last committed batch, nothing speculative
                 self.ctx.check_commands(self.dyn_job)
                 try:
+                    t0 = time.perf_counter()
                     item = self._results.get(timeout=_POLL_S)
                 except queue.Empty:
+                    _IDLE.inc(time.perf_counter() - t0, stage="commit")
                     continue
                 if item is _DONE:
                     break
@@ -214,13 +261,15 @@ class PipelineExecutor:
                         raise JobPaused(self.dyn_job.serialize_state(),
                                         errors=self.errors)
                     raise exc
-                t0 = time.perf_counter()
-                result = retry_call(
-                    lambda: self.spec.commit(self.ctx, state.data, item),
-                    policy=COMMIT_RETRY, classify=is_transient,
-                    cancel_check=lambda: self.ctx.check_commands(self.dyn_job),
-                    label=f"{self.dyn_job.job.NAME}-commit")
-                self._commit_s += time.perf_counter() - t0
+                with telemetry.span(self.trace, "pipeline.commit") as sp:
+                    result = retry_call(
+                        lambda: self.spec.commit(self.ctx, state.data, item),
+                        policy=COMMIT_RETRY, classify=is_transient,
+                        cancel_check=lambda: self.ctx.check_commands(
+                            self.dyn_job),
+                        label=f"{self.dyn_job.job.NAME}-commit")
+                self._commit_s += sp.duration_s
+                _BUSY.inc(sp.duration_s, stage="commit")
                 self._batches += 1
                 if result.more_steps:
                     raise JobError(
@@ -232,6 +281,7 @@ class PipelineExecutor:
                 state.step_number += 1
                 self.ctx.progress(completed_task_count=state.step_number)
         finally:
+            wall_sp.__exit__(None, None, None)
             self._stop.set()
             # unblock producers stuck on a full queue, then join
             for q in (self._pages, self._results):
@@ -272,14 +322,19 @@ class PipelineExecutor:
         if state.step_number < len(state.steps):
             state.step_number = len(state.steps)
             self.ctx.progress(completed_task_count=state.step_number)
+        # the report's stage timings are READ FROM SPAN DATA: the _page_s/
+        # _hash_s/_commit_s accumulators sum exactly the pipeline.* span
+        # durations above (and still work with telemetry off, where spans
+        # degrade to bare timers), so jobTrace and the scan report reconcile
+        # by construction
         merge_metadata(state.run_metadata, {
             "pipeline_page_s": self._page_s,
             "pipeline_hash_s": self._hash_s,
             "pipeline_commit_s": self._commit_s,
-            "pipeline_wall_s": time.perf_counter() - wall0,
+            "pipeline_wall_s": wall_sp.duration_s,
             "pipeline_batches": self._batches,
         })
         logger.debug(
             "pipeline %s: %d batches, page %.3fs | hash %.3fs | commit %.3fs "
             "| wall %.3fs", self.dyn_job.job.NAME, self._batches, self._page_s,
-            self._hash_s, self._commit_s, time.perf_counter() - wall0)
+            self._hash_s, self._commit_s, wall_sp.duration_s)

@@ -188,6 +188,15 @@ class Server:
         if req.path == "/health":
             return Response.text("OK")
         self._check_auth(req)
+        if req.path == "/metrics":
+            # Prometheus text exposition of the unified registry (ISSUE 5);
+            # renders in-memory state only — no DB, safe on the accept loop
+            from .. import telemetry
+
+            return Response(
+                headers={"content-type":
+                         "text/plain; version=0.0.4; charset=utf-8"},
+                body=telemetry.render_prometheus().encode())
         if not parts:
             from .webui import INDEX_HTML
 

@@ -33,9 +33,10 @@ import contextlib
 import logging
 import queue
 import threading
+import time
 from typing import TYPE_CHECKING, Any, Callable
 
-from .. import faults
+from .. import faults, telemetry
 from ..models import Instance, RelationOperationRow, SharedOperationRow
 from .apply import ApplyError, apply_relation, apply_shared, model_for
 from .crdt import CREATE, DELETE, UPDATE_PREFIX, CRDTOperation, RelationOp, SharedOp
@@ -58,6 +59,14 @@ PROD_BATCH = 1000
 #: bounds both the WAL commit cadence and how much a mid-round failure
 #: can roll back (everything re-pulls idempotently either way)
 SESSION_FLUSH_OPS = 4000
+
+_OPS_INGESTED = telemetry.counter(
+    "sd_sync_ops_ingested_total", "CRDT ops received for ingest")
+_OPS_APPLIED = telemetry.counter(
+    "sd_sync_ops_applied_total",
+    "ingested CRDT ops with materialized effect")
+_WINDOW_SECONDS = telemetry.histogram(
+    "sd_sync_window_seconds", "latency of one ingest window")
 
 
 def _update_field(kind: str) -> str | None:
@@ -335,6 +344,8 @@ class Ingester:
         effect (shadowed ops are still logged)."""
         db = self.library.db
         sync = self.library.sync
+        window_t0 = time.perf_counter()
+        _OPS_INGESTED.inc(len(wire_ops))
 
         # decode first (one malformed wire op — bad '_t', wrong key set —
         # from a buggy or malicious member must not abort the batch and
@@ -399,6 +410,8 @@ class Ingester:
             # rowids can be recycled — repopulating costs one query per
             # instance per batch
             sync._instance_ids.clear()
+        _OPS_APPLIED.inc(applied)
+        _WINDOW_SECONDS.observe(time.perf_counter() - window_t0)
         if applied:
             sync._broadcast(SyncMessage.INGESTED)
         return applied

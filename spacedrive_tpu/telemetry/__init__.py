@@ -1,0 +1,265 @@
+"""Unified telemetry: the metrics registry + structured spans (ISSUE 5).
+
+One process-wide :class:`~.registry.Registry` and one bounded ring of
+per-job :class:`~.spans.Trace` trees, exposed three ways:
+
+- ``GET /metrics`` on the server shell — Prometheus text exposition;
+- ``telemetry.snapshot`` / ``telemetry.jobTrace`` rspc queries;
+- ``python -m spacedrive_tpu.telemetry`` — pretty-printed snapshot.
+
+Instrumented subsystems (the metric catalogue lives in
+docs/architecture/observability.md): job lifecycle (queue wait, step
+latency, lane occupancy), every pipeline stage (busy/blocked/idle),
+hasher dispatch (batches/files/bytes → live files-per-sec and MFU via
+ops/roofline.py), utils/retry.py (attempts, backoff, budget
+exhaustion), the fault seams, sync ingest, and the relay
+probe/recapture path.
+
+``SD_TELEMETRY=off`` turns every record call into a no-op (one global
+read); spans still *measure* so job-report stage timings never depend
+on the switch. This module imports nothing from the rest of the
+package — any layer may instrument without cycles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import deque
+from pathlib import Path
+from typing import Any
+
+from . import spans as _spans
+from .registry import (
+    DEFAULT_BUCKETS,
+    METRIC_NAME_RE,
+    Registry,
+    enabled,
+    reload_enabled,
+    set_enabled,
+)
+from .spans import Span, Trace
+
+__all__ = [
+    "DEFAULT_BUCKETS", "METRIC_NAME_RE", "Registry", "Span", "Trace",
+    "counter", "enabled", "event", "finish_trace", "gauge", "histogram",
+    "job_trace", "recent_events", "registry", "reload_enabled",
+    "render_prometheus", "reset", "series_values", "set_enabled",
+    "snapshot", "span", "start_trace", "value",
+]
+
+_REGISTRY = Registry()
+
+#: recent events (relay recovered, verdict flips) surfaced in snapshot()
+_EVENTS: deque[dict[str, Any]] = deque(maxlen=256)
+_EVENTS_LOCK = threading.Lock()
+
+
+def registry() -> Registry:
+    return _REGISTRY
+
+
+# -- metric declaration passthroughs ------------------------------------------
+
+def counter(name: str, help_text: str = "", labels=()):
+    return _REGISTRY.counter(name, help_text, labels)
+
+
+def gauge(name: str, help_text: str = "", labels=()):
+    return _REGISTRY.gauge(name, help_text, labels)
+
+
+def histogram(name: str, help_text: str = "", labels=(),
+              buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+    return _REGISTRY.histogram(name, help_text, labels, buckets)
+
+
+def value(name: str, **label_values: str) -> float:
+    return _REGISTRY.value(name, **label_values)
+
+
+def series_values(name: str):
+    return _REGISTRY.series_values(name)
+
+
+def render_prometheus() -> str:
+    return _REGISTRY.render_prometheus()
+
+
+# -- spans / traces ------------------------------------------------------------
+
+def span(trace: Trace | None, name: str, parent: Span | None = None,
+         **attrs: Any) -> Span:
+    """A timed section under ``trace`` — or a bare timer when there is no
+    trace (telemetry off, non-job context): callers read
+    ``span.duration_s`` either way. ``parent`` pins a cross-thread parent
+    (pipeline stage threads nest under the job thread's run span)."""
+    if trace is None:
+        return Span(name, trace=None, attrs=attrs)
+    return trace.span(name, parent=parent, **attrs)
+
+
+def start_trace(name: str, trace_id: str | None = None,
+                resume: bool = False, **attrs: Any) -> Trace | None:
+    """Open a trace (None when telemetry is off — every consumer treats a
+    missing trace as 'just time, don't record'). With ``resume=True`` an
+    UNFINISHED ring entry under the same id is continued instead of
+    replaced — how a paused-then-resumed job keeps one tree whose span
+    sums still reconcile with its accumulated report metadata (a
+    cross-process resume necessarily starts fresh)."""
+    if not enabled():
+        return None
+    if resume and trace_id is not None:
+        existing = _spans.get_trace(trace_id)
+        if existing is not None and not existing.finished:
+            return existing
+    trace = Trace(trace_id or str(uuid.uuid4()), name, attrs)
+    _spans.remember(trace)
+    return trace
+
+
+def finish_trace(trace: Trace | None,
+                 export_dir: str | Path | None = None) -> dict[str, Any] | None:
+    """Close the root span, export JSONL under ``<export_dir>/logs/traces/``
+    and return the summarized form (what JobReport metadata carries)."""
+    if trace is None:
+        return None
+    trace.finish()
+    summary = trace.summary()
+    if export_dir is not None:
+        path = _spans.export_trace(trace, export_dir)
+        if path:
+            summary["file"] = path
+    return summary
+
+
+def job_trace(job_id: str,
+              data_dir: str | Path | None = None) -> dict[str, Any] | None:
+    """Nested span tree for a job: the in-memory ring first, then the
+    exported JSONL (survives ring eviction and restarts)."""
+    trace = _spans.get_trace(job_id)
+    if trace is not None:
+        return trace.tree()
+    if data_dir is not None:
+        return _spans.load_trace_tree(job_id, data_dir)
+    return None
+
+
+# -- events --------------------------------------------------------------------
+
+def event(name: str, **attrs: Any) -> None:
+    """A named point-in-time occurrence (relay recovered, device verdict
+    flipped): counted, kept in the snapshot ring."""
+    if not enabled():
+        return
+    # resolved per call (events are rare); the family is pre-declared
+    counter("sd_telemetry_events_total", "named telemetry events",
+            labels=("name",)).inc(name=name)
+    with _EVENTS_LOCK:
+        _EVENTS.append({"name": name, "unix": round(time.time(), 3),
+                        **attrs})
+
+
+def recent_events(limit: int = 64) -> list[dict[str, Any]]:
+    with _EVENTS_LOCK:
+        return list(_EVENTS)[-limit:]
+
+
+# -- snapshot ------------------------------------------------------------------
+
+def snapshot() -> dict[str, Any]:
+    """The full state in one JSON-safe dict — what ``telemetry.snapshot``
+    serves and what the bench's chaos pass reads."""
+    return {
+        "enabled": enabled(),
+        "metrics": _REGISTRY.snapshot(),
+        "events": recent_events(),
+        "recent_traces": _spans.recent_traces(),
+    }
+
+
+def reset() -> None:
+    """Tests: zero every series, drop traces and events (the declared
+    vocabulary survives)."""
+    _REGISTRY.reset()
+    _spans.clear_traces()
+    with _EVENTS_LOCK:
+        _EVENTS.clear()
+    _declare_core()
+
+
+# -- the core vocabulary -------------------------------------------------------
+# Declared eagerly so a scrape exposes the full metric set from process
+# start, not only after the first scan/retry/fault touches each family.
+# Instrumentation sites re-declare their families (same name/labels) to
+# get module-local handles — the registry memoizes by name, and a
+# mismatched re-declaration raises at the site module's import, which is
+# the intended fail-fast: vocabulary drift breaks loudly in any test run
+# instead of silently forking the series.
+
+def _declare_core() -> None:
+    gauge("sd_scan_files_per_sec",
+          "files/s of the most recent completed identify pass")
+    gauge("sd_hash_mfu",
+          "u32-VPU model-op-utilization of the last hash batch "
+          "(ops/roofline.py model)")
+    gauge("sd_hash_files_per_sec", "files/s of the last hash batch")
+    gauge("sd_hash_bytes_per_sec", "payload bytes/s of the last hash batch")
+    busy = counter("sd_pipeline_stage_busy_seconds",
+                   "time each pipeline stage spent executing its callable",
+                   labels=("stage",))
+    blocked = counter("sd_pipeline_stage_blocked_seconds",
+                      "time each stage spent blocked on a full downstream "
+                      "queue (backpressure)", labels=("stage",))
+    idle = counter("sd_pipeline_stage_idle_seconds",
+                   "time each stage spent waiting on an empty upstream "
+                   "queue", labels=("stage",))
+    for fam in (busy, blocked, idle):
+        for stage in ("page", "hash", "commit"):
+            fam.labels(stage=stage)
+    counter("sd_retry_attempts_total",
+            "re-calls made after a transient failure (utils/retry.py)")
+    counter("sd_retry_backoff_seconds_total",
+            "total wall time spent in retry backoff")
+    counter("sd_retry_gave_up_total",
+            "retry budgets exhausted (attempts or wall budget)")
+    counter("sd_faults_fired_total", "injected faults fired, per seam:kind",
+            labels=("seam", "kind"))
+    counter("sd_recovered_batches_total",
+            "hash batches re-dispatched on the CPU ladder after a device "
+            "failure")
+    counter("sd_quarantined_files_total",
+            "per-item failures quarantined by the identifier")
+    counter("sd_relay_probe_total", "relay liveness probes by outcome",
+            labels=("outcome",))
+    counter("sd_relay_recovered_total",
+            "relay recoveries observed by the recapture watcher")
+    counter("sd_sync_ops_ingested_total", "CRDT ops received for ingest")
+    counter("sd_sync_ops_applied_total",
+            "ingested CRDT ops with materialized effect")
+    counter("sd_p2p_hash_requests_total", "outbound remote-hasher batches")
+    counter("sd_p2p_hash_bytes_total",
+            "cas-message bytes shipped to remote hashers")
+    histogram("sd_sync_window_seconds", "latency of one ingest window")
+    histogram("sd_job_queue_wait_seconds",
+              "dispatch-queue wait per job", labels=("lane",))
+    histogram("sd_job_step_seconds", "sequential step latency per job",
+              labels=("job",))
+    gauge("sd_jobs_running", "running workers per lane", labels=("lane",))
+    gauge("sd_jobs_queued", "jobs waiting for lane capacity")
+    counter("sd_jobs_completed_total", "finished jobs by name and status",
+            labels=("job", "status"))
+    counter("sd_hash_batches_total", "hash batches dispatched per backend",
+            labels=("backend",))
+    counter("sd_hash_files_total", "files hashed per backend",
+            labels=("backend",))
+    counter("sd_hash_bytes_total", "cas-message payload bytes hashed per "
+            "backend", labels=("backend",))
+    histogram("sd_hash_batch_seconds", "hash batch latency per backend",
+              labels=("backend",))
+    counter("sd_telemetry_events_total", "named telemetry events",
+            labels=("name",))
+
+
+_declare_core()

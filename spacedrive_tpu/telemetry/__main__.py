@@ -1,0 +1,181 @@
+"""``python -m spacedrive_tpu.telemetry`` — pretty-print a snapshot.
+
+Default: render this process's own registry (useful after driving work
+in-process, or to verify the vocabulary). Against a running shell:
+
+    python -m spacedrive_tpu.telemetry --url http://127.0.0.1:8080
+    python -m spacedrive_tpu.telemetry --url ... --job <job_id>
+    python -m spacedrive_tpu.telemetry --prometheus
+
+``--url`` fetches ``telemetry.snapshot`` (or ``telemetry.jobTrace``) over
+the rspc HTTP surface; ``--prometheus`` prints the raw text exposition
+instead of the table form.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import urllib.error
+import urllib.request
+from typing import Any
+
+
+def _headers(auth: str | None) -> dict[str, str]:
+    headers = {"content-type": "application/json"}
+    if auth:
+        headers["Authorization"] = (
+            "Basic " + base64.b64encode(auth.encode()).decode())
+    return headers
+
+
+def _fetch(url: str, key: str, arg: Any = None,
+           auth: str | None = None) -> Any:
+    req = urllib.request.Request(
+        f"{url.rstrip('/')}/rspc/{key}",
+        data=json.dumps({"arg": arg}).encode(),
+        headers=_headers(auth), method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        # the shell wraps rspc/auth errors as 4xx JSON bodies — surface
+        # the message, not a urllib traceback
+        try:
+            detail = json.loads(e.read().decode()).get("error", str(e))
+        except Exception:
+            detail = str(e)
+        raise SystemExit(f"{key}: {detail}")
+    if "error" in body:
+        raise SystemExit(f"{key}: {body['error']}")
+    return body["result"]
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in labels.items()) + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return f"{v:.6g}"
+
+
+def print_snapshot(snap: dict[str, Any], out=sys.stdout) -> None:
+    print(f"telemetry {'ENABLED' if snap.get('enabled') else 'OFF'}",
+          file=out)
+    metrics = snap.get("metrics", {})
+    for name in sorted(metrics):
+        fam = metrics[name]
+        series = fam.get("series", [])
+        if not series:
+            continue
+        print(f"\n{name} ({fam['type']})"
+              + (f" — {fam['help']}" if fam.get("help") else ""), file=out)
+        for s in series:
+            lbl = _fmt_labels(s.get("labels", {}))
+            if fam["type"] == "histogram":
+                count = s.get("count", 0)
+                total = s.get("sum", 0.0)
+                mean = total / count if count else 0.0
+                print(f"  {lbl or '(all)':40s} count={count} "
+                      f"sum={_fmt_value(total)}s mean={mean:.4f}s", file=out)
+            else:
+                print(f"  {lbl or '(all)':40s} {_fmt_value(s['value'])}",
+                      file=out)
+    events = snap.get("events") or []
+    if events:
+        print("\nevents:", file=out)
+        for e in events[-16:]:
+            extra = {k: v for k, v in e.items() if k not in ("name", "unix")}
+            print(f"  {e['name']}"
+                  + (f" {extra}" if extra else ""), file=out)
+    traces = snap.get("recent_traces") or []
+    if traces:
+        print("\nrecent traces:", file=out)
+        for t in traces:
+            print(f"  {t['trace_id'][:8]} {t['name']} "
+                  f"{t['duration_s']:.3f}s "
+                  f"({sum(int(s['count']) for s in t['spans'].values())} "
+                  f"spans)", file=out)
+
+
+def print_tree(node: dict[str, Any], depth: int = 0, out=sys.stdout) -> None:
+    pad = "  " * depth
+    marker = "·" if node.get("event") else "—"
+    attrs = node.get("attrs") or {}
+    extra = f"  {attrs}" if attrs else ""
+    print(f"{pad}{node['name']} {marker} {node.get('duration_s', 0):.4f}s"
+          f"{extra}", file=out)
+    for child in node.get("children", []):
+        print_tree(child, depth + 1, out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m spacedrive_tpu.telemetry",
+        description="pretty-print a telemetry snapshot or job trace")
+    parser.add_argument("--url", default=None,
+                        help="running shell to query (default: this "
+                             "process's own registry)")
+    parser.add_argument("--auth", default=None, metavar="USER:PASSWORD",
+                        help="basic-auth credentials for a shell started "
+                             "with --auth")
+    parser.add_argument("--job", default=None,
+                        help="print the span tree of this job id instead "
+                             "of the metrics snapshot")
+    parser.add_argument("--data-dir", default=None,
+                        help="with --job and no --url: read the exported "
+                             "JSONL under <data-dir>/logs/traces/")
+    parser.add_argument("--prometheus", action="store_true",
+                        help="print the raw Prometheus text exposition")
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw JSON instead of the table")
+    args = parser.parse_args(argv)
+
+    from . import job_trace, render_prometheus, snapshot
+
+    if args.job:
+        if args.url:
+            tree = _fetch(args.url, "telemetry.jobTrace", args.job,
+                          auth=args.auth)
+        else:
+            tree = job_trace(args.job, data_dir=args.data_dir)
+        if tree is None:
+            print(f"no trace recorded for job {args.job!r}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(tree, indent=2, default=str))
+        else:
+            print_tree(tree)
+        return 0
+
+    if args.prometheus:
+        if args.url:
+            req = urllib.request.Request(
+                f"{args.url.rstrip('/')}/metrics",
+                headers=_headers(args.auth))
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    sys.stdout.write(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                raise SystemExit(f"/metrics: {e}")
+        else:
+            sys.stdout.write(render_prometheus())
+        return 0
+
+    snap = (_fetch(args.url, "telemetry.snapshot", auth=args.auth)
+            if args.url else snapshot())
+    if args.json:
+        print(json.dumps(snap, indent=2, default=str))
+    else:
+        print_snapshot(snap)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

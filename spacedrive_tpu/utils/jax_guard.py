@@ -105,8 +105,12 @@ def _relay_up_with_retry() -> bool:
     connect, and one refused probe must not pin a long-lived node to CPU.
     Only a probe that stays refused across the whole jittered window
     counts as down."""
-    from .. import faults
+    from .. import faults, telemetry
     from .retry import RetryPolicy, is_relay_flap, retry_call
+
+    outcomes = telemetry.counter(
+        "sd_relay_probe_total", "relay liveness probes by outcome",
+        labels=("outcome",))
 
     def probe() -> None:
         faults.inject("relay_probe")
@@ -118,8 +122,10 @@ def _relay_up_with_retry() -> bool:
                    policy=RetryPolicy(attempts=3, base_s=0.1, max_s=0.4,
                                       jitter=0.5, budget_s=2.0),
                    classify=is_relay_flap, label="relay-probe")
+        outcomes.inc(outcome="up")
         return True
     except ConnectionError:
+        outcomes.inc(outcome="down")
         return False
 
 

@@ -117,6 +117,18 @@ class RelayRecaptureWatcher:
 
     def _recapture(self) -> None:
         logger.info("relay recovered — running opportunistic device suite")
+        # the recovery is an operator-visible event, not only a log line:
+        # counter + telemetry event before the (long) capture starts
+        try:
+            from .. import telemetry
+
+            telemetry.counter(
+                "sd_relay_recovered_total",
+                "relay recoveries observed by the recapture watcher").inc()
+            telemetry.event("relay.recovered",
+                            out_path=str(self.out_path))
+        except Exception:
+            logger.exception("could not record relay recovery telemetry")
         # the device is back: hybrid hashers that a mid-batch wedge degraded
         # to native CPU re-probe both engines on their next batch (the
         # restore half of the degradation ladder, robustness.md)

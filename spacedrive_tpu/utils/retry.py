@@ -28,9 +28,10 @@ import errno
 import logging
 import random
 import sqlite3
-import threading
 import time
 from typing import Any, Callable
+
+from .. import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -101,30 +102,21 @@ def is_device_wedge(exc: BaseException) -> bool:
     return type(exc).__module__.split(".")[0] in ("jax", "jaxlib")
 
 
-# -- process-wide accounting ---------------------------------------------------
+# -- process-wide accounting (telemetry registry) ------------------------------
+# PR 4's bespoke module-global stats dict is gone: retry accounting lives on
+# the unified registry (chaos benches read the deltas from a telemetry
+# snapshot — sd_retry_attempts_total / sd_retry_backoff_seconds_total /
+# sd_retry_gave_up_total).
 
-_STATS_LOCK = threading.Lock()
-_STATS = {"retries": 0, "retry_total_s": 0.0, "gave_up": 0}
-
-
-def stats() -> dict[str, float]:
-    """Snapshot of process-wide retry accounting (chaos benches report the
-    delta across a run as ``retry_total_s``)."""
-    with _STATS_LOCK:
-        return dict(_STATS)
-
-
-def reset_stats() -> None:
-    with _STATS_LOCK:
-        _STATS.update(retries=0, retry_total_s=0.0, gave_up=0)
-
-
-def _account(waited_s: float, gave_up: bool) -> None:
-    with _STATS_LOCK:
-        _STATS["retries"] += 1
-        _STATS["retry_total_s"] += waited_s
-        if gave_up:
-            _STATS["gave_up"] += 1
+_ATTEMPTS = telemetry.counter(
+    "sd_retry_attempts_total",
+    "re-calls made after a transient failure (utils/retry.py)")
+_BACKOFF_S = telemetry.counter(
+    "sd_retry_backoff_seconds_total",
+    "total wall time spent in retry backoff")
+_GAVE_UP = telemetry.counter(
+    "sd_retry_gave_up_total",
+    "retry budgets exhausted (attempts or wall budget)")
 
 
 # -- the driver ----------------------------------------------------------------
@@ -156,12 +148,12 @@ def retry_call(fn: Callable[[], Any], *,
                 raise
             retries += 1
             if retries >= policy.attempts:
-                _account(0.0, gave_up=True)
+                _GAVE_UP.inc()
                 raise
             delay = policy.delay(retries - 1, rng)
             now = time.monotonic()
             if now + delay > deadline:
-                _account(0.0, gave_up=True)
+                _GAVE_UP.inc()
                 raise
             logger.debug("retry %d/%d%s in %.3fs after %r",
                          retries, policy.attempts - 1,
@@ -175,4 +167,5 @@ def retry_call(fn: Callable[[], Any], *,
                 waited += quantum
             if cancel_check is not None:
                 cancel_check()
-            _account(waited, gave_up=False)
+            _ATTEMPTS.inc()
+            _BACKOFF_S.inc(waited)

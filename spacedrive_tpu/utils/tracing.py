@@ -6,6 +6,14 @@ EnvFilter (RUST_LOG), and a global panic hook logging file:line. Here:
 TimedRotatingFileHandler (midnight, backupCount=4) under <data_dir>/logs,
 stdout at SD_LOG level (module overrides via "module=LEVEL" segments, the
 EnvFilter syntax subset), and sys.excepthook logging uncaught exceptions.
+
+Re-init semantics (ISSUE 5 satellite): ``init_logger`` is idempotent per
+``data_dir`` — calling it again with the SAME directory is a no-op, but a
+DIFFERENT directory swaps the file appender over (the old handler is
+closed and removed). The previous module-global ``_installed`` flag
+silently ignored the second call, so a second library open (and every
+test after the first) kept logging into the first library's directory.
+``reset_for_tests()`` tears the installation down completely.
 """
 
 from __future__ import annotations
@@ -14,63 +22,126 @@ import logging
 import logging.handlers
 import os
 import sys
+import threading
 from pathlib import Path
 
 _FORMAT = "%(asctime)s %(levelname).1s %(name)s %(message)s"
-_installed = False
+
+_LOCK = threading.Lock()
+#: installed state: the data_dir the file handler writes under, the
+#: handler itself, and whether the stdout layer / excepthook are wired
+_STATE: dict = {"data_dir": None, "file_handler": None,
+                "stream_handler": None, "hook_prev": None,
+                "hooks_installed": False}
 
 
 def init_logger(data_dir: str | Path, level: str | None = None) -> None:
-    """Idempotent; SD_LOG examples: "INFO", "DEBUG",
-    "INFO,spacedrive_tpu.locations=DEBUG"."""
-    global _installed
-    if _installed:
-        return
-    _installed = True
-
-    spec = level or os.environ.get("SD_LOG", "INFO")
-    parts = [p.strip() for p in spec.split(",") if p.strip()]
-    root_level = "INFO"
-    overrides: list[tuple[str, str]] = []
-    for part in parts:
-        if "=" in part:
-            module, _, lvl = part.partition("=")
-            overrides.append((module.strip(), lvl.strip().upper()))
-        else:
-            root_level = part.upper()
-
+    """Idempotent per data_dir; SD_LOG examples: "INFO", "DEBUG",
+    "INFO,spacedrive_tpu.locations=DEBUG". A call with a different
+    ``data_dir`` re-targets the file appender (second library open,
+    tests)."""
+    data_dir = Path(data_dir)
     pkg_logger = logging.getLogger("spacedrive_tpu")
-    pkg_logger.setLevel(getattr(logging, root_level, logging.INFO))
-    for module, lvl in overrides:
-        logging.getLogger(module).setLevel(getattr(logging, lvl, logging.INFO))
+    with _LOCK:
+        if _STATE["data_dir"] == data_dir:
+            return
 
-    formatter = logging.Formatter(_FORMAT)
+        spec = level or os.environ.get("SD_LOG", "INFO")
+        parts = [p.strip() for p in spec.split(",") if p.strip()]
+        root_level = "INFO"
+        overrides: list[tuple[str, str]] = []
+        for part in parts:
+            if "=" in part:
+                module, _, lvl = part.partition("=")
+                overrides.append((module.strip(), lvl.strip().upper()))
+            else:
+                root_level = part.upper()
 
-    log_dir = Path(data_dir) / "logs"
-    try:
-        log_dir.mkdir(parents=True, exist_ok=True)
-        file_handler = logging.handlers.TimedRotatingFileHandler(
-            log_dir / "sd.log", when="midnight", backupCount=4,
-            encoding="utf-8", delay=True)
-        file_handler.setFormatter(formatter)
-        pkg_logger.addHandler(file_handler)
-    except OSError as e:
-        logging.getLogger(__name__).warning("no file logging: %s", e)
+        pkg_logger.setLevel(getattr(logging, root_level, logging.INFO))
+        for module, lvl in overrides:
+            logging.getLogger(module).setLevel(
+                getattr(logging, lvl, logging.INFO))
 
-    # exact-type check: FileHandler subclasses StreamHandler, and a host
-    # app's file handler must not suppress the stdout layer
-    if not any(type(h) is logging.StreamHandler
-               for h in logging.getLogger().handlers):
-        stream = logging.StreamHandler()
-        stream.setFormatter(formatter)
-        logging.getLogger().addHandler(stream)
+        formatter = logging.Formatter(_FORMAT)
 
-    # panic-hook analogue (lib.rs:181-191): uncaught exceptions hit the log
-    previous = sys.excepthook
+        # build the NEW appender first; the working one is only swapped
+        # out once its replacement exists, and a failed target (unwritable
+        # dir) leaves state untouched so a later call retries instead of
+        # leaving the process with no file logging at all
+        new_handler = None
+        log_dir = data_dir / "logs"
+        try:
+            log_dir.mkdir(parents=True, exist_ok=True)
+            new_handler = logging.handlers.TimedRotatingFileHandler(
+                log_dir / "sd.log", when="midnight", backupCount=4,
+                encoding="utf-8", delay=True)
+            new_handler.setFormatter(formatter)
+        except OSError as e:
+            logging.getLogger(__name__).warning("no file logging: %s", e)
+        if new_handler is not None:
+            old = _STATE["file_handler"]
+            if old is not None:
+                pkg_logger.removeHandler(old)
+                try:
+                    old.close()
+                except Exception:
+                    pass
+            pkg_logger.addHandler(new_handler)
+            _STATE["file_handler"] = new_handler
+            _STATE["data_dir"] = data_dir
 
-    def hook(exc_type, exc, tb):
-        if exc_type is not KeyboardInterrupt:
-            pkg_logger.critical("uncaught exception", exc_info=(exc_type, exc, tb))
-        previous(exc_type, exc, tb)
+        if _STATE["hooks_installed"]:
+            return
+        _STATE["hooks_installed"] = True
 
-    sys.excepthook = hook
+        # stdout layer + panic hook install exactly once per process
+        # exact-type check: FileHandler subclasses StreamHandler, and a host
+        # app's file handler must not suppress the stdout layer
+        if not any(type(h) is logging.StreamHandler
+                   for h in logging.getLogger().handlers):
+            stream = logging.StreamHandler()
+            stream.setFormatter(formatter)
+            logging.getLogger().addHandler(stream)
+            _STATE["stream_handler"] = stream
+
+        # panic-hook analogue (lib.rs:181-191): uncaught exceptions hit
+        # the log
+        previous = sys.excepthook
+        _STATE["hook_prev"] = previous
+
+        def hook(exc_type, exc, tb):
+            if exc_type is not KeyboardInterrupt:
+                pkg_logger.critical("uncaught exception",
+                                    exc_info=(exc_type, exc, tb))
+            previous(exc_type, exc, tb)
+
+        sys.excepthook = hook
+
+
+def installed_data_dir() -> Path | None:
+    """The directory the file appender currently writes under (tests)."""
+    with _LOCK:
+        return _STATE["data_dir"]
+
+
+def reset_for_tests() -> None:
+    """Tear the installation down: remove + close the handlers, restore
+    the excepthook, forget the data_dir so the next init_logger installs
+    fresh."""
+    pkg_logger = logging.getLogger("spacedrive_tpu")
+    with _LOCK:
+        fh = _STATE["file_handler"]
+        if fh is not None:
+            pkg_logger.removeHandler(fh)
+            try:
+                fh.close()
+            except Exception:
+                pass
+        sh = _STATE["stream_handler"]
+        if sh is not None:
+            logging.getLogger().removeHandler(sh)
+        if _STATE["hook_prev"] is not None:
+            sys.excepthook = _STATE["hook_prev"]
+        _STATE.update(data_dir=None, file_handler=None,
+                      stream_handler=None, hook_prev=None,
+                      hooks_installed=False)

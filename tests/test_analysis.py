@@ -328,6 +328,71 @@ def test_retry_discipline_scoped_to_production_dirs(tmp_path):
     assert run_on(tmp_path, "server/x.py", src, "retry-discipline") == []
 
 
+# -- pass 11: telemetry-discipline --------------------------------------------
+
+def test_telemetry_discipline_flags_delta_into_dict(tmp_path):
+    """A perf_counter delta stored into a dict (subscript or literal) is
+    hand-rolled report timing — must go through telemetry.span."""
+    bad = run_on(tmp_path, "objects/bad_timing.py", (
+        "import time\n"
+        "def stage(batch):\n"
+        "    t0 = time.perf_counter()\n"
+        "    work()\n"
+        "    batch['gather_s'] = time.perf_counter() - t0\n"
+        "    batch['hash_s'] = round(time.perf_counter() - t0, 3)\n"
+        "    return {'media_s': time.perf_counter() - t0}\n"),
+        "telemetry-discipline")
+    assert [f.lineno for f in bad] == [5, 6, 7]
+    assert all("telemetry.span" in f.message for f in bad)
+
+
+def test_telemetry_discipline_allows_spans_logs_and_rates(tmp_path):
+    """Span-derived durations, log-line deltas and rate math stay legal."""
+    assert run_on(tmp_path, "pipeline/good_timing.py", (
+        "import time\n"
+        "from spacedrive_tpu import telemetry\n"
+        "def stage(trace, batch, logger):\n"
+        "    with telemetry.span(trace, 'pipeline.page') as sp:\n"
+        "        work()\n"
+        "    batch['page_s'] = sp.duration_s\n"
+        "    t0 = time.perf_counter()\n"
+        "    work()\n"
+        "    logger.debug('took %.3f', time.perf_counter() - t0)\n"
+        "    rate = 100 / max(1e-9, time.perf_counter() - t0)\n"
+        "    return rate\n"), "telemetry-discipline") == []
+
+
+def test_telemetry_discipline_flags_bad_metric_names(tmp_path):
+    bad = run_on(tmp_path, "sync/bad_metric.py", (
+        "from spacedrive_tpu import telemetry\n"
+        "C = telemetry.counter('ops_ingested', 'x')\n"
+        "G = telemetry.gauge('sd_ok_rate', 'fine')\n"
+        "H = telemetry.histogram('SD_Window_Seconds', 'x')\n"),
+        "telemetry-discipline")
+    assert [f.lineno for f in bad] == [2, 4]
+    assert all("sd_[a-z0-9_]" in f.message for f in bad)
+
+
+def test_telemetry_discipline_scoped_and_call_args_exempt(tmp_path):
+    src = (
+        "import time\n"
+        "def f(d):\n"
+        "    t0 = time.perf_counter()\n"
+        "    d['x'] = time.perf_counter() - t0\n")
+    # utils/ and server/ are out of scope (telemetry's own plumbing and
+    # the shells measure freely)
+    assert run_on(tmp_path, "utils/t.py", src, "telemetry-discipline") == []
+    assert run_on(tmp_path, "server/t.py", src, "telemetry-discipline") == []
+    # a delta passed INTO a call is the callee's business (verdict
+    # measurement etc.), even when the result lands in a dict
+    assert run_on(tmp_path, "objects/verdict.py", (
+        "import time\n"
+        "def f(d):\n"
+        "    t0 = time.perf_counter()\n"
+        "    d['v'] = score(time.perf_counter() - t0)\n"),
+        "telemetry-discipline") == []
+
+
 # -- waivers ------------------------------------------------------------------
 
 def test_scoped_waiver_silences_only_named_pass(tmp_path):

@@ -178,19 +178,59 @@ def test_client_procedure_error_surfaces(served_node):
 # ---------------------------------------------------------------------------
 
 def test_logger_writes_rotating_file(tmp_path):
-    import importlib
     import logging
 
     from spacedrive_tpu.utils import tracing
 
-    importlib.reload(tracing)  # reset the idempotency latch for this test
-    tracing.init_logger(tmp_path, level="DEBUG")
-    logging.getLogger("spacedrive_tpu.test_logger").info("hello sd.log")
-    for handler in logging.getLogger("spacedrive_tpu").handlers:
-        handler.flush()
-    log_file = tmp_path / "logs" / "sd.log"
-    assert log_file.exists()
-    assert "hello sd.log" in log_file.read_text()
+    tracing.reset_for_tests()
+    try:
+        tracing.init_logger(tmp_path, level="DEBUG")
+        logging.getLogger("spacedrive_tpu.test_logger").info("hello sd.log")
+        for handler in logging.getLogger("spacedrive_tpu").handlers:
+            handler.flush()
+        log_file = tmp_path / "logs" / "sd.log"
+        assert log_file.exists()
+        assert "hello sd.log" in log_file.read_text()
+    finally:
+        tracing.reset_for_tests()
+
+
+def test_logger_reinit_follows_data_dir_change(tmp_path):
+    """ISSUE 5 satellite: a second init_logger with a DIFFERENT data_dir
+    re-targets the file appender (a second library open used to keep
+    logging into the first directory forever); the SAME dir is a no-op."""
+    import logging
+
+    from spacedrive_tpu.utils import tracing
+
+    tracing.reset_for_tests()
+    try:
+        first, second = tmp_path / "a", tmp_path / "b"
+        log = logging.getLogger("spacedrive_tpu.test_reinit")
+        tracing.init_logger(first, level="DEBUG")
+        log.info("into-first")
+        same_handlers = list(logging.getLogger("spacedrive_tpu").handlers)
+        tracing.init_logger(first, level="DEBUG")  # same dir: no-op
+        assert list(logging.getLogger("spacedrive_tpu").handlers) \
+            == same_handlers
+        assert tracing.installed_data_dir() == first
+
+        tracing.init_logger(second, level="DEBUG")  # re-target
+        assert tracing.installed_data_dir() == second
+        log.info("into-second")
+        for handler in logging.getLogger("spacedrive_tpu").handlers:
+            handler.flush()
+        assert "into-first" in (first / "logs" / "sd.log").read_text()
+        text_b = (second / "logs" / "sd.log").read_text()
+        assert "into-second" in text_b and "into-first" not in text_b
+        # exactly one file handler remains on the package logger
+        import logging.handlers as lh
+
+        file_handlers = [h for h in logging.getLogger("spacedrive_tpu").handlers
+                         if isinstance(h, lh.TimedRotatingFileHandler)]
+        assert len(file_handlers) == 1
+    finally:
+        tracing.reset_for_tests()
 
 
 def test_media_data_av_fields_persist(tmp_data_dir):

@@ -1,0 +1,375 @@
+"""Unified telemetry gate (ISSUE 5): registry semantics, the span tree a
+pipelined scan produces (and its reconciliation with the scan report's
+stage timings), the Prometheus text round-trip on GET /metrics, the
+SD_TELEMETRY=off no-op, and chaos-counter agreement with the fault
+suite's report metadata."""
+
+import json
+import random
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from spacedrive_tpu import faults, telemetry
+from spacedrive_tpu.jobs import JobStatus
+from spacedrive_tpu.models import JobRow
+from spacedrive_tpu.objects import file_identifier as fi
+
+from .test_faults import _identify
+from .test_pipeline import _decoded, _seed_library
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Counters are process-global; every test starts from zero and
+    leaves the enabled flag as the environment set it."""
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    yield
+    faults.clear()
+    telemetry.reset()
+    telemetry.reload_enabled()
+
+
+# -- registry semantics --------------------------------------------------------
+
+
+def test_counter_gauge_labels_and_validation():
+    c = telemetry.counter("sd_t_ops_total", "ops", labels=("kind",))
+    c.inc(kind="a")
+    c.inc(2.5, kind="a")
+    c.inc(kind="b")
+    assert telemetry.value("sd_t_ops_total", kind="a") == 3.5
+    assert telemetry.value("sd_t_ops_total", kind="b") == 1.0
+    assert telemetry.value("sd_t_ops_total", kind="absent") == 0.0
+
+    g = telemetry.gauge("sd_t_depth")
+    g.set(7)
+    g.inc()
+    assert telemetry.value("sd_t_depth") == 8.0
+
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")  # label-set mismatch
+    with pytest.raises(ValueError):
+        telemetry.counter("not_sd_prefixed")  # name vocabulary
+    with pytest.raises(ValueError):
+        telemetry.gauge("sd_t_ops_total")  # re-declare as another type
+    with pytest.raises(ValueError):
+        c.labels(kind="x").inc(-1)  # counters only go up
+
+
+def test_histogram_fixed_buckets_and_snapshot():
+    h = telemetry.histogram("sd_t_lat_seconds", "lat",
+                            buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.labels().observe(v)
+    snap = telemetry.snapshot()["metrics"]["sd_t_lat_seconds"]
+    (series,) = snap["series"]
+    assert series["count"] == 5
+    assert series["buckets"] == {"0.01": 1, "0.1": 2, "1.0": 1, "+Inf": 1}
+    assert series["sum"] == pytest.approx(5.605)
+
+
+def test_concurrent_increments_from_threads():
+    """The pipeline-stage shape: many threads hammering one family; the
+    per-series lock must not lose increments (float += races under the
+    GIL without it)."""
+    c = telemetry.counter("sd_t_race_total", labels=("stage",))
+    page = c.labels(stage="page")
+
+    def worker():
+        for _ in range(2000):
+            page.inc()
+            c.inc(0.5, stage="hash")
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert telemetry.value("sd_t_race_total", stage="page") == 16000
+    assert telemetry.value("sd_t_race_total", stage="hash") == 8000
+
+
+# -- the pipelined-scan span tree ---------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def span_tree_scan(tmp_path_factory):
+    """One pipelined 2k-file identify; returns (tree, report metadata,
+    bytes hashed per the registry)."""
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    rng = random.Random(11)
+    root = tmp_path_factory.mktemp("telemetry") / "tree"
+    for d in range(4):
+        p = root / f"d{d}"
+        p.mkdir(parents=True)
+        for i in range(500):
+            if i % 100 == 0:
+                body = rng.randbytes(150_000 + i)  # sampled-class
+            elif i % 77 == 0:
+                body = b""  # empties ride along
+            else:
+                body = rng.randbytes(300 + (i * 13) % 1200)
+            (p / f"f{i:03d}.dat").write_bytes(body)
+
+    import os
+
+    old_pipeline = os.environ.get("SD_PIPELINE")
+    os.environ["SD_PIPELINE"] = "1"
+    old_batch = fi.BATCH_SIZE
+    fi.BATCH_SIZE = 256
+    try:
+        data_dir = tmp_path_factory.mktemp("telemetry_data")
+        node, lib, loc_id = _seed_library(data_dir, root, "spans")
+        jid = _identify(node, lib, loc_id)
+        row = lib.db.find_one(JobRow, {"id": jid})
+        meta = _decoded(row["metadata"])
+        tree = node.router.resolve("telemetry.jobTrace", jid)
+        trace_file = (data_dir / "logs" / "traces" / f"{jid}.jsonl")
+        hashed_bytes = telemetry.value("sd_hash_bytes_total", backend="cpu")
+        scan_rate = telemetry.value("sd_scan_files_per_sec")
+        node.shutdown()
+    finally:
+        fi.BATCH_SIZE = old_batch
+        if old_pipeline is None:
+            os.environ.pop("SD_PIPELINE", None)
+        else:
+            os.environ["SD_PIPELINE"] = old_pipeline
+    return tree, meta, hashed_bytes, trace_file, scan_rate
+
+
+def _spans_named(node, name, out=None):
+    out = [] if out is None else out
+    if node["name"] == name:
+        out.append(node)
+    for child in node.get("children", []):
+        _spans_named(child, name, out)
+    return out
+
+
+def test_span_tree_shape_and_stage_reconciliation(span_tree_scan):
+    tree, meta, _bytes, trace_file, _rate = span_tree_scan
+    assert tree["name"] == "job.file_identifier"
+    batches = meta["pipeline_batches"]
+    assert batches == 8  # ceil(2000/256)
+
+    pages = _spans_named(tree, "pipeline.page")
+    hashes = _spans_named(tree, "pipeline.hash")
+    commits = _spans_named(tree, "pipeline.commit")
+    # one page span per batch (the step budget exhausts exactly at the
+    # last batch, so no terminal empty page runs)
+    assert len(pages) == batches
+    assert len(hashes) == batches
+    assert len(commits) == batches
+    # stage spans are children of the job's pipeline.run span — including
+    # page/hash, which open on OTHER threads and pin the run span as
+    # their explicit parent (the documented taxonomy, observability.md)
+    runs = _spans_named(tree, "pipeline.run")
+    assert len(runs) == 1
+    run_children = {c["name"] for c in runs[0]["children"]}
+    assert {"pipeline.page", "pipeline.hash",
+            "pipeline.commit"} <= run_children
+
+    # the gather rides INSIDE the page span (nesting, not just presence)
+    gathers = [c for p in pages for c in p["children"]
+               if c["name"] == "identifier.gather"]
+    assert len(gathers) == batches
+
+    # reconciliation: report stage timings ARE the span sums (±5% per the
+    # acceptance criterion; equality by construction here)
+    for span_name, key, spans in (("pipeline.page", "pipeline_page_s", pages),
+                                  ("pipeline.hash", "pipeline_hash_s", hashes),
+                                  ("pipeline.commit", "pipeline_commit_s",
+                                   commits)):
+        total = sum(s["duration_s"] for s in spans)
+        assert total == pytest.approx(meta[key], rel=0.05), (span_name, total)
+
+    # ... and the summarized form in the report metadata agrees too
+    assert meta["trace"]["spans"]["pipeline.page"]["count"] == batches
+
+    # the JSONL export exists and rebuilds the same tree
+    assert trace_file.exists()
+    lines = [json.loads(x) for x in
+             trace_file.read_text().splitlines() if x.strip()]
+    assert {r["name"] for r in lines} >= {"pipeline.page", "pipeline.hash",
+                                          "pipeline.commit",
+                                          "identifier.gather"}
+
+
+def test_span_attrs_sum_to_report_totals(span_tree_scan):
+    tree, meta, hashed_bytes, _tf, scan_rate = span_tree_scan
+    gathers = _spans_named(tree, "identifier.gather")
+    gathered_files = sum(g["attrs"]["files"] for g in gathers)
+    gathered_bytes = sum(g["attrs"]["bytes"] for g in gathers)
+    empties = 2000 - gathered_files
+    assert gathered_files + empties == meta["total_orphan_paths"] == 2000
+    assert 0 < empties < 60  # the fixture's i%77 empties
+    # every gathered byte was hashed exactly once on the cpu backend
+    assert gathered_bytes == hashed_bytes
+    assert scan_rate > 0
+
+
+def test_trace_resume_continues_open_trace():
+    """The worker's pause path leaves the trace OPEN; a resume under the
+    same id continues it (span sums keep reconciling with accumulated
+    report metadata), while a finished trace is never resumed."""
+    t1 = telemetry.start_trace("job.x", trace_id="r1")
+    with telemetry.span(t1, "stage"):
+        pass
+    # in-process pause: worker does NOT finish the trace
+    t2 = telemetry.start_trace("job.x", trace_id="r1", resume=True)
+    assert t2 is t1
+    with telemetry.span(t2, "stage"):
+        pass
+    summary = telemetry.finish_trace(t2)
+    assert summary["spans"]["stage"]["count"] == 2
+    # terminal: a finished trace is replaced, not continued
+    t3 = telemetry.start_trace("job.x", trace_id="r1", resume=True)
+    assert t3 is not t1
+
+
+# -- GET /metrics round-trip ---------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE.+-]+$')
+
+
+def test_metrics_endpoint_prometheus_roundtrip(tmp_data_dir):
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.server.shell import Server
+
+    telemetry.counter("sd_t_http_total", "x", labels=("route",)).inc(
+        3, route="/spacedrive")
+    telemetry.gauge("sd_scan_files_per_sec").set(1234.5)
+    telemetry.histogram("sd_t_http_seconds").labels().observe(0.2)
+
+    node = Node(tmp_data_dir, probe_accelerator=False, watch_locations=False)
+    server = Server(node, port=0)
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=15) as r:
+            assert r.status == 200
+            assert r.headers["content-type"].startswith("text/plain")
+            body = r.read().decode()
+    finally:
+        server.stop()
+        node.shutdown()
+
+    # exposition validity: every non-comment line is one sample
+    families: dict[str, str] = {}
+    for line in body.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split(" ", 3)
+            families[name] = typ
+            continue
+        if line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), line
+
+    # the acceptance vocabulary is served
+    for required in ("sd_scan_files_per_sec", "sd_pipeline_stage_busy_seconds",
+                     "sd_retry_attempts_total", "sd_faults_fired_total",
+                     "sd_hash_mfu"):
+        assert required in families, required
+
+    # round-trip: scraped values equal registry values
+    assert 'sd_t_http_total{route="/spacedrive"} 3' in body
+    assert "sd_scan_files_per_sec 1234.5" in body
+    assert 'sd_t_http_seconds_bucket{le="0.25"} 1' in body
+    assert "sd_t_http_seconds_count 1" in body
+
+
+# -- SD_TELEMETRY=off no-op ----------------------------------------------------
+
+
+def test_disabled_telemetry_is_a_noop(tmp_path):
+    telemetry.set_enabled(False)
+    c = telemetry.counter("sd_t_off_total")
+    c.inc(5)
+    telemetry.gauge("sd_t_off_gauge").set(9)
+    telemetry.histogram("sd_t_off_seconds").labels().observe(1.0)
+    telemetry.event("t.off")
+    assert telemetry.value("sd_t_off_total") == 0.0
+    assert telemetry.value("sd_t_off_gauge") == 0.0
+    assert telemetry.snapshot()["events"] == []
+    assert telemetry.start_trace("job.x") is None
+
+    # spans still measure (report timings must not depend on the switch),
+    # they just record nothing
+    sp = telemetry.span(None, "anything")
+    with sp:
+        pass
+    assert sp.duration_s >= 0.0
+    assert telemetry.job_trace("nope", data_dir=tmp_path) is None
+
+
+def test_disabled_scan_still_reports_stage_timings(tmp_path, monkeypatch):
+    """With SD_TELEMETRY=off the scan report keeps its pipeline_*_s keys
+    (span objects degrade to bare timers) but carries no trace."""
+    telemetry.set_enabled(False)
+    monkeypatch.setattr(fi, "BATCH_SIZE", 64)
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    rng = random.Random(4)
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    for i in range(120):
+        (tree / f"f{i:03d}.dat").write_bytes(rng.randbytes(400 + i))
+
+    node, lib, loc_id = _seed_library(tmp_path / "off", tree, "off")
+    jid = _identify(node, lib, loc_id)
+    row = lib.db.find_one(JobRow, {"id": jid})
+    meta = _decoded(row["metadata"])
+    assert node.router.resolve("telemetry.jobTrace", jid) is None
+    node.shutdown()
+
+    assert row["status"] == JobStatus.COMPLETED
+    assert "trace" not in meta
+    assert meta["pipeline_batches"] == 2  # ceil(120/64)
+    assert meta["pipeline_wall_s"] > 0
+    assert meta["gather_s"] > 0
+
+
+# -- chaos agreement with the fault suite --------------------------------------
+
+
+def test_chaos_counters_match_report_metadata(tmp_path, monkeypatch):
+    """sd_faults_fired_total mirrors faults.fired() and
+    sd_quarantined_files_total mirrors the report's quarantined_files —
+    the same numbers tests/test_faults.py asserts from metadata."""
+    monkeypatch.setattr(fi, "BATCH_SIZE", 32)
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    rng = random.Random(6)
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    for i in range(80):
+        (tree / f"f{i:02d}.dat").write_bytes(rng.randbytes(500 + i))
+
+    node, lib, loc_id = _seed_library(tmp_path / "chaos", tree, "chaos")
+    faults.install("gather:enoent:4;hash:wedge:once", seed=77)
+    try:
+        jid = _identify(node, lib, loc_id)
+        fired = dict(faults.fired())
+    finally:
+        faults.clear()
+    row = lib.db.find_one(JobRow, {"id": jid})
+    meta = _decoded(row["metadata"])
+    node.shutdown()
+
+    assert row["status"] == JobStatus.COMPLETED_WITH_ERRORS
+    assert fired.get("gather:enoent") == 4
+    assert fired.get("hash:wedge") == 1
+
+    by_rule = {f"{lbl['seam']}:{lbl['kind']}": int(v)
+               for lbl, v in telemetry.series_values("sd_faults_fired_total")
+               if v}
+    assert by_rule == fired
+    assert telemetry.value("sd_quarantined_files_total") \
+        == meta["quarantined_files"] == 4
+    assert telemetry.value("sd_recovered_batches_total") \
+        == meta["recovered_batches"] == 1
+    assert telemetry.value("sd_retry_attempts_total") >= 0
