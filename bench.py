@@ -550,8 +550,19 @@ def bench_scan() -> dict:
             while fh.read(1 << 20):
                 pass
 
+    from spacedrive_tpu import telemetry as _tm
+
+    def _router_batches() -> dict[str, float]:
+        return {lbl["backend"]: v for lbl, v in
+                _tm.series_values("sd_hash_router_batches_total")}
+
     def one_scan(hasher: str, expect_all: bool = True) -> tuple[float, dict]:
         tmp = Path(tempfile.mkdtemp(prefix=f"sd_scan_{hasher}_"))
+        # per-batch router accounting for THIS scan (registry deltas):
+        # flips and per-engine routed batch counts ride back on the stages
+        # dict next to the job's own metadata keys
+        flips0 = _tm.value("sd_hash_router_flips_total")
+        rb0 = _router_batches()
         try:
             node = Node(tmp, probe_accelerator=False, watch_locations=False)
             # the GC actors' periodic ticks (30s/60s) would land inside one
@@ -584,6 +595,11 @@ def bench_scan() -> dict:
                 "SELECT metadata FROM job WHERE name='file_identifier' "
                 "ORDER BY date_created DESC LIMIT 1")
             stages = json.loads(row[0]["metadata"]) if row and row[0]["metadata"] else {}
+            stages["router_flips"] = int(
+                _tm.value("sd_hash_router_flips_total") - flips0)
+            stages["router_batches"] = {
+                k: int(v - rb0.get(k, 0)) for k, v in _router_batches().items()
+                if v - rb0.get(k, 0) > 0}
             node.shutdown()
             return dt, stages
         finally:
@@ -600,6 +616,21 @@ def bench_scan() -> dict:
     cpu2_t, _ = one_scan("cpu")
     times = {"cpu": min(cpu_t, cpu2_t), "hybrid": hyb_t}
 
+    # the telemetry A/B below runs two more FULL telemetry-on hybrid scans
+    # late in the process — on this container the process warms up
+    # monotonically, so those are often the least-biased samples. Fold the
+    # best ON run back into the headline (same "keep each engine's best"
+    # doctrine as the alternation above; the ON side is the production
+    # config the headline claims to measure).
+    telemetry_overhead, on_best_t, on_best_stages = \
+        _bench_telemetry_overhead(one_scan, n_files, times["hybrid"])
+    if on_best_stages is not None and on_best_t < times["hybrid"]:
+        times["hybrid"], hyb_stages = on_best_t, on_best_stages
+        # the cpu engine gets its own late sample so the vs_baseline
+        # comparison draws both engines from the same sampling windows
+        cpu3_t, _ = one_scan("cpu")
+        times["cpu"] = min(times["cpu"], cpu3_t)
+
     page_s = hyb_stages.get("pipeline_page_s", 0.0)
     hash_s = hyb_stages.get("pipeline_hash_s", 0.0)
     commit_s = hyb_stages.get("pipeline_commit_s", 0.0)
@@ -615,14 +646,21 @@ def bench_scan() -> dict:
 
     peak_rss_mb = _peak_rss_mb()
     rate = n_files / times["hybrid"]
+    # the new-knob visibility satellite: group-commit coalescing and the
+    # per-batch router's decisions, read from the chosen hybrid scan
+    batches = int(hyb_stages.get("pipeline_batches", 0))
+    txns = int(hyb_stages.get("commit_txns", 0))
+    txn_pages = round(batches / txns, 2) if txns else 0.0
+    router_flips = int(hyb_stages.get("router_flips", 0))
+    router_batches = hyb_stages.get("router_batches", {})
     print(f"info: scan {n_files} files e2e: cpu {times['cpu']:.1f}s | "
           f"hybrid {times['hybrid']:.1f}s ({rate:,.0f} files/s) | "
           f"identify page {page_s:.1f}s (gather {gather_s:.1f}s) "
           f"hash {hash_s:.1f}s commit {commit_s:.1f}s wall {wall_s:.1f}s "
-          f"(overlap {overlap:.2f}) | peak RSS {peak_rss_mb:.0f} MB",
+          f"(overlap {overlap:.2f}) | {batches} pages in {txns} txns "
+          f"({txn_pages}/txn) | router flips {router_flips} "
+          f"batches {router_batches} | peak RSS {peak_rss_mb:.0f} MB",
           file=sys.stderr)
-    telemetry_overhead = _bench_telemetry_overhead(one_scan, n_files,
-                                                   times["hybrid"])
     chaos = _bench_scan_chaos(one_scan, n_files, times["hybrid"]) \
         if CHAOS_MODE else None
     record = {
@@ -637,6 +675,10 @@ def bench_scan() -> dict:
         "commit_s": round(commit_s, 2),
         "identify_wall_s": round(wall_s, 2),
         "overlap_efficiency": round(overlap, 3),
+        "group_commit_txns": txns,
+        "commit_txn_pages": txn_pages,
+        "router_flips": router_flips,
+        "router_batches": router_batches,
         "peak_rss_mb": round(peak_rss_mb, 1),
         "telemetry_overhead": telemetry_overhead,
     }
@@ -646,7 +688,7 @@ def bench_scan() -> dict:
 
 
 def _bench_telemetry_overhead(one_scan, n_files: int,
-                              on_hybrid_s: float) -> dict:
+                              on_hybrid_s: float) -> tuple:
     """Same-session A/B for the always-on instrumentation (ISSUE 5 gate:
     telemetry-on must stay ≥0.95× the off files/s, i.e. inside the
     container's noise band). Single scans on this shared-core container
@@ -663,7 +705,7 @@ def _bench_telemetry_overhead(one_scan, n_files: int,
         telemetry.set_enabled(False)
         off_t, _ = one_scan("hybrid")
         telemetry.set_enabled(True)
-        on2_t, _ = one_scan("hybrid")
+        on2_t, on2_stages = one_scan("hybrid")
         # the headline scan joins the ON side only if it actually ran with
         # the recorder on — an operator benching with SD_TELEMETRY=off must
         # not have an off-measurement win as the "on" sample (that would
@@ -685,7 +727,9 @@ def _bench_telemetry_overhead(one_scan, n_files: int,
           f"{overhead['files_per_sec_on']:,.0f} files/s vs off "
           f"{overhead['files_per_sec_off']:,.0f} files/s "
           f"(on/off {overhead['on_vs_off']:.3f}x)", file=sys.stderr)
-    return overhead
+    # the extra ON run is a headline candidate (only when the recorder was
+    # actually on — off-config stages must never pose as the headline)
+    return overhead, on2_t, (on2_stages if was_enabled else None)
 
 
 #: chaos mode (``--faults`` / SD_BENCH_FAULTS=1): one extra scan under an

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from ..engine import AnalysisPass
 from .async_blocking import AsyncBlockingPass
+from .commit_discipline import CommitDisciplinePass
 from .jax_wedge import JaxWedgePass
 from .legacy import BareExceptPass, DuplicateDefPass, UnusedImportPass
 from .lock_discipline import LockDisciplinePass
@@ -30,6 +31,7 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     ResourceLeakPass,
     SwallowedExceptionPass,
     PipelineOrderingPass,
+    CommitDisciplinePass,
     RetryDisciplinePass,
     TelemetryDisciplinePass,
 )

@@ -1,0 +1,126 @@
+"""commit-discipline: group commit only stays byte-identical if every
+durable effect lives inside the commit stage's transaction scope.
+
+The group committer (pipeline/executor.py) may run several
+``pipeline_commit`` calls inside ONE outer transaction and roll them all
+back together on a transient failure, restoring a shallow snapshot of the
+checkpoint ``data``. That is only sound when:
+
+- **every DB write in ``pipeline_commit`` happens inside a
+  ``db.transaction()`` block** — a write outside it autocommits
+  immediately and would SURVIVE the group rollback, leaving rows from a
+  batch whose checkpoint cursor never advanced (re-committed on retry:
+  duplicate CRDT ops, torn uniqueness);
+- **the checkpoint ``data`` is only mutated by the commit stage** — a
+  ``data[...] = ...`` from ``pipeline_page``/``pipeline_process`` runs on
+  a speculative stage thread, so a pause would serialize state the
+  committer never made durable (the page stage keeps its speculative
+  cursor in ``scratch`` for exactly this reason).
+
+Mechanics: inside any function named ``pipeline_commit`` (including
+nested helpers defined within it), flag write-surface calls
+(execute/executemany/insert/insert_ignore/insert_many/update/upsert/
+delete on a DB-handle receiver — a name chain ending in ``db``) that are
+not lexically inside a ``with <...>.transaction(...)`` block. Inside
+``pipeline_page``/``pipeline_process``, flag subscript assignments to the
+``data`` parameter and mutating calls on it (update/setdefault/pop/
+popitem/clear). Reads are always fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+from .pipeline_ordering import WRITE_ATTRS, _is_db_receiver
+
+SPECULATIVE_STAGES = ("pipeline_page", "pipeline_process")
+
+DATA_MUTATORS = {"update", "setdefault", "pop", "popitem", "clear"}
+
+
+def _is_txn_with(node: ast.With) -> bool:
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr == "transaction":
+            return True
+    return False
+
+
+class CommitDisciplinePass(AnalysisPass):
+    id = "commit-discipline"
+    description = ("DB writes outside the commit stage's transaction scope, "
+                   "or checkpoint-data mutation outside pipeline_commit "
+                   "(group commit can only roll back what the txn owns)")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name == "pipeline_commit":
+                yield from self._check_commit(ctx, node)
+            elif node.name in SPECULATIVE_STAGES:
+                yield from self._check_speculative(ctx, node)
+
+    # -- rule 1: commit writes must sit inside db.transaction() -------------
+    def _check_commit(self, ctx: FileContext,
+                      fn: ast.FunctionDef) -> Iterator[Finding]:
+        def visit(node: ast.AST, in_txn: bool) -> Iterator[Finding]:
+            for child in ast.iter_child_nodes(node):
+                child_in_txn = in_txn
+                if isinstance(child, ast.With) and _is_txn_with(child):
+                    child_in_txn = True
+                if not in_txn and isinstance(child, ast.Call) \
+                        and isinstance(child.func, ast.Attribute):
+                    chain = dotted_name(child.func)
+                    if chain is not None and child.func.attr in WRITE_ATTRS \
+                            and _is_db_receiver(chain):
+                        yield ctx.finding(
+                            child.lineno, self.id,
+                            f"DB write '{chain}()' outside the commit "
+                            f"transaction scope — it would survive a "
+                            f"group-commit rollback; move it inside "
+                            f"'with db.transaction():'")
+                yield from visit(child, child_in_txn)
+
+        yield from visit(fn, False)
+
+    # -- rule 2: speculative stages never touch the checkpoint data ---------
+    def _check_speculative(self, ctx: FileContext,
+                           fn: ast.FunctionDef) -> Iterator[Finding]:
+        stage = fn.name.removeprefix("pipeline_")
+        data_params = {a.arg for a in (fn.args.args + fn.args.kwonlyargs)
+                       if a.arg == "data"}
+        if not data_params:
+            return
+        for node in ast.walk(fn):
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = node.targets
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "data":
+                    yield ctx.finding(
+                        node.lineno, self.id,
+                        f"checkpoint 'data' mutated in pipeline {stage} "
+                        f"stage — the cursor only advances in "
+                        f"pipeline_commit (speculative state belongs in "
+                        f"'scratch')")
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "data" \
+                    and node.func.attr in DATA_MUTATORS:
+                yield ctx.finding(
+                    node.lineno, self.id,
+                    f"checkpoint 'data.{node.func.attr}()' in pipeline "
+                    f"{stage} stage — the cursor only advances in "
+                    f"pipeline_commit (speculative state belongs in "
+                    f"'scratch')")

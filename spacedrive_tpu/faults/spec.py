@@ -6,7 +6,8 @@ A spec is a ``;``-separated list of rules, each ``seam:kind[:trigger]``:
 
 - **seam** — the named injection point (`faults.inject("<seam>")` sites).
   Installed seams: ``gather`` (per-file cas sample read), ``hash`` (the
-  identifier's hash dispatch), ``commit`` (DB transaction begin/commit),
+  identifier's hash dispatch; ``hash_dispatch`` is an accepted alias,
+  normalized at parse), ``commit`` (DB transaction begin/commit),
   ``sync_apply`` (CRDT op materialization), ``p2p_send`` (outbound peer
   requests), ``relay_probe`` (the jax_guard relay liveness check). The
   set is open: any string names a seam; rules for seams that never fire
@@ -103,6 +104,12 @@ class FaultSpecError(ValueError):
     """Malformed SD_FAULTS spec — raised at parse, never at a seam."""
 
 
+#: spelling aliases accepted in specs (normalized at parse, so ``fired()``
+#: and the telemetry series always carry the canonical seam name): the
+#: identifier's hash-dispatch seam reads naturally either way
+SEAM_ALIASES = {"hash_dispatch": "hash"}
+
+
 @dataclass
 class FaultRule:
     seam: str
@@ -148,6 +155,7 @@ class FaultPlan:
             raise FaultSpecError(
                 f"rule {raw!r}: expected seam:kind[:trigger]")
         seam, kind = parts[0].strip(), parts[1].strip()
+        seam = SEAM_ALIASES.get(seam, seam)
         if kind not in KINDS:
             raise FaultSpecError(
                 f"rule {raw!r}: unknown kind {kind!r} "

@@ -185,9 +185,11 @@ class Database:
 
     The reference leans on SQLite's WAL single-writer ("db is single threaded,
     nerd", job/manager.rs:31-32); here all writes funnel through one mutex'd
-    connection while reads may come from any thread (``check_same_thread`` off,
-    serialized mode). Good enough for the job-engine cadence; the TPU hashing
-    fan-out happens outside the write lock.
+    connection. Reads take a dedicated WAL reader connection (last committed
+    snapshot, never queued behind the writer lock) unless the calling thread
+    owns the open transaction — then they read the writer so the txn sees its
+    own uncommitted rows. This is what keeps the pipeline prefetcher paging
+    while the committer holds a multi-page group-commit transaction.
     """
 
     def __init__(self, path: str | Path, models: Iterable[type[Model]]) -> None:
@@ -207,6 +209,18 @@ class Database:
                                      cached_statements=512)
         self._conn.row_factory = sqlite3.Row
         self._txn_depth = 0
+        #: thread that currently owns the open transaction (mid-txn reads
+        #: from that thread must see its own uncommitted writes; every
+        #: other thread reads the last committed WAL snapshot)
+        self._txn_thread: int | None = None
+        # WAL reader connection (lazy): SELECTs from threads that are not
+        # inside the write transaction go here, so the pipeline prefetcher's
+        # page SELECT never serializes behind a (group-)commit transaction
+        # holding the writer lock. ":memory:" databases get no reader — a
+        # second :memory: connection would be a different database.
+        self._read_conn: sqlite3.Connection | None = None
+        self._read_lock = threading.Lock()
+        self._closed = False
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")
         self._conn.execute("PRAGMA synchronous=NORMAL")
@@ -237,6 +251,11 @@ class Database:
                     self._conn.execute(f"ALTER TABLE {model.TABLE} ADD COLUMN {col}")
 
     def close(self) -> None:
+        with self._read_lock:
+            self._closed = True
+            if self._read_conn is not None:
+                self._read_conn.close()
+                self._read_conn = None
         with self._lock:
             self._conn.close()
 
@@ -253,7 +272,41 @@ class Database:
                 with _Txn(self):
                     self._conn.executemany(sql, seq)
 
+    def _reader(self) -> sqlite3.Connection | None:
+        """The WAL reader connection (None for :memory:). Opened lazily —
+        after migrate() ran on the writer, so DDL is always visible. A
+        closed Database raises like the writer path would, instead of
+        silently re-opening a leaked connection."""
+        if self._closed:
+            raise sqlite3.ProgrammingError(
+                "Cannot operate on a closed database.")
+        if self.path == ":memory:":
+            return None
+        if self._read_conn is None:
+            conn = sqlite3.connect(self.path, check_same_thread=False,
+                                   cached_statements=512)
+            conn.row_factory = sqlite3.Row
+            # defense in depth: the reader must never become a second
+            # writer behind the single-writer discipline
+            conn.execute("PRAGMA query_only=ON")
+            self._read_conn = conn
+        return self._read_conn
+
     def query(self, sql: str, params: tuple | list = ()) -> list[sqlite3.Row]:
+        # mid-transaction reads from the txn-owning thread must go through
+        # the writer (they see the open txn's uncommitted rows); everyone
+        # else reads the last committed snapshot off the reader connection
+        # WITHOUT queueing on the writer lock. The unlocked depth/thread
+        # peek is safe: only the owning thread sets _txn_thread to its own
+        # id, so a stale read from any other thread routes to the reader —
+        # exactly where a non-owner belongs.
+        if self._txn_depth and self._txn_thread == threading.get_ident():
+            with self._lock:
+                return self._conn.execute(sql, params).fetchall()
+        with self._read_lock:
+            reader = self._reader()
+            if reader is not None:
+                return reader.execute(sql, params).fetchall()
         with self._lock:
             return self._conn.execute(sql, params).fetchall()
 
@@ -413,6 +466,7 @@ class _Txn:
             if self.db._txn_depth == 0:
                 retry_call(self._begin, policy=TXN_RETRY,
                            classify=is_sqlite_busy, label="txn-begin")
+                self.db._txn_thread = threading.get_ident()
             self.db._txn_depth += 1
         except BaseException:
             self.db._lock.release()
@@ -423,6 +477,7 @@ class _Txn:
         try:
             self.db._txn_depth -= 1
             if self.db._txn_depth == 0:
+                self.db._txn_thread = None
                 if exc_type is None:
                     try:
                         retry_call(self._commit, policy=TXN_RETRY,

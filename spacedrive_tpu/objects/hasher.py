@@ -71,6 +71,27 @@ _HASH_MFU = telemetry.gauge(
     "u32-VPU model-op-utilization of the last hash batch "
     "(ops/roofline.py model)")
 
+# -- per-batch router telemetry ------------------------------------------------
+# The hybrid router's decision inputs and outcomes: live transfer-inclusive
+# bytes/s per engine (EWMA over full dispatch wall time, H2D included),
+# engine flips, and per-engine routed-batch counts. These are the series
+# the bench's BENCH_r06 knobs (`router_flips`, per-backend batch counts)
+# and the tpu-backend.md router docs read.
+_ROUTER_BPS = telemetry.gauge(
+    "sd_hash_router_bytes_per_sec",
+    "EWMA transfer-inclusive payload bytes/s per engine (router input)",
+    labels=("backend",))
+_ROUTER_MFU = telemetry.gauge(
+    "sd_hash_router_device_mfu",
+    "u32-VPU MFU implied by the router's device-engine EWMA rate")
+_ROUTER_FLIPS = telemetry.counter(
+    "sd_hash_router_flips_total",
+    "engine flips by the per-batch hash router (hysteresis-damped)")
+_ROUTER_BATCHES = telemetry.counter(
+    "sd_hash_router_batches_total",
+    "hash (sub-)batches the hybrid router dispatched per engine",
+    labels=("backend",))
+
 class _OutermostGuard:
     """Process-wide outermost-call tracker (not thread-local: the
     hybrid's work-stealing branch runs the leaf backends on helper
@@ -236,20 +257,37 @@ class TpuHasher:
 
     # -- sampled (fixed-shape) pipeline ------------------------------------
     def _hash_sampled(self, paths, sizes, indices: list[int], out: list) -> None:
+        """Fused gather→hash with DOUBLE-BUFFERED H2D: while batch k's
+        kernel executes on device, batch k+1's host gather runs and its
+        rows are already staged device-side (``_stage_rows`` → async
+        ``jax.device_put``), and batch k-1's digests come back. Three
+        batches in flight — transfer is never serialized behind compute,
+        which is exactly where the one-shot r05 device path lost
+        (0.13 GB/s resident vs 0.07 GB/s transfer-inclusive)."""
         try:
             from ..native import cas_native
         except Exception:
             self._hash_python(paths, sizes, indices, out)
             return
 
-        import jax.numpy as jnp
         import numpy as np
 
-        from ..ops.blake3_jax import (_pad_to_tier, blake3_batch_rows,
-                                      digests_to_hex)
+        from ..ops.blake3_jax import _pad_to_tier, digests_to_hex
 
         stride = SAMPLED_CHUNKS * 1024
-        pending = None  # (device result, lengths, batch indices)
+        pending = None  # (device result, host lengths, batch indices)
+
+        def stage(idxs):
+            """Host gather + device staging for one sub-batch (enqueued
+            H2D overlaps whatever kernel is currently running)."""
+            tier = self._pad_lanes(_pad_to_tier(len(idxs)))
+            rows = np.zeros((tier, stride), np.uint8)
+            lengths = np.zeros(tier, np.int32)
+            cas_native.gather_batch([paths[i] for i in idxs],
+                                    [sizes[i] for i in idxs], rows, lengths)
+            rows32 = rows.view(np.uint32).reshape(tier, stride // 4)
+            dev_rows, dev_lengths = self._stage_rows(rows32, lengths)
+            return (dev_rows, dev_lengths, lengths, idxs)
 
         def collect(item):
             dev, lengths, idxs = item
@@ -260,15 +298,16 @@ class TpuHasher:
                 else:
                     out[i] = hexes[j][:16]
 
-        for start in range(0, len(indices), PIPELINE_BATCH):
-            idxs = indices[start : start + PIPELINE_BATCH]
-            tier = self._pad_lanes(_pad_to_tier(len(idxs)))
-            rows = np.zeros((tier, stride), np.uint8)
-            lengths = np.zeros(tier, np.int32)
-            cas_native.gather_batch([paths[i] for i in idxs],
-                                    [sizes[i] for i in idxs], rows, lengths)
-            dev = self._device_hash_rows(
-                rows.view(np.uint32).reshape(tier, stride // 4), lengths)
+        chunks = [indices[s : s + PIPELINE_BATCH]
+                  for s in range(0, len(indices), PIPELINE_BATCH)]
+        staged = stage(chunks[0])
+        for nxt in chunks[1:] + [None]:
+            dev_rows, dev_lengths, lengths, idxs = staged
+            # enqueue batch k's kernel (async jax dispatch) ...
+            dev = self._device_hash_rows(dev_rows, dev_lengths)
+            # ... then gather + H2D-stage batch k+1 while it runs ...
+            staged = stage(nxt) if nxt is not None else None
+            # ... and only now block on batch k-1's D2H digest readback
             if pending is not None:
                 collect(pending)
             pending = (dev, lengths, idxs)
@@ -317,27 +356,204 @@ class TpuHasher:
     def _pad_lanes(self, n: int) -> int:
         return n
 
+    def _stage_rows(self, rows32, lengths):
+        """Begin the H2D transfer for a gathered sub-batch (async enqueue;
+        completion overlaps the in-flight kernel). The sharded variant
+        keeps rows on host — the mesh decides placement per shard."""
+        from ..utils.jax_guard import ensure_jax_safe
+
+        ensure_jax_safe()  # memoized; device backends pass through get_hasher
+        import jax
+
+        return jax.device_put(rows32), jax.device_put(lengths)
+
     def _device_hash_rows(self, rows32, lengths):
         import jax.numpy as jnp
 
         from ..ops.blake3_jax import blake3_batch_rows
 
-        return blake3_batch_rows(jnp.asarray(rows32), jnp.asarray(lengths))
+        # donate: each staged row buffer is used exactly once (stage() in
+        # _hash_sampled allocates fresh per sub-batch)
+        return blake3_batch_rows(jnp.asarray(rows32), jnp.asarray(lengths),
+                                 donate=True)
+
+
+def _bounded_call(fn, deadline_s: float, name: str):
+    """Run ``fn`` on a bounded daemon worker: a wedged device service HANGS
+    rather than raising, and no per-batch dispatch may park the scan.
+    Returns ``("ok", value)``, ``("error", exc)``, or ``("timeout", None)``
+    (the leaked worker is a daemon; its result is discarded)."""
+    box: list = []
+
+    def _run() -> None:
+        try:
+            box.append(("ok", fn()))
+        except BaseException as e:  # noqa: BLE001 — scored by the caller
+            box.append(("error", e))
+
+    worker = threading.Thread(target=_run, daemon=True, name=name)
+    worker.start()
+    worker.join(timeout=deadline_s)
+    if not box:
+        return ("timeout", None)
+    return box[0]
+
+
+class BackendRouter:
+    """Per-batch cpu-vs-device routing from LIVE transfer-inclusive rates.
+
+    The one-shot probe verdict answered "which engine wins right now?" once
+    per process — wrong whenever transfer conditions drift mid-scan (relay
+    contention, page-cache state, a recovering tunnel). The router instead
+    keeps an EWMA of each engine's *transfer-inclusive* payload bytes/s —
+    measured around the full dispatch (host staging + H2D + kernel + D2H),
+    exactly the number "GPUs as Storage System Accelerators" says decides
+    offload — and re-picks per batch:
+
+    - **hysteresis**: the losing engine must beat the incumbent's EWMA by
+      ``HYSTERESIS``× to flip, so jittery rates don't flap the route;
+    - **exploration**: every ``EXPLORE_EVERY`` batches a small capped
+      sub-slice runs on the losing engine to keep its EWMA live (a rate
+      nobody measures can never win back the route);
+    - **degraded re-probe**: after a mid-batch device failure pins the
+      route to CPU, a bounded device probe re-runs after ``REPROBE_AFTER``
+      CPU-routed batches — a transient wedge without a relay-recovery
+      event must not pin CPU for the whole scan (the recapture watcher
+      stays the fast path when the relay *does* announce recovery).
+
+    Decisions and inputs are published on the unified registry
+    (``sd_hash_router_*``); MFU for the device EWMA comes from
+    ops/roofline.py.
+    """
+
+    HYSTERESIS = 1.25
+    EWMA_ALPHA = 0.3
+    EXPLORE_EVERY = 32
+    REPROBE_AFTER = 64
+    #: messages per exploration/re-probe sub-slice — bounds the cost of
+    #: measuring the losing engine to a sliver of one batch
+    PROBE_SLICE = 128
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.cpu_bps: float | None = None
+        self.dev_bps: float | None = None
+        self.current = "cpu"
+        self.degraded = False
+        self.flips = 0
+        self._streak = 0
+        self._cpu_since_degrade = 0
+
+    def seed(self, cpu_bps: float, dev_bps: float) -> None:
+        """Initialize from the one-time fused probe (both engines measured
+        on real work); the EWMAs take over from here."""
+        with self._lock:
+            self.cpu_bps = cpu_bps
+            self.dev_bps = dev_bps
+            self.current = "device" if dev_bps > cpu_bps else "cpu"
+            self.degraded = False
+            self._streak = 0
+
+    def reset(self) -> None:
+        """Forget everything (relay recovery / test isolation): the next
+        batch re-probes from scratch."""
+        with self._lock:
+            self.cpu_bps = self.dev_bps = None
+            self.current = "cpu"
+            self.degraded = False
+            self._streak = 0
+            self._cpu_since_degrade = 0
+
+    def degrade(self, reason: str = "") -> None:
+        """A device dispatch died mid-batch: pin the route to CPU until a
+        bounded re-probe (or the recapture watcher) clears it."""
+        with self._lock:
+            self.degraded = True
+            self.dev_bps = 0.0
+            self._cpu_since_degrade = 0
+            if self.current != "cpu":
+                self._flip_locked("cpu")
+        telemetry.event("hash_router_degraded", reason=reason)
+
+    def _flip_locked(self, to: str) -> None:
+        self.current = to
+        self.flips += 1
+        self._streak = 0
+        _ROUTER_FLIPS.inc()
+        logger.info("hash router: engine flipped to %s "
+                    "(cpu %.2f MB/s, device %.2f MB/s)", to,
+                    (self.cpu_bps or 0.0) / 1e6, (self.dev_bps or 0.0) / 1e6)
+
+    def route(self) -> tuple[str, str | None]:
+        """Pick engines for one batch: ``(main, probe)`` where ``probe``
+        (None most batches) asks the caller to run a capped sub-slice on
+        the named engine to refresh its live rate."""
+        with self._lock:
+            if self.degraded:
+                self._cpu_since_degrade += 1
+                if self._cpu_since_degrade >= self.REPROBE_AFTER:
+                    # the counter is NOT reset here: a batch that cannot
+                    # carry the probe (no routable messages) must not burn
+                    # the token — the offer repeats until a probe actually
+                    # runs, and then degrade() (failed/timed-out probe) or
+                    # observe("device") (success) restarts the bound
+                    return ("cpu", "device")
+                return ("cpu", None)
+            cur_bps = self.dev_bps if self.current == "device" else self.cpu_bps
+            other = "cpu" if self.current == "device" else "device"
+            other_bps = self.dev_bps if other == "device" else self.cpu_bps
+            if (cur_bps is not None and other_bps is not None
+                    and other_bps > cur_bps * self.HYSTERESIS):
+                self._flip_locked(other)
+                return (self.current, None)
+            self._streak += 1
+            if self._streak >= self.EXPLORE_EVERY:
+                self._streak = 0
+                return (self.current, other)
+            return (self.current, None)
+
+    def observe(self, engine: str, nbytes: int, seconds: float) -> None:
+        """Fold one measured dispatch into the engine's EWMA. A measured
+        device success also clears the degraded pin — the engine is
+        demonstrably alive, so the rate comparison takes back over."""
+        bps = nbytes / max(seconds, 1e-9)
+        with self._lock:
+            prev = self.dev_bps if engine == "device" else self.cpu_bps
+            ewma = bps if not prev else \
+                self.EWMA_ALPHA * bps + (1.0 - self.EWMA_ALPHA) * prev
+            if engine == "device":
+                self.dev_bps = ewma
+                if self.degraded:
+                    self.degraded = False
+                    logger.info("hash router: device re-probe succeeded "
+                                "(%.2f MB/s) — degraded pin cleared",
+                                bps / 1e6)
+            else:
+                self.cpu_bps = ewma
+        _ROUTER_BATCHES.inc(backend=engine)
+        _ROUTER_BPS.set(round(ewma, 1), backend=engine)
+        if engine == "device":
+            from ..ops import roofline
+
+            _ROUTER_MFU.set(round(roofline.mfu(ewma), 6))
 
 
 class HybridHasher:
     """Adaptive heterogeneous executor over the native-CPU and TPU engines.
 
     On first use it probes each engine's solo throughput on real work (the
-    results are kept, not discarded). The device engine is engaged only when
-    its measured rate beats the CPU's — then sampled chunks are work-stolen
-    from one queue with a tail guard so the slower engine's last chunk never
-    dominates the makespan. When the device loses the probe (e.g. this
-    harness: tunneled H2D is wire-limited AND device transfers collapse
-    ~100x under concurrent CPU load because the relay starves for the single
-    host core — measured 0.4s/chunk solo vs 39.7s under load), ALL sampled
-    work routes to the native path, so hybrid throughput equals the best
-    available engine by construction instead of losing to contention.
+    results are kept, not discarded); the probe SEEDS a
+    :class:`BackendRouter` that then re-picks the engine PER BATCH from
+    live transfer-inclusive rates (EWMA, hysteresis-damped, with periodic
+    exploration of the losing engine and a bounded re-probe out of the
+    degraded pin). On the fused path, when the device holds the route,
+    sampled chunks are work-stolen from one queue with a tail guard so the
+    slower engine's last chunk never dominates the makespan. On rigs where
+    the device loses (e.g. this harness: tunneled H2D is wire-limited AND
+    device transfers collapse ~100x under concurrent CPU load — measured
+    0.4s/chunk solo vs 39.7s under load), ALL sampled work routes native,
+    so hybrid throughput equals the best available engine by construction
+    instead of losing to contention.
 
     The reference has a single engine (CPU join_all, file_identifier/
     mod.rs:107-134); this seam is where a local-PCIe TPU host gets its
@@ -357,16 +573,27 @@ class HybridHasher:
         self._cpu = CpuHasher()
         self._cpu_rate: float | None = None
         self._device_rate: float | None = None
+        #: per-batch engine router (live transfer-inclusive rates + EWMA
+        #: hysteresis); seeded by the one-time fused probe below
+        self.router = BackendRouter()
 
     def degrade_device(self, reason: str = "") -> None:
-        """Flip the engine verdict to native CPU after a mid-batch device
-        failure (wedge, dead tunnel): later batches stop touching the
-        device path until :func:`reset_device_verdicts` re-arms the probe
-        (the relay recapture watcher calls it on recovery)."""
+        """Pin the route to native CPU after a mid-batch device failure
+        (wedge, dead tunnel). The pin is NOT forever: the router re-probes
+        the device on a bounded sub-slice after ``REPROBE_AFTER``
+        CPU-routed batches, and :func:`reset_device_verdicts` (the relay
+        recapture watcher) re-arms the full probe immediately."""
         self._cpu_rate = self._cpu_rate or 1.0
         self._device_rate = 0.0
+        self.router.degrade(reason)
         logger.warning("hybrid hasher degraded to native CPU%s",
                        f": {reason}" if reason else "")
+
+    def reset_verdict(self) -> None:
+        """Forget both engine measurements (recapture watcher path): the
+        next batch re-runs the fused probe and re-seeds the router."""
+        self._cpu_rate = self._device_rate = None
+        self.router.reset()
 
     def _cpu_into(self, paths, sizes, idxs: list[int], out: list) -> None:
         """Native-CPU hash ``idxs`` and scatter results into ``out``."""
@@ -375,49 +602,96 @@ class HybridHasher:
         for i, r in zip(idxs, res):
             out[i] = r
 
+    #: floor rate for the bounded device deadline: a dispatch slower than
+    #: this is indistinguishable from a wedge and gets abandoned
+    DEVICE_FLOOR_BPS = 512 * 1024
+
+    def _device_deadline_s(self, nbytes: int, probe: bool) -> float:
+        """Deadline for a bounded device dispatch. Probe/exploration slices
+        get a TIGHT bound derived from the CPU's live rate — the probe only
+        exists to ask "could the device win?", and a device that cannot
+        hash the slice within ~4× the CPU's time for the same bytes has
+        already answered no; waiting out a generous wedge deadline would
+        stall the scan ~40s per exploration on collapsed-transfer rigs.
+        Main-route dispatches (the device actually won) keep the generous
+        wedge-detection bound."""
+        if probe:
+            cpu_bps = self.router.cpu_bps or 0.0
+            if cpu_bps > 0:
+                return min(15.0, max(2.0, 4.0 * nbytes / cpu_bps))
+            return 15.0
+        return max(60.0, nbytes / self.DEVICE_FLOOR_BPS)
+
+    def _dispatch_gathered(self, engine: str, idxs: list[int], messages,
+                           out: list, probe: bool = False) -> None:
+        """Run one routed sub-batch: measure the transfer-inclusive rate
+        into the router's EWMA; a device failure/timeout finishes the
+        sub-batch natively (byte-identical digests) and degrades the pin."""
+        sub = [messages[i] for i in idxs]
+        nbytes = sum(len(m) for m in sub)
+        t0 = time.perf_counter()
+        if engine == "device":
+            status, res = _bounded_call(
+                lambda: self._tpu.hash_gathered(sub),
+                self._device_deadline_s(nbytes, probe),
+                "hybrid-device-dispatch")
+            if status == "ok":
+                self.router.observe("device", nbytes,
+                                    time.perf_counter() - t0)
+            else:
+                why = repr(res) if status == "error" else \
+                    "deadline exceeded (wedged device?)"
+                logger.warning("hybrid device dispatch failed mid-batch "
+                               "(%s); re-dispatching on native CPU", why)
+                self.degrade_device(why)
+                res = self._cpu.hash_gathered(sub)
+        else:
+            res = self._cpu.hash_gathered(sub)
+            self.router.observe("cpu", nbytes, time.perf_counter() - t0)
+        for i, r in zip(idxs, res):
+            out[i] = r
+
     @_count_hash_gathered
     def hash_gathered(self,
                       messages: list[bytes | Exception]) -> list[str | Exception]:
-        """Gathered-message route inherits the engine verdict from the last
-        ``hash_batch`` probe; an unprobed process routes native — the safe
-        default on wire-limited rigs (the pipelined identifier runs its
-        first batch through ``hash_batch`` precisely so the probe happens).
-        With no native lib there is nothing to race — mirror hash_batch's
-        routing to the device path, never the python oracle."""
+        """Gathered-message route: PER-BATCH engine choice by the router
+        (live transfer-inclusive rates, hysteresis, bounded re-probe). An
+        unprobed process routes native — the safe default on wire-limited
+        rigs (the pipelined identifier runs its first batch through
+        ``hash_batch`` precisely so the probe seeds the router). With no
+        native lib there is nothing to race — mirror hash_batch's routing
+        to the device path, never the python oracle."""
         if self._cpu._fast is None:
             return self._tpu.hash_gathered(messages)
-        if not (self._cpu_rate is not None and self._device_rate is not None
-                and self._device_rate > self._cpu_rate):
+        if self._cpu_rate is None or self._device_rate is None:
             return self._cpu.hash_gathered(messages)
-        # device won the probe: mirror hash_batch's small/sampled split —
-        # short messages stay on native CPU (IO-bound work whose varied
-        # lengths would fan the device path across many bucket shapes);
-        # sampled-class messages take the device
+        # mirror hash_batch's small/sampled split — short messages stay on
+        # native CPU (IO-bound work whose varied lengths would fan the
+        # device path across many bucket shapes); sampled-class messages
+        # are the routable payload
         big = [i for i, m in enumerate(messages)
                if not isinstance(m, Exception) and len(m) >= SAMPLED_MESSAGE_LEN]
         if not big:
             return self._cpu.hash_gathered(messages)
+        main, probe = self.router.route()
         big_set = set(big)
         rest = [i for i in range(len(messages)) if i not in big_set]
         out: list[str | Exception] = [None] * len(messages)  # type: ignore[list-item]
-        for idxs, backend in ((big, self._tpu), (rest, self._cpu)):
-            if not idxs:
-                continue
-            sub = [messages[i] for i in idxs]
-            if backend is self._tpu:
-                try:
-                    res = backend.hash_gathered(sub)
-                except Exception as e:  # noqa: BLE001 — device died mid-batch
-                    # the degradation ladder: finish THIS batch natively
-                    # (byte-identical digests) and flip the verdict so
-                    # later batches don't re-wedge
-                    logger.exception("hybrid device path failed mid-batch; "
-                                     "re-dispatching on native CPU")
-                    self.degrade_device(repr(e))
-                    res = self._cpu.hash_gathered(sub)
-            else:
-                res = backend.hash_gathered(sub)
-            for i, r in zip(idxs, res):
+        if probe is not None and probe != main and len(big) > 1:
+            # capped sub-slice on the losing engine keeps its EWMA live
+            # (and is the degraded path's bounded device re-probe) — under
+            # the TIGHT probe deadline, so a collapsed/wedged device costs
+            # seconds, not a generous wedge-detection window
+            cut = min(self.router.PROBE_SLICE, len(big) // 2)
+            if cut > 0:
+                self._dispatch_gathered(probe, big[:cut], messages, out,
+                                        probe=True)
+                big = big[cut:]
+        if big:
+            self._dispatch_gathered(main, big, messages, out)
+        if rest:
+            res = self._cpu.hash_gathered([messages[i] for i in rest])
+            for i, r in zip(rest, res):
                 out[i] = r
         return out
 
@@ -440,37 +714,30 @@ class HybridHasher:
         t0 = _time.perf_counter()
         # the device probe gets a hard deadline: a wedged device service
         # (dead tunnel) HANGS rather than raising, and a probe that never
-        # returns would stall every scan — run it in a bounded worker
-        import threading as _threading
-
-        probe_err: list[BaseException] = []
-
-        def _device_probe() -> None:
-            try:
-                self._tpu._hash_sampled(paths, sizes, dev_part, out)
-            except BaseException as e:  # noqa: BLE001 — scored below
-                probe_err.append(e)
-
-        worker = _threading.Thread(target=_device_probe, daemon=True,
-                                   name="hybrid-device-probe")
-        worker.start()
-        worker.join(timeout=max(60.0, k * 0.5))
-        if worker.is_alive():
-            logger.warning("hybrid probe: device engine unresponsive after "
-                           "deadline; routing everything to native CPU")
-            self._cpu_into(paths, sizes, dev_part, out)  # same values: benign
-            device_rate = 0.0
-        elif probe_err:
-            # a dying device must not leave half-set rates (permanently
-            # broken comparisons) — score it dead and finish on CPU
-            logger.warning("hybrid probe: device engine failed (%r); "
-                           "routing everything to native CPU", probe_err[0])
+        # returns would stall every scan — _bounded_call runs it on a
+        # bounded daemon worker (the one wedge-handling policy)
+        status, err = _bounded_call(
+            lambda: self._tpu._hash_sampled(paths, sizes, dev_part, out),
+            max(60.0, k * 0.5), "hybrid-device-probe")
+        if status == "ok":
+            device_rate = k / max(1e-9, _time.perf_counter() - t0)
+        else:
+            # timeout, or a dying device — either way it must not leave
+            # half-set rates (permanently broken comparisons): score it
+            # dead and finish on CPU (same values, benign overwrite)
+            logger.warning(
+                "hybrid probe: device engine %s; routing everything to "
+                "native CPU",
+                "unresponsive after deadline" if status == "timeout"
+                else f"failed ({err!r})")
             self._cpu_into(paths, sizes, dev_part, out)
             device_rate = 0.0
-        else:
-            device_rate = k / max(1e-9, _time.perf_counter() - t0)
-        # set both rates atomically only once both probes concluded
+        # set both rates atomically only once both probes concluded, and
+        # seed the per-batch router's EWMAs (probe files/s × the sampled
+        # message size = transfer-inclusive bytes/s on the probe slices)
         self._cpu_rate, self._device_rate = cpu_rate, device_rate
+        self.router.seed(cpu_rate * SAMPLED_MESSAGE_LEN,
+                         device_rate * SAMPLED_MESSAGE_LEN)
         logger.info("hybrid probe: cpu %.0f files/s, device %.0f files/s — %s",
                     self._cpu_rate, self._device_rate,
                     "engaging device" if self._device_rate > self._cpu_rate
@@ -578,6 +845,11 @@ class ShardedHasher(TpuHasher):
 
         return pad_batch_for_mesh(n, self._mesh)
 
+    def _stage_rows(self, rows32, lengths):
+        # host-side staging: the sharded row hasher shards the batch axis
+        # itself; a premature single-device put would just be resharded
+        return rows32, lengths
+
     def _device_hash_rows(self, rows32, lengths):
         import jax.numpy as jnp
 
@@ -620,7 +892,7 @@ def reset_device_verdicts() -> None:
     backends via get_hasher."""
     for backend in list(_instances.values()):
         if isinstance(backend, HybridHasher):
-            backend._cpu_rate = backend._device_rate = None
+            backend.reset_verdict()
             logger.info("hybrid hasher verdict reset — will re-probe "
                         "engines on the next batch")
 

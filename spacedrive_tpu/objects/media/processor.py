@@ -168,8 +168,11 @@ class MediaProcessorJob(StatefulJob):
     def pipeline_commit(self, ctx: WorkerContext, data: dict,
                         batch: dict) -> StepResult:
         db = ctx.library.db
-        for object_id, media in batch["media_rows"]:
-            db.upsert(MediaData, {"object_id": object_id}, media, media)
+        # one transaction per batch: atomic under the committer's retry,
+        # and it joins the executor's group-commit scope when armed
+        with db.transaction():
+            for object_id, media in batch["media_rows"]:
+                db.upsert(MediaData, {"object_id": object_id}, media, media)
         for cas_id in batch["thumbed"]:
             ctx.library.emit("new_thumbnail", {"cas_id": cas_id})
         return StepResult(metadata={"thumbnails_created": len(batch["thumbed"]),

@@ -277,22 +277,56 @@ def _single_chunk_root(words0: jax.Array, lengths: jax.Array,
 
 
 def blake3_batch_rows(rows: jax.Array, lengths: jax.Array,
-                      kernel: str | None = None) -> jax.Array:
+                      kernel: str | None = None,
+                      donate: bool = False) -> jax.Array:
     """Row-major entry: ``rows`` is (B, C*256) uint32 — each row one message
     in natural byte order (the layout the native gather writes). The
     (block, word, chunk, batch) permutation the scan wants happens ON DEVICE,
     where a 120MB transpose is ~free, instead of in a host numpy transpose
-    that used to dominate the pipeline profile."""
-    return _blake3_batch_rows_impl(rows, lengths, kernel=resolve_kernel(kernel))
+    that used to dominate the pipeline profile.
+
+    ``donate=True`` (the hasher pipeline's fused path) DONATES the row
+    buffer on a real accelerator: each staged sub-batch is ``device_put``
+    once and never touched again, so XLA may reuse the (tier × 57KiB)
+    transfer buffer as scratch instead of allocating per batch —
+    double-buffered H2D without doubling resident memory. Donation is
+    OPT-IN because it invalidates the caller's array: callers that reuse a
+    device-resident input across calls (the device micro-bench's repeat
+    loop) must keep the default. The CPU backend doesn't implement
+    donation (and would warn), so it always takes the plain entry point.
+    """
+    k = resolve_kernel(kernel)
+    if donate and _backend_supports_donation():
+        return _blake3_batch_rows_donated(rows, lengths, kernel=k)
+    return _blake3_batch_rows_impl(rows, lengths, kernel=k)
+
+
+@functools.lru_cache(maxsize=1)
+def _backend_supports_donation() -> bool:
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def _rows_hash_body(rows: jax.Array, lengths: jax.Array,
+                    kernel: str) -> jax.Array:
+    B, W = rows.shape
+    C = W // (BLOCKS_PER_CHUNK * 16)
+    words = rows.reshape(B, C, BLOCKS_PER_CHUNK, 16).transpose(2, 3, 1, 0)
+    return _blake3_batch_impl(words, lengths, kernel=kernel)
 
 
 @functools.partial(jax.jit, static_argnames=("kernel",))
 def _blake3_batch_rows_impl(rows: jax.Array, lengths: jax.Array, *,
                             kernel: str = "xla") -> jax.Array:
-    B, W = rows.shape
-    C = W // (BLOCKS_PER_CHUNK * 16)
-    words = rows.reshape(B, C, BLOCKS_PER_CHUNK, 16).transpose(2, 3, 1, 0)
-    return _blake3_batch_impl(words, lengths, kernel=kernel)
+    return _rows_hash_body(rows, lengths, kernel)
+
+
+@functools.partial(jax.jit, static_argnames=("kernel",), donate_argnums=(0,))
+def _blake3_batch_rows_donated(rows: jax.Array, lengths: jax.Array, *,
+                               kernel: str = "xla") -> jax.Array:
+    return _rows_hash_body(rows, lengths, kernel)
 
 
 # --------------------------------------------------------------------------

@@ -6,11 +6,17 @@ Thread layout (one executor per pipelined job run):
 
 Both queues are bounded (``SD_PIPELINE_DEPTH``), so a slow committer
 backpressures the dispatcher and a slow dispatcher backpressures the
-prefetcher — memory stays O(depth × batch) no matter how far the stages
-drift apart. The committer is the job's own worker thread: it polls the
-command channel between commits exactly like the sequential step loop, so
-Pause/Cancel/Shutdown land at a committed-batch boundary and the serialized
-checkpoint only ever reflects committed work.
+prefetcher — memory stays O((depth + group) × batch) no matter how far the
+stages drift apart. The committer is the job's own worker thread: it polls
+the command channel between commits exactly like the sequential step loop,
+so Pause/Cancel/Shutdown land at a committed-GROUP boundary and the
+serialized checkpoint only ever reflects committed work.
+
+Group commit (``SD_COMMIT_GROUP``): up to N processed pages share one
+durable transaction — each page's ``spec.commit`` runs in order and its own
+``db.transaction()`` joins the outer scope, so BEGIN/COMMIT (and fsync/WAL
+cost, and the ``commit`` fault seam) amortize over the group while row
+contents and CRDT op order stay byte-identical to the per-page committer.
 """
 
 from __future__ import annotations
@@ -49,10 +55,24 @@ _IDLE = telemetry.counter(
     "sd_pipeline_stage_idle_seconds",
     "time each stage spent waiting on an empty upstream queue",
     labels=("stage",))
+_COMMIT_TXNS = telemetry.counter(
+    "sd_commit_txns_total",
+    "durable transactions opened by the pipeline committer (group commit "
+    "coalesces SD_COMMIT_GROUP pages into each)")
+_COMMIT_PAGES = telemetry.counter(
+    "sd_commit_txn_pages_total",
+    "pipeline pages made durable through group-commit transactions")
 
 #: poll quantum for queue waits — also bounds pause latency, like the
 #: sequential loop's between-steps command check cadence
 _POLL_S = 0.05
+
+#: how long a partial commit group may wait for more pages before it
+#: flushes anyway. In a commit-bound pipeline the results queue never runs
+#: dry and groups fill to SD_COMMIT_GROUP; in a page/hash-bound pipeline
+#: this caps durability latency (pause itself is NOT delayed — a pause
+#: discards the uncommitted group and serializes the last flushed state)
+GROUP_LINGER_S = 0.5
 
 #: the committer's own retry over ``spec.commit``: patient (it sits ABOVE
 #: the _Txn-level busy retry, catching what escalates past that budget) and
@@ -102,6 +122,16 @@ def pipeline_depth() -> int:
         return 2
 
 
+def commit_group() -> int:
+    """Pages coalesced per durable transaction (``SD_COMMIT_GROUP``, min 1,
+    default 8). 1 restores the PR 3 one-txn-per-page committer — the
+    equivalence baseline for the group-commit byte-identity matrix."""
+    try:
+        return max(1, int(os.environ.get("SD_COMMIT_GROUP", "8")))
+    except ValueError:
+        return 8
+
+
 class PipelineExecutor:
     """Drive one pipelined job run; mutates the job's ``JobState`` exactly
     like the sequential loop in ``DynJob.run`` would."""
@@ -127,6 +157,7 @@ class PipelineExecutor:
         self._hash_s = 0.0
         self._commit_s = 0.0
         self._batches = 0
+        self._txns = 0
 
     # -- bounded put/get that never deadlock a drain -------------------------
     def _put(self, q: queue.Queue, item: Any) -> bool:
@@ -228,20 +259,99 @@ class PipelineExecutor:
         ]
         for t in threads:
             t.start()
+
+        # -- group commit: coalesce up to SD_COMMIT_GROUP processed pages
+        # into ONE durable transaction. Each page's spec.commit runs in
+        # arrival order; its own db.transaction() joins the outer scope
+        # (models/base._Txn re-entrancy), so durability — and the `commit`
+        # fault seam — lands once per GROUP. The checkpoint cursor and
+        # step_number still only advance with committed work: on any
+        # failure the whole group rolls back AND the in-memory `data`
+        # snapshot is restored before the exception escapes, so a pause
+        # arriving during the retry backoff serializes the last durable
+        # group boundary, never a torn group.
+        group_n = self.spec.group or commit_group()
+        db = getattr(getattr(self.ctx, "library", None), "db", None)
+        pending: list[Any] = []
+        pending_since = 0.0  # perf_counter of the oldest un-flushed page
+
+        def _flush() -> None:
+            if not pending:
+                return
+            # spec.commit mutates only top-level keys of `data` (the
+            # checkpoint-cursor contract, spec.py) — a shallow snapshot
+            # makes the group attempt restartable
+            snapshot = dict(state.data)
+
+            def attempt() -> list[Any]:
+                try:
+                    results: list[Any] = []
+                    if len(pending) == 1 or db is None:
+                        for it in pending:
+                            results.append(
+                                self.spec.commit(self.ctx, state.data, it))
+                    else:
+                        with db.transaction():
+                            for it in pending:
+                                results.append(
+                                    self.spec.commit(self.ctx, state.data,
+                                                     it))
+                    return results
+                except BaseException:
+                    state.data.clear()
+                    state.data.update(snapshot)
+                    raise
+
+            with telemetry.span(self.trace, "pipeline.commit",
+                                pages=len(pending)) as sp:
+                results = retry_call(
+                    attempt, policy=COMMIT_RETRY, classify=is_transient,
+                    cancel_check=lambda: self.ctx.check_commands(
+                        self.dyn_job),
+                    label=f"{self.dyn_job.job.NAME}-commit")
+            self._commit_s += sp.duration_s
+            _BUSY.inc(sp.duration_s, stage="commit")
+            self._txns += 1
+            _COMMIT_TXNS.inc()
+            _COMMIT_PAGES.inc(len(pending))
+            pending.clear()
+            for result in results:
+                self._batches += 1
+                if result.more_steps:
+                    raise JobError(
+                        f"{self.dyn_job.job.NAME}: pipelined jobs cannot "
+                        f"append steps mid-run")
+                if result.metadata:
+                    merge_metadata(state.run_metadata, result.metadata)
+                self.errors.extend(result.errors)
+                state.step_number += 1
+                self.ctx.progress(completed_task_count=state.step_number)
+
         try:
             while True:
                 # between-commits command poll: JobPaused serializes the
-                # state as of the last committed batch, nothing speculative
+                # state as of the last committed group, nothing speculative
                 self.ctx.check_commands(self.dyn_job)
                 try:
                     t0 = time.perf_counter()
                     item = self._results.get(timeout=_POLL_S)
                 except queue.Empty:
                     _IDLE.inc(time.perf_counter() - t0, stage="commit")
+                    # upstream is slow: a partial group that lingered past
+                    # its window commits now rather than holding completed
+                    # pages hostage to queue cadence — page/hash-bound
+                    # pipelines degrade toward smaller groups, never stall
+                    if pending and (time.perf_counter() - pending_since
+                                    > GROUP_LINGER_S):
+                        _flush()
                     continue
                 if item is _DONE:
+                    _flush()
                     break
                 if isinstance(item, _StageFailure):
+                    # completed pages first: the drain lands on an ordered
+                    # committed-group boundary before supervision acts
+                    _flush()
                     # stage supervision: a prefetch/dispatch thread that
                     # crashed on a TRANSIENT class (flaky IO, device wedge,
                     # injected chaos) drains to an ordered checkpoint-pause
@@ -261,25 +371,11 @@ class PipelineExecutor:
                         raise JobPaused(self.dyn_job.serialize_state(),
                                         errors=self.errors)
                     raise exc
-                with telemetry.span(self.trace, "pipeline.commit") as sp:
-                    result = retry_call(
-                        lambda: self.spec.commit(self.ctx, state.data, item),
-                        policy=COMMIT_RETRY, classify=is_transient,
-                        cancel_check=lambda: self.ctx.check_commands(
-                            self.dyn_job),
-                        label=f"{self.dyn_job.job.NAME}-commit")
-                self._commit_s += sp.duration_s
-                _BUSY.inc(sp.duration_s, stage="commit")
-                self._batches += 1
-                if result.more_steps:
-                    raise JobError(
-                        f"{self.dyn_job.job.NAME}: pipelined jobs cannot "
-                        f"append steps mid-run")
-                if result.metadata:
-                    merge_metadata(state.run_metadata, result.metadata)
-                self.errors.extend(result.errors)
-                state.step_number += 1
-                self.ctx.progress(completed_task_count=state.step_number)
+                if not pending:
+                    pending_since = time.perf_counter()
+                pending.append(item)
+                if len(pending) >= group_n:
+                    _flush()
         finally:
             wall_sp.__exit__(None, None, None)
             self._stop.set()
@@ -333,8 +429,10 @@ class PipelineExecutor:
             "pipeline_commit_s": self._commit_s,
             "pipeline_wall_s": wall_sp.duration_s,
             "pipeline_batches": self._batches,
+            "commit_txns": self._txns,
         })
         logger.debug(
-            "pipeline %s: %d batches, page %.3fs | hash %.3fs | commit %.3fs "
-            "| wall %.3fs", self.dyn_job.job.NAME, self._batches, self._page_s,
-            self._hash_s, self._commit_s, wall_sp.duration_s)
+            "pipeline %s: %d batches in %d txns, page %.3fs | hash %.3fs | "
+            "commit %.3fs | wall %.3fs", self.dyn_job.job.NAME, self._batches,
+            self._txns, self._page_s, self._hash_s, self._commit_s,
+            wall_sp.duration_s)

@@ -36,9 +36,20 @@ class PipelineSpec:
         must be best-effort (caught and logged, never raised) — an
         exception escaping ``commit`` asserts that nothing durable
         happened for this batch.
+        GROUP-COMMIT CONTRACT: the committer may run several ``commit``
+        calls inside ONE outer transaction (executor.commit_group), rolling
+        all of them back together on failure. Durable writes must therefore
+        go through ``db.transaction()`` (joining the outer scope), and
+        checkpoint mutations to ``data`` must be top-level key assignments —
+        the committer restores a shallow snapshot of ``data`` when a group
+        attempt fails, so nested-structure mutations would leak across a
+        rollback. The ``commit-discipline`` sdlint pass enforces the write
+        side.
     """
 
     page: Callable[..., Any]
     process: Callable[..., Any]
     commit: Callable[..., Any]
     depth: int | None = None
+    #: pages per durable transaction; None → executor.commit_group()
+    group: int | None = None

@@ -250,6 +250,21 @@ def _declare_core() -> None:
     gauge("sd_jobs_queued", "jobs waiting for lane capacity")
     counter("sd_jobs_completed_total", "finished jobs by name and status",
             labels=("job", "status"))
+    counter("sd_commit_txns_total",
+            "durable transactions opened by the pipeline committer (group "
+            "commit coalesces SD_COMMIT_GROUP pages into each)")
+    counter("sd_commit_txn_pages_total",
+            "pipeline pages made durable through group-commit transactions")
+    gauge("sd_hash_router_bytes_per_sec",
+          "EWMA transfer-inclusive payload bytes/s per engine (router "
+          "input)", labels=("backend",))
+    gauge("sd_hash_router_device_mfu",
+          "u32-VPU MFU implied by the router's device-engine EWMA rate")
+    counter("sd_hash_router_flips_total",
+            "engine flips by the per-batch hash router (hysteresis-damped)")
+    counter("sd_hash_router_batches_total",
+            "hash (sub-)batches the hybrid router dispatched per engine",
+            labels=("backend",))
     counter("sd_hash_batches_total", "hash batches dispatched per backend",
             labels=("backend",))
     counter("sd_hash_files_total", "files hashed per backend",

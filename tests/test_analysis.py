@@ -279,6 +279,48 @@ def test_pipeline_ordering_silent_outside_stage_functions(tmp_path):
         "pipeline-ordering") == []
 
 
+# -- pass 7: commit-discipline -------------------------------------------------
+
+def test_commit_discipline_flags_writes_outside_txn_scope(tmp_path):
+    """A pipeline_commit DB write outside `with db.transaction():` would
+    autocommit and survive a group-commit rollback — flagged; writes inside
+    the transaction block (however nested) and reads anywhere are fine."""
+    bad = run_on(tmp_path, "objects/bad.py", (
+        "class J:\n"
+        "    def pipeline_commit(self, ctx, data, batch):\n"
+        "        db = ctx.library.db\n"
+        "        db.update(None, {}, {})\n"
+        "        with db.transaction():\n"
+        "            db.executemany('U', [])\n"
+        "            for r in batch:\n"
+        "                db.upsert(None, {}, r, r)\n"
+        "        rows = db.query('SELECT 1')\n"
+        "        data['cursor'] = batch['cursor']\n"),
+        "commit-discipline")
+    assert [f.lineno for f in bad] == [4]
+    assert "transaction scope" in bad[0].message
+
+
+def test_commit_discipline_flags_checkpoint_mutation_in_stages(tmp_path):
+    """Speculative stages must keep their cursor in `scratch`: subscript
+    assignment to `data` (or data.update/pop/...) in pipeline_page/
+    pipeline_process is flagged; `scratch`/`batch` mutations are not, and
+    pipeline_commit owns `data` legitimately."""
+    bad = run_on(tmp_path, "objects/bad.py", (
+        "class J:\n"
+        "    def pipeline_page(self, ctx, data, scratch):\n"
+        "        scratch['cursor'] = 7\n"
+        "        data['cursor'] = 7\n"
+        "    def pipeline_process(self, ctx, data, batch):\n"
+        "        batch['cas'] = []\n"
+        "        data.update({'cursor': 9})\n"
+        "    def pipeline_commit(self, ctx, data, batch):\n"
+        "        data['cursor'] = batch['cursor']\n"),
+        "commit-discipline")
+    assert [f.lineno for f in bad] == [4, 7]
+    assert "page" in bad[0].message and "process" in bad[1].message
+
+
 # -- pass 10: retry-discipline -------------------------------------------------
 
 def test_retry_discipline_flags_sleep_in_retry_loop(tmp_path):

@@ -113,6 +113,48 @@ def test_chaos_scan_equivalent_to_fault_free(tmp_path, chaos_tree,
     assert chaos[2] == clean[2], "CRDT op order diverges under faults"
 
 
+def test_group_commit_chaos_byte_identical(tmp_path, chaos_tree, monkeypatch,
+                                           clean_faults):
+    """The group-commit chaos gate: a busy storm on the (now per-GROUP)
+    commit seam plus a one-shot hash-dispatch wedge, with SD_COMMIT_GROUP=8,
+    must stay byte-identical to the fault-free run. The busy count (6)
+    exhausts the inner TXN_RETRY budget exactly, so the whole-group
+    rollback + restore + COMMIT_RETRY escalation path runs for real.
+    (`hash_dispatch` is the spec alias for the identifier's hash seam.)"""
+    monkeypatch.setattr(fi, "BATCH_SIZE", 256)
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    monkeypatch.setenv("SD_COMMIT_GROUP", "8")
+
+    node_a, lib_a, loc_a = _seed_library(tmp_path / "clean", chaos_tree, "gclean")
+    _identify(node_a, lib_a, loc_a)
+    clean = _snapshot(lib_a)
+    node_a.shutdown()
+
+    node_b, lib_b, loc_b = _seed_library(tmp_path / "chaos", chaos_tree, "gchaos")
+    faults.install("commit:sqlite_busy:6;hash_dispatch:wedge:once", seed=7)
+    jid = _identify(node_b, lib_b, loc_b)
+    fired = faults.fired()
+    faults.clear()
+    chaos = _snapshot(lib_b)
+    row = lib_b.db.find_one(JobRow, {"id": jid})
+    meta = _decoded(row["metadata"])
+    node_b.shutdown()
+
+    # the alias normalized to the canonical seam and the storm happened
+    assert fired.get("hash:wedge") == 1, fired
+    assert fired.get("commit:sqlite_busy") == 6, fired
+
+    assert row["status"] == JobStatus.COMPLETED_WITH_ERRORS
+    assert meta["quarantined_files"] == 0
+    assert meta["recovered_batches"] == 1
+    assert meta["pipeline_batches"] == 8  # ceil(2000/256)
+    assert meta["commit_txns"] <= 8  # grouping actually engaged
+
+    assert chaos[0] == clean[0], "cas_id rows diverge under group-commit chaos"
+    assert chaos[1] == clean[1], "object linkage diverges under group-commit chaos"
+    assert chaos[2] == clean[2], "CRDT op order diverges under group-commit chaos"
+
+
 # -- per-item quarantine -------------------------------------------------------
 
 
@@ -297,6 +339,10 @@ def test_busy_storm_leaves_crdt_op_order_unchanged(tmp_path, monkeypatch,
     op stream."""
     monkeypatch.setattr(fi, "BATCH_SIZE", 8)
     monkeypatch.setenv("SD_PIPELINE", "1")
+    # per-page txns: this gate targets the _Txn-level busy retry, so every
+    # page must BEGIN/COMMIT through the seam (group commit would coalesce
+    # the run into one txn and starve the probabilistic storm of hits)
+    monkeypatch.setenv("SD_COMMIT_GROUP", "1")
     rng = random.Random(21)
     tree = tmp_path / "tree"
     tree.mkdir()
@@ -385,11 +431,62 @@ def test_sync_apply_crash_falls_back_to_careful_pass(tmp_path, clean_faults):
 def test_hybrid_degrade_flips_verdict_and_recapture_resets(monkeypatch):
     h = hasher_mod.HybridHasher()
     h._cpu_rate, h._device_rate = 10.0, 99.0
+    h.router.seed(10.0, 99.0)
+    assert h.router.current == "device"
     h.degrade_device("unit")
     assert h._device_rate == 0.0 and h._cpu_rate == 10.0
+    assert h.router.current == "cpu" and h.router.degraded
     monkeypatch.setattr(hasher_mod, "_instances", {"hybrid": h})
     hasher_mod.reset_device_verdicts()
     assert h._cpu_rate is None and h._device_rate is None
+    assert not h.router.degraded and h.router.cpu_bps is None
+
+
+def test_router_reprobes_device_after_bounded_cpu_batches():
+    """Satellite gate: a degraded route must NOT pin CPU for the whole
+    scan — after REPROBE_AFTER cpu-routed batches the router asks for a
+    bounded device probe, and a measured device success clears the pin."""
+    r = hasher_mod.BackendRouter()
+    r.seed(100.0, 500.0)
+    r.degrade("transient wedge")
+    assert r.current == "cpu" and r.degraded
+    probes = 0
+    for _ in range(r.REPROBE_AFTER - 1):
+        main, probe = r.route()
+        assert main == "cpu"
+        probes += probe is not None
+    assert probes == 0  # pinned, no device touch inside the bound
+    main, probe = r.route()
+    assert (main, probe) == ("cpu", "device")  # the bounded re-probe
+    # the offer REPEATS until a probe actually runs — a batch with no
+    # routable messages must not burn the token
+    assert r.route() == ("cpu", "device")
+    # a failed/timed-out probe (degrade) restarts the bound
+    r.degrade("probe timed out")
+    assert r.route() == ("cpu", None)
+    # a measured device success clears the pin and the rate comparison
+    # takes back over (hysteresis decides the flip)
+    r.observe("device", 10_000_000, 1.0)
+    assert not r.degraded
+
+
+def test_router_hysteresis_damps_flapping():
+    """The route only flips when the other engine's EWMA beats the
+    incumbent by HYSTERESIS× — jittery near-equal rates must not flap."""
+    r = hasher_mod.BackendRouter()
+    r.seed(100.0, 120.0)  # device wins the seed (ratio < hysteresis)
+    assert r.current == "device"
+    flips = r.flips
+    # cpu drifts slightly ahead, but inside the hysteresis band: no flip
+    r.observe("cpu", 130, 1.0)
+    assert r.route()[0] == "device" and r.flips == flips
+    # cpu rate decisively beats device × hysteresis: one flip, then stable
+    for _ in range(4):
+        r.observe("cpu", 1000, 1.0)
+    assert r.route()[0] == "cpu"
+    assert r.flips == flips + 1
+    assert r.route()[0] == "cpu"
+    assert r.flips == flips + 1
 
 
 # -- the primitives ------------------------------------------------------------

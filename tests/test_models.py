@@ -119,6 +119,57 @@ def test_concurrent_writers(db):
     assert db.count(Tag) == 200
 
 
+def test_reader_connection_snapshot_semantics(db):
+    """Reads during an open transaction: the txn-owning thread sees its own
+    uncommitted rows (writer connection); every other thread reads the last
+    committed WAL snapshot WITHOUT blocking on the writer lock — what keeps
+    the pipeline prefetcher paging under a long group-commit txn."""
+    db.insert(Tag, {"pub_id": "t-durable", "name": "durable"})
+
+    started = threading.Event()
+    release = threading.Event()
+    seen: dict[str, object] = {}
+
+    def holder():
+        with db.transaction():
+            db.insert(Tag, {"pub_id": "t-open", "name": "open"})
+            # owner reads through the writer: its own uncommitted row shows
+            seen["owner"] = {r["pub_id"] for r in db.query(
+                "SELECT pub_id FROM tag")}
+            started.set()
+            release.wait(10)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert started.wait(10)
+    import time as _time
+
+    t_read0 = _time.monotonic()
+    other = {r["pub_id"] for r in db.query("SELECT pub_id FROM tag")}
+    read_latency = _time.monotonic() - t_read0
+    release.set()
+    t.join(10)
+
+    assert seen["owner"] == {"t-durable", "t-open"}
+    assert other == {"t-durable"}  # committed snapshot, no torn read
+    assert read_latency < 1.0  # never queued behind the open transaction
+    # after commit, the reader sees the new row on its next query
+    assert {r["pub_id"] for r in db.query("SELECT pub_id FROM tag")} == \
+        {"t-durable", "t-open"}
+
+
+def test_memory_database_has_no_reader_split(tmp_path):
+    """:memory: databases must keep every read on the writer connection
+    (a second :memory: connection would be a different database)."""
+    mem = Database(":memory:", [Tag])
+    try:
+        mem.insert(Tag, {"pub_id": "m", "name": "m"})
+        assert mem.query("SELECT count(*) c FROM tag")[0]["c"] == 1
+        assert mem._read_conn is None
+    finally:
+        mem.close()
+
+
 def test_none_where_uses_is_null(db):
     """file_identifier's orphan query filters object_id IS NULL."""
     loc = db.insert(Location, {"pub_id": str(uuid.uuid4()), "path": "/x"})

@@ -137,9 +137,14 @@ def _snapshot(lib):
     return path_cas, path_obj, ops
 
 
+@pytest.mark.parametrize("group", [1, 4, 16])
 def test_pipelined_identify_equivalent_to_sequential(tmp_path, fixture_tree,
-                                                     monkeypatch):
+                                                     monkeypatch, group):
+    """The byte-identity matrix over SD_COMMIT_GROUP: per-page txns (1),
+    partial groups (4), and one-txn-per-run (16 > total batches) must all
+    match the sequential loop row-for-row and op-for-op."""
     monkeypatch.setattr(fi, "BATCH_SIZE", 16)  # several batches in flight
+    monkeypatch.setenv("SD_COMMIT_GROUP", str(group))
 
     monkeypatch.setenv("SD_PIPELINE", "0")
     node_a, lib_a, loc_a = _seed_library(tmp_path / "seq", fixture_tree, "seq")
@@ -160,14 +165,26 @@ def test_pipelined_identify_equivalent_to_sequential(tmp_path, fixture_tree,
     # the pipelined run really went through the streaming executor
     assert meta["pipeline_batches"] == 5  # ceil(80/16)
     assert meta["pipeline_wall_s"] > 0
+    # group commit actually coalesced: per-page mode opens one txn per
+    # batch; grouped modes open fewer (partial flushes may split groups
+    # when the queue runs dry, but never below ceil(batches/group))
+    if group == 1:
+        assert meta["commit_txns"] == 5
+    else:
+        assert -(-5 // group) <= meta["commit_txns"] <= 5
 
 
+@pytest.mark.parametrize("group", [1, 16])
 def test_pause_mid_pipeline_resumes_to_identical_state(tmp_path, fixture_tree,
-                                                       monkeypatch):
+                                                       monkeypatch, group):
+    """Pause landing mid-run — including mid-GROUP-commit (group=16 spans
+    the whole run, so the pause always interrupts a partially-accumulated
+    group): resume must neither re-commit nor skip pages."""
     # IDENTICAL batch size both runs: op order legitimately depends on batch
     # boundaries (per-batch cas updates then object creates), and the claim
     # under test is pipelined == sequential at the same boundaries
     monkeypatch.setattr(fi, "BATCH_SIZE", 8)
+    monkeypatch.setenv("SD_COMMIT_GROUP", str(group))
     monkeypatch.setenv("SD_PIPELINE", "0")
     node_a, lib_a, loc_a = _seed_library(tmp_path / "ref", fixture_tree, "ref")
     _identify(node_a, lib_a, loc_a)
@@ -203,11 +220,12 @@ def test_pause_mid_pipeline_resumes_to_identical_state(tmp_path, fixture_tree,
     assert row["status"] == JobStatus.PAUSED
     mid = identified()
     assert 0 < mid < 78, mid  # genuinely mid-run (80 files, 2 empty)
-    # the checkpoint cursor reflects only committed batches: a multiple of
-    # the batch size worth of rows, never a torn batch
+    # the checkpoint cursor reflects only committed batches: whole pages
+    # only (4 empty-file rows legitimately carry no cas_id), never a torn
+    # batch or a page beyond the committed group boundary
     state = _decoded(row["data"])
     committed = state["step_number"]
-    assert committed * 8 >= mid
+    assert committed * 8 >= mid >= committed * 8 - 4
 
     monkeypatch.setattr(fi, "read_sampled_batch", slow_gather)  # full speed
     assert node.jobs.resume(lib, jid)
@@ -223,3 +241,49 @@ def test_pause_mid_pipeline_resumes_to_identical_state(tmp_path, fixture_tree,
     assert len(cas_updates) == len([op for op in reference[2]
                                     if op[2] == "u:cas_id"])
     assert resumed[2] == reference[2], "CRDT op order diverges after resume"
+
+
+def test_cancel_mid_group_commit_leaves_whole_pages(tmp_path, fixture_tree,
+                                                    monkeypatch):
+    """A Cancel landing while the committer is accumulating a group must
+    leave the DB at a committed-page boundary: every written page is whole
+    (cas rows AND their CRDT ops), nothing from the abandoned group."""
+    monkeypatch.setattr(fi, "BATCH_SIZE", 8)
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    monkeypatch.setenv("SD_COMMIT_GROUP", "16")
+    slow_gather = fi.read_sampled_batch
+
+    def gather_with_drag(paths, sizes):
+        time.sleep(0.1)
+        return slow_gather(paths, sizes)
+
+    monkeypatch.setattr(fi, "read_sampled_batch", gather_with_drag)
+    node, lib, loc_id = _seed_library(tmp_path / "cancel", fixture_tree, "cx")
+    jid = node.jobs.spawn(lib, [fi.FileIdentifierJob({"location_id": loc_id})])
+
+    def identified():
+        return lib.db.query("SELECT count(*) c FROM file_path "
+                            "WHERE cas_id IS NOT NULL")[0]["c"]
+
+    deadline = time.monotonic() + 30
+    while identified() < 8 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert node.jobs.cancel(jid)
+    assert node.jobs.wait_idle(60)
+    row = lib.db.find_one(JobRow, {"id": jid})
+    assert row["status"] == JobStatus.CANCELED, JobStatus.NAMES[row["status"]]
+
+    # whole pages only: each committed page carries all its cas updates,
+    # and every identified row's page-mates are identified too (8-row
+    # pages, at most 4 empty rows across the whole tree)
+    n = identified()
+    n_ops = lib.db.query("SELECT count(*) c FROM shared_operation "
+                         "WHERE kind = 'u:cas_id'")[0]["c"]
+    assert n == n_ops, "cas rows and CRDT ops tore at the cancel boundary"
+    pages = lib.db.query(
+        "SELECT (SELECT count(*) FROM file_path f2 WHERE f2.cas_id IS NOT "
+        "NULL AND (f2.id - 1) / 8 = (f.id - 1) / 8) AS page_n "
+        "FROM file_path f WHERE f.cas_id IS NOT NULL GROUP BY (f.id - 1) / 8")
+    node.shutdown()
+    for r in pages:
+        assert r["page_n"] >= 7  # a page is whole modulo its 1 empty row

@@ -161,10 +161,14 @@ def test_span_tree_shape_and_stage_reconciliation(span_tree_scan):
     hashes = _spans_named(tree, "pipeline.hash")
     commits = _spans_named(tree, "pipeline.commit")
     # one page span per batch (the step budget exhausts exactly at the
-    # last batch, so no terminal empty page runs)
+    # last batch, so no terminal empty page runs); commit spans are per
+    # GROUP transaction — their `pages` attrs must account for every batch
     assert len(pages) == batches
     assert len(hashes) == batches
-    assert len(commits) == batches
+    txns = meta["commit_txns"]
+    assert len(commits) == txns
+    assert 1 <= txns <= batches
+    assert sum(c.get("attrs", {}).get("pages", 0) for c in commits) == batches
     # stage spans are children of the job's pipeline.run span — including
     # page/hash, which open on OTHER threads and pin the run span as
     # their explicit parent (the documented taxonomy, observability.md)
