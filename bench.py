@@ -787,6 +787,16 @@ def _bench_scan_chaos(one_scan, n_files: int, clean_hybrid_s: float) -> dict:
     return chaos
 
 
+def _apply_delay_totals(telemetry) -> tuple[int, float]:
+    """(count, sum) across every peer series of the apply-delay
+    histogram — bench deltas bracket one pull run."""
+    fam = telemetry.snapshot()["metrics"].get(
+        "sd_sync_apply_delay_seconds", {})
+    count = sum(s.get("count", 0) for s in fam.get("series", []))
+    total = sum(s.get("sum", 0.0) for s in fam.get("series", []))
+    return count, total
+
+
 def bench_sync() -> dict:
     """Two-node CRDT sync throughput (BASELINE config 5's replication
     half): emit N shared ops on instance A, pull+ingest them on B through
@@ -861,8 +871,12 @@ def bench_sync() -> dict:
             assert total >= n_ops, (total, n_ops)
             return dt
 
+        from spacedrive_tpu import telemetry
+
         ref_t = pull_all(100, True)     # reference design: per-op, 100-op window
+        delay_before = _apply_delay_totals(telemetry)
         prod_t = pull_all(1000, False)  # production: prefetched optimistic pass
+        delay_after = _apply_delay_totals(telemetry)
         # small windows through the session path: the 3× batch=100 tax
         # (BENCH_r05: 3.50s vs 1.17s) is per-window commit overhead, not
         # arbitration — grouped flushes should land near the batch=1000 rate
@@ -874,7 +888,7 @@ def bench_sync() -> dict:
               f" | reference batch=100 {ref_t:.2f}s", file=sys.stderr)
         node_a.shutdown()
         node_b.shutdown()
-        return {
+        record = {
             "metric": f"sync_ingest_ops_per_sec[{n_ops}ops,2node]",
             "value": round(rate, 1),
             "unit": "ops/sec",
@@ -882,6 +896,15 @@ def bench_sync() -> dict:
             "small_window_session_ops_per_sec": round(n_ops / small_t, 1),
             "emit_ops_per_sec": round(n_ops / emit_t, 1),
         }
+        # mesh observability ride-along: mean op_created->op_applied delay
+        # of the production pull (registry delta over
+        # sd_sync_apply_delay_seconds — emit-to-ingest distance on one
+        # host, the convergence-lag instrument the fleet soak will read)
+        d_count = delay_after[0] - delay_before[0]
+        if d_count > 0:
+            record["apply_delay_mean_s"] = round(
+                (delay_after[1] - delay_before[1]) / d_count, 6)
+        return record
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -46,6 +46,19 @@ export interface JobProgressEvent {
   id: string; status?: string; completed_task_count?: number;
   message?: string; [key: string]: unknown
 }
+/** One flight-recorder event (telemetry.watch / GET /telemetry/stream). */
+export interface TelemetryEvent {
+  seq: number; name: string; unix: number; [key: string]: unknown
+}
+/** An alert rule plus its live evaluator state (telemetry.alerts).
+ * `value` is the CONFIGURED threshold; `live_value` the last observation
+ * (null while the rule is healthy or has no matching series). */
+export interface AlertRuleState {
+  name: string; kind: string; series: string; op: string; value: number;
+  for_s: number; window_s: number; severity: string; description: string;
+  labels: Record<string, string>; firing: boolean; pending: boolean;
+  live_value: number | null; [key: string]: unknown
+}
 
 export type Procedures = {
   queries:
@@ -98,6 +111,7 @@ export type Procedures = {
 	{ key: "tags.getForObject", input: number, result: TagRow[] } |
 	{ key: "tags.getWithObjects", input: unknown, result: unknown } |
 	{ key: "tags.list", input: null, result: TagRow[] } |
+	{ key: "telemetry.alerts", input: null, result: { rules: AlertRuleState[] } } |
 	{ key: "telemetry.jobTrace", input: string | { job_id: string }, result: Record<string, unknown> | null } |
 	{ key: "telemetry.snapshot", input: null, result: Record<string, unknown> } |
 	{ key: "volumes.list", input: null, result: Record<string, unknown>[] },
@@ -191,7 +205,8 @@ export type Procedures = {
 	{ key: "locations.online", input: unknown, result: unknown } |
 	{ key: "notifications.listen", input: unknown, result: unknown } |
 	{ key: "p2p.events", input: null, result: Record<string, unknown> } |
-	{ key: "sync.newMessage", input: unknown, result: unknown },
+	{ key: "sync.newMessage", input: unknown, result: unknown } |
+	{ key: "telemetry.watch", input: null, result: TelemetryEvent },
 };
 
 /** Library-scoped procedures take a library_id — the client-side split of rspc.tsx:13-43. */
@@ -334,8 +349,10 @@ export type NodeProcedureKey =
 	"p2p.peers" |
 	"p2p.spacedrop" |
 	"search.ephemeralPaths" |
+	"telemetry.alerts" |
 	"telemetry.jobTrace" |
 	"telemetry.snapshot" |
+	"telemetry.watch" |
 	"toggleFeatureFlag" |
 	"volumes.list";
 export type ProcedureKey = LibraryProcedureKey | NodeProcedureKey;
@@ -478,8 +495,10 @@ export const procedures = {
 	"tags.getWithObjects": { kind: "query", scope: "library" },
 	"tags.list": { kind: "query", scope: "library" },
 	"tags.update": { kind: "mutation", scope: "library" },
+	"telemetry.alerts": { kind: "query", scope: "node" },
 	"telemetry.jobTrace": { kind: "query", scope: "node" },
 	"telemetry.snapshot": { kind: "query", scope: "node" },
+	"telemetry.watch": { kind: "subscription", scope: "node" },
 	"toggleFeatureFlag": { kind: "mutation", scope: "node" },
 	"volumes.list": { kind: "query", scope: "node" },
 } as const;

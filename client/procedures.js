@@ -550,12 +550,20 @@ window.SD_PROCEDURES = {
   "kind": "mutation",
   "scope": "library"
  },
+ "telemetry.alerts": {
+  "kind": "query",
+  "scope": "node"
+ },
  "telemetry.jobTrace": {
   "kind": "query",
   "scope": "node"
  },
  "telemetry.snapshot": {
   "kind": "query",
+  "scope": "node"
+ },
+ "telemetry.watch": {
+  "kind": "subscription",
   "scope": "node"
  },
  "toggleFeatureFlag": {

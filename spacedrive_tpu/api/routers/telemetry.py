@@ -1,5 +1,6 @@
-"""telemetry.* procedures (ISSUE 5): the rspc view of the unified
-registry and the per-job trace trees.
+"""telemetry.* procedures (ISSUE 5 + 7): the rspc view of the unified
+registry, the per-job trace trees, the live flight recorder, and the
+alert evaluator.
 
 - ``telemetry.snapshot`` — metrics + recent events + recent trace
   summaries in one JSON document (what ``python -m
@@ -7,12 +8,18 @@ registry and the per-job trace trees.
 - ``telemetry.jobTrace`` — the nested span tree of one job run (in-memory
   ring first, then the exported JSONL under ``<data_dir>/logs/traces/``),
   or null when nothing was recorded (``SD_TELEMETRY=off`` runs).
+- ``telemetry.watch`` — SUBSCRIPTION: the flight-recorder event stream
+  (job transitions, fault firings, router flips, sync sessions, alert
+  edges) live over the websocket; the SSE twin is ``GET
+  /telemetry/stream`` on the shell.
+- ``telemetry.alerts`` — every alert rule with its live firing state.
 """
 
 from __future__ import annotations
 
 from ... import telemetry
 from ..router import ApiError
+from ._util import filtered_subscription
 
 
 def mount(router) -> None:
@@ -29,3 +36,15 @@ def mount(router) -> None:
         if not job_id or not isinstance(job_id, str):
             raise ApiError("telemetry.jobTrace needs a job id")
         return telemetry.job_trace(job_id, data_dir=node.data_dir)
+
+    @router.subscription("telemetry.watch")
+    def watch(node, _arg):
+        """Live flight-recorder tail: one event per telemetry.event()
+        (the Node bridges the ring's hooks onto its event bus)."""
+        return filtered_subscription(node, {"telemetry.event"})
+
+    @router.query("telemetry.alerts")
+    def alerts(node, _arg):
+        """The SLO/alert rule set with live state (telemetry/alerts.py)."""
+        evaluator = getattr(node, "alerts", None)
+        return {"rules": evaluator.state() if evaluator is not None else []}

@@ -67,6 +67,19 @@ export interface JobProgressEvent {
   id: string; status?: string; completed_task_count?: number;
   message?: string; [key: string]: unknown
 }
+/** One flight-recorder event (telemetry.watch / GET /telemetry/stream). */
+export interface TelemetryEvent {
+  seq: number; name: string; unix: number; [key: string]: unknown
+}
+/** An alert rule plus its live evaluator state (telemetry.alerts).
+ * `value` is the CONFIGURED threshold; `live_value` the last observation
+ * (null while the rule is healthy or has no matching series). */
+export interface AlertRuleState {
+  name: string; kind: string; series: string; op: string; value: number;
+  for_s: number; window_s: number; severity: string; description: string;
+  labels: Record<string, string>; firing: boolean; pending: boolean;
+  live_value: number | null; [key: string]: unknown
+}
 """
 
 #: procedure key -> (arg TS type, result TS type); unlisted keys emit
@@ -171,9 +184,11 @@ TYPES: dict[str, tuple[str, str]] = {
     # sync
     "sync.messages": ("null", "Record<string, unknown>[]"),
     # telemetry
+    "telemetry.alerts": ("null", "{ rules: AlertRuleState[] }"),
     "telemetry.jobTrace": ("string | { job_id: string }",
                            "Record<string, unknown> | null"),
     "telemetry.snapshot": ("null", "Record<string, unknown>"),
+    "telemetry.watch": ("null", "TelemetryEvent"),
 }
 
 

@@ -204,6 +204,10 @@ class FaultPlan:
         if fired_rule is None:
             return
         _FIRED_TOTAL.inc(seam=fired_rule.seam, kind=fired_rule.kind)
+        # the firing is a flight-recorder event too: a chaos run tailed
+        # live shows WHERE the storm is biting, not just how often
+        telemetry.event("fault.fired", seam=fired_rule.seam,
+                        kind=fired_rule.kind, key=key)
         if fired_rule.kind == "hang":
             # the "never returns" failure mode (wedged tunnel, dead NFS):
             # block far past any drain deadline; daemon stage threads die

@@ -135,6 +135,10 @@ class Worker:
         r.status = JobStatus.RUNNING
         r.date_started = utc_now()
         r.upsert(self.library.db)
+        # flight-recorder edges: job state transitions are what a live
+        # tail (telemetry.watch / SSE) narrates between metric scrapes
+        telemetry.event("job.status", job=r.name, id=r.id,
+                        status=JobStatus.NAMES[JobStatus.RUNNING])
         self._started_at = time.monotonic()
         queued_at = getattr(self.dyn_job, "_queued_at_monotonic", None)
         if queued_at is not None:
@@ -183,6 +187,9 @@ class Worker:
             r.date_completed = utc_now()
             self._cancel_children()
         finally:
+            telemetry.event("job.status", job=r.name, id=r.id,
+                            status=JobStatus.NAMES.get(r.status,
+                                                       str(r.status)))
             self._finish_telemetry()
             r.upsert(self.library.db)
             self._emit_progress()

@@ -177,6 +177,35 @@ class Node:
 
         debug_initializer.apply(self)
 
+        # mesh observability (ISSUE 7): bridge the telemetry flight
+        # recorder onto this node's event bus (telemetry.watch / SSE tail
+        # it from there) and start the SLO/alert evaluator against the
+        # process registry. The hook is removed at shutdown — the registry
+        # is process-global, and test suites boot many Nodes.
+        from . import telemetry
+        from .notifications import emit_node_notification
+        from .telemetry.alerts import AlertEvaluator
+
+        def _telemetry_event_hook(record: dict,
+                                  _emit=self.events.emit_kind) -> None:
+            _emit("telemetry.event", record)
+
+        self._telemetry_event_hook = _telemetry_event_hook
+        telemetry.add_event_hook(_telemetry_event_hook)
+
+        def _alert_notify(rule, firing: bool, value) -> None:
+            if not firing:
+                return  # the resolved edge stays in the event ring
+            emit_node_notification(self, {
+                "type": "alert", "rule": rule.name, "series": rule.series,
+                "severity": rule.severity, "value": value,
+                "description": rule.description})
+
+        self.alerts = AlertEvaluator(
+            interval_s=float(os.environ.get("SD_ALERT_INTERVAL_S", "5")),
+            notify=_alert_notify)
+        self.alerts.start()
+
         # api::mount last — validates the invalidation-key contract
         # (api/mod.rs:102, invalidate.rs:82)
         from .api.router import mount as api_mount
@@ -214,6 +243,10 @@ class Node:
         """Graceful: checkpoint all jobs, stop watchers, close DBs
         (Node::shutdown, lib.rs:196)."""
         self.jobs.shutdown()
+        from . import telemetry
+
+        self.alerts.stop()
+        telemetry.remove_event_hook(self._telemetry_event_hook)
         if self.relay_recapture is not None:
             self.relay_recapture.stop()
         if self.locations is not None:

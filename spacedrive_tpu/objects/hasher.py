@@ -480,6 +480,11 @@ class BackendRouter:
         self.flips += 1
         self._streak = 0
         _ROUTER_FLIPS.inc()
+        # flight-recorder edge: router flips are exactly what an operator
+        # tails a live node for (telemetry.watch / SSE)
+        telemetry.event("hash_router_flip", to=to,
+                        cpu_bps=round(self.cpu_bps or 0.0),
+                        device_bps=round(self.dev_bps or 0.0))
         logger.info("hash router: engine flipped to %s "
                     "(cpu %.2f MB/s, device %.2f MB/s)", to,
                     (self.cpu_bps or 0.0) / 1e6, (self.dev_bps or 0.0) / 1e6)
@@ -1076,12 +1081,20 @@ class RemoteHasher:
         if peer_id is None:
             failed = todo
         else:
+            from ..telemetry import mesh
+
             p2p = self._node.p2p
+            # trace propagation: captured HERE (the pipeline hash thread,
+            # which holds the job trace's open span) — the p2p loop the
+            # coroutine runs on has no span context of its own
+            ctx = mesh.outbound_context(
+                origin=str(self._node.config.get().get("id") or ""))
             batches = self._wire_batches(todo, messages)
             for bi, idxs in enumerate(batches):
                 try:
                     ids = p2p.run_coro(p2p.request_hash_batch(
-                        peer_id, [messages[i] for i in idxs]), timeout=120)
+                        peer_id, [messages[i] for i in idxs], ctx=ctx),
+                        timeout=120)
                     for i, cid in zip(idxs, ids):
                         out[i] = cid
                 except Exception as e:

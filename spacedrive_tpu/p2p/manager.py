@@ -40,6 +40,7 @@ from .secure import (SecureReader, SecureWriter, derive_session_keys,
                      gen_ephemeral, transcript)
 from .spaceblock import receive_file, send_file
 from .. import telemetry
+from ..telemetry import mesh
 
 if TYPE_CHECKING:
     from ..node import Node
@@ -813,13 +814,31 @@ class P2PManager:
         from ..objects.hasher import hash_messages
 
         loop = asyncio.get_running_loop()
-        ids = await loop.run_in_executor(None, hash_messages, messages)
+        # trace propagation: the requester's envelope (if any) parents our
+        # serving span under ITS job trace — `telemetry.jobTrace <job_id>`
+        # on the requesting node then shows where the batch went, and this
+        # node's ring carries the serve under the same trace_id
+        label = mesh.peer_label(peer.identity)
+        ctx = mesh.TraceContext.from_wire(payload.get("ctx"))
+        trace = mesh.continue_trace(
+            ctx, origin=str(self.node.config.get().get("id") or ""),
+            name="p2p.hash")
+        with mesh.remote_span(trace, ctx, "p2p.hash_serve", peer=label,
+                              files=len(messages),
+                              bytes=sum(sizes)):
+            ids = await loop.run_in_executor(None, hash_messages, messages)
+        mesh.record_hash_serve(label, sum(sizes))
         writer.write(json_frame({"ok": True, "ids": ids}))
         await writer.drain()
 
     async def request_hash_batch(self, peer_id: str,
-                                 messages: list[bytes]) -> list[str]:
-        """Ship cas messages to a peer's hasher; returns cas_ids in order."""
+                                 messages: list[bytes],
+                                 ctx: "mesh.TraceContext | None" = None
+                                 ) -> list[str]:
+        """Ship cas messages to a peer's hasher; returns cas_ids in order.
+        ``ctx`` (captured on the CALLING thread — this coroutine runs on
+        the p2p loop, which has no span context) rides the header so the
+        serving peer's span stitches under the caller's job trace."""
         from .. import faults
 
         # chaos seam for outbound peer requests (raising kinds only — a
@@ -827,7 +846,9 @@ class P2PManager:
         faults.inject("p2p_send", key=peer_id)
         reader, writer, _meta = await self.open_stream(peer_id)
         try:
-            writer.write(Header.hash_batch([len(m) for m in messages]).to_bytes())
+            writer.write(Header.hash_batch(
+                [len(m) for m in messages],
+                ctx=ctx.to_wire() if ctx is not None else None).to_bytes())
             for m in messages:
                 writer.write(m)
             await writer.drain()

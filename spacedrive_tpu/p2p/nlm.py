@@ -19,8 +19,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import uuid
 from typing import TYPE_CHECKING, Any
 
+from .. import faults, telemetry
+from ..telemetry import mesh
 from .identity import remote_identity_of
 from .proto import (SYNC_NEW_OPERATIONS, Header, main_request_done,
                     main_request_get_operations, operations_frame, read_exact,
@@ -156,7 +159,22 @@ class NetworkedLibraries:
                 logger.debug("sync originate to %s failed: %s", peer_id[:12], e)
 
     async def _originate_to(self, library: "Library", peer_id: str) -> None:
+        # chaos seam for the sync-session dial (raising kinds only; `flap`
+        # simulates the mesh's connection churn) — the fleet-soak gate's
+        # p2p_send:flap rides this alongside the hash-batch seam
+        faults.inject("p2p_send", key=peer_id)
+        origin = str(self.node.config.get().get("id") or "")
         reader, writer, _meta = await self.manager.open_stream(peer_id)
+        # one mesh trace per push session, created only once the dial
+        # SUCCEEDED (an offline peer's retry loop must not fill the
+        # bounded trace ring with unfinished sessions): the receiver's
+        # sync.apply spans parent under our per-window serving spans
+        # (stitched by trace_id across both nodes' JSONL exports)
+        trace = mesh.new_trace(
+            "sync.push", origin,
+            f"sync-{library.id[:8]}-{uuid.uuid4().hex[:12]}",
+            library_id=library.id, peer=mesh.peer_label(peer_id))
+        windows = served = 0
         try:
             writer.write(Header.sync(library.id).to_bytes())
             writer.write(SYNC_NEW_OPERATIONS)
@@ -166,13 +184,46 @@ class NetworkedLibraries:
                 req = await read_json(reader)
                 if req.get("req") != "get_ops":
                     break  # done
-                ops, has_more = await loop.run_in_executor(
-                    None, library.sync.get_ops, req.get("clocks") or {},
-                    int(req.get("count") or OPS_PER_REQUEST))
-                writer.write(operations_frame(ops, has_more))
-                await writer.drain()
+                clocks = req.get("clocks") or {}
+                count = int(req.get("count") or OPS_PER_REQUEST)
+
+                def _serve(clocks=clocks, count=count):
+                    ops, has_more = library.sync.get_ops(clocks, count)
+                    # backlog left AFTER this window — the receiver's
+                    # sd_sync_peer_lag_ops signal rides the envelope
+                    pending = (max(0, library.sync.ops_pending(clocks)
+                                   - len(ops)) if has_more else 0)
+                    return ops, has_more, pending
+
+                with telemetry.span(trace, "sync.window") as span:
+                    ops, has_more, pending = await loop.run_in_executor(
+                        None, _serve)
+                    span.set(ops=len(ops), has_more=has_more,
+                             pending=pending)
+                    ctx = None
+                    if trace is not None:
+                        ctx = mesh.TraceContext(
+                            trace.trace_id, span.span_id, origin,
+                            hlc=library.sync.clock.last,
+                            pending=pending).to_wire()
+                    writer.write(operations_frame(ops, has_more, ctx=ctx))
+                    await writer.drain()
+                windows += 1
+                served += len(ops)
         finally:
             writer.close()
+            if trace is not None:
+                trace.attrs.update(windows=windows, ops=served)
+                node = self.node
+
+                def _export() -> None:
+                    telemetry.finish_trace(trace, export_dir=node.data_dir)
+                    mesh.prune_session_traces(node.data_dir)
+
+                await asyncio.get_running_loop().run_in_executor(
+                    None, _export)
+        telemetry.event("sync.push", peer=mesh.peer_label(peer_id),
+                        library_id=library.id, windows=windows, ops=served)
 
     # -- responder (pull + ingest) -------------------------------------------
     async def responder(self, reader, writer, library_id: str,
@@ -196,16 +247,25 @@ class NetworkedLibraries:
             return
         from ..sync.ingest import Ingester
 
-        ingester = Ingester(library)
+        ingester = Ingester(library, peer=peer.identity)
         loop = asyncio.get_running_loop()
+        windows = total_ops = 0
+        last_ctx: mesh.TraceContext | None = None
         while True:
             clocks = await loop.run_in_executor(None, library.sync.timestamps)
             writer.write(main_request_get_operations(clocks, OPS_PER_REQUEST))
             await writer.drain()
             batch = await read_json(reader)
             ops = batch.get("ops") or []
+            # the sender's trace-context envelope: stitches our apply spans
+            # under its serving spans and carries the lag signal
+            ctx = mesh.TraceContext.from_wire(batch.get("ctx"))
+            if ctx is not None:
+                last_ctx = ctx
             if ops:
-                await loop.run_in_executor(None, ingester.receive, ops)
+                await loop.run_in_executor(None, ingester.receive, ops, ctx)
+                windows += 1
+                total_ops += len(ops)
                 if not ingester.last_floor_advanced:
                     # every op in the window was skipped (malformed /
                     # transient poison) — the peer would hand us the
@@ -218,5 +278,20 @@ class NetworkedLibraries:
                 break
         writer.write(main_request_done())
         await writer.drain()
+        if last_ctx is not None:
+            # persist our half of the stitched trace: the sender's export
+            # holds the root + window spans, ours the apply spans — merged
+            # by trace_id they are one tree
+            from ..telemetry import spans as _spans
+
+            trace = _spans.get_trace(last_ctx.trace_id)
+            node = self.node
+            if trace is not None:
+                await loop.run_in_executor(
+                    None, lambda: mesh.export_partial(trace, node.data_dir))
+        mesh.record_session(ingester._peer_label)
+        telemetry.event("sync.session", peer=ingester._peer_label,
+                        library_id=library_id, windows=windows,
+                        ops=total_ops)
         self.manager.emit({"type": "SyncIngested", "library_id": library_id,
                            "from": peer.identity})

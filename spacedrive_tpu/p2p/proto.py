@@ -161,11 +161,17 @@ class Header:
         return cls(H_THUMBNAIL, {"library_id": library_id, "cas_id": cas_id})
 
     @classmethod
-    def hash_batch(cls, sizes: list[int]) -> "Header":
+    def hash_batch(cls, sizes: list[int],
+                   ctx: dict | None = None) -> "Header":
         """Shared-hasher request (BASELINE config 5): ``sizes[i]`` bytes of
         pre-gathered cas message follow the header for each item; the peer
-        replies with the cas_ids."""
-        return cls(H_HASH, {"sizes": sizes})
+        replies with the cas_ids. ``ctx`` is an optional trace-context
+        envelope (telemetry/mesh.py) so the server's hash-serve span
+        parents under the requesting job's trace."""
+        payload: dict = {"sizes": sizes}
+        if ctx is not None:
+            payload["ctx"] = ctx
+        return cls(H_HASH, payload)
 
     # wire -----------------------------------------------------------------
     def to_bytes(self) -> bytes:
@@ -210,9 +216,17 @@ def main_request_done() -> bytes:
     return json_frame({"req": "done"})
 
 
-def operations_frame(ops: list[dict], has_more: bool) -> bytes:
-    """Originator → responder: one batch of wire ops."""
-    return json_frame({"ops": ops, "has_more": has_more})
+def operations_frame(ops: list[dict], has_more: bool,
+                     ctx: dict | None = None) -> bytes:
+    """Originator → responder: one batch of wire ops. ``ctx`` is the
+    optional trace-context envelope (telemetry/mesh.py): trace_id, the
+    sender-side span serving this window, the sender's HLC watermark and
+    declared remaining backlog — what stitches cross-node traces and
+    feeds the receiver's convergence-lag gauges."""
+    payload: dict = {"ops": ops, "has_more": has_more}
+    if ctx is not None:
+        payload["ctx"] = ctx
+    return json_frame(payload)
 
 
 # -- spaceblock stream messages ---------------------------------------------

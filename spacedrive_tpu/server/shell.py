@@ -145,6 +145,15 @@ class Server:
                         await write_response(writer, req,
                                              Response.error(e.status, str(e)))
                     break
+                if req.method == "GET" and req.path == "/telemetry/stream":
+                    # SSE: the connection becomes a dedicated event stream
+                    # (like the websocket branch above)
+                    try:
+                        await self._sse_stream(req, reader, writer)
+                    except HttpError as e:
+                        await write_response(writer, req,
+                                             Response.error(e.status, str(e)))
+                    break
                 try:
                     resp = await self._route(req)
                 except HttpError as e:
@@ -469,6 +478,84 @@ class Server:
             headers["content-range"] = f"bytes {start}-{end - 1}/{size}"
             status = 206
         return Response(status, headers, body)
+
+    # -- live telemetry over SSE (ISSUE 7) -----------------------------------
+    async def _sse_stream(self, req: Request, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        """``GET /telemetry/stream`` — the flight-recorder event ring as a
+        text/event-stream: one SSE message per telemetry event, ``id:`` =
+        the ring's monotonic seq (a reconnecting tail passes it back as
+        ``?after=<seq>`` or ``Last-Event-ID`` to replay what it missed),
+        ``: keepalive`` comments while idle. Like the websocket
+        subscription path, each stream gets its OWN pump thread — parking
+        on the bus queue must never occupy the shared rspc worker pool
+        (8 open tails would otherwise starve every HTTP query)."""
+        self._check_auth(req)
+        from .. import telemetry
+
+        try:
+            after = int(req.query.get("after")
+                        or req.header("last-event-id") or -1)
+        except ValueError:
+            after = -1
+        sub = self.node.events.subscribe()
+        loop = asyncio.get_running_loop()
+        stop = threading.Event()
+
+        async def send(frame: bytes) -> None:
+            writer.write(frame)
+            await writer.drain()
+
+        def pump() -> None:
+            """Dedicated thread: blocking-drain the subscription into the
+            socket (the ws `pump` shape)."""
+            while not stop.is_set():
+                event = sub.get(timeout=15.0)
+                if sub.closed or stop.is_set():
+                    return
+                if event is None:  # idle: keep intermediaries from closing
+                    frame = b": keepalive\n\n"
+                elif event.kind != "telemetry.event":
+                    continue
+                else:
+                    frame = self._sse_frame(event.payload or {})
+                fut = asyncio.run_coroutine_threadsafe(send(frame), loop)
+                try:
+                    fut.result(10)
+                except Exception:
+                    return  # client went away — the normal end of a tail
+        try:
+            writer.write(b"HTTP/1.1 200 OK\r\n"
+                         b"content-type: text/event-stream\r\n"
+                         b"cache-control: no-cache\r\n"
+                         b"connection: close\r\n\r\n")
+            # replay: everything in the bounded ring the tail has not seen
+            # (subscribed BEFORE the replay read, so no gap in between —
+            # an event landing during replay is at worst duplicated, and
+            # consumers dedupe on seq)
+            for record in telemetry.recent_events(
+                    limit=256, after_seq=after if after >= 0 else None):
+                writer.write(self._sse_frame(record))
+            await writer.drain()
+            thread = threading.Thread(target=pump, daemon=True,
+                                      name="sse-telemetry")
+            thread.start()
+            # hold the handler open until the client hangs up (EOF) — SSE
+            # clients send nothing, so any read completing means teardown
+            while await reader.read(1024):
+                pass
+        except (ConnectionError, OSError, asyncio.CancelledError):
+            pass
+        finally:
+            stop.set()
+            sub.close()
+
+    @staticmethod
+    def _sse_frame(record: dict) -> bytes:
+        data = json.dumps(record, default=str)
+        seq = record.get("seq")
+        head = f"id: {seq}\n" if seq is not None else ""
+        return f"{head}data: {data}\n\n".encode()
 
     # -- rspc over websocket -------------------------------------------------
     async def _websocket(self, req: Request, reader: asyncio.StreamReader,

@@ -32,14 +32,17 @@ from __future__ import annotations
 import contextlib
 import logging
 import queue
+import sys
 import threading
 import time
 from typing import TYPE_CHECKING, Any, Callable
 
 from .. import faults, telemetry
 from ..models import Instance, RelationOperationRow, SharedOperationRow
+from ..telemetry import mesh
 from .apply import ApplyError, apply_relation, apply_shared, model_for
 from .crdt import CREATE, DELETE, UPDATE_PREFIX, CRDTOperation, RelationOp, SharedOp
+from .hlc import to_unix
 from .manager import SyncMessage
 
 if TYPE_CHECKING:
@@ -60,13 +63,18 @@ PROD_BATCH = 1000
 #: can roll back (everything re-pulls idempotently either way)
 SESSION_FLUSH_OPS = 4000
 
+# every ingest family carries a bounded-cardinality ``peer`` label (hash-
+# truncated node id via mesh.peer_label, "local" for transport-less
+# ingest) — two aggressive peers must be distinguishable in one scrape
 _OPS_INGESTED = telemetry.counter(
-    "sd_sync_ops_ingested_total", "CRDT ops received for ingest")
+    "sd_sync_ops_ingested_total", "CRDT ops received for ingest",
+    labels=("peer",))
 _OPS_APPLIED = telemetry.counter(
     "sd_sync_ops_applied_total",
-    "ingested CRDT ops with materialized effect")
+    "ingested CRDT ops with materialized effect", labels=("peer",))
 _WINDOW_SECONDS = telemetry.histogram(
-    "sd_sync_window_seconds", "latency of one ingest window")
+    "sd_sync_window_seconds", "latency of one ingest window",
+    labels=("peer",))
 
 
 def _update_field(kind: str) -> str | None:
@@ -76,8 +84,19 @@ def _update_field(kind: str) -> str | None:
 class Ingester:
     """Synchronous core (usable inline); Actor wraps it in a thread."""
 
-    def __init__(self, library: "Library", reference_mode: bool = False) -> None:
+    def __init__(self, library: "Library", reference_mode: bool = False,
+                 peer: str | None = None) -> None:
         self.library = library
+        #: identity of the node whose ops this ingester receives (None for
+        #: transport-less/test ingest) — attribution only, never auth
+        self.peer = peer
+        self._peer_label = mesh.peer_label(peer)
+        # per-peer series handles memoized off the hot loop
+        self._ops_ingested = _OPS_INGESTED.labels(peer=self._peer_label)
+        self._ops_applied = _OPS_APPLIED.labels(peer=self._peer_label)
+        self._window_seconds = _WINDOW_SECONDS.labels(peer=self._peer_label)
+        self._apply_delay = mesh.apply_delay_series(self._peer_label)
+        self._fresh_ts: list[int] = []
         #: reference-faithful ingestion (benchmark baseline): per-op
         #: arbitration queries and per-op savepoints, exactly the shape of
         #: the reference's receive_crdt_operation loop
@@ -339,13 +358,28 @@ class Ingester:
             logger.warning("sync ingest created placeholder instance %s", pub_id)
 
     # -- application ---------------------------------------------------------
-    def receive(self, wire_ops: list[dict[str, Any]]) -> int:
+    def _own_origin(self) -> str:
+        """This node's id (span-id base for continued mesh traces)."""
+        node = getattr(self.library, "node", None)
+        if node is not None:
+            try:
+                return str(node.config.get().get("id") or self.library.id)
+            except Exception:
+                pass
+        return self.library.id
+
+    def receive(self, wire_ops: list[dict[str, Any]],
+                ctx: "mesh.TraceContext | None" = None) -> int:
         """Ingest a batch; returns the number of ops with materialized
-        effect (shadowed ops are still logged)."""
+        effect (shadowed ops are still logged). ``ctx`` is the sender's
+        trace-context envelope: when present, this window's apply span
+        parents under the sender's serving span (stitched by trace_id)
+        and the per-peer convergence-lag gauges update from its HLC
+        watermark and declared backlog."""
         db = self.library.db
         sync = self.library.sync
         window_t0 = time.perf_counter()
-        _OPS_INGESTED.inc(len(wire_ops))
+        self._ops_ingested.inc(len(wire_ops))
 
         # decode first (one malformed wire op — bad '_t', wrong key set —
         # from a buggy or malicious member must not abort the batch and
@@ -357,6 +391,17 @@ class Ingester:
                 decoded.append(CRDTOperation.from_wire(wire))
             except Exception as e:
                 logger.warning("sync ingest dropped malformed op: %s", e)
+
+        trace = mesh.continue_trace(ctx, origin=self._own_origin())
+        apply_span = mesh.remote_span(trace, ctx, "sync.apply",
+                                      peer=self._peer_label,
+                                      ops=len(decoded))
+        apply_span.__enter__()
+        applied = 0
+        # timestamps of ops durably LOGGED this window (the passes append)
+        # — the apply-delay histogram must not re-count duplicate
+        # deliveries or poison-replayed windows as fresh applies
+        self._fresh_ts = []
 
         # NOTE on the raw SAVEPOINTs: db.transaction() holds the connection
         # RLock for the whole batch, so no other thread can interleave
@@ -410,8 +455,22 @@ class Ingester:
             # rowids can be recycled — repopulating costs one query per
             # instance per batch
             sync._instance_ids.clear()
-        _OPS_APPLIED.inc(applied)
-        _WINDOW_SECONDS.observe(time.perf_counter() - window_t0)
+            apply_span.set(applied=applied)
+            apply_span.__exit__(*sys.exc_info())
+        self._ops_applied.inc(applied)
+        self._window_seconds.observe(time.perf_counter() - window_t0)
+        # convergence lag + end-to-end delay, from the envelope and the
+        # ops' own HLC stamps (per-op observe is a bisect+lock; the window
+        # is the unit of everything else). Delay counts only ops durably
+        # logged THIS window: duplicates and poison replays are not
+        # fresh applies.
+        max_ts = max((op.timestamp for op in decoded), default=0)
+        mesh.record_ingest_window(self._peer_label, ctx, max_ts)
+        if telemetry.enabled():
+            now_unix = time.time()
+            for ts in self._fresh_ts:
+                self._apply_delay.observe(max(0.0, now_unix - to_unix(ts)))
+        self._fresh_ts = []
         if applied:
             sync._broadcast(SyncMessage.INGESTED)
         return applied
@@ -423,6 +482,9 @@ class Ingester:
         applied = 0
         seen_clocks: dict[str, int] = {}
         pending_log: list[CRDTOperation] = []
+        # reset per PASS: an aborted optimistic pass rolls its log rows
+        # back, so its entries must not survive into the careful re-run
+        self._fresh_ts = []
         # Dropped-op floor policy, by failure class (careful pass):
         #
         # - TRANSIENT failures (savepoint rollback: DB error while logging)
@@ -480,6 +542,7 @@ class Ingester:
                 self._ensure_instance(op.instance)
                 pending_log.append(op)
                 self._cache_logged(op)
+                self._fresh_ts.append(op.timestamp)
                 _advance(op.instance, op.timestamp)
                 if effect:
                     applied += 1
@@ -507,6 +570,18 @@ class Ingester:
                 except Exception as e:
                     db.execute("ROLLBACK TO ingest_effect")
                     db.execute("RELEASE ingest_effect")
+                    # TRANSIENT classes (sqlite busy, EIO/EINTR) are NOT
+                    # deterministic in the op's content — logging such an
+                    # op "without effect" would advance the floor past it
+                    # and lose the materialization forever (divergence).
+                    # Escalate to the poison path instead: floor capped
+                    # below the op, replayed next round, applies once the
+                    # contention clears. The chaos gate
+                    # (sync_apply:sqlite_busy) byte-identity rests on this.
+                    from ..utils.retry import is_sqlite_busy, is_transient_io
+
+                    if is_sqlite_busy(e) or is_transient_io(e):
+                        raise
                     log = (logger.warning if isinstance(e, ApplyError)
                            else logger.exception)
                     log("sync op %s logged without effect: %s", op.id, e)
@@ -531,6 +606,7 @@ class Ingester:
                 continue
             db.execute("RELEASE ingest_op")
             self._cache_logged(op)
+            self._fresh_ts.append(op.timestamp)
             # advance the clock floor only once the op is durably logged
             _advance(op.instance, op.timestamp)
             if effect:

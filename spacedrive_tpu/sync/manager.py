@@ -244,6 +244,27 @@ class SyncManager:
                 out[row["pub_id"]] = row["timestamp"] or 0
         return out
 
+    def ops_pending(self, clocks: dict[str, int] | None = None) -> int:
+        """How many logged ops are strictly newer (per origin instance)
+        than ``clocks`` — the sender-side backlog count a sync window's
+        trace-context envelope declares so the RECEIVER can publish its
+        own convergence lag (``sd_sync_peer_lag_ops``) without a second
+        round trip. One COUNT per instance per table, each an indexed
+        range SEARCH on (instance_id, timestamp) — a CASE-over-instance_id
+        form would degrade to a full index scan of the whole op-log on
+        every served window."""
+        clocks = clocks or {}
+        db = self.library.db
+        total = 0
+        for r in db.find(Instance):
+            floor = clocks.get(r["pub_id"], 0)
+            for table in ("shared_operation", "relation_operation"):
+                total += db.query(
+                    f"SELECT count(*) AS c FROM {table} "
+                    "WHERE instance_id = ? AND timestamp > ?",
+                    [r["id"], floor])[0]["c"]
+        return total
+
     def get_ops(self, clocks: dict[str, int] | None = None,
                 count: int = 100) -> tuple[list[dict[str, Any]], bool]:
         """Ops strictly newer (per origin instance) than ``clocks``, merged

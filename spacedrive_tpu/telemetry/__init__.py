@@ -23,6 +23,7 @@ package — any layer may instrument without cycles.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid
@@ -43,17 +44,24 @@ from .spans import Span, Trace
 
 __all__ = [
     "DEFAULT_BUCKETS", "METRIC_NAME_RE", "Registry", "Span", "Trace",
-    "counter", "enabled", "event", "finish_trace", "gauge", "histogram",
-    "job_trace", "recent_events", "registry", "reload_enabled",
-    "render_prometheus", "reset", "series_values", "set_enabled",
-    "snapshot", "span", "start_trace", "value",
+    "add_event_hook", "counter", "enabled", "event", "finish_trace",
+    "gauge", "histogram", "job_trace", "recent_events", "registry",
+    "reload_enabled", "remove_event_hook", "render_prometheus", "reset",
+    "series_values", "set_enabled", "snapshot", "span", "start_trace",
+    "value",
 ]
 
 _REGISTRY = Registry()
 
-#: recent events (relay recovered, verdict flips) surfaced in snapshot()
+#: the flight recorder: recent events (state transitions, fault firings,
+#: router flips, relay recovery, alert edges) surfaced in snapshot() and
+#: streamed live through the event hooks (telemetry.watch / SSE)
 _EVENTS: deque[dict[str, Any]] = deque(maxlen=256)
 _EVENTS_LOCK = threading.Lock()
+_EVENTS_SEQ = 0
+#: fan-out hooks (the Node bridges these onto its event bus); must be
+#: cheap and never raise into the instrumented hot path
+_EVENT_HOOKS: list[Any] = []
 
 
 def registry() -> Registry:
@@ -150,20 +158,52 @@ def job_trace(job_id: str,
 
 def event(name: str, **attrs: Any) -> None:
     """A named point-in-time occurrence (relay recovered, device verdict
-    flipped): counted, kept in the snapshot ring."""
+    flipped, job transition, alert edge): counted, kept in the bounded
+    flight-recorder ring with a process-monotonic ``seq``, and fanned out
+    to the registered hooks for live streaming."""
+    global _EVENTS_SEQ
     if not enabled():
         return
     # resolved per call (events are rare); the family is pre-declared
     counter("sd_telemetry_events_total", "named telemetry events",
             labels=("name",)).inc(name=name)
     with _EVENTS_LOCK:
-        _EVENTS.append({"name": name, "unix": round(time.time(), 3),
-                        **attrs})
+        _EVENTS_SEQ += 1
+        record = {"seq": _EVENTS_SEQ, "name": name,
+                  "unix": round(time.time(), 3), **attrs}
+        _EVENTS.append(record)
+        hooks = list(_EVENT_HOOKS)
+    for hook in hooks:
+        try:
+            hook(record)
+        except Exception:  # a broken listener must never stall producers
+            logging.getLogger(__name__).exception(
+                "telemetry event hook failed for %s", name)
 
 
-def recent_events(limit: int = 64) -> list[dict[str, Any]]:
+def add_event_hook(hook) -> None:
+    """Register a live-event listener (``hook(record: dict)``); hooks run
+    synchronously on the emitting thread — hand off, never block."""
     with _EVENTS_LOCK:
-        return list(_EVENTS)[-limit:]
+        if hook not in _EVENT_HOOKS:
+            _EVENT_HOOKS.append(hook)
+
+
+def remove_event_hook(hook) -> None:
+    with _EVENTS_LOCK:
+        if hook in _EVENT_HOOKS:
+            _EVENT_HOOKS.remove(hook)
+
+
+def recent_events(limit: int = 64,
+                  after_seq: int | None = None) -> list[dict[str, Any]]:
+    """Ring tail; with ``after_seq`` only events newer than that sequence
+    number (how the SSE stream replays what a reconnecting tail missed)."""
+    with _EVENTS_LOCK:
+        events = list(_EVENTS)
+    if after_seq is not None:
+        events = [e for e in events if e.get("seq", 0) > after_seq]
+    return events[-limit:]
 
 
 # -- snapshot ------------------------------------------------------------------
@@ -235,13 +275,44 @@ def _declare_core() -> None:
             labels=("outcome",))
     counter("sd_relay_recovered_total",
             "relay recoveries observed by the recapture watcher")
-    counter("sd_sync_ops_ingested_total", "CRDT ops received for ingest")
+    # sync ingest families carry a bounded-cardinality ``peer`` label
+    # (hash-truncated node id, "local" for transport-less ingest) so two
+    # aggressive peers are distinguishable in one scrape
+    counter("sd_sync_ops_ingested_total", "CRDT ops received for ingest",
+            labels=("peer",))
     counter("sd_sync_ops_applied_total",
-            "ingested CRDT ops with materialized effect")
+            "ingested CRDT ops with materialized effect", labels=("peer",))
     counter("sd_p2p_hash_requests_total", "outbound remote-hasher batches")
     counter("sd_p2p_hash_bytes_total",
             "cas-message bytes shipped to remote hashers")
-    histogram("sd_sync_window_seconds", "latency of one ingest window")
+    histogram("sd_sync_window_seconds", "latency of one ingest window",
+              labels=("peer",))
+    # mesh observability (ISSUE 7): per-peer convergence lag + remote
+    # attribution; declared here so the catalogue is scrape-visible from
+    # boot (telemetry/mesh.py holds the matching module handles)
+    gauge("sd_sync_peer_lag_ops",
+          "CRDT ops the peer has logged that this node has not yet "
+          "ingested (sender-declared backlog after each sync window)",
+          labels=("peer",))
+    gauge("sd_sync_peer_lag_seconds",
+          "HLC delta between the peer's watermark and the newest op "
+          "applied from it", labels=("peer",))
+    histogram("sd_sync_apply_delay_seconds",
+              "op_created -> op_applied end-to-end latency (op HLC stamp "
+              "vs local wall clock at ingest)", labels=("peer",))
+    counter("sd_sync_remote_windows_total",
+            "sync ingest windows received per peer", labels=("peer",))
+    counter("sd_sync_remote_sessions_total",
+            "sync-over-wire sessions completed per peer", labels=("peer",))
+    counter("sd_p2p_hash_serve_total",
+            "inbound remote-hasher batches served per peer",
+            labels=("peer",))
+    counter("sd_p2p_hash_serve_bytes_total",
+            "cas-message bytes hashed on behalf of remote peers",
+            labels=("peer",))
+    gauge("sd_alerts_firing",
+          "1 while the named alert rule is firing (telemetry/alerts.py)",
+          labels=("rule",))
     histogram("sd_job_queue_wait_seconds",
               "dispatch-queue wait per job", labels=("lane",))
     histogram("sd_job_step_seconds", "sequential step latency per job",

@@ -59,9 +59,17 @@ def _fmt_labels(labels: dict[str, str]) -> str:
     return "{" + ",".join(f"{k}={v}" for k, v in labels.items()) + "}"
 
 
-def _fmt_value(v: float) -> str:
-    if isinstance(v, float) and v == int(v):
-        return str(int(v))
+def _fmt_value(v: Any) -> str:
+    # defensive: a snapshot can carry inf/NaN gauges (a rate computed over
+    # a zero interval) or a missing value — the pretty-printer must render
+    # them, never raise (int(inf) is an OverflowError)
+    try:
+        if isinstance(v, float) and v == int(v):
+            return str(int(v))
+    except (OverflowError, ValueError):
+        return str(v)
+    if not isinstance(v, (int, float)):
+        return str(v)
     return f"{v:.6g}"
 
 
@@ -71,22 +79,26 @@ def print_snapshot(snap: dict[str, Any], out=sys.stdout) -> None:
     metrics = snap.get("metrics", {})
     for name in sorted(metrics):
         fam = metrics[name]
-        series = fam.get("series", [])
-        if not series:
-            continue
-        print(f"\n{name} ({fam['type']})"
+        series = fam.get("series") or []
+        print(f"\n{name} ({fam.get('type', '?')})"
               + (f" — {fam['help']}" if fam.get("help") else ""), file=out)
+        if not series:
+            # a labeled family after a registry reset has a declared name
+            # but no live series — render it empty rather than skipping
+            # (the catalogue stays visible) and never raise on it
+            print("  (no live series)", file=out)
+            continue
         for s in series:
             lbl = _fmt_labels(s.get("labels", {}))
-            if fam["type"] == "histogram":
+            if fam.get("type") == "histogram":
                 count = s.get("count", 0)
                 total = s.get("sum", 0.0)
                 mean = total / count if count else 0.0
                 print(f"  {lbl or '(all)':40s} count={count} "
                       f"sum={_fmt_value(total)}s mean={mean:.4f}s", file=out)
             else:
-                print(f"  {lbl or '(all)':40s} {_fmt_value(s['value'])}",
-                      file=out)
+                print(f"  {lbl or '(all)':40s} "
+                      f"{_fmt_value(s.get('value'))}", file=out)
     events = snap.get("events") or []
     if events:
         print("\nevents:", file=out)
@@ -115,6 +127,40 @@ def print_tree(node: dict[str, Any], depth: int = 0, out=sys.stdout) -> None:
         print_tree(child, depth + 1, out)
 
 
+def _follow(url: str, auth: str | None = None, after: int | None = None,
+            as_json: bool = False, out=sys.stdout) -> int:
+    """Tail ``GET /telemetry/stream`` (SSE): print one line per event.
+    Returns when the server closes the stream (shutdown) or on Ctrl-C."""
+    query = f"?after={after}" if after is not None else ""
+    req = urllib.request.Request(
+        f"{url.rstrip('/')}/telemetry/stream{query}", headers=_headers(auth))
+    try:
+        resp = urllib.request.urlopen(req, timeout=60)
+    except urllib.error.HTTPError as e:
+        raise SystemExit(f"/telemetry/stream: {e}")
+    try:
+        for raw in resp:
+            line = raw.decode("utf-8", "replace").rstrip("\n")
+            if not line.startswith("data: "):
+                continue  # id:/keepalive framing
+            if as_json:
+                print(line[len("data: "):], file=out, flush=True)
+                continue
+            try:
+                record = json.loads(line[len("data: "):])
+            except json.JSONDecodeError:
+                continue
+            extra = {k: v for k, v in record.items()
+                     if k not in ("name", "unix", "seq")}
+            print(f"[{record.get('seq', '?')}] {record.get('name', '?')}"
+                  + (f" {extra}" if extra else ""), file=out, flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        resp.close()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m spacedrive_tpu.telemetry",
@@ -135,9 +181,24 @@ def main(argv: list[str] | None = None) -> int:
                         help="print the raw Prometheus text exposition")
     parser.add_argument("--json", action="store_true",
                         help="print the raw JSON instead of the table")
+    parser.add_argument("--follow", action="store_true",
+                        help="with --url: tail the node's live event "
+                             "stream (GET /telemetry/stream, SSE) — job "
+                             "transitions, fault firings, router flips, "
+                             "sync sessions, alert edges; Ctrl-C to stop")
+    parser.add_argument("--after", type=int, default=None, metavar="SEQ",
+                        help="with --follow: replay ring events newer "
+                             "than this sequence number first")
     args = parser.parse_args(argv)
 
     from . import job_trace, render_prometheus, snapshot
+
+    if args.follow:
+        if not args.url:
+            parser.error("--follow needs --url (it tails a RUNNING shell; "
+                         "an in-process registry has no live producer)")
+        return _follow(args.url, auth=args.auth, after=args.after,
+                       as_json=args.json)
 
     if args.job:
         if args.url:

@@ -40,6 +40,15 @@ MAX_TRACES = 128
 
 ROOT_SPAN_ID = 0
 
+#: the innermost trace the CURRENT THREAD has an open span in — how code
+#: far from the worker (the remote-hasher dispatch, a p2p request) finds
+#: the trace context to propagate without threading it through every call
+_CURRENT = threading.local()
+
+
+def current_trace() -> "Trace | None":
+    return getattr(_CURRENT, "trace", None)
+
 
 class Span:
     """A timed section. Context manager; reentrant-unsafe by design (one
@@ -50,7 +59,8 @@ class Span:
 
     def __init__(self, name: str, trace: "Trace | None" = None,
                  attrs: dict[str, Any] | None = None,
-                 parent: "Span | None" = None) -> None:
+                 parent: "Span | None" = None,
+                 parent_id: int | None = None) -> None:
         self.name = name
         self.trace = trace
         self.attrs = attrs or {}
@@ -62,10 +72,15 @@ class Span:
         self._t0 = 0.0
         # explicit cross-thread parent (pipeline stage threads open their
         # spans under the job thread's pipeline.run span; the per-thread
-        # stack cannot see it)
+        # stack cannot see it). ``parent_id`` pins a parent known only by
+        # id — the CROSS-NODE case, where the parent span lives in another
+        # process and arrived as a trace-context envelope (telemetry/mesh).
         self._pinned = False
         if parent is not None and parent.span_id >= 0:
             self.parent_id = parent.span_id
+            self._pinned = True
+        elif parent_id is not None and parent_id >= 0:
+            self.parent_id = parent_id
             self._pinned = True
 
     def set(self, **attrs: Any) -> None:
@@ -98,14 +113,18 @@ class Trace:
     own stack; finished spans append under one lock."""
 
     def __init__(self, trace_id: str, name: str,
-                 attrs: dict[str, Any] | None = None) -> None:
+                 attrs: dict[str, Any] | None = None,
+                 span_id_base: int = 0) -> None:
         self.trace_id = trace_id
         self.name = name
         self.attrs = dict(attrs or {})
         self.finished = False
         self._final_s: float | None = None
         self._lock = threading.Lock()
-        self._ids = itertools.count(1)
+        # ``span_id_base``: mesh traces allocate ids above a per-node base
+        # so two nodes appending to ONE logical trace (stitched later by
+        # trace_id) can never collide on span ids
+        self._ids = itertools.count(span_id_base + 1)
         self._records: list[dict[str, Any]] = []
         self._tls = threading.local()
         self._root_start_unix = time.time()
@@ -113,10 +132,12 @@ class Trace:
 
     # -- span plumbing -------------------------------------------------------
     def span(self, name: str, parent: Span | None = None,
-             **attrs: Any) -> Span:
+             parent_id: int | None = None, **attrs: Any) -> Span:
         """``parent`` pins an explicit (possibly cross-thread) parent;
+        ``parent_id`` pins a remote (cross-node) parent by bare id;
         otherwise the opening thread's current span is the parent."""
-        return Span(name, trace=self, attrs=attrs, parent=parent)
+        return Span(name, trace=self, attrs=attrs, parent=parent,
+                    parent_id=parent_id)
 
     def _stack(self) -> list[Span]:
         stack = getattr(self._tls, "stack", None)
@@ -131,6 +152,13 @@ class Trace:
             span.parent_id = stack[-1].span_id if stack else ROOT_SPAN_ID
         span.span_id = next(self._ids)
         stack.append(span)
+        _CURRENT.trace = self
+
+    def current_span_id(self) -> int:
+        """Id of the calling thread's innermost open span (the root when
+        none is open) — what an outbound trace-context envelope carries."""
+        stack = self._stack()
+        return stack[-1].span_id if stack else ROOT_SPAN_ID
 
     def _exit(self, span: Span) -> None:
         stack = self._stack()
@@ -138,6 +166,8 @@ class Trace:
             stack.pop()
         elif span in stack:  # mismatched nesting: drop back to it
             del stack[stack.index(span):]
+        if not stack and getattr(_CURRENT, "trace", None) is self:
+            _CURRENT.trace = None
         record = {
             "span_id": span.span_id,
             "parent_id": span.parent_id,

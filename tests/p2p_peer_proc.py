@@ -8,6 +8,9 @@ answers newline-delimited commands on stdin:
 
   check_tag <pub_id>   -> {"found": bool, "name": ...}
   ops_count            -> {"count": N}
+  emit_ops <n>         -> {"emitted": n}   (n tag create-ops; triggers a
+                                            sync push session to peers)
+  sync_traces          -> {"files": [...]} (exported sync-* trace JSONL)
   quit                 -> exits
 """
 
@@ -58,6 +61,23 @@ def main() -> int:
             n = library.db.query(
                 "SELECT count(*) c FROM shared_operation")[0]["c"]
             print(json.dumps({"count": n}), flush=True)
+        elif parts[0] == "emit_ops":
+            n = int(parts[1])
+            start = library.db.query(
+                "SELECT count(*) c FROM shared_operation")[0]["c"]
+            ops, rows = [], []
+            for i in range(n):
+                pub = f"proc-tag-{start}-{i}"
+                ops.append(library.sync.shared_create(
+                    Tag, pub, {"name": f"pt{i}"}))
+                rows.append({"pub_id": pub, "name": f"pt{i}"})
+            library.sync.write_ops(
+                ops, lambda db, rows=rows: [db.insert(Tag, r) for r in rows])
+            print(json.dumps({"emitted": n}), flush=True)
+        elif parts[0] == "sync_traces":
+            traces = sorted(str(p) for p in
+                            (data_dir / "logs" / "traces").glob("sync-*.jsonl"))
+            print(json.dumps({"files": traces}), flush=True)
         else:
             print(json.dumps({"error": f"unknown command {parts[0]}"}), flush=True)
 

@@ -1,0 +1,571 @@
+"""Mesh observability gate (ISSUE 7): cross-node trace propagation,
+per-peer convergence lag, the flight-recorder/live-stream surface, the
+SLO/alert evaluator, the telemetry CLI hardening, and the metrics-
+catalogue drift gate.
+
+The two-node runs here are WIRE-LESS: a push session mirrors the exact
+shape of ``p2p/nlm.py`` (``get_ops`` + ``ops_pending`` served under
+``sync.window`` spans, the trace-context envelope on every window,
+``Ingester.receive(ops, ctx)`` on the receiving library) without the
+socket, because the p2p session layer needs the ``cryptography`` package
+this container lacks. The true cross-process/socket variant lives in
+tests/test_p2p_two_process.py (skipped without session crypto).
+"""
+
+import json
+import random
+import re
+import threading
+import time
+import urllib.request
+import uuid
+from pathlib import Path
+
+import pytest
+
+from spacedrive_tpu import faults, telemetry
+from spacedrive_tpu.models import Tag
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.objects import file_identifier as fi
+from spacedrive_tpu.sync.ingest import Ingester
+from spacedrive_tpu.telemetry import alerts, mesh
+from spacedrive_tpu.telemetry import spans as tspans
+
+from .test_faults import _identify
+from .test_pipeline import _seed_library
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    yield
+    faults.clear()
+    telemetry.reset()
+    telemetry.reload_enabled()
+
+
+# -- two wire-less nodes -------------------------------------------------------
+
+
+@pytest.fixture()
+def two_libs(tmp_path, monkeypatch):
+    """Two Nodes (p2p off — no socket in this harness) whose libraries
+    are cross-registered, the bench_sync pairing shape."""
+    monkeypatch.setenv("SD_P2P_DISABLED", "1")
+    node_a = Node(tmp_path / "a", probe_accelerator=False,
+                  watch_locations=False)
+    node_b = Node(tmp_path / "b", probe_accelerator=False,
+                  watch_locations=False)
+    lib_a = node_a.libraries.create("mesh-a")
+    lib_b = node_b.libraries.create("mesh-b")
+    lib_a.sync.emit_messages = True
+    lib_a.add_remote_instance(lib_b.instance())
+    lib_b.add_remote_instance(lib_a.instance())
+    yield node_a, lib_a, node_b, lib_b
+    node_a.shutdown()
+    node_b.shutdown()
+
+
+def _emit_tags(lib, n, prefix="t"):
+    ops, rows = [], []
+    for i in range(n):
+        pub = f"{prefix}-{i}"
+        ops.append(lib.sync.shared_create(Tag, pub, {"name": f"{prefix}{i}"}))
+        rows.append({"pub_id": pub, "name": f"{prefix}{i}"})
+    lib.sync.write_ops(ops, lambda db, rows=rows: [db.insert(Tag, r)
+                                                   for r in rows])
+
+
+PEER_B = "peer-identity-b"  # the "dialed peer" the chaos seam keys on
+
+
+def _push_session(node_a, lib_a, lib_b, ingester, batch=200,
+                  on_window=None):
+    """One sync push session A -> B, the exact serving shape of
+    nlm._originate_to / responder, minus the socket."""
+    faults.inject("p2p_send", key=PEER_B)
+    origin = str(node_a.config.get()["id"])
+    trace = mesh.new_trace(
+        "sync.push", origin,
+        f"sync-{lib_a.id[:8]}-{uuid.uuid4().hex[:12]}",
+        library_id=lib_a.id, peer=mesh.peer_label(PEER_B))
+    while True:
+        clocks = lib_b.sync.timestamps()
+        ops, has_more = lib_a.sync.get_ops(clocks, batch)
+        pending = (max(0, lib_a.sync.ops_pending(clocks) - len(ops))
+                   if has_more else 0)
+        with telemetry.span(trace, "sync.window") as sp:
+            sp.set(ops=len(ops), has_more=has_more, pending=pending)
+            ctx = None
+            if trace is not None:
+                ctx = mesh.TraceContext(trace.trace_id, sp.span_id, origin,
+                                        hlc=lib_a.sync.clock.last,
+                                        pending=pending)
+            ingester.receive(ops, ctx)
+        if on_window is not None:
+            on_window()
+        if ops and not ingester.last_floor_advanced:
+            break  # no progress: end the session like the responder does
+        if not has_more:
+            break
+    telemetry.finish_trace(trace, export_dir=node_a.data_dir)
+    return trace
+
+
+def _op_log(lib):
+    return sorted((r["id"], r["timestamp"], r["model"], r["record_id"],
+                   r["kind"], r["data"])
+                  for r in lib.db.query("SELECT * FROM shared_operation"))
+
+
+# -- trace-context envelope ----------------------------------------------------
+
+
+def test_trace_context_wire_roundtrip_and_garbage():
+    ctx = mesh.TraceContext("sync-ab-12", 7, "node-a", hlc=5 << 32, pending=3)
+    assert mesh.TraceContext.from_wire(ctx.to_wire()) == ctx
+    # garbage degrades to None, never raises — and path-traversal shaped
+    # trace ids are rejected before they can ever name an export file
+    for bad in (None, "x", [], {"t": "../../etc", "s": 1},
+                {"t": "ok", "s": -1}, {"t": "ok", "s": "7"},
+                {"t": "a" * 200, "s": 1}):
+        assert mesh.TraceContext.from_wire(bad) is None
+    # unattributable extras degrade to defaults
+    loose = mesh.TraceContext.from_wire(
+        {"t": "ok-id", "s": 2, "o": 9, "h": "x", "p": -4})
+    assert loose == mesh.TraceContext("ok-id", 2, "", 0, None)
+
+
+def test_peer_label_bounded_and_stable():
+    a, b = mesh.peer_label("node-identity-a"), mesh.peer_label("node-b")
+    assert a != b and len(a) == len(b) == 8
+    assert mesh.peer_label("node-identity-a") == a
+    assert mesh.peer_label(None) == mesh.peer_label("") == "local"
+    assert mesh.span_id_base("a") != mesh.span_id_base("b")
+    assert mesh.span_id_base("a") >= (1 << 32)
+
+
+# -- propagation + lag over a wire-less session --------------------------------
+
+
+def test_sync_session_propagates_trace_and_lag(two_libs):
+    node_a, lib_a, node_b, lib_b = two_libs
+    _emit_tags(lib_a, 900)
+    ingester = Ingester(lib_b, peer=PEER_B)
+    label = mesh.peer_label(PEER_B)
+
+    lag_seen = []
+    trace = _push_session(node_a, lib_a, lib_b, ingester, batch=200,
+                          on_window=lambda: lag_seen.append(
+                              telemetry.value("sd_sync_peer_lag_ops",
+                                              peer=label)))
+
+    # converged: same op-log rows, lag gauges back to 0
+    assert _op_log(lib_a) == _op_log(lib_b)
+    assert lag_seen[0] > 0          # mid-session backlog was visible
+    assert lag_seen[-1] == 0.0
+    assert telemetry.value("sd_sync_peer_lag_ops", peer=label) == 0.0
+    assert telemetry.value("sd_sync_peer_lag_seconds", peer=label) \
+        < 60.0  # HLC watermark delta, small on one host
+
+    # peer-labeled ingest families (satellite: two peers distinguishable)
+    assert telemetry.value("sd_sync_ops_ingested_total", peer=label) >= 900
+    assert telemetry.value("sd_sync_ops_applied_total", peer=label) == 900
+    assert telemetry.value("sd_sync_remote_windows_total", peer=label) >= 5
+
+    # end-to-end apply delay histogram observed per op
+    snap = telemetry.snapshot()["metrics"]["sd_sync_apply_delay_seconds"]
+    (series,) = [s for s in snap["series"] if s["labels"]["peer"] == label]
+    assert series["count"] >= 900
+
+    # the trace stitches IN-RING: apply spans parent under window spans
+    recs = trace.records()
+    windows = [r for r in recs if r["name"] == "sync.window"]
+    applies = [r for r in recs if r["name"] == "sync.apply"]
+    window_ids = {r["span_id"] for r in windows}
+    assert applies and all(r["parent_id"] in window_ids for r in applies)
+    assert sum(r["attrs"]["ops"] for r in windows) \
+        == sum(r["attrs"]["ops"] for r in applies) == 900
+    # ... and on DISK: the sender export carries the whole stitched tree
+    exported = (Path(node_a.data_dir) / "logs" / "traces"
+                / f"{trace.trace_id}.jsonl")
+    assert exported.exists()
+    names = {json.loads(x)["name"] for x in
+             exported.read_text().splitlines() if x.strip()}
+    assert {"sync.push", "sync.window", "sync.apply"} <= names
+
+
+def test_cross_process_stitch_shape(two_libs):
+    """Emulate the two-process case: the receiver's ring does NOT hold
+    the sender's trace (cleared between send and receive), so
+    continue_trace builds a fresh Trace under the same trace_id with the
+    receiver's own span-id base — the two JSONL halves merge into one
+    tree."""
+    node_a, lib_a, node_b, lib_b = two_libs
+    _emit_tags(lib_a, 50)
+    ops, has_more = lib_a.sync.get_ops(lib_b.sync.timestamps(), 1000)
+    assert not has_more
+    origin_a = str(node_a.config.get()["id"])
+    trace = mesh.new_trace("sync.push", origin_a, "sync-stitch-0001",
+                           library_id=lib_a.id)
+    with telemetry.span(trace, "sync.window") as sp:
+        sp.set(ops=len(ops), has_more=False, pending=0)
+        ctx = mesh.TraceContext(trace.trace_id, sp.span_id, origin_a,
+                                hlc=lib_a.sync.clock.last, pending=0)
+    telemetry.finish_trace(trace, export_dir=node_a.data_dir)
+    sender_file = (Path(node_a.data_dir) / "logs" / "traces"
+                   / "sync-stitch-0001.jsonl")
+    assert sender_file.exists()
+
+    tspans.clear_traces()  # "other process": ring miss forces a new Trace
+    ingester = Ingester(lib_b, peer=PEER_B)
+    applied = ingester.receive(ops, ctx)
+    assert applied == 50
+    receiver_trace = tspans.get_trace("sync-stitch-0001")
+    assert receiver_trace is not None and receiver_trace is not trace
+    mesh.export_partial(receiver_trace, node_b.data_dir)
+    receiver_file = (Path(node_b.data_dir) / "logs" / "traces"
+                     / "sync-stitch-0001.jsonl")
+
+    merged = [json.loads(x) for f in (sender_file, receiver_file)
+              for x in f.read_text().splitlines() if x.strip()]
+    assert len({r["trace_id"] for r in merged}) == 1
+    window = next(r for r in merged if r["name"] == "sync.window")
+    apply_ = next(r for r in merged if r["name"] == "sync.apply")
+    assert apply_["parent_id"] == window["span_id"]
+    assert apply_["span_id"] != window["span_id"]
+    tree = tspans.build_tree("sync-stitch-0001", merged)
+    assert tree["name"] == "sync.push"
+    window_node = next(c for c in tree["children"]
+                       if c["name"] == "sync.window")
+    assert any(c["name"] == "sync.apply" for c in window_node["children"])
+
+
+# -- the chaos acceptance gate -------------------------------------------------
+
+
+def test_chaos_sync_converges_with_lag_alert_cycle(two_libs):
+    """ISSUE 7 acceptance: a two-node sync run under
+    ``sync_apply:sqlite_busy`` + ``p2p_send:flap`` converges
+    byte-identically, ``sd_sync_peer_lag_ops`` returns to 0, a lag alert
+    fires AND clears in the event ring, and a stitched cross-node trace
+    lands on disk."""
+    node_a, lib_a, node_b, lib_b = two_libs
+    label = mesh.peer_label(PEER_B)
+    evaluator = alerts.AlertEvaluator(
+        [alerts.AlertRule(name="sync-peer-lag", kind="threshold",
+                          series="sd_sync_peer_lag_ops", op="gt",
+                          value=10.0, for_s=0.0)])
+
+    _emit_tags(lib_a, 600, prefix="chaos")
+    ingester = Ingester(lib_b, peer=PEER_B)
+    faults.install("sync_apply:sqlite_busy:4;p2p_send:flap:2", seed=11)
+    try:
+        deadline = time.monotonic() + 90
+        traces = []
+        while time.monotonic() < deadline:
+            try:
+                traces.append(_push_session(
+                    node_a, lib_a, lib_b, ingester, batch=100,
+                    on_window=evaluator.evaluate_once))
+            except ConnectionRefusedError:
+                continue  # flap: the originator retries the session
+            if _op_log(lib_a) == _op_log(lib_b):
+                break
+        fired = faults.fired()
+    finally:
+        faults.clear()
+    evaluator.evaluate_once()
+
+    # the storm actually bit, and convergence is byte-identical anyway
+    assert fired.get("sync_apply:sqlite_busy") == 4, fired
+    assert fired.get("p2p_send:flap") == 2, fired
+    assert _op_log(lib_a) == _op_log(lib_b)
+    assert len(_op_log(lib_b)) == 600
+    assert lib_a.db.count(Tag) == lib_b.db.count(Tag) == 600
+
+    # lag returned to 0 and the alert cycled firing -> resolved
+    assert telemetry.value("sd_sync_peer_lag_ops", peer=label) == 0.0
+    assert telemetry.value("sd_alerts_firing", rule="sync-peer-lag") == 0.0
+    names = [e["name"] for e in telemetry.recent_events(limit=256)]
+    assert "alert.firing" in names and "alert.resolved" in names
+    assert names.index("alert.firing") < names.index("alert.resolved")
+    assert "fault.fired" in names  # the storm narrated itself live
+
+    # a stitched cross-node trace is on disk
+    stitched = False
+    for path in (Path(node_a.data_dir) / "logs" / "traces").glob(
+            "sync-*.jsonl"):
+        recs = [json.loads(x) for x in path.read_text().splitlines()
+                if x.strip()]
+        names_ = {r["name"] for r in recs}
+        if {"sync.window", "sync.apply"} <= names_:
+            stitched = True
+            break
+    assert stitched
+
+
+def test_transient_busy_in_careful_pass_is_replayed_not_lost(two_libs):
+    """The convergence enabler: an injected busy that fires in the
+    CAREFUL pass must poison (floor capped, replayed next session), not
+    be logged-without-effect — which would silently drop the
+    materialization forever."""
+    node_a, lib_a, node_b, lib_b = two_libs
+    _emit_tags(lib_a, 30, prefix="busy")
+    ingester = Ingester(lib_b, peer=PEER_B)
+    # 2 firings: one aborts the optimistic pass, one hits the careful
+    # pass for a specific op — exactly the lost-effect shape
+    faults.install("sync_apply:sqlite_busy:2", seed=3)
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline \
+                and _op_log(lib_a) != _op_log(lib_b):
+            _push_session(node_a, lib_a, lib_b, ingester, batch=1000)
+    finally:
+        faults.clear()
+    assert _op_log(lib_a) == _op_log(lib_b)
+    assert lib_b.db.count(Tag) == 30  # every effect materialized
+
+
+# -- alert evaluator -----------------------------------------------------------
+
+
+def test_alert_threshold_for_s_and_events():
+    g = telemetry.gauge("sd_sync_peer_lag_ops", "", labels=("peer",))
+    ev = alerts.AlertEvaluator([alerts.AlertRule(
+        name="lag", kind="threshold", series="sd_sync_peer_lag_ops",
+        op="gt", value=100.0, for_s=10.0)])
+    g.set(500, peer="p1")
+    assert not ev.evaluate_once(now=0.0)[0]["firing"]     # pending
+    assert not ev.evaluate_once(now=5.0)[0]["firing"]     # still held < 10s
+    state = ev.evaluate_once(now=10.0)[0]
+    assert state["firing"] and state["live_value"] == 500.0
+    assert state["value"] == 100.0  # the CONFIGURED threshold survives
+    assert telemetry.value("sd_alerts_firing", rule="lag") == 1.0
+    # a dip resets the hold; recovery clears immediately
+    g.set(50, peer="p1")
+    assert not ev.evaluate_once(now=11.0)[0]["firing"]
+    assert telemetry.value("sd_alerts_firing", rule="lag") == 0.0
+    names = [e["name"] for e in telemetry.recent_events()]
+    assert names.count("alert.firing") == 1
+    assert names.count("alert.resolved") == 1
+
+
+def test_alert_lt_skips_zero_and_labels_filter():
+    g = telemetry.gauge("sd_scan_files_per_sec")
+    ev = alerts.AlertEvaluator([alerts.AlertRule(
+        name="floor", kind="threshold", series="sd_scan_files_per_sec",
+        op="lt", value=100.0, for_s=0.0)])
+    # never-scanned (0) must NOT fire the floor rule
+    assert not ev.evaluate_once(now=0.0)[0]["firing"]
+    g.set(40)
+    assert ev.evaluate_once(now=1.0)[0]["firing"]
+    g.set(400)
+    assert not ev.evaluate_once(now=2.0)[0]["firing"]
+
+    # labels filter: only the matching series can fire
+    lbl = telemetry.gauge("sd_hash_router_bytes_per_sec", "",
+                          labels=("backend",))
+    lbl.set(1e9, backend="cpu")
+    ev2 = alerts.AlertEvaluator([alerts.AlertRule(
+        name="dev", kind="threshold",
+        series="sd_hash_router_bytes_per_sec",
+        labels={"backend": "device"}, op="gt", value=1.0, for_s=0.0)])
+    assert not ev2.evaluate_once(now=0.0)[0]["firing"]
+    lbl.set(2.0, backend="device")
+    assert ev2.evaluate_once(now=1.0)[0]["firing"]
+
+
+def test_alert_rate_and_absence():
+    c = telemetry.counter("sd_quarantined_files_total")
+    ev = alerts.AlertEvaluator([
+        alerts.AlertRule(name="spike", kind="rate",
+                         series="sd_quarantined_files_total", op="gt",
+                         value=5.0, window_s=10.0, for_s=0.0),
+        alerts.AlertRule(name="missing", kind="absence",
+                         series="sd_hash_router_bytes_per_sec",
+                         labels={"backend": "device"}, for_s=5.0),
+    ])
+    st = {s["name"]: s for s in ev.evaluate_once(now=0.0)}
+    assert not st["spike"]["firing"]
+    c.inc(100)  # 100 in 5s -> 20/s over the window
+    st = {s["name"]: s for s in ev.evaluate_once(now=5.0)}
+    assert st["spike"]["firing"] and st["spike"]["live_value"] == 20.0
+    st = {s["name"]: s for s in ev.evaluate_once(now=20.0)}  # window drained
+    assert not st["spike"]["firing"]
+
+    # absence: fires after the grace, resolves when the series appears
+    assert st["missing"]["firing"]  # held since t=0 > 5s grace
+    telemetry.gauge("sd_hash_router_bytes_per_sec", "",
+                    labels=("backend",)).set(3e9, backend="device")
+    st = {s["name"]: s for s in ev.evaluate_once(now=21.0)}
+    assert not st["missing"]["firing"]
+
+
+def test_alert_notify_hook_and_validation():
+    calls = []
+    g = telemetry.gauge("sd_jobs_queued")
+    ev = alerts.AlertEvaluator(
+        [alerts.AlertRule(name="q", kind="threshold",
+                          series="sd_jobs_queued", op="gt", value=5.0,
+                          for_s=0.0)],
+        notify=lambda rule, firing, value: calls.append(
+            (rule.name, firing, value)))
+    g.set(9)
+    ev.evaluate_once(now=0.0)
+    g.set(0)
+    ev.evaluate_once(now=1.0)
+    assert calls == [("q", True, 9.0), ("q", False, None)]
+
+    with pytest.raises(alerts.AlertRuleError):
+        alerts.AlertRule(name="bad", kind="nope", series="sd_jobs_queued")
+    with pytest.raises(alerts.AlertRuleError):
+        alerts.AlertRule(name="bad", kind="threshold", series="not_sd")
+    with pytest.raises(alerts.AlertRuleError):
+        alerts.AlertEvaluator([
+            alerts.AlertRule(name="dup", kind="absence",
+                             series="sd_jobs_queued"),
+            alerts.AlertRule(name="dup", kind="absence",
+                             series="sd_jobs_queued")])
+
+
+def test_default_rules_cover_issue_slos():
+    names = {r.name for r in alerts.default_rules()}
+    assert {"sync-peer-lag", "quarantine-spike", "scan-rate-floor",
+            "device-numbers-missing"} <= names
+    # every stock rule round-trips through the dict grammar
+    for rule in alerts.default_rules():
+        assert alerts.AlertRule.from_dict(rule.to_dict()) == rule
+
+
+# -- CLI hardening + live tail (satellite) -------------------------------------
+
+
+def _shell(node):
+    from spacedrive_tpu.server.shell import Server
+
+    server = Server(node, port=0)
+    server.start()
+    return server
+
+
+def test_cli_renders_reset_registry_with_labeled_families(tmp_path, capsys):
+    """Satellite: after a registry reset every labeled family has a
+    declared name but ZERO live series — the --url pretty-printer must
+    render them as empty, never raise (and non-finite gauge values must
+    render too)."""
+    from spacedrive_tpu.telemetry.__main__ import main as telemetry_cli
+
+    node = Node(tmp_path / "cli", probe_accelerator=False,
+                watch_locations=False)
+    server = _shell(node)
+    try:
+        telemetry.reset()  # labeled families drop all live series
+        telemetry.gauge("sd_hash_bytes_per_sec").set(float("inf"))
+        rc = telemetry_cli(["--url", f"http://127.0.0.1:{server.port}"])
+    finally:
+        server.stop()
+        node.shutdown()
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "sd_sync_peer_lag_ops" in out      # declared vocabulary visible
+    assert "(no live series)" in out
+    assert "inf" in out
+
+
+def test_cli_follow_tails_live_events(tmp_path, capsys):
+    from spacedrive_tpu.telemetry import __main__ as tcli
+
+    node = Node(tmp_path / "follow", probe_accelerator=False,
+                watch_locations=False)
+    server = _shell(node)
+    telemetry.event("seeded.before", k=1)
+    lines: list[str] = []
+
+    class _Out:
+        def write(self, s):
+            lines.append(s)
+
+        def flush(self):
+            pass
+
+    def tail():
+        tcli._follow(f"http://127.0.0.1:{server.port}", out=_Out())
+
+    t = threading.Thread(target=tail, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 15
+        telemetry.event("live.edge", n=2)
+        while time.monotonic() < deadline \
+                and not any("live.edge" in s for s in lines):
+            time.sleep(0.1)
+            telemetry.event("live.edge", n=2)
+    finally:
+        server.stop()
+        node.shutdown()
+    t.join(timeout=10)
+    text = "".join(lines)
+    assert "seeded.before" in text  # ring replay on connect
+    assert "live.edge" in text      # live push
+
+
+# -- the metrics-catalogue drift gate (satellite) ------------------------------
+
+_SD_NAME = re.compile(r"\bsd_[a-z0-9_]+\b")
+
+
+def test_metrics_catalogue_has_no_drift(tmp_path, monkeypatch):
+    """Scrape /metrics after a pipelined scan + a sync round-trip and
+    diff the family names against the observability.md catalogue tables
+    (both directions). `sd_t_*` is the reserved test-family prefix and
+    is ignored; prose/code-block mentions in the doc are ignored (only
+    `|`-table rows are the catalogue)."""
+    monkeypatch.setattr(fi, "BATCH_SIZE", 64)
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    monkeypatch.setenv("SD_P2P_DISABLED", "1")
+    rng = random.Random(9)
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    for i in range(150):
+        (tree / f"f{i:03d}.dat").write_bytes(rng.randbytes(300 + i))
+
+    node, lib, loc_id = _seed_library(tmp_path / "drift", tree, "drift")
+    node_b = Node(tmp_path / "drift_b", probe_accelerator=False,
+                  watch_locations=False)
+    server = _shell(node)
+    try:
+        _identify(node, lib, loc_id)  # pipelined scan
+        lib_b = node_b.libraries.create("drift-mirror")
+        lib.add_remote_instance(lib_b.instance())
+        lib_b.add_remote_instance(lib.instance())
+        _push_session(node, lib, lib_b, Ingester(lib_b, peer=PEER_B))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=15) as r:
+            body = r.read().decode()
+    finally:
+        server.stop()
+        node_b.shutdown()
+        node.shutdown()
+
+    scraped = {line.split(" ")[2] for line in body.splitlines()
+               if line.startswith("# TYPE ")}
+    scraped = {n for n in scraped if not n.startswith("sd_t_")}
+    assert len(scraped) > 40  # the scan+sync round-trip touched the stack
+
+    doc = (Path(__file__).resolve().parents[1] / "docs" / "architecture"
+           / "observability.md").read_text()
+    documented = set()
+    for line in doc.splitlines():
+        if line.lstrip().startswith("|"):
+            documented.update(_SD_NAME.findall(line))
+
+    missing_from_doc = sorted(scraped - documented)
+    assert not missing_from_doc, (
+        f"series served on /metrics but absent from the observability.md "
+        f"catalogue tables: {missing_from_doc}")
+    ghost_in_doc = sorted(documented - scraped)
+    assert not ghost_in_doc, (
+        f"catalogue rows naming series the registry no longer declares: "
+        f"{ghost_in_doc}")

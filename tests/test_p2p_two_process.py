@@ -28,6 +28,13 @@ from .test_p2p import wait_for
 
 PEER_SCRIPT = Path(__file__).with_name("p2p_peer_proc.py")
 
+try:  # the p2p session layer hard-requires it (p2p/secure.py)
+    import cryptography  # noqa: F401
+
+    HAS_SESSION_CRYPTO = True
+except ImportError:
+    HAS_SESSION_CRYPTO = False
+
 
 @pytest.fixture()
 def peer_a(tmp_path):
@@ -101,3 +108,90 @@ def test_two_process_pair_sync_and_fetch(peer_a, tmp_path):
         assert n == len(expect) and sink.getvalue() == expect
     finally:
         b.shutdown()
+
+
+@pytest.mark.skipif(not HAS_SESSION_CRYPTO,
+                    reason="p2p session crypto requires the 'cryptography' "
+                           "package (the pure-python fallback covers "
+                           "identity only); the wire-less stitch variant "
+                           "in test_mesh_telemetry.py still runs")
+def test_two_process_trace_stitching(peer_a, tmp_path):
+    """Cross-PROCESS trace propagation (ISSUE 7): a sync push session
+    originated in process A exports its root + window spans under A's
+    data dir; the receiver (this process) exports its apply spans under
+    B's data dir with the SAME trace_id — merging the two JSONL files
+    rebuilds one tree whose apply spans parent under A's window spans
+    and whose op counts reconcile."""
+    proc, info, _tree = peer_a
+    a_traces = Path(tmp_path / "a_data") / "logs" / "traces"
+    b_traces: Path | None = None
+
+    b = Node(tmp_path / "b_data", probe_accelerator=False)
+    try:
+        b.router.resolve("p2p.pair", {"peer_id": f"127.0.0.1:{info['port']}"})
+        lib_b = wait_for(lambda: next((l for l in b.libraries.list()
+                                       if l.id == info["library_id"]), None),
+                         timeout=40, msg="library mirrored from process A")
+        wait_for(lambda: lib_b.db.count(FilePath) == info["file_paths"],
+                 timeout=40, msg="file_paths replicated across processes")
+        b_traces = Path(b.data_dir) / "logs" / "traces"
+
+        # a fresh batch of ops on A triggers a new push session A -> B
+        emitted = ask(proc, "emit_ops 120")
+        assert emitted["emitted"] == 120
+
+        def stitched():
+            if not b_traces.is_dir() or not a_traces.is_dir():
+                return None
+            ours = {p.name: p for p in b_traces.glob("sync-*.jsonl")}
+            for a_file in a_traces.glob("sync-*.jsonl"):
+                b_file = ours.get(a_file.name)
+                if b_file is None:
+                    continue
+                sender = [json.loads(x) for x in
+                          a_file.read_text().splitlines() if x.strip()]
+                receiver = [json.loads(x) for x in
+                            b_file.read_text().splitlines() if x.strip()]
+                applies = [r for r in receiver if r["name"] == "sync.apply"]
+                if applies and any(r["name"] == "sync.window"
+                                   for r in sender):
+                    return sender, receiver
+            return None
+
+        sender, receiver = wait_for(stitched, timeout=40, interval=0.5,
+                                    msg="matching sync trace JSONL on "
+                                        "both sides")
+    finally:
+        b.shutdown()
+
+    # one trace_id across both processes
+    trace_ids = {r["trace_id"] for r in sender} | {r["trace_id"]
+                                                   for r in receiver}
+    assert len(trace_ids) == 1
+    # the merged tree stitches: every apply span parents under a sender
+    # window span, and window/apply op counts reconcile
+    windows = [r for r in sender if r["name"] == "sync.window"]
+    applies = [r for r in receiver if r["name"] == "sync.apply"]
+    window_ids = {r["span_id"] for r in windows}
+    assert all(r["parent_id"] in window_ids for r in applies), (
+        windows, applies)
+    served = sum(r["attrs"]["ops"] for r in windows)
+    applied = sum(r["attrs"]["ops"] for r in applies)
+    assert served == applied > 0
+    # span-id bases are disjoint (24-bit node hash above bit 32)
+    assert window_ids.isdisjoint({r["span_id"] for r in applies})
+
+    from spacedrive_tpu.telemetry.spans import build_tree
+
+    merged = build_tree(next(iter(trace_ids)), sender + receiver)
+    assert merged["name"] == "sync.push"
+
+    def find(node, name, out):
+        if node["name"] == name:
+            out.append(node)
+        for child in node.get("children", []):
+            find(child, name, out)
+        return out
+
+    tree_applies = find(merged, "sync.apply", [])
+    assert len(tree_applies) == len(applies)
