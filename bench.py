@@ -33,6 +33,12 @@ import time
 from pathlib import Path
 
 MODE = os.environ.get("SD_BENCH_MODE", "combined")
+#: ``--fleet``: the synthetic-device-fleet soak (ISSUE 8) — N in-process
+#: peers pushing CRDT sessions through admission control + partitioned
+#: ingest lanes at ONE node; emits the fleet record to BENCH_fleet.json
+#: so the trajectory file exists for future PRs
+if "--fleet" in sys.argv[1:]:
+    MODE = "fleet"
 REPEATS = int(os.environ.get("SD_BENCH_REPEATS", "3"))
 #: ``--faults`` (or SD_BENCH_FAULTS=1): bench_scan adds a chaos pass under
 #: an injected fault storm and reports recovery overhead alongside
@@ -909,6 +915,76 @@ def bench_sync() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_fleet() -> dict:
+    """Fleet survival headline (ISSUE 8): N synthetic peers hammering one
+    node through the real admission budget + partitioned ingest lanes
+    (tests/fleet_harness.py, wire-less session mirror), with remote hash
+    batches and rspc query traffic alongside. Emits
+    ``fleet{peers, ops_per_sec_total, p99_apply_delay_s, shed_ops,
+    peak_rss_mb, max_peer_lag_ops}`` and writes the record to
+    BENCH_fleet.json — the trajectory file future fleet PRs measure
+    against."""
+    import shutil
+
+    from spacedrive_tpu import telemetry
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.fleet_harness import Fleet
+
+    peers = int(os.environ.get("SD_BENCH_FLEET_PEERS", "8"))
+    ops_per_peer = int(os.environ.get("SD_BENCH_FLEET_OPS", "5000"))
+    lanes = int(os.environ.get("SD_BENCH_FLEET_LANES", "4"))
+    telemetry.set_enabled(True)
+    tmp = Path(tempfile.mkdtemp(prefix="sd_bench_fleet_"))
+    try:
+        fleet = Fleet(tmp, peers=peers, lanes=lanes)
+        try:
+            res = fleet.run_storm(ops_per_peer=ops_per_peer, batch=500,
+                                  emit_chunks=2, hash_traffic=True,
+                                  query_traffic=True)
+            fleet.drain()
+            converged_target = len(
+                fleet.target_lib.db.query(
+                    "SELECT id FROM shared_operation")) \
+                == peers * ops_per_peer
+        finally:
+            fleet.shutdown()
+        record = {
+            "metric": (f"fleet_ops_per_sec[{peers}peers,"
+                       f"{ops_per_peer}ops,{lanes}lanes]"),
+            "value": res["ops_per_sec_total"],
+            "unit": "ops/sec",
+            "fleet": {
+                "peers": peers,
+                "ops_per_sec_total": res["ops_per_sec_total"],
+                "p99_apply_delay_s": res["p99_apply_delay_s"],
+                "shed_ops": res["shed_ops"],
+                "peak_rss_mb": res["peak_rss_mb"],
+                "max_peer_lag_ops": res["max_peer_lag_ops"],
+            },
+            "lanes": lanes,
+            "ops_total": res["ops_total"],
+            "elapsed_s": res["elapsed_s"],
+            "shed_windows": res["shed_windows"],
+            "sessions": res["sessions"],
+            "hash_batches": res["hash_batches"],
+            "max_admission_ops": res["max_admission_ops"],
+            "max_lane_depth": res["max_lane_depth"],
+            "rss_growth_mb": res["rss_growth_mb"],
+            "errors": res["errors"],
+            "converged": converged_target,
+        }
+        out = Path(__file__).resolve().parent / "BENCH_fleet.json"
+        out.write_text(json.dumps(record, indent=1) + "\n")
+        print(f"info: fleet {peers} peers x {ops_per_peer} ops, {lanes} "
+              f"lanes: {res['ops_per_sec_total']:,.0f} ops/s total, "
+              f"{res['shed_ops']} ops shed, peak RSS "
+              f"{res['peak_rss_mb']:.0f}MB -> {out.name}", file=sys.stderr)
+        return record
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _guard_device_init() -> str:
     """The tunneled device backend HANGS (not errors) when its relay dies,
     and the platform plugin forces device init regardless of JAX_PLATFORMS —
@@ -991,8 +1067,11 @@ def main() -> int:
     # every mode can touch jax (even the scan's hybrid warmup probes the
     # device), so every mode gets the deadline-guarded init; children
     # inherit the parent's verdict via SD_BENCH_DEVICE_VERDICT so the
-    # probe cost is paid once per combined run
-    platform = _guard_device_init()
+    # probe cost is paid once per combined run. The fleet soak is
+    # CPU-only by construction (CRDT ingest + admission control — no
+    # device work), so it skips the probe and its relay-recovery wait.
+    platform = ("cpu(fleet: no device work)" if MODE == "fleet"
+                else _guard_device_init())
     # opportunistic recapture: the combined suite runs for many minutes on
     # the CPU fallback — keep watching the relay in the background and, if
     # it recovers mid-run, measure the device suite after all (one shot,
@@ -1016,6 +1095,8 @@ def main() -> int:
         record = bench_scan()
     elif MODE == "sync":
         record = bench_sync()
+    elif MODE == "fleet":
+        record = bench_fleet()
     elif MODE == "dedup_1m":
         record = bench_dedup_1m()
     else:  # combined (default): dedup headline + north-star identify record
@@ -1062,7 +1143,10 @@ def main() -> int:
             record["device_recapture"] = str(watcher.out_path)
             print(f"info: relay recovered mid-run — device suite captured "
                   f"to {watcher.out_path}", file=sys.stderr)
-    if platform != "device":
+    if MODE == "fleet":
+        # CPU-only by design: no device metrics exist to caveat
+        record["platform"] = platform
+    elif platform != "device":
         record["platform"] = platform
         # unmissable: the device metrics in this record are fallback
         # numbers, not regressions — a judge reading `value` alone must

@@ -59,6 +59,22 @@ export interface AlertRuleState {
   labels: Record<string, string>; firing: boolean; pending: boolean;
   live_value: number | null; [key: string]: unknown
 }
+/** The node-wide ingest admission budget (sync.fleetStatus). */
+export interface IngestBudgetStatus {
+  budget_ops: number; budget_bytes: number; ops_in_flight: number;
+  bytes_in_flight: number; peers_in_flight: number; shed_windows: number;
+  shed_ops: number
+}
+/** One library's partitioned ingest-lane pool (sync.fleetStatus). */
+export interface IngestLaneStatus {
+  lanes: number; queue_depths: number[]; queue_bound: number;
+  windows: number; submissions: number
+}
+/** sync.fleetStatus: how the node is holding up under fleet load. */
+export interface FleetStatus {
+  budget: IngestBudgetStatus | null;
+  libraries: Record<string, IngestLaneStatus>
+}
 
 export type Procedures = {
   queries:
@@ -106,6 +122,7 @@ export type Procedures = {
 	{ key: "search.pathsCount", input: { location_id?: number; [key: string]: unknown }, result: number } |
 	{ key: "spaces.list", input: null, result: CollectionRow[] } |
 	{ key: "spaces.objects", input: number, result: FilePathRow[] } |
+	{ key: "sync.fleetStatus", input: null, result: FleetStatus } |
 	{ key: "sync.messages", input: null, result: Record<string, unknown>[] } |
 	{ key: "tags.get", input: number, result: TagRow | null } |
 	{ key: "tags.getForObject", input: number, result: TagRow[] } |
@@ -349,6 +366,7 @@ export type NodeProcedureKey =
 	"p2p.peers" |
 	"p2p.spacedrop" |
 	"search.ephemeralPaths" |
+	"sync.fleetStatus" |
 	"telemetry.alerts" |
 	"telemetry.jobTrace" |
 	"telemetry.snapshot" |
@@ -485,6 +503,7 @@ export const procedures = {
 	"spaces.objects": { kind: "query", scope: "library" },
 	"spaces.removeObjects": { kind: "mutation", scope: "library" },
 	"spaces.update": { kind: "mutation", scope: "library" },
+	"sync.fleetStatus": { kind: "query", scope: "node" },
 	"sync.messages": { kind: "query", scope: "library" },
 	"sync.newMessage": { kind: "subscription", scope: "library" },
 	"tags.assign": { kind: "mutation", scope: "library" },

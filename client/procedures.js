@@ -510,6 +510,10 @@ window.SD_PROCEDURES = {
   "kind": "mutation",
   "scope": "library"
  },
+ "sync.fleetStatus": {
+  "kind": "query",
+  "scope": "node"
+ },
  "sync.messages": {
   "kind": "query",
   "scope": "library"

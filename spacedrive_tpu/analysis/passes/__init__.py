@@ -14,6 +14,7 @@ from .jax_wedge import JaxWedgePass
 from .legacy import BareExceptPass, DuplicateDefPass, UnusedImportPass
 from .lock_discipline import LockDisciplinePass
 from .pipeline_ordering import PipelineOrderingPass
+from .queue_discipline import QueueDisciplinePass
 from .resource_leak import ResourceLeakPass
 from .retry_discipline import RetryDisciplinePass
 from .swallowed import SwallowedExceptionPass
@@ -34,6 +35,7 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     CommitDisciplinePass,
     RetryDisciplinePass,
     TelemetryDisciplinePass,
+    QueueDisciplinePass,
 )
 
 

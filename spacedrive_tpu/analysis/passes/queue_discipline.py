@@ -1,0 +1,126 @@
+"""queue-discipline: in-memory queues on the ingest/dispatch paths are bounded.
+
+ISSUE 8's overload postmortem in one sentence: every unbounded queue
+between a peer and a durable write is a memory leak with a workload
+attached. The admission/lane layer (sync/admission.py, sync/lanes.py)
+bounds the CRDT receive path by construction; this pass keeps the
+invariant from regressing anywhere in the production subsystems that sit
+on those paths (``sync|p2p|jobs|pipeline``): a ``queue.Queue()`` /
+``collections.deque()`` constructed **without an explicit bound** is a
+finding.
+
+Mechanics: flag calls to ``queue.Queue`` / ``queue.LifoQueue`` /
+``queue.PriorityQueue`` (dotted or imported bare) whose ``maxsize`` is
+absent, ``0``, or negative (the stdlib's "unbounded" spellings), any use
+of ``queue.SimpleQueue`` (it has no bound at all), and ``deque`` calls
+with no ``maxlen``. Bare names only count when the file actually imports
+them from ``queue``/``collections`` — a local helper named ``deque`` is
+not a queue. A deliberate unbounded queue states its displacement
+argument in a comment and carries a scoped waiver
+(``# lint: ok(queue-discipline)``), e.g. the jobs manager's overflow
+deque (bounded by job-hash dedup, persisted as Queued rows).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+
+SCOPED_DIRS = ("sync", "p2p", "jobs", "pipeline")
+
+#: queue.* constructors taking maxsize (first positional or keyword)
+SIZED = {"Queue", "LifoQueue", "PriorityQueue"}
+#: never boundable — any construction is a finding
+UNSIZABLE = {"SimpleQueue"}
+
+
+def _bare_imports(tree: ast.Module) -> dict[str, str]:
+    """name -> origin module for ``from queue import Queue``-style imports
+    (aliases resolved to the imported symbol's real name)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+                "queue", "collections"):
+            for alias in node.names:
+                out[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+    return out
+
+
+def _is_unbounded_literal(node: ast.expr) -> bool:
+    """The stdlib's explicit "no bound" spellings: 0, negative, None."""
+    if isinstance(node, ast.Constant):
+        return node.value is None or (isinstance(node.value, (int, float))
+                                      and node.value <= 0)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return True  # -1 etc.
+    return False
+
+
+def _classify(call: ast.Call, bare: dict[str, str]) -> str | None:
+    """Return the canonical constructor name ('queue.Queue',
+    'collections.deque', ...) when ``call`` builds a queue, else None."""
+    chain = dotted_name(call.func)
+    if chain is None:
+        return None
+    if "." in chain:
+        mod, _, name = chain.rpartition(".")
+        if mod == "queue" and name in SIZED | UNSIZABLE:
+            return f"queue.{name}"
+        if mod == "collections" and name == "deque":
+            return "collections.deque"
+        return None
+    return bare.get(chain)
+
+
+def _bound_arg(call: ast.Call, canonical: str) -> ast.expr | None:
+    """The expression supplying the bound, or None when absent."""
+    if canonical == "collections.deque":
+        for kw in call.keywords:
+            if kw.arg == "maxlen":
+                return kw.value
+        if len(call.args) >= 2:
+            return call.args[1]
+        return None
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            return kw.value
+    if call.args:
+        return call.args[0]
+    return None
+
+
+class QueueDisciplinePass(AnalysisPass):
+    id = "queue-discipline"
+    description = ("unbounded queue.Queue()/deque() in sync|p2p|jobs|"
+                   "pipeline (overload must shed, not buffer)")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dirs(*SCOPED_DIRS):
+            return
+        bare = _bare_imports(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canonical = _classify(node, bare)
+            if canonical is None:
+                continue
+            if canonical in {f"queue.{n}" for n in UNSIZABLE}:
+                yield ctx.finding(
+                    node.lineno, self.id,
+                    f"{canonical} has no capacity bound at all — use "
+                    "queue.Queue(maxsize=N) so overload sheds instead of "
+                    "buffering")
+                continue
+            bound = _bound_arg(node, canonical)
+            if bound is None or _is_unbounded_literal(bound):
+                kwarg = ("maxlen" if canonical == "collections.deque"
+                         else "maxsize")
+                yield ctx.finding(
+                    node.lineno, self.id,
+                    f"{canonical} constructed without an explicit {kwarg} "
+                    "bound: an unbounded in-memory queue on an ingest/"
+                    "dispatch path turns overload into memory growth — "
+                    "bound it (or waive with a displacement argument)")

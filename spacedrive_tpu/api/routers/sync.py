@@ -17,3 +17,18 @@ def mount(router) -> None:
     @router.library_subscription("sync.newMessage")
     def new_message(node, library, _arg):
         return filtered_subscription(node, {"sync.newMessage"}, library.id)
+
+    @router.query("sync.fleetStatus")
+    def fleet_status(node, _arg):
+        """The fleet-survival surface (ISSUE 8): the node-wide ingest
+        admission budget (ops/bytes in flight vs configured bounds, shed
+        totals) and, per loaded library, the partitioned ingest-lane pool
+        (lane count, bounded queue depths) when one is active."""
+        budget = getattr(node, "ingest_budget", None)
+        libraries = {}
+        for library in node.libraries.list():
+            pool = library.__dict__.get("_ingest_lanes")
+            if pool is not None:
+                libraries[library.id] = pool.status()
+        return {"budget": budget.status() if budget is not None else None,
+                "libraries": libraries}

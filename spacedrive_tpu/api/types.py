@@ -80,6 +80,22 @@ export interface AlertRuleState {
   labels: Record<string, string>; firing: boolean; pending: boolean;
   live_value: number | null; [key: string]: unknown
 }
+/** The node-wide ingest admission budget (sync.fleetStatus). */
+export interface IngestBudgetStatus {
+  budget_ops: number; budget_bytes: number; ops_in_flight: number;
+  bytes_in_flight: number; peers_in_flight: number; shed_windows: number;
+  shed_ops: number
+}
+/** One library's partitioned ingest-lane pool (sync.fleetStatus). */
+export interface IngestLaneStatus {
+  lanes: number; queue_depths: number[]; queue_bound: number;
+  windows: number; submissions: number
+}
+/** sync.fleetStatus: how the node is holding up under fleet load. */
+export interface FleetStatus {
+  budget: IngestBudgetStatus | null;
+  libraries: Record<string, IngestLaneStatus>
+}
 """
 
 #: procedure key -> (arg TS type, result TS type); unlisted keys emit
@@ -182,6 +198,7 @@ TYPES: dict[str, tuple[str, str]] = {
     "p2p.nlmState": ("null", "Record<string, unknown>"),
     "p2p.peers": ("null", "PeerMetadata[]"),
     # sync
+    "sync.fleetStatus": ("null", "FleetStatus"),
     "sync.messages": ("null", "Record<string, unknown>[]"),
     # telemetry
     "telemetry.alerts": ("null", "{ rules: AlertRuleState[] }"),

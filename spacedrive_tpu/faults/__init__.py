@@ -23,12 +23,14 @@ import logging
 import os
 
 from .spec import (INJECTED_ATTR, KINDS, DeviceWedgeError, FaultInjected,
-                   FaultPlan, FaultSpecError)
+                   FaultPlan, FaultSpecError, IngestOverloadError,
+                   PeerBusyError)
 
 __all__ = [
     "DeviceWedgeError", "FaultInjected", "FaultPlan", "FaultSpecError",
-    "INJECTED_ATTR", "KINDS", "active", "clear", "fired", "inject",
-    "install", "is_injected", "reload", "seam_armed",
+    "INJECTED_ATTR", "IngestOverloadError", "KINDS", "PeerBusyError",
+    "active", "clear", "fired", "inject", "install", "is_injected",
+    "reload", "seam_armed",
 ]
 
 logger = logging.getLogger(__name__)

@@ -8,10 +8,12 @@ A spec is a ``;``-separated list of rules, each ``seam:kind[:trigger]``:
   Installed seams: ``gather`` (per-file cas sample read), ``hash`` (the
   identifier's hash dispatch; ``hash_dispatch`` is an accepted alias,
   normalized at parse), ``commit`` (DB transaction begin/commit),
-  ``sync_apply`` (CRDT op materialization), ``p2p_send`` (outbound peer
-  requests), ``relay_probe`` (the jax_guard relay liveness check). The
-  set is open: any string names a seam; rules for seams that never fire
-  are inert.
+  ``sync_apply`` (CRDT op materialization), ``sync_ingest`` (the receive
+  path's admission check — kind ``overload`` synthesizes budget
+  exhaustion there), ``p2p_send`` (outbound peer requests; kind ``busy``
+  synthesizes a peer's BUSY answer), ``relay_probe`` (the jax_guard relay
+  liveness check). The set is open: any string names a seam; rules for
+  seams that never fire are inert.
 - **kind** — which failure to synthesize (:data:`KINDS`); each maps to
   the exception class the real failure mode raises, so the production
   handlers are exercised, not test doubles. ``hang`` blocks instead of
@@ -62,6 +64,28 @@ class DeviceWedgeError(RuntimeError):
     sd_transient = True
 
 
+class PeerBusyError(RuntimeError):
+    """A peer shed our request with an explicit BUSY answer (admission
+    control) — kind ``busy`` synthesizes it at the ``p2p_send`` seam. The
+    caller backs off for ``retry_after_ms`` and resumes from its
+    acknowledged watermark; it must never treat BUSY as a dead peer."""
+
+    sd_transient = True
+    sd_busy = True
+
+    def __init__(self, msg: str, retry_after_ms: int = 250) -> None:
+        super().__init__(msg)
+        self.retry_after_ms = retry_after_ms
+
+
+class IngestOverloadError(RuntimeError):
+    """Injected admission-budget exhaustion (kind ``overload``, seam
+    ``sync_ingest``): forces the receive path's admission check to shed
+    the window exactly as a real over-budget node would."""
+
+    sd_transient = True
+
+
 #: sentinel marker on every injected exception so reports/tests can tell
 #: synthesized faults from organic ones
 INJECTED_ATTR = "sd_injected"
@@ -96,6 +120,8 @@ KINDS: dict[str, Callable[[str], BaseException]] = {
     "wedge": _mk(DeviceWedgeError, "device wedge"),
     "crash": _mk(FaultInjected, "injected crash"),
     "flap": _mk(ConnectionRefusedError, "connection refused"),
+    "busy": _mk(PeerBusyError, "peer busy"),
+    "overload": _mk(IngestOverloadError, "ingest overload"),
     "hang": None,  # type: ignore[dict-item]  # blocks, never raises
 }
 

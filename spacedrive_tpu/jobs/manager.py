@@ -8,10 +8,15 @@ hashing — happens *inside* a step on the TPU, not across jobs. Lanes
 runs thumbnail decode/encode (file I/O + compute, no sync ops) concurrently
 with the default lane's scan chain, so media processing for identified
 prefixes starts while the identifier is still hashing — DB writes still
-serialize on the connection lock. Dedup by job hash (:109-114), queue
-overflow persisted as Queued reports (:162-177), chained-job completion
-(:180-205), and cold resume of Paused/Running/Queued reports at startup
-(:269-319).
+serialize on the connection lock.
+
+Lanes are **per library** (ISSUE 8): the single-writer argument is a
+per-library-DB argument, so capacity is keyed by ``(library.id, LANE)`` —
+one library's scan chain can never starve another library's jobs on a node
+serving a fleet. The occupancy gauge keeps its bounded ``lane`` label
+(summed across libraries). Dedup by job hash (:109-114), queue overflow
+persisted as Queued reports (:162-177), chained-job completion (:180-205),
+and cold resume of Paused/Running/Queued reports at startup (:269-319).
 """
 
 from __future__ import annotations
@@ -46,7 +51,11 @@ class Jobs:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._running: dict[str, Worker] = {}  # job id -> worker
-        self._queue: deque[tuple["Library", DynJob]] = deque()
+        # the overflow queue is deliberately unbounded IN MEMORY but bounded
+        # in practice by job-hash dedup (one entry per distinct job) and
+        # persisted as Queued reports — a maxlen deque would silently DROP
+        # jobs, which is worse than the memory it saves
+        self._queue: deque[tuple["Library", DynJob]] = deque()  # lint: ok(queue-discipline)
         self._shutting_down = False
         self._idle = threading.Event()
         self._idle.set()
@@ -78,20 +87,26 @@ class Jobs:
         self.ingest(library, head)
         return head.id
 
-    def _lane_load(self, lane: str) -> int:
-        """Running workers in ``lane`` (callers hold the lock)."""
+    def _lane_load(self, library_id: str, lane: str) -> int:
+        """Running workers in ``library_id``'s ``lane`` (callers hold the
+        lock) — capacity is per (library, lane), never cross-library."""
         return sum(1 for w in self._running.values()
-                   if w.dyn_job.job.LANE == lane)
+                   if w.library.id == library_id
+                   and w.dyn_job.job.LANE == lane)
 
     def _update_occupancy(self, lane: str) -> None:
-        """Lane-occupancy + queue-depth gauges (callers hold the lock)."""
-        _RUNNING.set(self._lane_load(lane), lane=lane)
+        """Lane-occupancy + queue-depth gauges (callers hold the lock).
+        The gauge sums the lane across libraries: the label set must stay
+        bounded by the lane vocabulary, not the library population."""
+        _RUNNING.set(sum(1 for w in self._running.values()
+                         if w.dyn_job.job.LANE == lane), lane=lane)
         _QUEUED.set(len(self._queue))
 
     def _pop_dispatchable(self) -> tuple["Library", DynJob] | None:
-        """First queued job whose lane has capacity (callers hold the lock)."""
+        """First queued job whose (library, lane) has capacity (callers
+        hold the lock)."""
         for i, (lib, queued) in enumerate(self._queue):
-            if self._lane_load(queued.job.LANE) < MAX_WORKERS:
+            if self._lane_load(lib.id, queued.job.LANE) < MAX_WORKERS:
                 del self._queue[i]
                 return lib, queued
         return None
@@ -112,7 +127,7 @@ class Jobs:
                 if queued.hash() == new_hash:
                     raise JobAlreadyRunning(
                         f"job {dyn_job.job.NAME} already queued (hash {new_hash[:8]})")
-            if self._lane_load(dyn_job.job.LANE) < MAX_WORKERS:
+            if self._lane_load(library.id, dyn_job.job.LANE) < MAX_WORKERS:
                 self._dispatch(library, dyn_job)
             else:
                 dyn_job.report.status = JobStatus.QUEUED

@@ -77,7 +77,10 @@ class Worker:
         self.library = library
         self.dyn_job = dyn_job
         self.report = dyn_job.report
-        self._commands: queue.Queue[str] = queue.Queue()
+        # bounded (queue-discipline): the command vocabulary is 3 deep and
+        # each is idempotent — 32 pending commands already means the job
+        # loop is wedged, and more buffering would not unwedge it
+        self._commands: queue.Queue[str] = queue.Queue(maxsize=32)
         self._thread: threading.Thread | None = None
         self._started_at = 0.0
         self._last_progress_emit = 0.0
@@ -92,7 +95,24 @@ class Worker:
         self._thread.start()
 
     def send_command(self, command: str) -> None:
-        self._commands.put(command)
+        while True:
+            try:
+                self._commands.put_nowait(command)
+                return
+            except queue.Full:
+                # displace the OLDEST pending command: each is idempotent
+                # and the newest reflects current intent — but a pending
+                # cancel must never be lost behind pause/resume toggles,
+                # so displacing a cancel sheds the incoming toggle and
+                # re-queues the cancel in its place
+                try:
+                    dropped = self._commands.get_nowait()
+                except queue.Empty:
+                    continue
+                if dropped == "cancel" and command != "cancel":
+                    dropped, command = command, dropped
+                logger.warning("job %s command queue full; displaced %s",
+                               self.report.id[:8], dropped)
 
     def join(self, timeout: float | None = None) -> None:
         if self._thread is not None:

@@ -82,6 +82,9 @@ class Library:
         remover = getattr(self, "orphan_remover", None)
         if remover is not None:
             remover.stop()
+        pool = self.__dict__.pop("_ingest_lanes", None)
+        if pool is not None:  # partitioned ingest lanes (sync/lanes.py)
+            pool.close()
         self.db.close()
 
 

@@ -101,6 +101,13 @@ class Node:
         self.libraries = Libraries(self.data_dir, node=self)
         self.locations = None  # attached by locations layer
         self.p2p = None  # attached by p2p layer
+        # node-wide admission budget for the CRDT/p2p receive path: every
+        # ingest source (p2p sync responder, remote hash serving, the
+        # fleet harness) admits through this so overload sheds with an
+        # explicit BUSY instead of buffering unboundedly
+        from .sync.admission import IngestBudget
+
+        self.ingest_budget = IngestBudget()
         try:
             from .crypto.keymanager import KeyManager
 

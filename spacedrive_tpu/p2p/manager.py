@@ -801,35 +801,68 @@ class P2PManager:
             writer.write(json_frame({"ok": False, "error": "not a member"}))
             await writer.drain()
             return
+        # node-wide admission budget (shared with the sync receive path):
+        # remote hash batches are ingest too — over budget, the peer gets
+        # an explicit busy answer (with the advised backoff) instead of
+        # this node buffering sum(sizes) more in-flight bytes. The payload
+        # is still drained (bounded) so the refusal, like the membership
+        # one, does not strand bytes in the substream buffer.
+        admission = None
+        budget = getattr(self.node, "ingest_budget", None)
+        if budget is not None:
+            from ..sync.admission import Busy
+
+            verdict = budget.try_admit(mesh.peer_label(peer.identity),
+                                       len(sizes), sum(sizes))
+            if isinstance(verdict, Busy):
+                mesh.record_busy_sent(mesh.peer_label(peer.identity))
+                try:
+                    await asyncio.wait_for(
+                        _read_all_payload(reader, sizes, collect=False),
+                        HASH_PAYLOAD_TIMEOUT)
+                except (asyncio.TimeoutError, asyncio.IncompleteReadError):
+                    pass
+                writer.write(json_frame({
+                    "ok": False, "error": "busy", "busy": True,
+                    "retry_after_ms": verdict.retry_after_ms}))
+                await writer.drain()
+                return
+            admission = verdict
         try:
-            messages = await asyncio.wait_for(
-                _read_all_payload(reader, sizes, collect=True),
-                HASH_PAYLOAD_TIMEOUT)
-        except asyncio.TimeoutError:
-            writer.write(json_frame({"ok": False,
-                                     "error": "payload read timed out"}))
+            try:
+                messages = await asyncio.wait_for(
+                    _read_all_payload(reader, sizes, collect=True),
+                    HASH_PAYLOAD_TIMEOUT)
+            except asyncio.TimeoutError:
+                writer.write(json_frame({"ok": False,
+                                         "error": "payload read timed out"}))
+                await writer.drain()
+                return
+
+            from ..objects.hasher import hash_messages
+
+            loop = asyncio.get_running_loop()
+            # trace propagation: the requester's envelope (if any) parents
+            # our serving span under ITS job trace — `telemetry.jobTrace
+            # <job_id>` on the requesting node then shows where the batch
+            # went, and this node's ring carries the serve under the same
+            # trace_id
+            label = mesh.peer_label(peer.identity)
+            ctx = mesh.TraceContext.from_wire(payload.get("ctx"))
+            trace = mesh.continue_trace(
+                ctx, origin=str(self.node.config.get().get("id") or ""),
+                name="p2p.hash")
+            with mesh.remote_span(trace, ctx, "p2p.hash_serve", peer=label,
+                                  files=len(messages),
+                                  bytes=sum(sizes)):
+                ids = await loop.run_in_executor(None, hash_messages,
+                                                 messages)
+            mesh.record_hash_serve(label, sum(sizes))
+            writer.write(json_frame({"ok": True, "ids": ids}))
             await writer.drain()
-            return
-
-        from ..objects.hasher import hash_messages
-
-        loop = asyncio.get_running_loop()
-        # trace propagation: the requester's envelope (if any) parents our
-        # serving span under ITS job trace — `telemetry.jobTrace <job_id>`
-        # on the requesting node then shows where the batch went, and this
-        # node's ring carries the serve under the same trace_id
-        label = mesh.peer_label(peer.identity)
-        ctx = mesh.TraceContext.from_wire(payload.get("ctx"))
-        trace = mesh.continue_trace(
-            ctx, origin=str(self.node.config.get().get("id") or ""),
-            name="p2p.hash")
-        with mesh.remote_span(trace, ctx, "p2p.hash_serve", peer=label,
-                              files=len(messages),
-                              bytes=sum(sizes)):
-            ids = await loop.run_in_executor(None, hash_messages, messages)
-        mesh.record_hash_serve(label, sum(sizes))
-        writer.write(json_frame({"ok": True, "ids": ids}))
-        await writer.drain()
+        finally:
+            if admission is not None:
+                admission.release()
 
     async def request_hash_batch(self, peer_id: str,
                                  messages: list[bytes],
@@ -854,6 +887,17 @@ class P2PManager:
             await writer.drain()
             reply = await read_json(reader)
             if not reply.get("ok"):
+                if reply.get("busy"):
+                    # the peer's admission budget shed the batch — surface
+                    # the typed BUSY (transient) so the hasher's fallback
+                    # routes the batch to the local engine instead of
+                    # treating the peer as broken
+                    from ..faults import PeerBusyError
+
+                    mesh.record_busy_received(mesh.peer_label(peer_id))
+                    raise PeerBusyError(
+                        "peer hasher busy",
+                        retry_after_ms=int(reply.get("retry_after_ms") or 0))
                 raise ProtocolError(reply.get("error", "hash batch refused"))
             ids = reply["ids"]
             if len(ids) != len(messages):

@@ -19,15 +19,18 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import uuid
 from typing import TYPE_CHECKING, Any
 
 from .. import faults, telemetry
+from ..faults import PeerBusyError
 from ..telemetry import mesh
+from ..utils.retry import RetryPolicy, is_transient
 from .identity import remote_identity_of
-from .proto import (SYNC_NEW_OPERATIONS, Header, main_request_done,
-                    main_request_get_operations, operations_frame, read_exact,
-                    read_json)
+from .proto import (SYNC_NEW_OPERATIONS, Header, main_request_busy,
+                    main_request_done, main_request_get_operations,
+                    operations_frame, read_exact, read_json, read_json_sized)
 
 if TYPE_CHECKING:
     from ..library import Library
@@ -36,6 +39,13 @@ if TYPE_CHECKING:
 logger = logging.getLogger(__name__)
 
 OPS_PER_REQUEST = 1000  # sync/mod.rs responder OPS_PER_REQUEST
+
+#: backoff shape for re-originating a push session after a mid-session
+#: flap or a peer's BUSY answer (utils/retry.py's one policy type; the
+#: sleep itself is asyncio — retry_call's blocking quanta would park the
+#: shared p2p event loop)
+ORIGINATE_RETRY = RetryPolicy(attempts=5, base_s=0.2, max_s=5.0,
+                              budget_s=60.0)
 
 UNAVAILABLE = "Unavailable"
 DISCOVERED = "Discovered"
@@ -49,6 +59,16 @@ class NetworkedLibraries:
         # lib_id -> instance RemoteIdentity str -> {"state", "peer"}
         self._libraries: dict[str, dict[str, dict[str, Any]]] = {}
         self._hooked: set[str] = set()  # libraries whose sync we subscribed
+        # (library_id, peer_id) -> the responder's last-ACKNOWLEDGED HLC
+        # clocks (every GetOperations request declares what is durably
+        # applied; a BUSY frame carries an explicit watermark). A session
+        # retry resumes from this instead of re-pushing applied windows.
+        self._ack_watermarks: dict[tuple[str, str], dict[str, int]] = {}
+        # single-flight latches (p2p event-loop only, no lock needed): a
+        # (library, peer) with a live push session coalesces further
+        # CREATED events into one rerun instead of stacking sessions
+        self._originating: set[tuple[str, str]] = set()
+        self._rerun: set[tuple[str, str]] = set()
 
     def attach(self) -> None:
         """Subscribe to library manager events (replays Load for loaded
@@ -144,6 +164,37 @@ class NetworkedLibraries:
         return {r["node_remote_identity"] for r in library.db.find(Instance)
                 if r.get("node_remote_identity")}
 
+    # -- acknowledged-watermark bookkeeping ----------------------------------
+    def _record_ack(self, library_id: str, peer_id: str,
+                    clocks: Any) -> None:
+        """Fold a responder-declared clock map into the peer's acknowledged
+        watermark (only-raise: clocks are monotone floors of what that peer
+        has DURABLY applied). Every GetOperations request is an implicit
+        ack; a BUSY frame is an explicit one."""
+        if not isinstance(clocks, dict):
+            return
+        wm = self._ack_watermarks.setdefault((library_id, peer_id), {})
+        for pub_id, ts in clocks.items():
+            if isinstance(pub_id, str) and isinstance(ts, int) \
+                    and ts > wm.get(pub_id, 0):
+                wm[pub_id] = ts
+
+    def ack_watermark(self, library_id: str,
+                      peer_id: str) -> dict[str, int] | None:
+        """The last clocks ``peer_id`` acknowledged for ``library_id`` (a
+        copy), or None before any session reached the serve loop."""
+        wm = self._ack_watermarks.get((library_id, peer_id))
+        return dict(wm) if wm is not None else None
+
+    def _acked_everything(self, library: "Library", peer_id: str) -> bool:
+        """True when the peer's acknowledged watermark already covers every
+        op we could serve — a session retry would push zero windows."""
+        wm = self._ack_watermarks.get((library.id, peer_id))
+        if wm is None:
+            return False
+        ops, _has_more = library.sync.get_ops(dict(wm), 1)
+        return not ops
+
     # -- originator (push notify + serve pulls) ------------------------------
     async def originate(self, library: "Library") -> None:
         """Alert every connected MEMBER peer that this library has new ops;
@@ -152,11 +203,98 @@ class NetworkedLibraries:
         members = self.member_nodes(library)
         targets = {p.identity for p in self.manager.peers.values()
                    if p.connected and p.identity in members}
-        for peer_id in targets:
+        # concurrent per peer: one busy/flapping peer's backoff budget
+        # (up to ORIGINATE_RETRY.budget_s) must not delay healthy peers
+        await asyncio.gather(
+            *(self._originate_single_flight(library, p) for p in targets))
+
+    async def _originate_single_flight(self, library: "Library",
+                                       peer_id: str) -> None:
+        """At most one live push session per (library, peer). A burst of
+        CREATED events (every emitted op fires one) used to stack a task
+        per event, each independently re-dialing a peer whose admission
+        control was already shedding load — retry amplification against
+        the node this PR is trying to protect. Now later events coalesce
+        into a single rerun of the running session (which serves from the
+        live op-log, so a rerun only matters for ops that land after its
+        final GetOperations). Latch flips happen between awaits on the one
+        p2p loop — no lock."""
+        key = (library.id, peer_id)
+        if key in self._originating:
+            self._rerun.add(key)
+            return
+        self._originating.add(key)
+        try:
+            while True:
+                self._rerun.discard(key)
+                await self._originate_with_retry(library, peer_id)
+                if key not in self._rerun:
+                    return
+        finally:
+            self._originating.discard(key)
+            self._rerun.discard(key)
+
+    async def _originate_with_retry(self, library: "Library",
+                                    peer_id: str) -> None:
+        """Drive one push session to completion through transient faults.
+
+        A mid-session flap or a peer's BUSY answer used to abandon the push
+        until the next local CREATED event — with admission control that
+        would strand shed windows indefinitely. Retries back off on
+        ORIGINATE_RETRY's jittered schedule (asyncio sleeps: retry_call's
+        blocking quanta would park the shared p2p loop) and RESUME: every
+        GetOperations request and BUSY frame updates the peer's
+        acknowledged HLC watermark, so a retry whose watermark already
+        covers our op-log is dropped outright instead of re-dialing and
+        re-serving applied windows (and re-inflating the peer's declared
+        backlog / sd_sync_peer_lag_ops)."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + ORIGINATE_RETRY.budget_s
+        retries = 0
+        while True:
             try:
                 await self._originate_to(library, peer_id)
+                return
             except Exception as e:
-                logger.debug("sync originate to %s failed: %s", peer_id[:12], e)
+                if not is_transient(e):
+                    logger.debug("sync originate to %s failed: %s",
+                                 peer_id[:12], e)
+                    return
+                retries += 1
+                if retries >= ORIGINATE_RETRY.attempts:
+                    logger.warning("sync originate to %s gave up after %d "
+                                   "attempts: %s", peer_id[:12], retries, e)
+                    return
+                delay = ORIGINATE_RETRY.delay(retries - 1, random)
+                busy = isinstance(e, PeerBusyError)
+                if busy:
+                    # the peer TOLD us when to come back; never earlier
+                    delay = max(delay, e.retry_after_ms / 1000.0)
+                    mesh.record_busy_received(mesh.peer_label(peer_id))
+                if loop.time() + delay > deadline:
+                    logger.warning("sync originate to %s exhausted its "
+                                   "retry budget: %s", peer_id[:12], e)
+                    return
+                # resume-from-watermark: if everything we have is already
+                # acknowledged as durable on the peer, the retry has
+                # nothing to push (the flap ate only the goodbye). A DB
+                # hiccup here (locked under the very load that caused the
+                # retry, library unloaded mid-backoff) must not escape the
+                # wrapper — it just means "can't prove done, retry".
+                try:
+                    done = await loop.run_in_executor(
+                        None, self._acked_everything, library, peer_id)
+                except Exception as check_err:
+                    logger.debug("sync originate to %s: watermark check "
+                                 "failed: %s", peer_id[:12], check_err)
+                    done = False
+                if done:
+                    return
+                logger.debug("sync originate to %s: retry %d in %.2fs "
+                             "after %r", peer_id[:12], retries, delay, e)
+                if busy:
+                    mesh.record_busy_backoff(delay)
+                await asyncio.sleep(delay)
 
     async def _originate_to(self, library: "Library", peer_id: str) -> None:
         # chaos seam for the sync-session dial (raising kinds only; `flap`
@@ -182,9 +320,23 @@ class NetworkedLibraries:
             loop = asyncio.get_running_loop()
             while True:
                 req = await read_json(reader)
-                if req.get("req") != "get_ops":
+                kind = req.get("req")
+                if kind == "busy":
+                    # admission control shed our last window: the frame's
+                    # watermark is an explicit ack of everything durably
+                    # applied — record it, then surface BUSY to the retry
+                    # wrapper (back off retry_after_ms, resume from there)
+                    self._record_ack(library.id, peer_id,
+                                     req.get("watermark"))
+                    raise PeerBusyError(
+                        f"peer {peer_id[:12]} shed the window",
+                        retry_after_ms=int(req.get("retry_after_ms") or 0))
+                if kind != "get_ops":
                     break  # done
                 clocks = req.get("clocks") or {}
+                # the request's clocks are the peer's durable floors — an
+                # implicit acknowledgment of every op at-or-below them
+                self._record_ack(library.id, peer_id, clocks)
                 count = int(req.get("count") or OPS_PER_REQUEST)
 
                 def _serve(clocks=clocks, count=count):
@@ -245,17 +397,22 @@ class NetworkedLibraries:
         if notify != SYNC_NEW_OPERATIONS:
             logger.warning("unexpected sync message %r", notify)
             return
+        from ..sync.admission import Busy
         from ..sync.ingest import Ingester
+        from ..sync.lanes import get_lane_pool, lane_count
 
         ingester = Ingester(library, peer=peer.identity)
+        label = mesh.peer_label(peer.identity)
+        budget = getattr(self.node, "ingest_budget", None)
         loop = asyncio.get_running_loop()
         windows = total_ops = 0
+        shed = False
         last_ctx: mesh.TraceContext | None = None
         while True:
             clocks = await loop.run_in_executor(None, library.sync.timestamps)
             writer.write(main_request_get_operations(clocks, OPS_PER_REQUEST))
             await writer.drain()
-            batch = await read_json(reader)
+            batch, nbytes = await read_json_sized(reader)
             ops = batch.get("ops") or []
             # the sender's trace-context envelope: stitches our apply spans
             # under its serving spans and carries the lag signal
@@ -263,7 +420,37 @@ class NetworkedLibraries:
             if ctx is not None:
                 last_ctx = ctx
             if ops:
-                await loop.run_in_executor(None, ingester.receive, ops, ctx)
+                # admission control: the node-wide ingest budget bounds
+                # (ops, bytes) admitted-but-not-yet-durable across EVERY
+                # concurrent session. Over budget → answer BUSY with our
+                # durable clocks (the ack watermark the originator resumes
+                # from) instead of buffering the window, and end the
+                # session — shed, don't crash.
+                admission = None
+                if budget is not None:
+                    verdict = budget.try_admit(label, len(ops), nbytes)
+                    if isinstance(verdict, Busy):
+                        mesh.record_busy_sent(label)
+                        writer.write(main_request_busy(
+                            verdict.retry_after_ms, clocks))
+                        await writer.drain()
+                        shed = True
+                        break
+                    admission = verdict
+
+                def _apply(ops=ops, ctx=ctx):
+                    if lane_count() > 1:
+                        _applied, advanced = get_lane_pool(library).receive(
+                            ops, ctx, peer=peer.identity)
+                        ingester.last_floor_advanced = advanced
+                    else:
+                        ingester.receive(ops, ctx)
+
+                try:
+                    await loop.run_in_executor(None, _apply)
+                finally:
+                    if admission is not None:
+                        admission.release()  # durable (or rolled back)
                 windows += 1
                 total_ops += len(ops)
                 if not ingester.last_floor_advanced:
@@ -276,8 +463,9 @@ class NetworkedLibraries:
                     break
             if not batch.get("has_more"):
                 break
-        writer.write(main_request_done())
-        await writer.drain()
+        if not shed:
+            writer.write(main_request_done())
+            await writer.drain()
         if last_ctx is not None:
             # persist our half of the stitched trace: the sender's export
             # holds the root + window spans, ours the apply spans — merged
@@ -289,8 +477,8 @@ class NetworkedLibraries:
             if trace is not None:
                 await loop.run_in_executor(
                     None, lambda: mesh.export_partial(trace, node.data_dir))
-        mesh.record_session(ingester._peer_label)
-        telemetry.event("sync.session", peer=ingester._peer_label,
+        mesh.record_session(label)
+        telemetry.event("sync.session", peer=label,
                         library_id=library_id, windows=windows,
                         ops=total_ops)
         self.manager.emit({"type": "SyncIngested", "library_id": library_id,

@@ -54,6 +54,13 @@ async def read_json(reader: asyncio.StreamReader) -> Any:
     return json.loads((await read_frame(reader)).decode())
 
 
+async def read_json_sized(reader: asyncio.StreamReader) -> tuple[Any, int]:
+    """(decoded frame, wire byte length) — the receive-path admission
+    budget accounts bytes from the actual frame size, not an estimate."""
+    raw = await read_frame(reader)
+    return json.loads(raw.decode()), len(raw)
+
+
 def json_frame(obj: Any) -> bytes:
     return frame(json.dumps(obj, separators=(",", ":")).encode())
 
@@ -214,6 +221,18 @@ def main_request_get_operations(clocks: dict[str, int], count: int) -> bytes:
 
 def main_request_done() -> bytes:
     return json_frame({"req": "done"})
+
+
+def main_request_busy(retry_after_ms: int,
+                      watermark: dict[str, int]) -> bytes:
+    """Responder → originator: admission control shed this window.
+    ``watermark`` is the responder's DURABLE per-instance clocks — an
+    explicit acknowledgment of everything applied so far, so the
+    originator resumes from it after ``retry_after_ms`` instead of
+    restarting the push (docs/architecture/robustness.md, "Overload &
+    admission control")."""
+    return json_frame({"req": "busy", "retry_after_ms": int(retry_after_ms),
+                       "watermark": watermark})
 
 
 def operations_frame(ops: list[dict], has_more: bool,

@@ -9,13 +9,16 @@ it to direct calls exactly like the reference's fake-transport test
 (core/crates/sync/tests/lib.rs:102-217).
 """
 
+from .admission import Busy, IngestBudget
 from .crdt import CREATE, DELETE, UPDATE_PREFIX, CRDTOperation, RelationOp, SharedOp, ref
 from .hlc import HLC, ntp64
 from .ingest import Actor, Ingester
+from .lanes import IngestLanes, get_lane_pool, lane_count
 from .manager import SyncManager, SyncMessage
 
 __all__ = [
     "CREATE", "DELETE", "UPDATE_PREFIX", "CRDTOperation", "RelationOp",
-    "SharedOp", "ref", "HLC", "ntp64", "Actor", "Ingester", "SyncManager",
-    "SyncMessage",
+    "SharedOp", "ref", "HLC", "ntp64", "Actor", "Busy", "Ingester",
+    "IngestBudget", "IngestLanes", "SyncManager", "SyncMessage",
+    "get_lane_pool", "lane_count",
 ]

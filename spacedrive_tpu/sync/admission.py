@@ -1,0 +1,216 @@
+"""Admission control for the CRDT receive path: shed, don't buffer.
+
+Before this module the ingest side accepted every window a peer handed it
+and queued the work behind one serialized lane — overload meant unbounded
+memory growth and lag that never drains. The LSHBloom discipline (PAPERS.md,
+arxiv 2411.04257) applies verbatim to streaming ingest: keep a HARD bound
+on in-flight state and degrade explicitly when it is hit.
+
+:class:`IngestBudget` tracks ops and bytes admitted-but-not-yet-durable
+across every ingest source of one node. ``try_admit`` either returns an
+:class:`Admission` token (``release()`` it when the window is durable) or a
+:class:`Busy` verdict carrying ``retry_after_ms`` — the responder answers
+the peer with an explicit BUSY frame instead of buffering the window, and
+the originator backs off and resumes from its acknowledged watermark
+(p2p/nlm.py; docs/architecture/robustness.md "Overload & admission
+control").
+
+Fairness: the budget is shared, but a peer with NOTHING in flight that
+asks for less than its fair share (budget ÷ peers currently in flight) is
+never shed — only the hard global bound sheds a peer that already holds
+in-flight work, even an under-share one. A flooding peer therefore absorbs
+the shedding while well-behaved peers keep draining — the per-peer
+fairness gate in tests/test_fleet.py rests on this.
+
+The ``sync_ingest`` fault seam lives at the admission check: an armed
+``sync_ingest:overload`` rule sheds windows exactly as a real over-budget
+node would, which is how the fleet chaos soak exercises the whole
+BUSY/backoff/resume loop deterministically.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+
+from .. import faults, telemetry
+
+#: default ops admitted-but-not-yet-durable across all peers (≈ four
+#: production pull windows); bytes default sized for JSON-framed windows
+DEFAULT_BUDGET_OPS = int(os.environ.get("SD_SYNC_INGEST_BUDGET_OPS", "4000"))
+DEFAULT_BUDGET_BYTES = int(os.environ.get("SD_SYNC_INGEST_BUDGET_BYTES",
+                                          str(32 * 1024 * 1024)))
+#: what a shed peer is told to wait before resuming (ms); scaled up with
+#: how far over budget the node is
+BASE_RETRY_AFTER_MS = int(os.environ.get("SD_SYNC_RETRY_AFTER_MS", "200"))
+
+_SHED_WINDOWS = telemetry.counter(
+    "sd_sync_shed_windows_total",
+    "ingest windows answered BUSY instead of buffered", labels=("peer",))
+_SHED_OPS = telemetry.counter(
+    "sd_sync_shed_ops_total",
+    "CRDT ops shed by admission control (re-served after backoff)",
+    labels=("peer",))
+_ADMIT_OPS = telemetry.gauge(
+    "sd_sync_admission_ops_in_flight",
+    "CRDT ops admitted but not yet durable")
+_ADMIT_BYTES = telemetry.gauge(
+    "sd_sync_admission_bytes_in_flight",
+    "window bytes admitted but not yet durable")
+_BUDGET_OPS = telemetry.gauge(
+    "sd_sync_admission_budget_ops", "configured ingest budget (ops)")
+_BUDGET_BYTES = telemetry.gauge(
+    "sd_sync_admission_budget_bytes", "configured ingest budget (bytes)")
+
+
+@dataclass(frozen=True)
+class Busy:
+    """The shed verdict: tell the peer when to come back. ``watermark`` is
+    filled in by the session layer (the receiver's durable clocks — the
+    acknowledgment the originator resumes from)."""
+
+    retry_after_ms: int
+    reason: str = "over budget"
+
+
+class Admission:
+    """Token for one admitted window; ``release()`` exactly once when the
+    window's ops are durable (or abandoned)."""
+
+    __slots__ = ("_budget", "_peer", "_ops", "_bytes", "_released")
+
+    def __init__(self, budget: "IngestBudget", peer: str, ops: int,
+                 nbytes: int) -> None:
+        self._budget = budget
+        self._peer = peer
+        self._ops = ops
+        self._bytes = nbytes
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._budget._release(self._peer, self._ops, self._bytes)
+
+    def __enter__(self) -> "Admission":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class IngestBudget:
+    """Bounded (ops, bytes) in flight across every ingest source of a node.
+
+    Thread-safe; the p2p responder, the pull Actor's remote path, and the
+    fleet harness's wire-less sessions all admit through one instance per
+    node (``Node.ingest_budget``)."""
+
+    def __init__(self, max_ops: int = DEFAULT_BUDGET_OPS,
+                 max_bytes: int = DEFAULT_BUDGET_BYTES) -> None:
+        self.max_ops = max(1, int(max_ops))
+        self.max_bytes = max(1, int(max_bytes))
+        self._lock = threading.Lock()
+        self._ops = 0
+        self._bytes = 0
+        #: peer label -> (ops, bytes) currently in flight
+        self._per_peer: dict[str, tuple[int, int]] = {}
+        self._shed_windows = 0
+        self._shed_ops = 0
+        _BUDGET_OPS.set(self.max_ops)
+        _BUDGET_BYTES.set(self.max_bytes)
+
+    # -- admission -----------------------------------------------------------
+    def try_admit(self, peer: str, ops: int,
+                  nbytes: int = 0) -> Admission | Busy:
+        """Admit ``ops``/``nbytes`` for ``peer`` or return a Busy verdict.
+        A window larger than the whole budget is still admitted when the
+        node is otherwise idle (a peer must always be able to make
+        progress; the bound is on BUFFERED work, not window size)."""
+        try:
+            # chaos seam: an armed sync_ingest rule sheds this window (any
+            # raising kind — `overload` is the canonical one)
+            faults.inject("sync_ingest", key=peer)
+        except Exception:
+            return self._shed(peer, ops, "injected overload")
+        with self._lock:
+            p_ops, p_bytes = self._per_peer.get(peer, (0, 0))
+            active = len(self._per_peer) + (0 if peer in self._per_peer
+                                            else 1)
+            over_global = (self._ops + ops > self.max_ops
+                           or self._bytes + nbytes > self.max_bytes)
+            if over_global and self._ops == 0 and self._bytes == 0:
+                over_global = False  # idle node: oversized windows admit
+            # fairness floor: a peer under its fair share (ops AND bytes)
+            # is only shed by the hard global bound when it ALREADY holds
+            # in-flight work — so total in-flight can overshoot the budget
+            # by at most one sub-share window per fresh source
+            fair_ops = self.max_ops // max(1, active)
+            fair_bytes = self.max_bytes // max(1, active)
+            under_share = (p_ops + ops <= max(fair_ops, 1)
+                           and p_bytes + nbytes <= max(fair_bytes, 1))
+            if over_global and (not under_share or p_ops > 0):
+                pressure = self._shed_locked(ops)
+            else:
+                self._ops += ops
+                self._bytes += nbytes
+                self._per_peer[peer] = (p_ops + ops, p_bytes + nbytes)
+                pressure = None
+        if pressure is not None:
+            return self._busy(peer, ops, pressure, "over budget")
+        self._publish()
+        return Admission(self, peer, ops, nbytes)
+
+    def _shed_locked(self, ops: int) -> float:
+        """Shed bookkeeping (callers hold the lock); returns the pressure
+        factor scaling the advised backoff so a storm of shed peers
+        decorrelates instead of re-dialing in lockstep."""
+        self._shed_windows += 1
+        self._shed_ops += ops
+        return max(1.0, self._ops / self.max_ops)
+
+    def _shed(self, peer: str, ops: int, reason: str) -> Busy:
+        with self._lock:
+            pressure = self._shed_locked(ops)
+        return self._busy(peer, ops, pressure, reason)
+
+    def _busy(self, peer: str, ops: int, pressure: float,
+              reason: str) -> Busy:
+        _SHED_WINDOWS.inc(peer=peer)
+        _SHED_OPS.inc(ops, peer=peer)
+        telemetry.event("sync.shed", peer=peer, ops=ops, reason=reason)
+        return Busy(retry_after_ms=int(BASE_RETRY_AFTER_MS * pressure),
+                    reason=reason)
+
+    def _release(self, peer: str, ops: int, nbytes: int) -> None:
+        with self._lock:
+            self._ops = max(0, self._ops - ops)
+            self._bytes = max(0, self._bytes - nbytes)
+            p_ops, p_bytes = self._per_peer.get(peer, (0, 0))
+            p_ops, p_bytes = max(0, p_ops - ops), max(0, p_bytes - nbytes)
+            if p_ops == 0 and p_bytes == 0:
+                self._per_peer.pop(peer, None)
+            else:
+                self._per_peer[peer] = (p_ops, p_bytes)
+        self._publish()
+
+    def _publish(self) -> None:
+        with self._lock:
+            ops, nbytes = self._ops, self._bytes
+        _ADMIT_OPS.set(ops)
+        _ADMIT_BYTES.set(nbytes)
+
+    # -- introspection (the fleet status surface) ----------------------------
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "budget_ops": self.max_ops,
+                "budget_bytes": self.max_bytes,
+                "ops_in_flight": self._ops,
+                "bytes_in_flight": self._bytes,
+                "peers_in_flight": len(self._per_peer),
+                "shed_windows": self._shed_windows,
+                "shed_ops": self._shed_ops,
+            }

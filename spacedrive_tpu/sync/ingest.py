@@ -75,6 +75,10 @@ _OPS_APPLIED = telemetry.counter(
 _WINDOW_SECONDS = telemetry.histogram(
     "sd_sync_window_seconds", "latency of one ingest window",
     labels=("peer",))
+_SHED_REPLAYS = telemetry.counter(
+    "sd_sync_shed_replays_total",
+    "known-poison replays deferred past the per-round fairness cap",
+    labels=("peer",))
 
 
 def _update_field(kind: str) -> str | None:
@@ -83,6 +87,15 @@ def _update_field(kind: str) -> str | None:
 
 class Ingester:
     """Synchronous core (usable inline); Actor wraps it in a thread."""
+
+    #: fairness bound on poison REPLAYS per ingest pass: ops that already
+    #: failed in a previous round get at most this many re-attempts per
+    #: round before the rest are deferred (floor capped, no DB work), so a
+    #: hot poisoned record can never starve live ingest of fresh windows
+    REPLAY_OPS_PER_ROUND = 64
+    #: memory bound on the known-poison id set (oldest half evicted past
+    #: this — an evicted id simply counts as fresh again next round)
+    POISON_MEMORY = 4096
 
     def __init__(self, library: "Library", reference_mode: bool = False,
                  peer: str | None = None) -> None:
@@ -96,7 +109,18 @@ class Ingester:
         self._ops_applied = _OPS_APPLIED.labels(peer=self._peer_label)
         self._window_seconds = _WINDOW_SECONDS.labels(peer=self._peer_label)
         self._apply_delay = mesh.apply_delay_series(self._peer_label)
+        self._shed_replays = _SHED_REPLAYS.labels(peer=self._peer_label)
         self._fresh_ts: list[int] = []
+        #: op ids that poisoned in an earlier round (id -> failure count);
+        #: replays of these are fairness-capped per round (REPLAY_OPS_PER_
+        #: ROUND) and the whole batch skips the optimistic pass (a known
+        #: poison would abort it every time — pure wasted savepoint work)
+        self._poison_seen: dict[str, int] = {}
+        #: lane mode (set by sync/lanes.py): receive() skips floor
+        #: persistence and window-level mesh recording, accumulating the
+        #: observed clocks/caps for the dispatcher to merge across lanes
+        self.deferred_clocks: dict[str, int] = {}
+        self.deferred_caps: dict[str, int] = {}
         #: reference-faithful ingestion (benchmark baseline): per-op
         #: arbitration queries and per-op savepoints, exactly the shape of
         #: the reference's receive_crdt_operation loop
@@ -369,13 +393,22 @@ class Ingester:
         return self.library.id
 
     def receive(self, wire_ops: list[dict[str, Any]],
-                ctx: "mesh.TraceContext | None" = None) -> int:
+                ctx: "mesh.TraceContext | None" = None,
+                defer_clocks: bool = False) -> int:
         """Ingest a batch; returns the number of ops with materialized
         effect (shadowed ops are still logged). ``ctx`` is the sender's
         trace-context envelope: when present, this window's apply span
         parents under the sender's serving span (stitched by trace_id)
         and the per-peer convergence-lag gauges update from its HLC
-        watermark and declared backlog."""
+        watermark and declared backlog.
+
+        ``defer_clocks`` is the lane-shard mode (sync/lanes.py): the
+        instance clock floors are NOT persisted here — the observed
+        clocks and poison caps accumulate into ``deferred_clocks`` /
+        ``deferred_caps`` for the dispatcher to merge across every lane
+        of the window (a poison in one lane must cap the floor even when
+        another lane applied later ops from the same instance) — and the
+        window-level mesh/lag recording is left to the dispatcher."""
         db = self.library.db
         sync = self.library.sync
         window_t0 = time.perf_counter()
@@ -416,16 +449,27 @@ class Ingester:
         # the documented poison/floor semantics. Both passes are
         # deterministic over the same prefetched state, so a clean optimistic
         # pass is bit-identical to what the careful pass would have done.
+        # a batch carrying known-poison replays skips the optimistic pass:
+        # the poison would abort it deterministically, paying a full batch
+        # savepoint rollback before every careful re-run
+        has_known_poison = (bool(self._poison_seen)
+                            and any(op.id in self._poison_seen
+                                    for op in decoded))
         try:
             with db.transaction():
                 if self.reference_mode:
-                    applied, seen_clocks = self._ingest_pass(decoded, careful=True)
+                    applied, seen_clocks, caps = self._ingest_pass(
+                        decoded, careful=True)
+                elif has_known_poison:
+                    self._prefetch(decoded)
+                    applied, seen_clocks, caps = self._ingest_pass(
+                        decoded, careful=True)
                 else:
                     self._prefetch(decoded)
                     db.execute("SAVEPOINT ingest_batch")
                     try:
-                        applied, seen_clocks = self._ingest_pass(decoded,
-                                                                 careful=False)
+                        applied, seen_clocks, caps = self._ingest_pass(
+                            decoded, careful=False)
                         db.execute("RELEASE ingest_batch")
                     except Exception:
                         db.execute("ROLLBACK TO ingest_batch")
@@ -436,15 +480,26 @@ class Ingester:
                         # rows the id-memo already recorded
                         sync._instance_ids.clear()
                         self._prefetch(decoded)  # DB rolled back: rebuild
-                        applied, seen_clocks = self._ingest_pass(decoded,
-                                                                 careful=True)
-                # persist per-origin clocks (ingest.rs:136-159)
-                self.last_floor_advanced = False
-                for pub_id, ts in seen_clocks.items():
-                    row = db.find_one(Instance, {"pub_id": pub_id})
-                    if row is not None and (row["timestamp"] or 0) < ts:
-                        db.update(Instance, {"pub_id": pub_id}, {"timestamp": ts})
-                        self.last_floor_advanced = True
+                        applied, seen_clocks, caps = self._ingest_pass(
+                            decoded, careful=True)
+                if defer_clocks:
+                    # lane mode: accumulate for the dispatcher's cross-lane
+                    # merge (floors only-raise; caps only-lower)
+                    for pub_id, ts in seen_clocks.items():
+                        if ts > self.deferred_clocks.get(pub_id, 0):
+                            self.deferred_clocks[pub_id] = ts
+                    for pub_id, cap in caps.items():
+                        self.deferred_caps[pub_id] = min(
+                            self.deferred_caps.get(pub_id, cap), cap)
+                else:
+                    # persist per-origin clocks (ingest.rs:136-159)
+                    self.last_floor_advanced = False
+                    for pub_id, ts in seen_clocks.items():
+                        row = db.find_one(Instance, {"pub_id": pub_id})
+                        if row is not None and (row["timestamp"] or 0) < ts:
+                            db.update(Instance, {"pub_id": pub_id},
+                                      {"timestamp": ts})
+                            self.last_floor_advanced = True
         finally:
             # caches are batch-scoped; standalone method calls stay query-based
             self._shared_hist = self._rel_hist = None
@@ -458,14 +513,16 @@ class Ingester:
             apply_span.set(applied=applied)
             apply_span.__exit__(*sys.exc_info())
         self._ops_applied.inc(applied)
-        self._window_seconds.observe(time.perf_counter() - window_t0)
         # convergence lag + end-to-end delay, from the envelope and the
         # ops' own HLC stamps (per-op observe is a bisect+lock; the window
         # is the unit of everything else). Delay counts only ops durably
         # logged THIS window: duplicates and poison replays are not
-        # fresh applies.
-        max_ts = max((op.timestamp for op in decoded), default=0)
-        mesh.record_ingest_window(self._peer_label, ctx, max_ts)
+        # fresh applies. In lane mode the DISPATCHER records the window
+        # (each lane only saw a shard of it).
+        if not defer_clocks:
+            self._window_seconds.observe(time.perf_counter() - window_t0)
+            max_ts = max((op.timestamp for op in decoded), default=0)
+            mesh.record_ingest_window(self._peer_label, ctx, max_ts)
         if telemetry.enabled():
             now_unix = time.time()
             for ts in self._fresh_ts:
@@ -475,13 +532,16 @@ class Ingester:
             sync._broadcast(SyncMessage.INGESTED)
         return applied
 
-    def _ingest_pass(self, decoded: list[CRDTOperation],
-                     careful: bool) -> tuple[int, dict[str, int]]:
+    def _ingest_pass(self, decoded: list[CRDTOperation], careful: bool
+                     ) -> tuple[int, dict[str, int], dict[str, int]]:
         db = self.library.db
         sync = self.library.sync
         applied = 0
         seen_clocks: dict[str, int] = {}
         pending_log: list[CRDTOperation] = []
+        #: replay fairness budget: re-attempts of KNOWN-poison ops this
+        #: pass; fresh ops never count against it
+        replay_budget = self.REPLAY_OPS_PER_ROUND
         # reset per PASS: an aborted optimistic pass rolls its log rows
         # back, so its entries must not survive into the careful re-run
         self._fresh_ts = []
@@ -547,6 +607,19 @@ class Ingester:
                 if effect:
                     applied += 1
                 continue
+            # replay fairness cap (satellite of ISSUE 8): an op that
+            # already poisoned in an earlier round gets a bounded number
+            # of re-attempts per round; past the budget it is deferred
+            # outright (floor capped as if it failed again, zero DB work)
+            # so a hot poisoned record cannot starve the fresh tail of
+            # the window
+            replayed = op.id in self._poison_seen
+            if replayed:
+                if replay_budget <= 0:
+                    _poison(op.instance, op.timestamp)
+                    self._shed_replays.inc()
+                    continue
+                replay_budget -= 1
             # per-op savepoint: effect + log commit or roll back as a
             # unit — an applied-but-unlogged op would be invisible to
             # future arbitration and never propagate transitively
@@ -602,9 +675,12 @@ class Ingester:
                     self._known_instances.discard(op.instance)
                 sync._instance_ids.pop(op.instance, None)
                 _poison(op.instance, op.timestamp)
+                self._remember_poison(op.id)
                 logger.exception("sync ingest skipped poison op %s", op.id)
                 continue
             db.execute("RELEASE ingest_op")
+            if replayed:
+                self._poison_seen.pop(op.id, None)  # healed
             self._cache_logged(op)
             self._fresh_ts.append(op.timestamp)
             # advance the clock floor only once the op is durably logged
@@ -613,7 +689,19 @@ class Ingester:
                 applied += 1
         if pending_log:
             sync.log_ops(pending_log)
-        return applied, seen_clocks
+        return applied, seen_clocks, poison_cap
+
+    def _remember_poison(self, op_id: str) -> None:
+        # pop+reinsert so a repeat offender moves to the back of the
+        # insertion order: eviction below is then LRU — it drops ids not
+        # seen poisoning recently, never the hot still-failing ones the
+        # replay cap and optimistic-pass skip exist for
+        self._poison_seen[op_id] = self._poison_seen.pop(op_id, 0) + 1
+        if len(self._poison_seen) > self.POISON_MEMORY:
+            # evict the oldest half (insertion order); an evicted id just
+            # counts as fresh on its next replay
+            for k in list(self._poison_seen)[: self.POISON_MEMORY // 2]:
+                del self._poison_seen[k]
 
 
 class Actor:
@@ -626,18 +714,27 @@ class Actor:
         self.library = library
         self.transport = transport
         self.batch = batch
-        self._wake: queue.Queue[object | None] = queue.Queue()
+        # wakes COALESCE: one pending wake already guarantees a full pull
+        # round, so the queue stays bounded no matter how fast notify()
+        # fires (the sdlint queue-discipline invariant)
+        self._wake: queue.Queue[object | None] = queue.Queue(maxsize=4)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"sync-ingest-{library.id[:8]}")
         self._stopped = False
         self._thread.start()
 
     def notify(self) -> None:
-        self._wake.put(object())
+        try:
+            self._wake.put_nowait(object())
+        except queue.Full:
+            pass  # a wake is already pending; this one is subsumed
 
     def stop(self) -> None:
         self._stopped = True
-        self._wake.put(None)
+        try:
+            self._wake.put_nowait(None)
+        except queue.Full:
+            pass  # queue non-empty: the loop will see _stopped on next get
         self._thread.join(timeout=5)
 
     def _run(self) -> None:
@@ -675,11 +772,21 @@ class Actor:
                     # windows (per-window receive() semantics preserved):
                     # small pull windows no longer pay a WAL commit each
                     # (the 3× batch=100 tax), and the DB lock is never held
-                    # across a (possibly remote, possibly hung) transport
+                    # across a (possibly remote, possibly hung) transport.
+                    # With SD_SYNC_INGEST_LANES > 1 the windows go through
+                    # the library's partitioned lane pool instead.
                     if windows:
-                        with self.ingester.session():
-                            for ops in windows:
-                                self.ingester.receive(ops)
+                        from .lanes import get_lane_pool, lane_count
+
+                        if lane_count() > 1:
+                            pool = get_lane_pool(self.library)
+                            _, advanced = pool.receive_many(
+                                [(ops, None) for ops in windows])
+                            self.ingester.last_floor_advanced = advanced
+                        else:
+                            with self.ingester.session():
+                                for ops in windows:
+                                    self.ingester.receive(ops)
                         if not self.ingester.last_floor_advanced:
                             # the final window was entirely skipped — the
                             # durable floors did not move, so the transport

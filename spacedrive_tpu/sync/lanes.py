@@ -1,0 +1,421 @@
+"""Partitioned sync ingest: shard windows into K independent apply lanes.
+
+Until ISSUE 8 the CRDT ingest path was one serialized lane: every window
+from every peer queued behind a single ``Ingester.receive``. This module is
+the SEDD shape (PAPERS.md, arxiv 2501.01046 — many independent shards
+batched through one accelerator-adjacent node) applied to ingest: incoming
+windows are sharded by **(model, record-id prefix)** into K lanes, each a
+worker thread with its own bounded queue and its own per-peer
+:class:`~.ingest.Ingester` (session-txn batching from PR 3 intact), so
+independent records arbitrate and apply concurrently while records that
+share arbitration history never split across lanes.
+
+Why this is convergence-safe (the K∈{1,4} byte-identity gate):
+
+- arbitration is strictly **per record** — a shared op's effect depends
+  only on its own record's logged history, and one record's ops always
+  land in one lane, in window order;
+- arrival order across records provably does not matter (the 4!-
+  permutation test in tests/test_sync.py) — lanes only reorder across
+  records;
+- ops whose application READS other records (relation ops linking two
+  endpoints, shared ops carrying ``ref`` FK markers) are deferred to a
+  **second wave** applied after every lane of the window drains, so a
+  referenced row created elsewhere in the same window is present exactly
+  as it would be under serial timestamp-ordered apply;
+- instance clock floors are merged across lanes after the barrier — a
+  poison in one lane caps the floor below itself even when another lane
+  applied later ops from the same instance — and persisted only once all
+  lane transactions committed (floors never run ahead of durability).
+
+The pool is **per library** (the apply side is single-writer per library
+DB; lanes overlap decode, prefetch SELECTs on the reader connection, and
+arbitration while durable writes serialize on the writer lock) and shared
+by every ingest source: the pull Actor, p2p responder sessions, and the
+fleet harness all submit to the same K lanes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any
+
+from .. import telemetry
+from ..models import Instance
+from ..telemetry import mesh
+from .crdt import is_ref
+from .ingest import _WINDOW_SECONDS, Ingester
+
+if TYPE_CHECKING:
+    from ..library import Library
+
+logger = logging.getLogger(__name__)
+
+#: K — 1 keeps the exact pre-lane single-path behavior (the default)
+LANES_ENV = "SD_SYNC_INGEST_LANES"
+#: bounded depth of each lane's work queue (submissions, not ops); a full
+#: lane applies backpressure to the submitter, never unbounded buffering
+DEPTH_ENV = "SD_SYNC_LANE_DEPTH"
+MAX_LANES = 16
+
+_LANE_COUNT = telemetry.gauge(
+    "sd_sync_ingest_lane_count", "configured sync ingest apply lanes")
+_LANE_DEPTH = telemetry.gauge(
+    "sd_sync_ingest_lane_depth", "queued submissions per ingest lane",
+    labels=("lane",))
+_LANE_BUSY = telemetry.gauge(
+    "sd_sync_ingest_lane_busy", "1 while the lane is applying a shard",
+    labels=("lane",))
+_LANE_OPS = telemetry.counter(
+    "sd_sync_ingest_lane_ops_total", "CRDT ops applied per ingest lane",
+    labels=("lane",))
+
+
+def lane_count() -> int:
+    try:
+        n = int(os.environ.get(LANES_ENV, "1"))
+    except ValueError:
+        return 1
+    return max(1, min(MAX_LANES, n))
+
+
+def _lane_depth() -> int:
+    try:
+        n = int(os.environ.get(DEPTH_ENV, "8"))
+    except ValueError:
+        return 8
+    return max(1, n)
+
+
+def _has_ref(data: Any) -> bool:
+    if is_ref(data):
+        return True
+    if isinstance(data, dict):
+        return any(is_ref(v) for v in data.values())
+    return False
+
+
+def lane_key(wire: dict[str, Any], lanes: int) -> int | None:
+    """Shard index for one wire op, or ``None`` for the deferred second
+    wave (ops whose APPLICATION reads other records: relation links and
+    ``ref``-carrying shared ops). Sharding is (model, record-id prefix) —
+    deterministic, so a poisoned record replays into the same lane."""
+    typ = wire.get("typ")
+    if not isinstance(typ, dict):
+        return 0  # malformed: any lane may drop it
+    if typ.get("_t") == "relation":
+        return None
+    if _has_ref(typ.get("data")):
+        return None
+    key = f"{typ.get('model')}\x00{str(typ.get('record_id'))[:8]}"
+    return zlib.crc32(key.encode("utf-8", "replace")) % lanes
+
+
+@dataclass
+class _LaneTask:
+    """One lane's share of a submission: the per-window shards, in window
+    order, applied under one session transaction."""
+
+    ingester: Ingester
+    parts: list[tuple[list[dict[str, Any]], Any]]
+    done: threading.Event = field(default_factory=threading.Event)
+    applied: int = 0
+    clocks: dict[str, int] = field(default_factory=dict)
+    caps: dict[str, int] = field(default_factory=dict)
+    error: BaseException | None = None
+
+
+class IngestLanes:
+    """K apply lanes over one library. ``receive``/``receive_many`` block
+    until the submission is durable and the merged clock floors are
+    persisted — the submitter (a p2p session, the Actor round) keeps its
+    at-most-one-window-in-flight admission semantics."""
+
+    def __init__(self, library: "Library", lanes: int | None = None,
+                 depth: int | None = None) -> None:
+        self.library = library
+        self.lanes = lanes if lanes is not None else lane_count()
+        self._depth = depth if depth is not None else _lane_depth()
+        self._lock = threading.Lock()
+        #: (peer, lane index) -> Ingester — an ingester's batch caches and
+        #: poison memory are single-threaded state, so each is owned by
+        #: exactly one lane thread (plus one wave-2 ingester per peer,
+        #: used only on submitter threads under _wave2_lock)
+        self._ingesters: dict[tuple[str | None, int], Ingester] = {}
+        self._queues: list[queue.Queue[_LaneTask | None]] = []
+        self._threads: list[threading.Thread] = []
+        self._wave2_lock = threading.Lock()
+        self._closed = False
+        self._windows = 0
+        self._submissions = 0
+        if self.lanes > 1:
+            for i in range(self.lanes):
+                q: queue.Queue[_LaneTask | None] = queue.Queue(
+                    maxsize=self._depth)
+                t = threading.Thread(
+                    target=self._worker, args=(i, q), daemon=True,
+                    name=f"sync-lane-{library.id[:8]}-{i}")
+                self._queues.append(q)
+                self._threads.append(t)
+                t.start()
+        _LANE_COUNT.set(self.lanes)
+
+    # -- public entry points -------------------------------------------------
+    def receive(self, ops: list[dict[str, Any]], ctx=None,
+                peer: str | None = None) -> tuple[int, bool]:
+        """One window. Returns (applied, floor_advanced)."""
+        return self.receive_many([(ops, ctx)], peer=peer)
+
+    def receive_many(self, windows: list[tuple[list[dict[str, Any]], Any]],
+                     peer: str | None = None) -> tuple[int, bool]:
+        """Apply several buffered windows (the Actor's flush group) as one
+        submission. Window order is preserved within every lane."""
+        if not windows:
+            return 0, False
+        if self.lanes <= 1:
+            return self._receive_serial(windows, peer)
+        t0 = time.perf_counter()
+        self._submissions += 1
+        label = mesh.peer_label(peer)
+        # shard every window; wave-2 ops keep original (window, op) order
+        lane_parts: list[list[tuple[list[dict[str, Any]], Any]]] = [
+            [] for _ in range(self.lanes)]
+        wave2: list[tuple[list[dict[str, Any]], Any]] = []
+        for ops, ctx in windows:
+            shards: list[list[dict[str, Any]]] = [
+                [] for _ in range(self.lanes)]
+            deferred: list[dict[str, Any]] = []
+            for wire in ops:
+                idx = lane_key(wire, self.lanes)
+                if idx is None:
+                    deferred.append(wire)
+                else:
+                    shards[idx].append(wire)
+            for i, shard in enumerate(shards):
+                if shard:
+                    lane_parts[i].append((shard, ctx))
+            if deferred:
+                wave2.append((deferred, ctx))
+
+        # wave 1: fan out, barrier on every lane (bounded queues: a
+        # saturated lane blocks the submitter — backpressure, not buffering)
+        tasks: list[tuple[int, _LaneTask]] = []
+        for i, parts in enumerate(lane_parts):
+            if not parts:
+                continue
+            task = _LaneTask(self._ingester(peer, i), parts)
+            while True:
+                if self._closed:
+                    raise RuntimeError("ingest lane pool is closed")
+                try:
+                    self._queues[i].put(task, timeout=1.0)
+                    break
+                except queue.Full:
+                    continue
+            _LANE_DEPTH.set(self._queues[i].qsize(), lane=str(i))
+            tasks.append((i, task))
+        for _i, task in tasks:
+            while not task.done.wait(timeout=1.0):
+                # close() fails drained tasks; a task that raced in after
+                # the drain would otherwise strand this submitter forever
+                if self._closed and not task.done.wait(timeout=2.0):
+                    raise RuntimeError(
+                        "ingest lane pool closed with a submission "
+                        "in flight")
+
+        applied = sum(t.applied for _i, t in tasks)
+        merged_clocks: dict[str, int] = {}
+        merged_caps: dict[str, int] = {}
+        first_error: BaseException | None = None
+        for _i, task in tasks:
+            if task.error is not None:
+                first_error = first_error or task.error
+                continue
+            for pub_id, ts in task.clocks.items():
+                if ts > merged_clocks.get(pub_id, 0):
+                    merged_clocks[pub_id] = ts
+            for pub_id, cap in task.caps.items():
+                merged_caps[pub_id] = min(merged_caps.get(pub_id, cap), cap)
+
+        # wave 2: ops that read other records apply AFTER the barrier, in
+        # original order, on the submitter thread (serialized per pool so
+        # two sessions' wave-2 shards cannot interleave one ingester)
+        if wave2 and first_error is None:
+            w2 = self._ingester(peer, -1)
+            try:
+                with self._wave2_lock, w2.session():
+                    for ops, ctx in wave2:
+                        applied += w2.receive(ops, ctx, defer_clocks=True)
+                clocks, caps = self._take_deferred(w2)
+                for pub_id, ts in clocks.items():
+                    if ts > merged_clocks.get(pub_id, 0):
+                        merged_clocks[pub_id] = ts
+                for pub_id, cap in caps.items():
+                    merged_caps[pub_id] = min(
+                        merged_caps.get(pub_id, cap), cap)
+            except Exception as e:  # lane-equivalent failure: floors hold
+                # the rolled-back session's deferred clocks must not
+                # linger on the shared wave-2 ingester — a later
+                # submission would merge them and advance floors past
+                # ops that were never durably logged
+                self._take_deferred(w2)
+                first_error = e
+
+        # cross-lane floor merge: only-raise, then poison caps only-lower.
+        # If ANY lane failed, persist NOTHING: the failed lane may hold
+        # earlier ops from the same origin instance as a lane that
+        # committed, and advancing the floor past them would lose them
+        # forever (the committed lanes' ops are durably LOGGED, so the
+        # idempotent re-pull skips them as duplicates — floors catch up
+        # on the retry).
+        if first_error is not None:
+            raise first_error
+        for pub_id, cap in merged_caps.items():
+            if merged_clocks.get(pub_id, 0) > cap:
+                merged_clocks[pub_id] = cap
+        advanced = self._persist_floors(merged_clocks)
+
+        # window-level mesh recording (the lanes skipped it): lag gauges
+        # from the LAST window's envelope, window count per window. No
+        # window is durable before the barrier + floor merge, so the
+        # submission's wall time is split across its windows — count
+        # matches the serial path's one-observe-per-window and the _sum
+        # stays the real wall time, not windows× it.
+        elapsed = time.perf_counter() - t0
+        window_seconds = _WINDOW_SECONDS.labels(peer=label)
+        per_window_s = elapsed / len(windows)
+        for ops, ctx in windows:
+            max_ts = max((w.get("timestamp") for w in ops
+                          if isinstance(w.get("timestamp"), int)),
+                         default=0)
+            mesh.record_ingest_window(label, ctx, max_ts)
+            window_seconds.observe(per_window_s)
+            self._windows += 1
+        logger.debug("lane ingest: %d windows, %d applied in %.3fs",
+                     len(windows), applied, time.perf_counter() - t0)
+        return applied, advanced
+
+    def _receive_serial(self, windows, peer: str | None) -> tuple[int, bool]:
+        """K=1: the exact pre-lane path (session-grouped windows)."""
+        ing = self._ingester(peer, 0)
+        applied = 0
+        with ing.session():
+            for ops, ctx in windows:
+                applied += ing.receive(ops, ctx)
+        self._windows += len(windows)
+        self._submissions += 1
+        return applied, ing.last_floor_advanced
+
+    # -- internals -----------------------------------------------------------
+    def _ingester(self, peer: str | None, lane: int) -> Ingester:
+        with self._lock:
+            ing = self._ingesters.get((peer, lane))
+            if ing is None:
+                ing = Ingester(self.library, peer=peer)
+                self._ingesters[(peer, lane)] = ing
+            return ing
+
+    @staticmethod
+    def _take_deferred(ing: Ingester) -> tuple[dict[str, int], dict[str, int]]:
+        clocks, caps = ing.deferred_clocks, ing.deferred_caps
+        ing.deferred_clocks, ing.deferred_caps = {}, {}
+        return clocks, caps
+
+    def _persist_floors(self, clocks: dict[str, int]) -> bool:
+        """Only-raise floor persistence, AFTER every lane txn committed —
+        a floor must never run ahead of the durability of its ops."""
+        if not clocks:
+            return False
+        db = self.library.db
+        advanced = False
+        with db.transaction():
+            for pub_id, ts in clocks.items():
+                row = db.find_one(Instance, {"pub_id": pub_id})
+                if row is not None and (row["timestamp"] or 0) < ts:
+                    db.update(Instance, {"pub_id": pub_id},
+                              {"timestamp": ts})
+                    advanced = True
+        return advanced
+
+    def _worker(self, idx: int, q: "queue.Queue[_LaneTask | None]") -> None:
+        lane = str(idx)
+        busy = _LANE_BUSY.labels(lane=lane)
+        depth = _LANE_DEPTH.labels(lane=lane)
+        ops_total = _LANE_OPS.labels(lane=lane)
+        while True:
+            task = q.get()
+            depth.set(q.qsize())
+            if task is None:
+                return
+            busy.set(1)
+            try:
+                ing = task.ingester
+                with ing.session():  # one durable txn per lane task
+                    for ops, ctx in task.parts:
+                        task.applied += ing.receive(ops, ctx,
+                                                    defer_clocks=True)
+                task.clocks, task.caps = self._take_deferred(ing)
+                ops_total.inc(sum(len(ops) for ops, _ in task.parts))
+            except Exception as e:
+                # session txn rolled back: none of this lane's shards are
+                # durable, so its clocks must not merge (re-pulled intact)
+                self._take_deferred(task.ingester)
+                task.error = e
+                logger.exception("ingest lane %d failed", idx)
+            finally:
+                busy.set(0)
+                task.done.set()
+
+    # -- lifecycle / introspection -------------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for q in self._queues:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+        # fail any task queued behind the sentinel so its submitter
+        # unblocks with an error instead of waiting on a dead worker
+        for q in self._queues:
+            while True:
+                try:
+                    task = q.get_nowait()
+                except queue.Empty:
+                    break
+                if task is not None and not task.done.is_set():
+                    task.error = RuntimeError("ingest lane pool closed")
+                    task.done.set()
+
+    def status(self) -> dict[str, Any]:
+        return {
+            "lanes": self.lanes,
+            "queue_depths": [q.qsize() for q in self._queues],
+            "queue_bound": self._depth,
+            "windows": self._windows,
+            "submissions": self._submissions,
+        }
+
+
+_POOL_LOCK = threading.Lock()
+
+
+def get_lane_pool(library: "Library", lanes: int | None = None) -> IngestLanes:
+    """The library's shared lane pool (memoized on the library object;
+    closed with it). Serialized: two first callers racing the check-then-
+    set would each build a pool and leak the loser's K lane threads."""
+    with _POOL_LOCK:
+        pool = library.__dict__.get("_ingest_lanes")
+        if pool is None or (lanes is not None and pool.lanes != lanes):
+            if pool is not None:
+                pool.close()
+            pool = IngestLanes(library, lanes=lanes)
+            library.__dict__["_ingest_lanes"] = pool
+        return pool

@@ -68,6 +68,19 @@ _HASH_SERVE = counter(
 _HASH_SERVE_BYTES = counter(
     "sd_p2p_hash_serve_bytes_total",
     "cas-message bytes hashed on behalf of remote peers", labels=("peer",))
+# admission control (ISSUE 8): BUSY answers on the p2p receive path —
+# sent when OUR budget sheds a peer's work, received when a peer sheds ours
+_BUSY_SENT = counter(
+    "sd_p2p_busy_replies_total",
+    "BUSY answers this node sent (its admission budget shed the request)",
+    labels=("peer",))
+_BUSY_RECEIVED = counter(
+    "sd_p2p_busy_received_total",
+    "BUSY answers received from peers (their budget shed our request)",
+    labels=("peer",))
+_BUSY_BACKOFF_S = counter(
+    "sd_p2p_busy_backoff_seconds_total",
+    "wall time spent backing off after a peer's BUSY answer")
 
 
 def peer_label(identity: str | None) -> str:
@@ -260,6 +273,22 @@ def record_session(label: str) -> None:
 def record_hash_serve(label: str, payload_bytes: int) -> None:
     _HASH_SERVE.inc(peer=label)
     _HASH_SERVE_BYTES.inc(payload_bytes, peer=label)
+
+
+def record_busy_sent(label: str) -> None:
+    _BUSY_SENT.inc(peer=label)
+
+
+def record_busy_received(label: str) -> None:
+    _BUSY_RECEIVED.inc(peer=label)
+
+
+def record_busy_backoff(backoff_s: float) -> None:
+    """Wall time ACTUALLY about to be spent sleeping on a peer's BUSY —
+    callers record this adjacent to the sleep, after any give-up checks,
+    so the counter never claims backoff that was skipped."""
+    if backoff_s > 0:
+        _BUSY_BACKOFF_S.inc(backoff_s)
 
 
 def apply_delay_series(label: str):

@@ -1,0 +1,477 @@
+"""Synthetic device fleet: many aggressive peers hammering ONE node.
+
+ISSUE 8's tentpole driver. A :class:`Fleet` owns one TARGET node and N
+in-process peers, each a full Node + Library with its own CRDT instance.
+Every peer pushes its op-log at the target through the REAL survival
+stack — the node-wide admission budget (``Node.ingest_budget``), the
+partitioned ingest lanes (``sync/lanes.py``), the BUSY/backoff/resume
+loop, and the per-peer Ingesters — while optional side traffic (remote
+hash batches through the same budget, rspc queries against the mounted
+router) keeps the node busy the way a real fleet would.
+
+The sessions are WIRE-LESS for the same reason as
+tests/test_mesh_telemetry.py: the socket p2p layer needs the
+``cryptography`` package this container lacks. Each push session mirrors
+the exact frame sequence of ``p2p/nlm.py`` — the responder's durable
+clocks drive ``get_ops`` windows, every window carries the trace-context
+envelope (HLC watermark + declared backlog, so ``sd_sync_peer_lag_ops``
+is live), admission is checked per window with the window's serialized
+byte size, a shed window surfaces as :class:`PeerBusyError` exactly like
+a BUSY frame, and the retry wrapper backs off on the same
+``ORIGINATE_RETRY`` policy shape and resumes from the acknowledged
+watermark (the responder's re-read clocks). The true socket variant
+lives in tests/test_p2p_two_process.py machinery and stays
+crypto-gated.
+
+Used by tests/test_fleet.py (the chaos soak / fairness / lane-
+equivalence gates) and ``bench.py --fleet`` (BENCH_fleet.json).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import uuid
+from pathlib import Path
+
+from spacedrive_tpu import faults, telemetry
+from spacedrive_tpu.faults import PeerBusyError
+from spacedrive_tpu.models import Tag
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.sync.admission import Busy, IngestBudget
+from spacedrive_tpu.sync.ingest import Ingester
+from spacedrive_tpu.sync.lanes import IngestLanes, get_lane_pool
+from spacedrive_tpu.telemetry import mesh
+from spacedrive_tpu.utils.retry import RetryPolicy, is_transient
+
+#: fleet sessions retry fast (test-sized mirror of nlm.ORIGINATE_RETRY)
+SESSION_RETRY = RetryPolicy(attempts=50, base_s=0.02, max_s=0.25,
+                            budget_s=120.0)
+
+
+def op_log(lib) -> list[tuple]:
+    """The byte-identity view of a library's CRDT state: every logged op
+    (shared + relation), fully ordered."""
+    shared = [(r["id"], r["timestamp"], r["model"], r["record_id"],
+               r["kind"], r["data"])
+              for r in lib.db.query("SELECT * FROM shared_operation")]
+    rel = [(r["id"], r["timestamp"], r["relation"], r["item_id"],
+            r["group_id"], r["kind"], r["data"])
+           for r in lib.db.query("SELECT * FROM relation_operation")]
+    return sorted(shared) + sorted(rel)
+
+
+def materialized_rows(lib) -> list[tuple]:
+    """Materialized rows keyed by pub_id, surrogate rowids excluded —
+    lanes reorder ACROSS records, so autoincrement ids are the one column
+    legitimately allowed to differ (the SD_COMMIT_GROUP discipline).
+    Covers tags, objects, and tag↔object links (the wave-2 relations)."""
+    tags = sorted(("tag", r["pub_id"], r["name"], r["color"])
+                  for r in lib.db.query(
+                      "SELECT pub_id, name, color FROM tag"))
+    objs = sorted(("object", r["pub_id"], r["kind"])
+                  for r in lib.db.query("SELECT pub_id, kind FROM object"))
+    links = sorted(("link", r["tp"], r["op"])
+                   for r in lib.db.query(
+                       "SELECT t.pub_id AS tp, o.pub_id AS op "
+                       "FROM tag_on_object r "
+                       "JOIN tag t ON t.id = r.tag_id "
+                       "JOIN object o ON o.id = r.object_id"))
+    return tags + objs + links
+
+
+class FleetPeer:
+    """One synthetic device: its own Node/Library emitting tag ops, plus
+    the push-session driver at the target."""
+
+    def __init__(self, fleet: "Fleet", index: int, data_dir: Path) -> None:
+        self.fleet = fleet
+        self.index = index
+        self.identity = f"fleet-peer-{index:02d}"
+        self.label = mesh.peer_label(self.identity)
+        self.node = Node(data_dir, probe_accelerator=False,
+                         watch_locations=False)
+        self.library = self.node.libraries.create(f"fleet-{index:02d}")
+        self.library.sync.emit_messages = True
+        self.emitted = 0
+        self.sessions = 0
+        self.busy_seen = 0
+        self.windows_served = 0
+        self.ops_served = 0
+        self.error: BaseException | None = None
+        # the target-side ingester for THIS peer (poison memory and batch
+        # caches are per-peer state, like the responder's)
+        self._ingester: Ingester | None = None
+
+    # -- emission ------------------------------------------------------------
+    def emit(self, n: int, chunk: int = 200) -> None:
+        """n tag create-ops on this peer's library (the CREATED burst a
+        real device produces while indexing)."""
+        lib = self.library
+        for start in range(0, n, chunk):
+            ops, rows = [], []
+            for i in range(start, min(n, start + chunk)):
+                pub = f"p{self.index:02d}-t{self.emitted + i}"
+                ops.append(lib.sync.shared_create(
+                    Tag, pub, {"name": f"n{self.index}-{self.emitted + i}"}))
+                rows.append({"pub_id": pub,
+                             "name": f"n{self.index}-{self.emitted + i}"})
+            lib.sync.write_ops(
+                ops, lambda db, rows=rows: [db.insert(Tag, r) for r in rows])
+        self.emitted += n
+
+    # -- the push session (wire-less nlm mirror) -----------------------------
+    def _session(self, batch: int) -> None:
+        """One originate→responder round: serve get_ops windows from the
+        target's durable clocks until drained, through admission. A shed
+        window raises PeerBusyError (the BUSY frame); a flap raises out
+        of the dial seam."""
+        fleet = self.fleet
+        # the dial: chaos seam keyed by this peer, exactly nlm's
+        faults.inject("p2p_send", key=self.identity)
+        self.sessions += 1
+        origin = str(self.node.config.get().get("id") or "")
+        trace = mesh.new_trace(
+            "sync.push", origin,
+            f"sync-{self.library.id[:8]}-{uuid.uuid4().hex[:12]}",
+            library_id=self.library.id, peer=self.label)
+        try:
+            while True:
+                clocks = fleet.target_lib.sync.timestamps()
+                ops, has_more = self.library.sync.get_ops(clocks, batch)
+                if not ops:
+                    if not has_more:
+                        # nothing newer than the watermark: declare the
+                        # drained backlog so the lag gauge settles to 0
+                        mesh.record_ingest_window(
+                            self.label, mesh.TraceContext(
+                                trace.trace_id, 0, origin,
+                                hlc=self.library.sync.clock.last,
+                                pending=0), 0)
+                    return
+                nbytes = len(json.dumps(ops, separators=(",", ":")))
+                pending = (max(0, self.library.sync.ops_pending(clocks)
+                               - len(ops)) if has_more else 0)
+                with telemetry.span(trace, "sync.window") as span:
+                    span.set(ops=len(ops), has_more=has_more,
+                             pending=pending)
+                    ctx = mesh.TraceContext(
+                        trace.trace_id, span.span_id, origin,
+                        hlc=self.library.sync.clock.last, pending=pending)
+                    # responder half: admission, then the lane pool (or
+                    # this peer's serial ingester)
+                    verdict = fleet.budget.try_admit(self.label, len(ops),
+                                                     nbytes)
+                    if isinstance(verdict, Busy):
+                        mesh.record_busy_sent(self.label)
+                        self.busy_seen += 1
+                        raise PeerBusyError(
+                            f"{self.identity} shed",
+                            retry_after_ms=verdict.retry_after_ms)
+                    try:
+                        fleet.apply(self, ops, ctx)
+                    finally:
+                        verdict.release()
+                self.windows_served += 1
+                self.ops_served += len(ops)
+                if not has_more:
+                    return
+        finally:
+            telemetry.finish_trace(trace, export_dir=self.node.data_dir)
+
+    def push_until_drained(self, batch: int = 500) -> None:
+        """nlm._originate_with_retry, thread-shaped: retry transient
+        session failures (flap, BUSY) with jittered backoff, honoring a
+        BUSY frame's retry_after_ms, resuming from the target's durable
+        clocks (the acknowledged watermark) every time."""
+        rng = random.Random(0xF1EE7 + self.index)
+        deadline = time.monotonic() + SESSION_RETRY.budget_s
+        retries = 0
+        while True:
+            try:
+                self._session(batch)
+                return
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if not is_transient(e):
+                    self.error = e
+                    raise
+                retries += 1
+                if retries >= SESSION_RETRY.attempts \
+                        or time.monotonic() > deadline:
+                    self.error = e
+                    raise
+                delay = SESSION_RETRY.delay(retries - 1, rng)
+                if isinstance(e, PeerBusyError):
+                    delay = max(delay, e.retry_after_ms / 1000.0)
+                    mesh.record_busy_received(self.label)
+                    mesh.record_busy_backoff(delay)
+                time.sleep(delay)
+
+    def shutdown(self) -> None:
+        self.node.shutdown()
+
+
+class Fleet:
+    """The whole rig: one target node, N peers, optional side traffic,
+    and a sampler proving the bounded-memory claim while it runs."""
+
+    def __init__(self, root: Path, peers: int = 8, lanes: int = 1,
+                 budget_ops: int | None = None,
+                 budget_bytes: int | None = None) -> None:
+        self.root = Path(root)
+        self.target = Node(self.root / "target", probe_accelerator=False,
+                           watch_locations=False)
+        self.target_lib = self.target.libraries.create("fleet-target")
+        self.lanes = lanes
+        # the fleet admits through the target node's own budget so the
+        # rspc fleet-status surface and the gauges show THIS traffic
+        if budget_ops is not None or budget_bytes is not None:
+            self.target.ingest_budget = IngestBudget(
+                max_ops=budget_ops or 4000,
+                max_bytes=budget_bytes or 32 * 1024 * 1024)
+        self.budget: IngestBudget = self.target.ingest_budget
+        self.pool: IngestLanes = get_lane_pool(self.target_lib, lanes=lanes)
+        self.peers: list[FleetPeer] = []
+        for i in range(peers):
+            peer = FleetPeer(self, i, self.root / f"peer{i:02d}")
+            self.target_lib.add_remote_instance(peer.library.instance())
+            peer.library.add_remote_instance(self.target_lib.instance())
+            self.peers.append(peer)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.samples: dict[str, float] = {
+            "max_admission_ops": 0.0, "max_admission_bytes": 0.0,
+            "max_lane_depth": 0.0, "max_peer_lag_ops": 0.0,
+            "max_rss_mb": 0.0, "start_rss_mb": _rss_mb(),
+        }
+        self.query_errors: list[str] = []
+        self.hash_batches = 0
+
+    # -- the apply half every session shares ---------------------------------
+    def apply(self, peer: FleetPeer, ops, ctx) -> None:
+        if self.lanes > 1:
+            self.pool.receive(ops, ctx, peer=peer.identity)
+        else:
+            if peer._ingester is None:
+                peer._ingester = Ingester(self.target_lib,
+                                          peer=peer.identity)
+            peer._ingester.receive(ops, ctx)
+
+    # -- side traffic ---------------------------------------------------------
+    def _hash_traffic(self, stop: threading.Event, msg_bytes: int = 4096,
+                      batch: int = 32) -> None:
+        """Remote hash batches through the SAME admission budget, the
+        _serve_hash_batch shape (admit → hash → release)."""
+        from spacedrive_tpu.objects.hasher import hash_messages
+
+        rng = random.Random(0xA5)
+        label = mesh.peer_label("fleet-hash-client")
+        payload = [rng.randbytes(msg_bytes) for _ in range(batch)]
+        while not stop.is_set():
+            verdict = self.budget.try_admit(label, len(payload),
+                                            sum(map(len, payload)))
+            if isinstance(verdict, Busy):
+                mesh.record_busy_sent(label)
+                stop.wait(verdict.retry_after_ms / 1000.0)
+                continue
+            try:
+                hash_messages(payload)
+                self.hash_batches += 1
+                mesh.record_hash_serve(label, sum(map(len, payload)))
+            finally:
+                verdict.release()
+            stop.wait(0.01)
+
+    def _query_traffic(self, stop: threading.Event) -> None:
+        """rspc reads against the live router while ingest storms."""
+        from spacedrive_tpu.api.router import mount
+
+        router = mount(self.target)
+        keys = [("libraries.list", None, None),
+                ("sync.fleetStatus", None, None),
+                ("jobs.reports", None, self.target_lib.id),
+                ("telemetry.snapshot", None, None)]
+        while not stop.is_set():
+            for key, arg, lib_id in keys:
+                try:
+                    router.resolve(key, arg, library_id=lib_id)
+                except Exception as e:  # noqa: BLE001 — recorded, asserted on
+                    self.query_errors.append(f"{key}: {e!r}")
+            stop.wait(0.05)
+
+    def _sampler(self, stop: threading.Event) -> None:
+        s = self.samples
+        while not stop.is_set():
+            s["max_admission_ops"] = max(
+                s["max_admission_ops"],
+                telemetry.value("sd_sync_admission_ops_in_flight"))
+            s["max_admission_bytes"] = max(
+                s["max_admission_bytes"],
+                telemetry.value("sd_sync_admission_bytes_in_flight"))
+            for depth in self.pool.status()["queue_depths"]:
+                s["max_lane_depth"] = max(s["max_lane_depth"], depth)
+            for peer in self.peers:
+                s["max_peer_lag_ops"] = max(
+                    s["max_peer_lag_ops"],
+                    telemetry.value("sd_sync_peer_lag_ops",
+                                    peer=peer.label))
+            s["max_rss_mb"] = max(s["max_rss_mb"], _rss_mb())
+            stop.wait(0.05)
+
+    # -- orchestration --------------------------------------------------------
+    def run_storm(self, ops_per_peer: int, batch: int = 500,
+                  emit_chunks: int = 4, hash_traffic: bool = False,
+                  query_traffic: bool = False,
+                  on_tick=None) -> dict:
+        """The storm: every peer emits in ``emit_chunks`` bursts, pushing
+        a full session after each burst, all peers concurrent. Returns
+        the result dict (throughput, sheds, maxima)."""
+        stop = self._stop
+        self._threads = [threading.Thread(
+            target=self._sampler, args=(stop,), daemon=True,
+            name="fleet-sampler")]
+        if hash_traffic:
+            self._threads.append(threading.Thread(
+                target=self._hash_traffic, args=(stop,), daemon=True,
+                name="fleet-hash"))
+        if query_traffic:
+            self._threads.append(threading.Thread(
+                target=self._query_traffic, args=(stop,), daemon=True,
+                name="fleet-query"))
+        for t in self._threads:
+            t.start()
+
+        def drive(peer: FleetPeer) -> None:
+            per_burst = max(1, ops_per_peer // emit_chunks)
+            done = 0
+            try:
+                while done < ops_per_peer:
+                    n = min(per_burst, ops_per_peer - done)
+                    peer.emit(n)
+                    done += n
+                    peer.push_until_drained(batch)
+                    if on_tick is not None:
+                        on_tick()
+            except BaseException as e:  # noqa: BLE001 — surfaced in result
+                peer.error = peer.error or e
+
+        t0 = time.perf_counter()
+        workers = [threading.Thread(target=drive, args=(p,), daemon=True,
+                                    name=f"fleet-push-{p.index}")
+                   for p in self.peers]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        stop.clear()
+
+        total = sum(p.emitted for p in self.peers)
+        status = self.budget.status()
+        return {
+            "peers": len(self.peers),
+            "lanes": self.lanes,
+            "ops_total": total,
+            "elapsed_s": round(elapsed, 3),
+            "ops_per_sec_total": round(total / elapsed, 1) if elapsed else 0.0,
+            "shed_windows": status["shed_windows"],
+            "shed_ops": status["shed_ops"],
+            "busy_sessions": sum(p.busy_seen for p in self.peers),
+            "sessions": sum(p.sessions for p in self.peers),
+            "hash_batches": self.hash_batches,
+            "errors": [repr(p.error) for p in self.peers
+                       if p.error is not None],
+            "p99_apply_delay_s": p99_apply_delay(),
+            "peak_rss_mb": round(self.samples["max_rss_mb"], 1),
+            "rss_growth_mb": round(self.samples["max_rss_mb"]
+                                   - self.samples["start_rss_mb"], 1),
+            "max_peer_lag_ops": self.samples["max_peer_lag_ops"],
+            "max_admission_ops": self.samples["max_admission_ops"],
+            "max_admission_bytes": self.samples["max_admission_bytes"],
+            "max_lane_depth": self.samples["max_lane_depth"],
+        }
+
+    def drain(self, batch: int = 1000) -> None:
+        """Push every peer's remaining backlog (fault-free tail) so lag
+        gauges settle to 0."""
+        for peer in self.peers:
+            peer.push_until_drained(batch)
+
+    def mirror_back(self, batch: int = 2000, timeout_s: float = 300.0
+                    ) -> None:
+        """Target → peers: pull the target's full op-log into every peer
+        until all participants hold identical logs — the 'op-log rows
+        equal on all participants' half of the gate. Serial on purpose:
+        the applies are GIL-bound python, so on the container's 2 cores
+        concurrent pullers only contend (measured ~2k ops/s aggregate
+        threaded vs ~8k serial)."""
+        target = self.target_lib
+        for peer in self.peers:
+            ing = Ingester(peer.library, peer="fleet-target")
+            deadline = time.monotonic() + timeout_s
+            done = False
+            while not done:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"mirror_back stalled for {peer.identity}")
+                clocks = peer.library.sync.timestamps()
+                ops, has_more = target.sync.get_ops(clocks, batch)
+                if ops:
+                    with ing.session():
+                        ing.receive(ops)
+                    if not ing.last_floor_advanced:
+                        break
+                if not has_more:
+                    done = True
+
+    def converged(self) -> bool:
+        want = op_log(self.target_lib)
+        return all(op_log(p.library) == want for p in self.peers)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for peer in self.peers:
+            peer.shutdown()
+        self.target.shutdown()
+
+
+def p99_apply_delay() -> float:
+    """p99 of sd_sync_apply_delay_seconds across every peer series, from
+    the histogram buckets (upper-bound estimate: the bucket edge)."""
+    snap = telemetry.snapshot()
+    fam = snap.get("metrics", snap).get("sd_sync_apply_delay_seconds")
+    if fam is None:
+        return 0.0
+    # merge buckets across series
+    merged: dict[str, int] = {}
+    total = 0
+    for series in fam.get("series", []):
+        total += series.get("count", 0)
+        for bound, count in series.get("buckets", {}).items():
+            merged[bound] = merged.get(bound, 0) + count
+    if not total:
+        return 0.0
+    numeric = sorted(((float("inf") if b == "+Inf" else float(b)), c)
+                     for b, c in merged.items())
+    need = 0.99 * total
+    seen = 0
+    for bound, count in numeric:
+        seen += count
+        if seen >= need:
+            return bound if bound != float("inf") else numeric[-2][0]
+    return numeric[-1][0]
+
+
+def _rss_mb() -> float:
+    try:
+        parts = Path("/proc/self/statm").read_text().split()
+        return int(parts[1]) * 4096 / (1024 * 1024)
+    except Exception:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0

@@ -435,6 +435,54 @@ def test_telemetry_discipline_scoped_and_call_args_exempt(tmp_path):
         "telemetry-discipline") == []
 
 
+# -- pass 12: queue-discipline -------------------------------------------------
+
+def test_queue_discipline_flags_unbounded_constructions(tmp_path):
+    """ISSUE 8 fixture: every unbounded spelling is a finding — absent
+    bound, explicit 0/None/negative, and SimpleQueue (unboundable)."""
+    bad = run_on(tmp_path, "sync/bad.py", (
+        "import queue\n"
+        "from collections import deque\n"
+        "q1 = queue.Queue()\n"
+        "q2 = queue.Queue(maxsize=0)\n"
+        "q3 = queue.LifoQueue(0)\n"
+        "q4 = queue.SimpleQueue()\n"
+        "d1 = deque()\n"
+        "d2 = deque([], None)\n"), "queue-discipline")
+    assert [f.lineno for f in bad] == [3, 4, 5, 6, 7, 8]
+    assert "bound" in bad[0].message
+
+
+def test_queue_discipline_allows_bounded_and_nonqueue_names(tmp_path):
+    # every bounded spelling is silent
+    assert run_on(tmp_path, "p2p/good.py", (
+        "import queue\n"
+        "import collections\n"
+        "q1 = queue.Queue(maxsize=8)\n"
+        "q2 = queue.PriorityQueue(16)\n"
+        "d1 = collections.deque(maxlen=4)\n"
+        "d2 = collections.deque([], 4)\n"), "queue-discipline") == []
+    # a local helper named deque/Queue with no queue/collections import
+    # is not a queue
+    assert run_on(tmp_path, "jobs/local.py", (
+        "def deque():\n"
+        "    return []\n"
+        "d = deque()\n"
+        "q = Queue()\n"), "queue-discipline") == []
+
+
+def test_queue_discipline_scoped_and_waivable(tmp_path):
+    src = "import queue\nq = queue.Queue()\n"
+    # out-of-scope subsystems buffer freely (telemetry rings, shells)
+    assert run_on(tmp_path, "telemetry/q.py", src, "queue-discipline") == []
+    assert run_on(tmp_path, "server/q.py", src, "queue-discipline") == []
+    # a displacement-argument waiver silences it in scope
+    assert run_on(tmp_path, "jobs/waived.py", (
+        "import queue\n"
+        "q = queue.Queue()  # lint: ok(queue-discipline)\n"),
+        "queue-discipline") == []
+
+
 # -- waivers ------------------------------------------------------------------
 
 def test_scoped_waiver_silences_only_named_pass(tmp_path):

@@ -1,0 +1,537 @@
+"""Fleet survival gate (ISSUE 8): the synthetic device fleet, partitioned
+ingest lanes, admission-controlled receive, and the per-library jobs
+lanes.
+
+The heavy gates ride :mod:`tests.fleet_harness` — wire-less mirrors of
+the p2p session layer (the socket variant needs the ``cryptography``
+package this container lacks; see tests/test_mesh_telemetry.py for the
+same argument). The unit tests underneath pin the pieces the gates rest
+on: the admission budget's fairness floor, deterministic lane sharding,
+the poison-replay fairness cap, and the originator's acknowledged-
+watermark bookkeeping.
+"""
+
+import threading
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from spacedrive_tpu import faults, telemetry
+from spacedrive_tpu.models import Object, Tag, TagOnObject
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.sync.admission import Busy, IngestBudget
+from spacedrive_tpu.sync.ingest import Ingester
+from spacedrive_tpu.sync.lanes import IngestLanes, lane_key
+from spacedrive_tpu.telemetry import alerts, mesh
+
+from .fleet_harness import (Fleet, materialized_rows, op_log,
+                            p99_apply_delay)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry(monkeypatch):
+    monkeypatch.delenv("SD_SYNC_INGEST_LANES", raising=False)
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    yield
+    faults.clear()
+    telemetry.reset()
+    telemetry.reload_enabled()
+
+
+# -- admission budget (unit) ---------------------------------------------------
+
+
+def test_ingest_budget_admits_releases_and_sheds():
+    budget = IngestBudget(max_ops=1000, max_bytes=10_000)
+    a = budget.try_admit("p1", 600, 5_000)
+    assert not isinstance(a, Busy)
+    # over the ops bound with work in flight -> shed, with scaled backoff
+    verdict = budget.try_admit("p1", 600, 1_000)
+    assert isinstance(verdict, Busy) and verdict.retry_after_ms >= 200
+    st = budget.status()
+    assert st["shed_windows"] == 1 and st["shed_ops"] == 600
+    assert st["ops_in_flight"] == 600 and st["peers_in_flight"] == 1
+    a.release()
+    a.release()  # idempotent
+    st = budget.status()
+    assert st["ops_in_flight"] == 0 and st["bytes_in_flight"] == 0
+    # bytes bound sheds too (a fresh peer asking beyond its byte fair
+    # share gets no fairness-floor pass)
+    b = budget.try_admit("p1", 10, 9_000)
+    assert not isinstance(b, Busy)
+    assert isinstance(budget.try_admit("p2", 10, 6_000), Busy)
+    b.release()
+
+
+def test_ingest_budget_oversized_window_admits_when_idle():
+    """The bound is on BUFFERED work, not window size: a window larger
+    than the whole budget must still make progress on an idle node."""
+    budget = IngestBudget(max_ops=100, max_bytes=1_000)
+    big = budget.try_admit("p1", 5_000, 50_000)
+    assert not isinstance(big, Busy)
+    # ...but only while idle: the next one waits
+    assert isinstance(budget.try_admit("p2", 5_000, 0), Busy)
+    big.release()
+
+
+def test_ingest_budget_fairness_floor_protects_quiet_peers():
+    """A peer under its fair share with nothing in flight is never shed —
+    the flooder absorbs the shedding (the flood gate rests on this)."""
+    budget = IngestBudget(max_ops=1_000)
+    flood = budget.try_admit("flood", 900, 0)
+    assert not isinstance(flood, Busy)
+    # over budget globally, but the quiet peer is under its fair share
+    quiet = budget.try_admit("quiet", 100, 0)
+    assert not isinstance(quiet, Busy)
+    # the flooder's NEXT window (already holding in-flight work) sheds
+    assert isinstance(budget.try_admit("flood", 900, 0), Busy)
+    flood.release()
+    quiet.release()
+
+
+def test_ingest_budget_overload_seam_sheds_deterministically():
+    budget = IngestBudget(max_ops=10_000)
+    faults.install("sync_ingest:overload:2", seed=1)
+    try:
+        assert isinstance(budget.try_admit("p", 10, 0), Busy)
+        assert isinstance(budget.try_admit("p", 10, 0), Busy)
+        ok = budget.try_admit("p", 10, 0)
+        assert not isinstance(ok, Busy)
+        ok.release()
+        assert faults.fired().get("sync_ingest:overload") == 2
+    finally:
+        faults.clear()
+
+
+# -- lane sharding (unit) ------------------------------------------------------
+
+
+def test_lane_key_deterministic_and_wave2_deferral():
+    shared = {"typ": {"_t": "shared", "model": "tag", "record_id": "r1",
+                      "kind": "c", "data": {"name": "x"}}}
+    assert lane_key(shared, 4) == lane_key(shared, 4)
+    assert 0 <= lane_key(shared, 4) < 4
+    # one record always lands in one lane; different records spread
+    spread = {lane_key({"typ": {"_t": "shared", "model": "tag",
+                                "record_id": f"rec-{i}", "kind": "c",
+                                "data": {}}}, 4) for i in range(64)}
+    assert len(spread) > 1
+    # relation ops and ref-carrying shared ops defer to wave 2
+    rel = {"typ": {"_t": "relation", "relation": "tag_on_object",
+                   "item_id": "a", "group_id": "b", "kind": "c",
+                   "data": {}}}
+    assert lane_key(rel, 4) is None
+    ref = {"typ": {"_t": "shared", "model": "file_path", "record_id": "r",
+                   "kind": "uobject_id",
+                   "data": {"__sd_ref__": "object", "pub_id": "x"}}}
+    from spacedrive_tpu.sync.crdt import is_ref
+
+    if is_ref(ref["typ"]["data"]):  # ref marker shape is load-bearing
+        assert lane_key(ref, 4) is None
+    # malformed ops land in lane 0 (any lane may drop them)
+    assert lane_key({"typ": "garbage"}, 4) == 0
+
+
+# -- poison-replay fairness cap (satellite regression) -------------------------
+
+
+def test_replay_cap_prevents_poison_starvation(tmp_path):
+    """A window carrying hundreds of known-poison replays must not starve
+    its fresh tail: replays are capped per round, fresh ops all apply in
+    round one, and the deferred replays heal over later rounds."""
+    node = Node(tmp_path / "n", probe_accelerator=False,
+                watch_locations=False)
+    try:
+        src = node.libraries.create("src")
+        dst = node.libraries.create("dst")
+        src.sync.emit_messages = True
+        dst.add_remote_instance(src.instance())
+        src.add_remote_instance(dst.instance())
+        ops, rows = [], []
+        for i in range(500):
+            pub = f"replay-{i:03d}"
+            ops.append(src.sync.shared_create(Tag, pub, {"name": f"t{i}"}))
+            rows.append({"pub_id": pub, "name": f"t{i}"})
+        src.sync.write_ops(ops, lambda db, rows=rows: [db.insert(Tag, r)
+                                                       for r in rows])
+        wire, has_more = src.sync.get_ops(dst.sync.timestamps(), 1000)
+        assert not has_more and len(wire) == 500
+
+        ing = Ingester(dst, peer="replay-peer")
+        # the first 200 (timestamp order) are known poison from an
+        # "earlier round"; the remaining 300 are the fresh tail
+        for w in wire[:200]:
+            ing._poison_seen[w["id"]] = 1
+        cap = Ingester.REPLAY_OPS_PER_ROUND
+        applied = ing.receive(wire)
+        # fresh tail fully applied + exactly one replay budget's worth
+        assert applied == 300 + cap
+        label = mesh.peer_label("replay-peer")
+        assert telemetry.value("sd_sync_shed_replays_total",
+                               peer=label) == 200 - cap
+        assert len(ing._poison_seen) == 200 - cap
+        # deferred replays heal across later rounds (floor stayed capped,
+        # so the transport re-serves them)
+        for _ in range(4):
+            wire, _ = src.sync.get_ops(dst.sync.timestamps(), 1000)
+            if not wire:
+                break
+            ing.receive(wire)
+        assert not ing._poison_seen
+        assert dst.db.count(Tag) == 500
+        assert op_log(src) == op_log(dst)
+    finally:
+        node.shutdown()
+
+
+# -- acknowledged-watermark bookkeeping (satellite) ----------------------------
+
+
+def test_ack_watermark_only_raises_and_detects_full_ack(tmp_path):
+    from spacedrive_tpu.p2p.nlm import NetworkedLibraries
+
+    node = Node(tmp_path / "n", probe_accelerator=False,
+                watch_locations=False)
+    try:
+        lib = node.libraries.create("wm")
+        lib.sync.emit_messages = True
+        nl = NetworkedLibraries(SimpleNamespace(node=node))
+        nl._record_ack(lib.id, "peer-x", {"a": 5, "b": 2})
+        nl._record_ack(lib.id, "peer-x", {"a": 3, "c": 7})   # only-raise
+        nl._record_ack(lib.id, "peer-x", "garbage")          # ignored
+        nl._record_ack(lib.id, "peer-x", {"d": "NaN", 9: 9})  # junk entries
+        assert nl.ack_watermark(lib.id, "peer-x") == {"a": 5, "b": 2,
+                                                      "c": 7}
+        assert nl.ack_watermark(lib.id, "peer-y") is None
+
+        # full-ack detection against a real op-log: acked clocks that
+        # cover everything -> a retry has nothing to push
+        lib.sync.write_ops(
+            [lib.sync.shared_create(Tag, "wm-1", {"name": "x"})],
+            lambda db: db.insert(Tag, {"pub_id": "wm-1", "name": "x"}))
+        assert not nl._acked_everything(lib, "peer-x")  # stale junk ack
+        nl._record_ack(lib.id, "peer-x", lib.sync.timestamps())
+        assert nl._acked_everything(lib, "peer-x")
+        lib.sync.write_ops(
+            [lib.sync.shared_create(Tag, "wm-2", {"name": "y"})],
+            lambda db: db.insert(Tag, {"pub_id": "wm-2", "name": "y"}))
+        assert not nl._acked_everything(lib, "peer-x")
+    finally:
+        node.shutdown()
+
+
+# -- BUSY → backoff → resume (satellite + admission loop) ----------------------
+
+
+def test_busy_sheds_resume_without_resending(tmp_path):
+    """Three injected overloads shed three windows; every session retry
+    resumes from the target's durable clocks, so the peer serves each op
+    exactly once (ops_served == emitted — no window-0 restart tax) and
+    the BUSY counters account for the cycle."""
+    fleet = Fleet(tmp_path, peers=1, lanes=1)
+    try:
+        faults.install("sync_ingest:overload:3", seed=5)
+        res = fleet.run_storm(ops_per_peer=1500, batch=300, emit_chunks=3)
+        faults.clear()
+        peer = fleet.peers[0]
+        assert res["errors"] == []
+        assert res["shed_windows"] == 3 and res["busy_sessions"] == 3
+        assert res["shed_ops"] == 900  # 3 shed windows x 300 ops, re-served
+        assert peer.ops_served == 1500  # resume: nothing re-sent
+        assert telemetry.value("sd_p2p_busy_replies_total",
+                               peer=peer.label) == 3
+        assert telemetry.value("sd_p2p_busy_received_total",
+                               peer=peer.label) == 3
+        assert telemetry.value("sd_sync_shed_windows_total",
+                               peer=peer.label) == 3
+        assert fleet.converged()
+        assert telemetry.value("sd_sync_peer_lag_ops", peer=peer.label) == 0
+    finally:
+        faults.clear()
+        fleet.shutdown()
+
+
+# -- per-peer fairness under a flood (satellite gate) --------------------------
+
+
+def test_flooding_peer_absorbs_sheds_quiet_peers_drain(tmp_path):
+    """4 peers, one flooding with oversized concurrent sessions against a
+    small budget: the three quiet peers are never shed (fairness floor),
+    their lag drains to 0, and every shed lands on the flooder."""
+    fleet = Fleet(tmp_path, peers=4, lanes=4, budget_ops=1500)
+    flooder, *quiet = fleet.peers
+    try:
+        flooder.emit(2800)
+        for q in quiet:
+            q.emit(300)
+
+        def flood():
+            flooder.push_until_drained(batch=1400)
+
+        def drip(q):
+            q.push_until_drained(batch=100)
+
+        threads = [threading.Thread(target=flood, daemon=True)
+                   for _ in range(3)]
+        threads += [threading.Thread(target=drip, args=(q,), daemon=True)
+                    for q in quiet]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not any(t.is_alive() for t in threads)
+
+        shed_total = fleet.budget.status()["shed_windows"]
+        flooder_shed = telemetry.value("sd_sync_shed_windows_total",
+                                       peer=flooder.label)
+        for q in quiet:
+            assert telemetry.value("sd_sync_shed_windows_total",
+                                   peer=q.label) == 0
+            assert telemetry.value("sd_sync_peer_lag_ops",
+                                   peer=q.label) == 0
+        assert flooder_shed == shed_total  # the flooder absorbed them all
+        assert flooder_shed > 0  # the flood actually hit the budget
+        # nothing was lost to the shedding: every op landed on the target
+        assert len(op_log(fleet.target_lib)) == 2800 + 3 * 300
+    finally:
+        fleet.shutdown()
+
+
+# -- partitioned-lane byte-identity (acceptance) -------------------------------
+
+
+def test_lane_equivalence_k1_vs_k4(tmp_path):
+    """The SAME wire windows ingested through K=1 and K=4 lanes produce a
+    byte-identical op-log and identical materialized rows (modulo
+    surrogate rowids) — including wave-2 relation ops linking records
+    created in the same window by different lanes."""
+    fleet = Fleet(tmp_path / "a", peers=3, lanes=1)
+    node_b = Node(tmp_path / "b", probe_accelerator=False,
+                  watch_locations=False)
+    lib_b = node_b.libraries.create("target-k4")
+    pool_b = IngestLanes(lib_b, lanes=4, depth=4)
+    try:
+        for peer in fleet.peers:
+            lib_b.add_remote_instance(peer.library.instance())
+        # mixed emission: tags + objects + tag_on_object links (wave 2)
+        rich = fleet.peers[0].library
+        ops = []
+        for i in range(40):
+            ops.append(rich.sync.shared_create(
+                Tag, f"eq-t{i}", {"name": f"t{i}"}))
+            ops.append(rich.sync.shared_create(
+                Object, f"eq-o{i}", {"kind": i % 7}))
+            ops.append(rich.sync.relation_create(
+                TagOnObject, f"eq-t{i}", f"eq-o{i}"))
+
+        def _mat(db):
+            for i in range(40):
+                db.insert(Tag, {"pub_id": f"eq-t{i}", "name": f"t{i}"})
+                db.insert(Object, {"pub_id": f"eq-o{i}", "kind": i % 7})
+                tid = db.find_one(Tag, {"pub_id": f"eq-t{i}"})["id"]
+                oid = db.find_one(Object, {"pub_id": f"eq-o{i}"})["id"]
+                db.insert(TagOnObject, {"tag_id": tid, "object_id": oid})
+
+        rich.sync.write_ops(ops, _mat)
+        for peer in fleet.peers[1:]:
+            peer.emit(400)
+
+        # identical windows into both targets, interleaved across peers
+        windows: list[tuple[object, list[dict]]] = []
+        for peer in fleet.peers:
+            wire, has_more = peer.library.sync.get_ops({}, 10_000)
+            assert not has_more
+            for i in range(0, len(wire), 250):
+                windows.append((peer, wire[i:i + 250]))
+        for peer, chunk in windows:
+            fleet.apply(peer, chunk, None)            # K=1 serial path
+            pool_b.receive(chunk, None, peer=peer.identity)  # K=4 lanes
+
+        assert op_log(fleet.target_lib) == op_log(lib_b)
+        assert materialized_rows(fleet.target_lib) == materialized_rows(lib_b)
+        assert lib_b.db.count(Tag) == 40 + 800
+        # every link materialized despite its endpoints landing in
+        # different lanes of the same window
+        assert lib_b.db.query(
+            "SELECT count(*) c FROM tag_on_object")[0]["c"] == 40
+        # lane telemetry saw real fan-out
+        assert telemetry.value("sd_sync_ingest_lane_count") == 4
+    finally:
+        pool_b.close()
+        node_b.shutdown()
+        fleet.shutdown()
+
+
+def test_lane_failure_persists_no_floors(tmp_path, monkeypatch):
+    """If ANY lane of a submission fails, NO clock floors persist — the
+    failed lane may hold earlier ops of an instance another lane
+    committed, and a persisted merged floor would skip them forever. The
+    idempotent retry dup-skips the committed lanes and converges."""
+    import sqlite3
+
+    from spacedrive_tpu.models import Instance
+
+    node = Node(tmp_path / "n", probe_accelerator=False,
+                watch_locations=False)
+    pool = None
+    try:
+        src = node.libraries.create("src")
+        dst = node.libraries.create("dst")
+        src.sync.emit_messages = True
+        dst.add_remote_instance(src.instance())
+        ops, rows = [], []
+        for i in range(400):
+            pub = f"lf-{i:03d}"
+            ops.append(src.sync.shared_create(Tag, pub, {"name": f"t{i}"}))
+            rows.append({"pub_id": pub, "name": f"t{i}"})
+        src.sync.write_ops(ops, lambda db, rows=rows: [db.insert(Tag, r)
+                                                       for r in rows])
+        wire, _ = src.sync.get_ops({}, 1000)
+        pool = IngestLanes(dst, lanes=4, depth=4)
+
+        real = Ingester.receive
+        state = {"failed": False}
+
+        def flaky(self, ops, ctx=None, defer_clocks=False):
+            if defer_clocks and not state["failed"]:
+                state["failed"] = True
+                raise sqlite3.OperationalError("database is locked")
+            return real(self, ops, ctx, defer_clocks=defer_clocks)
+
+        monkeypatch.setattr(Ingester, "receive", flaky)
+        with pytest.raises(sqlite3.OperationalError):
+            pool.receive(wire, None, peer="lane-fail-peer")
+        # the committed lanes' ops ARE durable, but no floor moved
+        row = dst.db.find_one(Instance,
+                              {"pub_id": src.sync.instance_pub_id})
+        assert (row["timestamp"] or 0) == 0
+        assert 0 < len(op_log(dst)) < 400
+        # the transport's idempotent re-pull converges
+        applied, advanced = pool.receive(wire, None, peer="lane-fail-peer")
+        assert advanced
+        assert op_log(src) == op_log(dst)
+        assert dst.db.count(Tag) == 400
+    finally:
+        if pool is not None:
+            pool.close()
+        node.shutdown()
+
+
+# -- per-library jobs lanes (tentpole part 3) ----------------------------------
+
+
+def test_job_lanes_are_per_library(tmp_path):
+    """Two libraries' default-lane jobs run CONCURRENTLY on one manager;
+    a third job in the SAME library still queues behind that library's
+    running one."""
+    from spacedrive_tpu.jobs.manager import Jobs
+    from spacedrive_tpu.library import Libraries
+
+    from .test_jobs import ToyJob
+
+    libs = Libraries(tmp_path, node=None)
+    lib_a = libs.create("lane-a")
+    lib_b = libs.create("lane-b")
+    jobs = Jobs()
+    try:
+        overlap = {"seen": False}
+        t0 = time.monotonic()
+        jobs.spawn(lib_a, [ToyJob({"steps": 6, "delay": 0.15, "tag": "a"})])
+        jobs.spawn(lib_b, [ToyJob({"steps": 6, "delay": 0.15, "tag": "b"})])
+        # same-library job: must queue (lane capacity 1 per library)
+        jobs.spawn(lib_a, [ToyJob({"steps": 1, "tag": "a2"})])
+        while time.monotonic() - t0 < 30:
+            with jobs._lock:
+                lanes = {(w.library.id, w.dyn_job.job.LANE)
+                         for w in jobs._running.values()}
+            if {(lib_a.id, "default"), (lib_b.id, "default")} <= lanes:
+                overlap["seen"] = True
+                break
+            time.sleep(0.01)
+        assert jobs.wait_idle(60)
+        assert overlap["seen"], "cross-library jobs never overlapped"
+    finally:
+        jobs.shutdown()
+        libs.close()
+
+
+# -- the fleet chaos soak gate (acceptance) ------------------------------------
+
+
+def test_fleet_chaos_soak_gate(tmp_path):
+    """ISSUE 8 acceptance, sized for the container: 8 peers x 5k ops with
+    ``sync_apply:sqlite_busy`` + ``p2p_send:flap`` + ``sync_ingest:
+    overload`` active. Byte-identical convergence on all 9 participants,
+    every peer's lag back to 0, the sync-peer-lag alert fires AND
+    resolves, and queue depth + RSS stay bounded for the whole run."""
+    budget_ops = 4000
+    rss_budget_mb = 900  # configured growth bound for the whole soak
+    fleet = Fleet(tmp_path, peers=8, lanes=4, budget_ops=budget_ops)
+    evaluator = alerts.AlertEvaluator(
+        [alerts.AlertRule(name="sync-peer-lag", kind="threshold",
+                          series="sd_sync_peer_lag_ops", op="gt",
+                          value=400.0, for_s=0.0)])
+    stop = threading.Event()
+
+    def evaluate():
+        while not stop.is_set():
+            evaluator.evaluate_once()
+            stop.wait(0.05)
+
+    ev_thread = threading.Thread(target=evaluate, daemon=True)
+    ev_thread.start()
+    try:
+        faults.install(
+            "sync_apply:sqlite_busy:6;p2p_send:flap:4;"
+            "sync_ingest:overload:3", seed=8)
+        res = fleet.run_storm(ops_per_peer=5000, batch=500, emit_chunks=2,
+                              hash_traffic=True, query_traffic=True)
+        fired = faults.fired()
+        faults.clear()
+        fleet.drain()
+        evaluator.evaluate_once()
+        stop.set()
+        ev_thread.join(timeout=10)
+
+        # the storm actually bit
+        assert fired.get("sync_apply:sqlite_busy") == 6, fired
+        assert fired.get("p2p_send:flap") == 4, fired
+        assert fired.get("sync_ingest:overload") == 3, fired
+        assert res["errors"] == []
+        assert res["ops_total"] == 8 * 5000
+        assert not fleet.query_errors, fleet.query_errors[:3]
+
+        # byte-identical convergence on ALL participants
+        fleet.mirror_back()
+        assert fleet.converged()
+        assert len(op_log(fleet.target_lib)) == 8 * 5000
+
+        # every peer's lag drained to 0
+        for peer in fleet.peers:
+            assert telemetry.value("sd_sync_peer_lag_ops",
+                                   peer=peer.label) == 0.0, peer.identity
+
+        # the lag alert cycled firing -> resolved in the event ring
+        assert res["max_peer_lag_ops"] > 400  # the backlog was visible
+        assert telemetry.value("sd_alerts_firing",
+                               rule="sync-peer-lag") == 0.0
+        names = [e["name"] for e in telemetry.recent_events(limit=2048)]
+        assert "alert.firing" in names and "alert.resolved" in names
+        assert names.index("alert.firing") < names.index("alert.resolved")
+
+        # bounded the whole run: admission never exceeded the configured
+        # budget (fairness-floor slack: one sub-share window per source),
+        # lane queues stayed under their bound, RSS under its budget
+        assert 0 < res["max_admission_ops"] <= budget_ops + 64
+        assert res["max_lane_depth"] <= fleet.pool.status()["queue_bound"]
+        assert res["rss_growth_mb"] < rss_budget_mb, res
+        assert res["p99_apply_delay_s"] < 120.0
+        # the side traffic really ran alongside
+        assert res["hash_batches"] > 0
+    finally:
+        stop.set()
+        faults.clear()
+        fleet.shutdown()
