@@ -39,6 +39,11 @@ MODE = os.environ.get("SD_BENCH_MODE", "combined")
 #: so the trajectory file exists for future PRs
 if "--fleet" in sys.argv[1:]:
     MODE = "fleet"
+#: ``--crash``: the process-kill torture matrix (ISSUE 9) — SIGKILL real
+#: node subprocesses at seeded seam hits, restart, and measure recovery;
+#: emits the record to BENCH_crash.json
+if "--crash" in sys.argv[1:]:
+    MODE = "crash"
 REPEATS = int(os.environ.get("SD_BENCH_REPEATS", "3"))
 #: ``--faults`` (or SD_BENCH_FAULTS=1): bench_scan adds a chaos pass under
 #: an injected fault storm and reports recovery overhead alongside
@@ -985,6 +990,93 @@ def bench_fleet() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_crash() -> dict:
+    """Crash-recovery headline (ISSUE 9): the seeded kill matrix from
+    tests/crash_harness.py — spawn a real node subprocess per workload,
+    SIGKILL it at a seam-driven point (mid-group-commit, mid-gather,
+    mid-sync-window, mid-backup), restart the same data dir, and measure
+    recovery. Emits ``crash{kills_survived, mean_recovery_s,
+    mean_pages_lost}`` plus the per-kill ledger and writes the record to
+    BENCH_crash.json. ``pages_lost`` is the work the restart re-ran
+    because the kill rolled it back short of a durable checkpoint: scan =
+    reference pages minus the checkpoint page the restart booted from;
+    sync = windows re-served off the durable clock floors; backup = the
+    one atomic archive write (nothing partial ever survives)."""
+    import math
+    import shutil
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests import crash_harness as ch
+
+    tmp = Path(tempfile.mkdtemp(prefix="sd_bench_crash_"))
+    kills = []
+    try:
+        tree = ch.make_tree(tmp / "tree")
+        ops = ch.gen_ops_file(tmp / "ops.jsonl")
+        scan_args = {"tree": str(tree)}
+        sync_args = {"ops_file": str(ops)}
+        _rc, scan_ref = ch.run_child("scan", tmp / "scan-ref", scan_args)
+        _rc, sync_ref = ch.run_child("sync", tmp / "sync-ref", sync_args)
+        _rc, bk_ref = ch.run_child("backup", tmp / "bk-ref", {})
+        ref_pages = math.ceil(ch.SCAN_FILES / ch.SCAN_BATCH)
+
+        for spec in ch.SCAN_KILLS:
+            res = ch.run_kill_point(tmp, "scan", spec, scan_args)
+            durable = max((j["checkpoint_step"] or 0
+                           for j in res["pre_jobs"].values()), default=0)
+            kills.append({
+                "kill_point": res["kill_point"],
+                "recovery_s": res["recovery_s"],
+                "pages_lost": ref_pages - durable,
+                "identical": res["snapshot"] == scan_ref["snapshot"],
+            })
+        for spec in ch.SYNC_KILLS:
+            res = ch.run_kill_point(tmp, "sync", spec, sync_args)
+            kills.append({
+                "kill_point": res["kill_point"],
+                "recovery_s": res["recovery_s"],
+                "pages_lost": math.ceil(
+                    (res["initial_pending"] or 0) / ch.SYNC_WINDOW),
+                "identical": res["oplog"] == sync_ref["oplog"],
+            })
+        for spec in ch.BACKUP_KILLS:
+            res = ch.run_kill_point(tmp, "backup", spec, {})
+            kills.append({
+                "kill_point": res["kill_point"],
+                "recovery_s": res["recovery_s"],
+                "pages_lost": 1,
+                "identical": res["snapshot"] == bk_ref["snapshot"],
+            })
+
+        survived = sum(1 for k in kills if k["identical"])
+        mean_recovery = sum(k["recovery_s"] for k in kills) / len(kills)
+        mean_pages = sum(k["pages_lost"] for k in kills) / len(kills)
+        record = {
+            "metric": f"crash_kill_matrix[{len(kills)}kills]",
+            "value": survived,
+            "unit": "kills survived byte-identically",
+            "crash": {
+                "kills_survived": survived,
+                "kills_total": len(kills),
+                "mean_recovery_s": round(mean_recovery, 3),
+                "mean_pages_lost": round(mean_pages, 2),
+            },
+            "commit_group": ch.COMMIT_GROUP,
+            "scan_pages_total": ref_pages,
+            "sync_windows_total": math.ceil(ch.SYNC_OPS / ch.SYNC_WINDOW),
+            "kills": kills,
+        }
+        out = Path(__file__).resolve().parent / "BENCH_crash.json"
+        out.write_text(json.dumps(record, indent=1) + "\n")
+        print(f"info: crash matrix {survived}/{len(kills)} kills survived "
+              f"byte-identically, mean recovery {mean_recovery:.2f}s, mean "
+              f"pages lost to rollback {mean_pages:.1f} -> {out.name}",
+              file=sys.stderr)
+        return record
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _guard_device_init() -> str:
     """The tunneled device backend HANGS (not errors) when its relay dies,
     and the platform plugin forces device init regardless of JAX_PLATFORMS —
@@ -1069,8 +1161,10 @@ def main() -> int:
     # inherit the parent's verdict via SD_BENCH_DEVICE_VERDICT so the
     # probe cost is paid once per combined run. The fleet soak is
     # CPU-only by construction (CRDT ingest + admission control — no
-    # device work), so it skips the probe and its relay-recovery wait.
+    # device work), so it skips the probe and its relay-recovery wait; the
+    # crash matrix likewise (its children pin JAX_PLATFORMS=cpu).
     platform = ("cpu(fleet: no device work)" if MODE == "fleet"
+                else "cpu(crash: no device work)" if MODE == "crash"
                 else _guard_device_init())
     # opportunistic recapture: the combined suite runs for many minutes on
     # the CPU fallback — keep watching the relay in the background and, if
@@ -1097,6 +1191,8 @@ def main() -> int:
         record = bench_sync()
     elif MODE == "fleet":
         record = bench_fleet()
+    elif MODE == "crash":
+        record = bench_crash()
     elif MODE == "dedup_1m":
         record = bench_dedup_1m()
     else:  # combined (default): dedup headline + north-star identify record

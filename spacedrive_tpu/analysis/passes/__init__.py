@@ -10,6 +10,7 @@ from __future__ import annotations
 from ..engine import AnalysisPass
 from .async_blocking import AsyncBlockingPass
 from .commit_discipline import CommitDisciplinePass
+from .durability_discipline import DurabilityDisciplinePass
 from .jax_wedge import JaxWedgePass
 from .legacy import BareExceptPass, DuplicateDefPass, UnusedImportPass
 from .lock_discipline import LockDisciplinePass
@@ -36,6 +37,7 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     RetryDisciplinePass,
     TelemetryDisciplinePass,
     QueueDisciplinePass,
+    DurabilityDisciplinePass,
 )
 
 

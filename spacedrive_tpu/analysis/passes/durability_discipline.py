@@ -1,0 +1,114 @@
+"""durability-discipline: artifact writes must be crash-safe.
+
+A user-visible artifact (backup, thumbnail, trace export, preference/
+config sidecar) written with a bare ``open(path, "w")`` /
+``path.write_bytes(...)`` is observable half-written: a SIGKILL or a full
+disk mid-write leaves a torn file that poisons every later reader. The
+tempfile → fsync → rename discipline (``utils/atomic``) closes that
+window — a crash leaves the old artifact or the new one, never a hybrid.
+
+Scope: the artifact-producing subsystems — ``objects/``, ``telemetry/``,
+and the package-root ``backups.py`` / ``preferences.py`` modules.
+
+Mechanics: flag
+
+- ``open(<target>, "<mode>")`` calls whose literal mode writes or appends
+  (contains ``w`` or ``a``; ``x``/``r+`` modes are content *operations* —
+  exclusive creates and in-place edits — not artifact writes), and
+- ``<target>.write_bytes(...)`` / ``<target>.write_text(...)`` calls,
+
+unless the target expression mentions a temp name (an identifier
+containing ``tmp`` — the tempfile half of the discipline; the rename half
+is what the atomic helpers own). Writers with a genuine reason to stream
+in place (e.g. crypto_jobs' ciphertext output, unlinked on failure) carry
+a line waiver: ``# lint: ok(durability-discipline)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding
+
+#: top-level package dirs in scope (FileContext.top_dir)
+SCOPE_DIRS = ("objects", "telemetry")
+#: package-root modules in scope (top_dir is '' for these)
+SCOPE_FILES = ("backups.py", "preferences.py")
+
+WRITE_METHODS = {"write_bytes", "write_text"}
+
+
+def _mentions_tmp(node: ast.AST) -> bool:
+    """True when any identifier in the expression contains 'tmp' — the
+    write is (heuristically) the tempfile half of tempfile+rename."""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            name = sub.value
+        if name and "tmp" in name.lower():
+            return True
+    return False
+
+
+def _open_mode(call: ast.Call) -> str | None:
+    """The literal mode of an ``open(...)`` call ('r' when omitted); None
+    when the mode is dynamic (not flaggable without false positives)."""
+    mode_node: ast.AST | None = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode_node = kw.value
+    if mode_node is None:
+        return "r"
+    if isinstance(mode_node, ast.Constant) and isinstance(mode_node.value, str):
+        return mode_node.value
+    return None
+
+
+class DurabilityDisciplinePass(AnalysisPass):
+    id = "durability-discipline"
+    description = ("artifact writes in objects|telemetry|backups|"
+                   "preferences must use tempfile+rename (utils/atomic) — "
+                   "a torn write survives a crash as a poisoned artifact")
+
+    def _in_scope(self, ctx: FileContext) -> bool:
+        return ctx.in_dirs(*SCOPE_DIRS) or ctx.relpath in SCOPE_FILES
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not self._in_scope(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # path.write_bytes(...) / path.write_text(...)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in WRITE_METHODS:
+                if not _mentions_tmp(node.func.value):
+                    yield ctx.finding(
+                        node.lineno, self.id,
+                        f"'.{node.func.attr}()' writes an artifact in "
+                        f"place — a crash mid-write leaves it torn; use "
+                        f"utils/atomic (atomic_write_bytes/atomic_path) or "
+                        f"waive with a rationale")
+                continue
+            # open(path, "w"/"a"...)
+            if isinstance(node.func, ast.Name) and node.func.id == "open":
+                mode = _open_mode(node)
+                if mode is None or not any(c in mode for c in "wa"):
+                    continue
+                target = node.args[0] if node.args else node
+                if _mentions_tmp(target):
+                    continue
+                yield ctx.finding(
+                    node.lineno, self.id,
+                    f"open(..., {mode!r}) writes an artifact in place — a "
+                    f"crash mid-write leaves it torn; use utils/atomic "
+                    f"(atomic_write_bytes/atomic_path) or waive with a "
+                    f"rationale")

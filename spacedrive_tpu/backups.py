@@ -2,23 +2,42 @@
 
 Parity with core/src/api/backups.rs:32-108: a backup file = fixed-size magic
 header (magic bytes, backup id, timestamp, library id, library name) followed
-by a tar.gz of the `.sdlibrary` config and `.db` database. Restore unloads
-the library, untars over the originals, and reloads.
+by a tar.gz of the `.sdlibrary` config and `.db` database.
+
+Crash-consistency contract (ISSUE 9):
+
+- **backup** writes are atomic (tempfile → fsync → rename, utils/atomic):
+  a kill mid-backup leaves no ``.bkp`` at all, never a torn one;
+- **restore** validates the tarball and the header ``library_id`` first,
+  extracts into a temp dir next to the live files, and only then renames
+  the validated files over the originals — a kill at ANY point during a
+  restore leaves the old library intact (the renames are last, and
+  per-file atomic);
+- the boot-time integrity ladder (recovery.py) reuses the same validated
+  extraction to repair a library whose DB fails ``PRAGMA quick_check``.
 """
 
 from __future__ import annotations
 
+import gzip
 import io
 import json
+import logging
 import struct
 import tarfile
 import time
 import uuid
+import zlib
 from pathlib import Path
 from typing import TYPE_CHECKING, Any
 
+from . import faults
+from .utils.atomic import TMP_MARK, atomic_write_bytes
+
 if TYPE_CHECKING:
     from .node import Node
+
+logger = logging.getLogger(__name__)
 
 MAGIC = b"SDTPUBAK"  # 8 bytes
 HEADER_LEN = 256
@@ -59,39 +78,175 @@ def list_backups(node: "Node") -> list[dict[str, Any]]:
     return out
 
 
+def _member_names(library_id: str) -> tuple[str, str]:
+    return f"{library_id}.sdlibrary", f"{library_id}.db"
+
+
+def validate_backup(path: str | Path,
+                    expect_library_id: str | None = None) -> dict[str, Any]:
+    """Full validation BEFORE any restore touches the live library: magic +
+    header parse, ``library_id`` match, and a complete tar.gz walk (every
+    member read end-to-end, which checks the gzip CRC — a truncated or
+    bit-flipped backup fails here, not halfway through an extraction).
+    Returns the parsed header; raises ``ValueError`` on any problem."""
+    try:
+        header = read_header(path)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        raise ValueError(f"backup {path}: unreadable header ({e})") from e
+    library_id = header.get("library_id")
+    if not library_id:
+        raise ValueError(f"backup {path}: header missing library_id")
+    if expect_library_id is not None and library_id != expect_library_id:
+        raise ValueError(
+            f"backup {path}: header library_id {library_id!r} does not match "
+            f"the restore target {expect_library_id!r}")
+    want = set(_member_names(library_id))
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(HEADER_LEN)
+            body = io.BytesIO(fh.read())
+        # full gzip drain FIRST: the stream CRC only verifies at EOF, and a
+        # member-walk alone can skip trailing tar padding where a flipped
+        # bit would otherwise hide
+        with gzip.GzipFile(fileobj=body) as gz:
+            while gz.read(1 << 20):
+                pass
+        body.seek(0)
+        with tarfile.open(fileobj=body, mode="r:gz") as tar:
+            seen = set()
+            for member in tar:
+                if member.name not in want:
+                    continue  # forward-compat: extra members ignored
+                seen.add(member.name)
+                if not member.isreg():
+                    raise ValueError(
+                        f"backup {path}: member {member.name} is not a "
+                        f"regular file")
+    except ValueError:
+        raise
+    except (OSError, tarfile.TarError, EOFError, zlib.error) as e:
+        raise ValueError(f"backup {path}: corrupt archive ({e})") from e
+    missing = want - seen
+    if missing:
+        raise ValueError(f"backup {path}: missing member(s) {sorted(missing)}")
+    return header
+
+
+def find_latest_backup(backups_path: str | Path,
+                       library_id: str) -> Path | None:
+    """Newest VALID backup of ``library_id`` under ``backups_path`` (by
+    header timestamp) — what the boot-repair ladder restores from.
+    Invalid/foreign files are skipped, never raised on."""
+    best: tuple[int, Path] | None = None
+    for path in Path(backups_path).glob("*.bkp"):
+        try:
+            header = validate_backup(path, expect_library_id=library_id)
+        except ValueError:
+            continue
+        ts = int(header.get("timestamp") or 0)
+        if best is None or ts > best[0]:
+            best = (ts, path)
+    return best[1] if best else None
+
+
 def do_backup(node: "Node", library_id: str) -> str:
     library = node.libraries.get(library_id)
     backup_id = str(uuid.uuid4())
     target = backups_dir(node) / f"{backup_id}.bkp"
     cfg_path = node.libraries.dir / f"{library_id}.sdlibrary"
     db_path = node.libraries.dir / f"{library_id}.db"
+    # fold the WAL into the main file so the tar'd .db is self-contained
     library.db.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+    # chaos seam: enospc degrades gracefully (no torn .bkp thanks to the
+    # atomic write), kill rehearses a mid-backup process death
+    faults.inject("backup", key=library_id)
     buf = io.BytesIO()
     with tarfile.open(fileobj=buf, mode="w:gz") as tar:
         tar.add(cfg_path, arcname=f"{library_id}.sdlibrary")
         tar.add(db_path, arcname=f"{library_id}.db")
-    with open(target, "wb") as fh:
-        fh.write(_header(backup_id, library_id, library.name))
-        fh.write(buf.getvalue())
+    faults.inject("backup", key="write")
+    try:
+        atomic_write_bytes(
+            target,
+            _header(backup_id, library_id, library.name) + buf.getvalue())
+    except OSError as e:
+        from .recovery import is_disk_full, note_disk_full
+
+        if is_disk_full(e):
+            # the atomic write guarantees no torn .bkp survived; the
+            # counter tells the operator WHY the backup is missing
+            note_disk_full("backup")
+        raise
     return backup_id
 
 
+def extract_validated(backup_path: str | Path, library_id: str,
+                      dest_dir: Path) -> tuple[Path, Path]:
+    """Extract the config + DB members into ``dest_dir`` (the caller's temp
+    dir, same filesystem as the live files so the final renames are
+    atomic). Returns ``(cfg_tmp, db_tmp)``."""
+    cfg_name, db_name = _member_names(library_id)
+    with open(backup_path, "rb") as fh:
+        fh.seek(HEADER_LEN)
+        # buffered: extractall seeks backwards in the gzip stream, and a
+        # gzip rewind over the raw file would land on the magic header
+        buf = io.BytesIO(fh.read())
+    with tarfile.open(fileobj=buf, mode="r:gz") as tar:
+        members = [m for m in tar.getmembers()
+                   if m.name in (cfg_name, db_name)]
+        tar.extractall(dest_dir, members=members, filter="data")
+    return dest_dir / cfg_name, dest_dir / db_name
+
+
+def restore_files(backup_path: str | Path, library_id: str,
+                  libraries_dir: Path, pre_validated: bool = False) -> None:
+    """The crash-safe half of a restore: validated temp-dir extraction +
+    atomic renames over the live files. Shared by :func:`do_restore` and
+    the boot-repair ladder (recovery.py), which runs before any Library
+    object exists. A kill anywhere before the renames leaves the old
+    library untouched; the renames themselves are per-file atomic (DB
+    first, then config — the pair comes from one snapshot either way).
+
+    ``pre_validated`` skips the validation walk when the caller just ran
+    :func:`validate_backup` on this path — a full gzip-CRC drain reads the
+    whole archive, so a multi-GB restore should not pay it twice."""
+    if not pre_validated:
+        validate_backup(backup_path, expect_library_id=library_id)
+    tmp_dir = libraries_dir / f"{library_id}{TMP_MARK}.restore"
+    import shutil
+
+    shutil.rmtree(tmp_dir, ignore_errors=True)  # stale prior attempt
+    tmp_dir.mkdir(parents=True)
+    try:
+        cfg_tmp, db_tmp = extract_validated(backup_path, library_id, tmp_dir)
+        # chaos seam: a kill here proves the originals survive a mid-restore
+        # process death (everything so far touched only the temp dir)
+        faults.inject("restore", key=library_id)
+        # stale WAL/SHM sidecars of the OLD database must not be replayed
+        # into the restored file
+        (libraries_dir / f"{library_id}.db-wal").unlink(missing_ok=True)
+        (libraries_dir / f"{library_id}.db-shm").unlink(missing_ok=True)
+        import os
+
+        os.replace(db_tmp, libraries_dir / f"{library_id}.db")
+        os.replace(cfg_tmp, libraries_dir / f"{library_id}.sdlibrary")
+    finally:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+
+
 def do_restore(node: "Node", backup_path: str | Path) -> str:
-    header = read_header(backup_path)
+    header = validate_backup(backup_path)
     library_id = header["library_id"]
-    # unload if loaded (restore semantics: backups.rs restore)
+    # unload if loaded (restore semantics: backups.rs restore) — only after
+    # validation passed, so a bad backup never takes the library down
     try:
         library = node.libraries.get(library_id)
         library.close()
         node.libraries._libraries.pop(library_id, None)
     except KeyError:
         pass
-    with open(backup_path, "rb") as fh:
-        fh.seek(HEADER_LEN)
-        with tarfile.open(fileobj=io.BytesIO(fh.read()), mode="r:gz") as tar:
-            members = [m for m in tar.getmembers()
-                       if m.name in (f"{library_id}.sdlibrary", f"{library_id}.db")]
-            tar.extractall(node.libraries.dir, members=members, filter="data")
+    restore_files(backup_path, library_id, node.libraries.dir,
+                  pre_validated=True)
     node.libraries._load(library_id)
     return library_id
 

@@ -3,7 +3,9 @@
 Production-scale scanning treats partial failure as the steady state; this
 package makes every failure mode *rehearsable*. A fault plan is armed from
 ``SD_FAULTS`` (grammar in :mod:`.spec`; seed via ``SD_FAULTS_SEED``) and
-consulted at named seams in the hot paths:
+consulted at named seams in the hot paths (kinds include ``enospc`` for
+the full-disk story and ``kill`` — a literal SIGKILL at the seam — for
+the crash-recovery harness):
 
     from spacedrive_tpu import faults
     faults.inject("gather", key=str(path))   # no-op unless armed

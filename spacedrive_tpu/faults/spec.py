@@ -22,6 +22,9 @@ A spec is a ``;``-separated list of rules, each ``seam:kind[:trigger]``:
     * absent            → every hit
     * ``once``          → the first hit only
     * integer ``N``     → the first N hits
+    * ``skipN``         → every hit AFTER the first N (pins a ``kill`` to
+      an exact mid-workload point: ``commit:kill:skip3`` dies at the 4th
+      transaction seam hit)
     * float ``p``       → each hit independently with probability p,
       drawn from the rule's own seeded RNG (``SD_FAULTS_SEED``, default
       0) — two runs with the same seed and the same call sequence fire
@@ -115,6 +118,7 @@ KINDS: dict[str, Callable[[str], BaseException]] = {
         _errno.ENOENT, f"no such file [injected{': ' + key if key else ''}]"),
     "eacces": lambda key: PermissionError(
         _errno.EACCES, f"permission denied [injected{': ' + key if key else ''}]"),
+    "enospc": _oserror(_errno.ENOSPC, "no space left on device"),
     "truncate": _mk(EOFError, "short read"),
     "sqlite_busy": _mk(sqlite3.OperationalError, "database is locked"),
     "wedge": _mk(DeviceWedgeError, "device wedge"),
@@ -123,6 +127,7 @@ KINDS: dict[str, Callable[[str], BaseException]] = {
     "busy": _mk(PeerBusyError, "peer busy"),
     "overload": _mk(IngestOverloadError, "ingest overload"),
     "hang": None,  # type: ignore[dict-item]  # blocks, never raises
+    "kill": None,  # type: ignore[dict-item]  # SIGKILLs the process
 }
 
 
@@ -140,7 +145,7 @@ SEAM_ALIASES = {"hash_dispatch": "hash"}
 class FaultRule:
     seam: str
     kind: str
-    #: "always" | "count" | "prob"
+    #: "always" | "count" | "prob" | "skip"
     mode: str
     remaining: int = 0
     prob: float = 0.0
@@ -155,6 +160,12 @@ class FaultRule:
             self.remaining -= 1
         elif self.mode == "prob":
             if self.rng.random() >= self.prob:
+                return False
+        elif self.mode == "skip":
+            # fire on every hit AFTER the first N — how the crash harness
+            # pins a kill to "the (N+1)th transaction commit" exactly
+            if self.remaining > 0:
+                self.remaining -= 1
                 return False
         self.fired += 1
         return True
@@ -192,6 +203,15 @@ class FaultPlan:
         trig = parts[2].strip()
         if trig == "once":
             return FaultRule(seam, kind, "count", remaining=1, rng=rng)
+        if trig.startswith("skip"):
+            try:
+                n = int(trig[4:])
+            except ValueError:
+                raise FaultSpecError(
+                    f"rule {raw!r}: skip trigger must be 'skip<N>'") from None
+            if n < 0:
+                raise FaultSpecError(f"rule {raw!r}: skip count must be >= 0")
+            return FaultRule(seam, kind, "skip", remaining=n, rng=rng)
         try:
             if "." in trig:
                 p = float(trig)
@@ -239,6 +259,18 @@ class FaultPlan:
             # block far past any drain deadline; daemon stage threads die
             # with the process
             threading.Event().wait(HANG_S)
+            return
+        if fired_rule.kind == "kill":
+            # the real-crash failure mode: SIGKILL this process AT the seam
+            # (no atexit, no flushes — exactly what the kernel OOM killer or
+            # a power cut does). The crash-recovery harness arms this with a
+            # skipN trigger to die mid-group-commit / mid-gather / mid-sync-
+            # window deterministically.
+            import os as _os
+            import signal as _signal
+
+            _os.kill(_os.getpid(), _signal.SIGKILL)
+            threading.Event().wait(HANG_S)  # never reached; belt-and-braces
             return
         exc = KINDS[fired_rule.kind](key)
         setattr(exc, INJECTED_ATTR, True)

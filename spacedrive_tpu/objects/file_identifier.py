@@ -33,6 +33,7 @@ from typing import Any
 
 from .. import faults, telemetry
 from ..jobs import EarlyFinish, JobError, StatefulJob, StepResult, WorkerContext
+from ..recovery import is_disk_full, note_disk_full
 from ..models import FilePath, Location, Object, utc_now
 from ..sync.crdt import ref
 from .cas import read_sampled_batch_fast as read_sampled_batch
@@ -254,6 +255,12 @@ class FileIdentifierJob(StatefulJob):
                 errors.append(
                     f"quarantined {_abs_path(location_path, row)}: {cas!r}")
                 quarantined += 1
+                if is_disk_full(cas):
+                    # ENOSPC during the gather (a full disk can fail reads
+                    # through mmap'd page allocation and vanished temp
+                    # space): degrade per-item like every other quarantine,
+                    # but light up the one disk-full series operators watch
+                    note_disk_full("gather")
             else:
                 identified.append((row, cas))
         if quarantined:

@@ -21,6 +21,10 @@ import threading
 import subprocess
 from pathlib import Path
 
+from ... import faults
+from ...recovery import is_disk_full, note_disk_full
+from ...utils.atomic import atomic_path, atomic_write_bytes, atomic_write_text
+
 logger = logging.getLogger(__name__)
 
 TARGET_PX = 262_144.0
@@ -51,7 +55,7 @@ def thumbnail_dir(data_dir: str | Path) -> Path:
         d.mkdir(parents=True, exist_ok=True)
         version_file = d / "version.txt"
         if not version_file.exists():
-            version_file.write_text(str(THUMBNAIL_VERSION))
+            atomic_write_text(version_file, str(THUMBNAIL_VERSION))
         # benign race: mkdir/version-stamp are idempotent and the set is a
         # pure memo — double work on a concurrent first call, never
         # corruption, and the hot listing path stays lock-free
@@ -89,17 +93,26 @@ def _ffmpeg_capable() -> bool:
 
 def generate_thumbnail(source: str | Path, data_dir: str | Path, cas_id: str,
                        extension: str | None = None) -> Path | None:
-    """Create (or reuse) the WebP thumbnail for one file; returns the path."""
+    """Create (or reuse) the WebP thumbnail for one file; returns the path.
+
+    Skip-and-log on ANY failure (including ENOSPC — the ``thumbnail``
+    chaos seam rehearses it): a thumbnail is regenerable, so a full disk
+    degrades to "no preview yet", never a failed media job. Writes are
+    atomic (utils/atomic), so a kill mid-encode leaves no torn WebP for
+    the explorer to render."""
     out = thumbnail_path(data_dir, cas_id)
     if out.exists():
         return out
     out.parent.mkdir(parents=True, exist_ok=True)
     ext = (extension or Path(source).suffix.lstrip(".")).lower()
     try:
+        faults.inject("thumbnail", key=cas_id)
         if ext in THUMBNAILABLE_VIDEO_EXTENSIONS:
             return _video_thumbnail(Path(source), out)
         return _image_thumbnail(Path(source), out, ext)
     except Exception as e:
+        if is_disk_full(e):
+            note_disk_full("thumbnail")
         logger.warning("thumbnail failed for %s: %s", source, e)
         return None
 
@@ -187,9 +200,8 @@ def _image_thumbnail(source: Path, out: Path, ext: str | None = None) -> Path:
         if w * h > TARGET_PX:
             factor = math.sqrt(TARGET_PX / (w * h))
             img = img.resize((max(1, round(w * factor)), max(1, round(h * factor))))
-        tmp = out.with_suffix(".tmp.webp")
-        _save_webp(img, tmp)
-    tmp.replace(out)
+        with atomic_path(out) as tmp:
+            _save_webp(img, tmp)
     return out
 
 
@@ -216,24 +228,22 @@ def _video_thumbnail(source: Path, out: Path) -> Path | None:
             # one representative frame (cover art preferred, else 10% in),
             # then the same √(area) scale + WebP path images take
             frame = native.decode_frame_rgb(source)
-            tmp = out.with_suffix(".tmp.webp")
             img = Image.fromarray(frame)
             w, h = img.size
             if w * h > TARGET_PX:
                 factor = math.sqrt(TARGET_PX / (w * h))
                 img = img.resize((max(1, round(w * factor)),
                                   max(1, round(h * factor))))
-            _save_webp(img, tmp)
-            tmp.replace(out)
+            with atomic_path(out) as tmp:
+                _save_webp(img, tmp)
             return out
         except Exception as e:
             logger.debug("native video decode failed for %s (%s); CLI fallback",
                          source, e)
     if _FFMPEG is None:
         return None
-    tmp = out.with_suffix(".tmp.webp")
-    _cli_grab_frame(source, tmp, 512, webp_quality=WEBP_QUALITY)
-    tmp.replace(out)
+    with atomic_path(out) as tmp:
+        _cli_grab_frame(source, tmp, 512, webp_quality=WEBP_QUALITY)
     return out
 
 
@@ -246,7 +256,9 @@ def _cli_grab_frame(source: Path, out: Path, size: int,
            "-i", str(source), "-frames:v", "1",
            "-vf", f"scale='min({size},iw)':-2"]
     if webp_quality is not None:
-        cmd += ["-quality", str(webp_quality)]
+        # explicit container: the atomic-write temp has no .webp suffix for
+        # ffmpeg to infer the format from
+        cmd += ["-f", "webp", "-quality", str(webp_quality)]
     subprocess.run(cmd + [str(out)], check=True, timeout=30,
                    capture_output=True)
 
@@ -331,8 +343,8 @@ def video_to_thumbnail(source: str | Path, out: str | Path, size: int = 256,
                        film_strip: bool = False) -> None:
     """Write a video thumbnail file (lib.rs to_thumbnail)."""
     out = Path(out)
-    out.parent.mkdir(parents=True, exist_ok=True)
-    out.write_bytes(video_to_webp_bytes(source, size, quality, film_strip))
+    atomic_write_bytes(out, video_to_webp_bytes(source, size, quality,
+                                                film_strip))
 
 
 # ---------------------------------------------------------------------------
@@ -572,11 +584,12 @@ def generate_thumbnails_batched(entries, data_dir: str | Path,
             continue
         for (_source, cas_id, out, _ext), thumb in zip(batch_meta, thumbs):
             try:
-                out.parent.mkdir(parents=True, exist_ok=True)
-                tmp = out.with_suffix(".tmp.webp")
-                _save_webp(Image.fromarray(thumb), tmp)
-                tmp.replace(out)
+                faults.inject("thumbnail", key=cas_id)
+                with atomic_path(out) as tmp:
+                    _save_webp(Image.fromarray(thumb), tmp)
                 out_paths[cas_id] = out
             except Exception as e:
+                if is_disk_full(e):
+                    note_disk_full("thumbnail")
                 logger.warning("thumbnail encode failed for %s: %s", cas_id, e)
     return out_paths

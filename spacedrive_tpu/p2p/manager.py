@@ -105,6 +105,12 @@ class P2PManager:
         self.discovery: Discovery | None = None
         self.pairing = PairingManager(self)
         self.nlm = NetworkedLibraries(self)
+        # accept-layer per-peer token bucket (throttle.py): a peer that
+        # ignores BUSY gets its substreams RESET before any session
+        # machinery runs
+        from .throttle import SessionThrottle
+
+        self.session_throttle = SessionThrottle()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._stop: asyncio.Event | None = None
@@ -523,6 +529,15 @@ class P2PManager:
     async def _dispatch_substream(self, sub, peer: Peer) -> None:
         """One inbound substream = one header-tagged exchange
         (protocol.rs:13-27 dispatch, previously one-per-connection)."""
+        # accept-layer throttle: one token per inbound exchange. A peer
+        # that ignores BUSY/backoff and floods sessions is refused HERE —
+        # before the header parse, the responder coroutine, or the
+        # admission budget spend — with a RESET so its dial fails fast.
+        if not self.session_throttle.admit(peer.identity):
+            logger.warning("p2p substream from %s throttled at accept "
+                           "(token bucket empty)", peer.identity[:8])
+            sub.reset()
+            return
         failed = True
         try:
             header = await Header.from_stream(sub)

@@ -1,0 +1,85 @@
+"""Per-peer session throttling at the accept layer (ROADMAP fleet rung (c)).
+
+Admission control (sync/admission.py) sheds *windows* with an explicit
+BUSY and trusts the peer to back off. A malicious or broken peer can
+ignore BUSY and just keep opening sessions — each one costs a header
+parse, a responder coroutine, and an admission round-trip before it is
+shed again. This module bounds that at the cheapest possible point: the
+substream accept layer, BEFORE any session machinery runs.
+
+:class:`SessionThrottle` is a classic token bucket per peer identity:
+``SD_P2P_SESSION_RATE`` tokens/s (default 10) with a burst of
+``SD_P2P_SESSION_BURST`` (default 30). Well-behaved peers (a handful of
+sessions per push round plus hash batches) never notice it; a
+BUSY-ignoring flooder drains its bucket and gets its substreams RESET at
+accept, counted per peer in ``sd_p2p_throttled_sessions_total`` — the
+series an operator (or a future auto-ban rung) watches.
+
+Buckets are per-peer and bounded in number (LRU past ``MAX_PEERS``), so
+an identity-churning flooder cannot balloon the map.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .. import telemetry
+from ..telemetry import mesh
+
+DEFAULT_RATE = float(os.environ.get("SD_P2P_SESSION_RATE", "10"))
+DEFAULT_BURST = float(os.environ.get("SD_P2P_SESSION_BURST", "30"))
+
+_THROTTLED = telemetry.counter(
+    "sd_p2p_throttled_sessions_total",
+    "inbound sessions refused by the per-peer accept-layer token bucket",
+    labels=("peer",))
+
+
+class SessionThrottle:
+    """Token bucket per peer; ``admit(peer_id)`` spends one token or
+    refuses. Thread-safe; ``clock`` is injectable for tests."""
+
+    MAX_PEERS = 1024
+
+    def __init__(self, rate: float = DEFAULT_RATE,
+                 burst: float = DEFAULT_BURST, clock=time.monotonic) -> None:
+        self.rate = max(0.1, float(rate))
+        self.burst = max(1.0, float(burst))
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: peer id -> (tokens, last refill stamp); insertion-ordered for LRU
+        self._buckets: dict[str, tuple[float, float]] = {}
+        self._throttled = 0
+
+    def admit(self, peer_id: str) -> bool:
+        now = self._clock()
+        label = mesh.peer_label(peer_id)
+        with self._lock:
+            tokens, last = self._buckets.pop(peer_id, (self.burst, now))
+            tokens = min(self.burst, tokens + (now - last) * self.rate)
+            admitted = tokens >= 1.0
+            if admitted:
+                tokens -= 1.0
+            else:
+                self._throttled += 1
+            self._buckets[peer_id] = (tokens, now)  # re-insert = LRU touch
+            while len(self._buckets) > self.MAX_PEERS:
+                self._buckets.pop(next(iter(self._buckets)))
+        if not admitted:
+            _THROTTLED.inc(peer=label)
+            telemetry.event("p2p.session_throttled", peer=label)
+        return admitted
+
+    def retry_after_s(self, peer_id: str) -> float:
+        """Seconds until the peer's bucket holds one token again."""
+        with self._lock:
+            tokens, _last = self._buckets.get(peer_id, (self.burst, 0.0))
+        return max(0.0, (1.0 - tokens) / self.rate)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"rate_per_s": self.rate, "burst": self.burst,
+                    "tracked_peers": len(self._buckets),
+                    "throttled_sessions": self._throttled}

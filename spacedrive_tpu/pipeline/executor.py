@@ -24,11 +24,13 @@ from __future__ import annotations
 import logging
 import os
 import queue
+import sqlite3
 import threading
 import time
 from typing import TYPE_CHECKING, Any
 
 from .. import telemetry
+from ..recovery import is_disk_full, note_disk_full
 from ..utils.retry import RetryPolicy, is_device_wedge, is_transient, retry_call
 
 if TYPE_CHECKING:
@@ -158,6 +160,23 @@ class PipelineExecutor:
         self._commit_s = 0.0
         self._batches = 0
         self._txns = 0
+
+    def _persist_checkpoint(self) -> None:
+        """Write the current (fully committed) state into the job report row
+        so process death resumes here (jobs/manager.cold_resume revives
+        RUNNING rows from report.data). One small autocommit UPDATE per
+        group transaction; failures cost re-run work, never correctness."""
+        db = getattr(getattr(self.ctx, "library", None), "db", None)
+        if db is None:
+            return
+        try:
+            report = self.dyn_job.report
+            report.data = self.dyn_job.serialize_state()
+            report.upsert(db)
+        except Exception:
+            logger.exception(
+                "pipeline %s: checkpoint persist failed (resume falls back "
+                "to the previous checkpoint)", self.dyn_job.job.NAME)
 
     # -- bounded put/get that never deadlock a drain -------------------------
     def _put(self, q: queue.Queue, item: Any) -> bool:
@@ -304,11 +323,32 @@ class PipelineExecutor:
 
             with telemetry.span(self.trace, "pipeline.commit",
                                 pages=len(pending)) as sp:
-                results = retry_call(
-                    attempt, policy=COMMIT_RETRY, classify=is_transient,
-                    cancel_check=lambda: self.ctx.check_commands(
-                        self.dyn_job),
-                    label=f"{self.dyn_job.job.NAME}-commit")
+                try:
+                    results = retry_call(
+                        attempt, policy=COMMIT_RETRY, classify=is_transient,
+                        cancel_check=lambda: self.ctx.check_commands(
+                            self.dyn_job),
+                        label=f"{self.dyn_job.job.NAME}-commit")
+                except (OSError, sqlite3.OperationalError) as e:
+                    if not is_disk_full(e):
+                        raise
+                    # full disk mid-commit (OSError ENOSPC from artifact
+                    # IO, or SQLite's own SQLITE_FULL "database or disk is
+                    # full"): retrying cannot free space and failing would
+                    # throw away the whole run — checkpoint-pause at the
+                    # last durable group instead (the group rolled back and
+                    # `data` was snapshot-restored above), resumable once
+                    # the operator frees space
+                    note_disk_full("commit")
+                    self.errors.append(
+                        f"commit hit a full disk (ENOSPC); checkpoint-"
+                        f"paused at batch {self._batches}: {e!r}")
+                    logger.error(
+                        "pipeline %s: disk full during commit; pausing at "
+                        "committed batch %d", self.dyn_job.job.NAME,
+                        self._batches)
+                    raise JobPaused(self.dyn_job.serialize_state(),
+                                    errors=self.errors) from e
             self._commit_s += sp.duration_s
             _BUSY.inc(sp.duration_s, stage="commit")
             self._txns += 1
@@ -326,6 +366,13 @@ class PipelineExecutor:
                 self.errors.extend(result.errors)
                 state.step_number += 1
                 self.ctx.progress(completed_task_count=state.step_number)
+            # durable crash checkpoint (ISSUE 9): persist the serialized
+            # state now that this group is committed, so a SIGKILL resumes
+            # at this boundary instead of step 0. Best-effort and OUTSIDE
+            # the group transaction: a kill between the commit and this
+            # upsert resumes one group early, and re-running a committed
+            # group is idempotent (its rows are no longer orphans).
+            self._persist_checkpoint()
 
         try:
             while True:

@@ -1,0 +1,215 @@
+"""Boot-time crash recovery: DB integrity gate + the repair ladder.
+
+The library SQLite file is the one artifact the whole system cannot
+regenerate, and nothing used to look at it between "the process died" and
+"the next scan wrote into it". This module is the boot-order gate
+(``Libraries._load`` runs it BEFORE the model layer opens the file):
+
+1. **WAL recovery** — opening the database replays a leftover ``-wal``
+   sidecar (SQLite's own crash recovery); a non-empty sidecar at boot is
+   counted (``sd_boot_integrity_wal_recovered_total``) so operators can
+   see how often nodes die with un-checkpointed work.
+2. **`PRAGMA quick_check`** — structural validation on a throwaway
+   read-only-intent connection. Passing costs milliseconds on healthy
+   files and is the gate for everything after it.
+3. **Repair ladder on corruption** — quarantine the damaged file (plus
+   WAL/SHM sidecars) under ``libraries/quarantine/``, then restore the
+   newest VALID backup of that library (validated tarball + matching
+   header ``library_id``, backups.py). No backup → the library comes up
+   with a fresh empty DB next to its quarantined remains. Either way the
+   node BOOTS — corruption is a repair event with telemetry and a stock
+   alert (``db-quick-check-failed``), never a boot failure.
+
+Disk-full accounting also lives here: every graceful-degradation site
+(gather quarantine, committer checkpoint-pause, thumbnail skip, trace
+export falling back to the in-memory ring, backup failure) reports
+through :func:`note_disk_full`, so ``sd_recovery_disk_full_total{site}``
+is the one series that says "this node is out of disk" regardless of
+which subsystem hit ENOSPC first.
+"""
+
+from __future__ import annotations
+
+import errno
+import logging
+import sqlite3
+import time
+from pathlib import Path
+from typing import Any
+
+from . import telemetry
+
+logger = logging.getLogger(__name__)
+
+_BOOT_CHECKS = telemetry.counter(
+    "sd_boot_integrity_checks_total",
+    "boot-time library DB integrity checks by outcome",
+    labels=("outcome",))
+_WAL_RECOVERED = telemetry.counter(
+    "sd_boot_integrity_wal_recovered_total",
+    "boots that found (and replayed) a non-empty WAL sidecar")
+_CHECK_SECONDS = telemetry.histogram(
+    "sd_boot_integrity_check_seconds",
+    "latency of one boot-time quick_check pass")
+_REPAIRS = telemetry.counter(
+    "sd_recovery_repairs_total",
+    "repair-ladder actions taken on a corrupt library DB",
+    labels=("action",))
+_COLD_RESUMED = telemetry.counter(
+    "sd_recovery_cold_resumed_jobs_total",
+    "interrupted jobs revived from their checkpoints at boot")
+_DISK_FULL = telemetry.counter(
+    "sd_recovery_disk_full_total",
+    "ENOSPC hits absorbed by graceful degradation, per site",
+    labels=("site",))
+
+
+def is_disk_full(exc: BaseException) -> bool:
+    """ENOSPC (and the quota-equivalent EDQUOT): the disk is full. Not
+    transient — retrying cannot free space — but never fatal either: every
+    wired seam degrades (quarantine / skip / pause / ring-only). SQLite
+    reports the same condition as its own ``OperationalError`` (SQLITE_FULL,
+    "database or disk is full") rather than an OSError — a real full disk
+    mid-commit surfaces THAT way, so it must classify identically."""
+    if isinstance(exc, OSError) and exc.errno in (
+            errno.ENOSPC, getattr(errno, "EDQUOT", errno.ENOSPC)):
+        return True
+    return (isinstance(exc, sqlite3.OperationalError)
+            and "disk is full" in str(exc).lower())
+
+
+def note_disk_full(site: str) -> None:
+    """Record one absorbed ENOSPC at ``site`` (gather | commit | thumbnail
+    | trace_export | backup | config) — counter + flight-recorder event."""
+    _DISK_FULL.inc(site=site)
+    telemetry.event("disk.full", site=site)
+
+
+def note_cold_resumed(count: int = 1) -> None:
+    if count > 0:
+        _COLD_RESUMED.inc(count)
+
+
+def quick_check_file(db_path: str | Path) -> list[str]:
+    """``PRAGMA quick_check`` on a throwaway connection; ``[]`` = sound.
+    An unopenable/not-a-database file reports as a single problem row
+    instead of raising — the caller treats both identically (corrupt)."""
+    try:
+        conn = sqlite3.connect(db_path, timeout=10.0)
+        try:
+            rows = conn.execute("PRAGMA quick_check").fetchall()
+        finally:
+            conn.close()
+    except sqlite3.Error as e:
+        return [f"unopenable: {e}"]
+    problems = [r[0] for r in rows]
+    return [] if problems == ["ok"] else problems
+
+
+def _quarantine(libraries_dir: Path, lib_id: str) -> Path | None:
+    """Move the damaged DB (+ sidecars) into ``libraries/quarantine/`` so
+    the evidence survives the repair; returns the quarantined DB path."""
+    import os
+
+    qdir = libraries_dir / "quarantine"
+    qdir.mkdir(parents=True, exist_ok=True)
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    moved: Path | None = None
+    for suffix in (".db", ".db-wal", ".db-shm"):
+        src = libraries_dir / f"{lib_id}{suffix}"
+        if not src.exists():
+            continue
+        dest = qdir / f"{lib_id}{suffix}.corrupt-{stamp}"
+        n = 0
+        while dest.exists():  # same-second double corruption in tests
+            n += 1
+            dest = qdir / f"{lib_id}{suffix}.corrupt-{stamp}.{n}"
+        os.replace(src, dest)
+        if suffix == ".db":
+            moved = dest
+    return moved
+
+
+def ensure_library_integrity(libraries_dir: str | Path, lib_id: str,
+                             backups_path: str | Path | None = None,
+                             node: Any = None) -> dict[str, Any]:
+    """The boot gate for one library DB; runs BEFORE the model layer opens
+    the file. Returns a verdict dict (``outcome`` ∈ ok | missing | repaired
+    | fresh) — and never raises: a corrupt DB becomes a repair, not a boot
+    failure."""
+    libraries_dir = Path(libraries_dir)
+    db_path = libraries_dir / f"{lib_id}.db"
+    if not db_path.exists():
+        return {"outcome": "missing"}
+
+    wal = libraries_dir / f"{lib_id}.db-wal"
+    wal_pending = wal.exists() and wal.stat().st_size > 0
+
+    t0 = time.perf_counter()
+    problems = quick_check_file(db_path)
+    _CHECK_SECONDS.observe(time.perf_counter() - t0)
+
+    if not problems:
+        _BOOT_CHECKS.inc(outcome="ok")
+        if wal_pending:
+            # quick_check's connection already replayed the WAL — the
+            # interrupted process's durable-but-uncheckpointed work made it
+            _WAL_RECOVERED.inc()
+        return {"outcome": "ok", "wal_recovered": wal_pending}
+
+    _BOOT_CHECKS.inc(outcome="corrupt")
+    telemetry.event("db.quick_check_failed", library=lib_id,
+                    problems=problems[:4])
+    logger.error("library %s failed quick_check (%d problem(s): %s); "
+                 "entering the repair ladder", lib_id[:8], len(problems),
+                 problems[:2])
+    quarantined = _quarantine(libraries_dir, lib_id)
+    _REPAIRS.inc(action="quarantine")
+
+    backup: Path | None = None
+    if backups_path is not None and Path(backups_path).is_dir():
+        from .backups import find_latest_backup
+
+        backup = find_latest_backup(backups_path, lib_id)
+    if backup is not None:
+        try:
+            from .backups import restore_files
+
+            # find_latest_backup already ran the full validation walk on
+            # this path — don't pay the gzip-CRC drain a second time
+            restore_files(backup, lib_id, libraries_dir, pre_validated=True)
+            _REPAIRS.inc(action="restore_backup")
+            telemetry.event("db.restored_from_backup", library=lib_id,
+                            backup=str(backup))
+            logger.warning("library %s restored from backup %s "
+                           "(damaged file kept at %s)", lib_id[:8],
+                           backup.name, quarantined)
+            _notify(node, lib_id, "restored_from_backup", str(backup))
+            return {"outcome": "repaired", "backup": str(backup),
+                    "quarantined": str(quarantined) if quarantined else None}
+        except Exception:
+            logger.exception("restore from %s failed; library %s starts "
+                             "with a fresh DB", backup, lib_id[:8])
+    _REPAIRS.inc(action="fresh_db")
+    logger.warning("library %s has no restorable backup; starting with a "
+                   "fresh DB (damaged file kept at %s)", lib_id[:8],
+                   quarantined)
+    _notify(node, lib_id, "fresh_db", None)
+    return {"outcome": "fresh",
+            "quarantined": str(quarantined) if quarantined else None}
+
+
+def _notify(node: Any, lib_id: str, action: str,
+            backup: str | None) -> None:
+    """Loud surface for a repair (best-effort: notifications must never
+    block a boot that is already recovering from corruption)."""
+    if node is None:
+        return
+    try:
+        from .notifications import emit_node_notification
+
+        emit_node_notification(node, {
+            "kind": "library_db_repaired", "library_id": lib_id,
+            "action": action, "backup": backup})
+    except Exception:
+        logger.exception("repair notification could not be emitted")

@@ -346,6 +346,29 @@ def _declare_core() -> None:
               labels=("backend",))
     counter("sd_telemetry_events_total", "named telemetry events",
             labels=("name",))
+    # crash-consistent durability (ISSUE 9): boot integrity + repair ladder
+    # + disk-full degradation + accept-layer throttling (recovery.py,
+    # p2p/throttle.py hold the matching module handles)
+    boot = counter("sd_boot_integrity_checks_total",
+                   "boot-time library DB integrity checks by outcome",
+                   labels=("outcome",))
+    for outcome in ("ok", "corrupt"):
+        boot.labels(outcome=outcome)
+    counter("sd_boot_integrity_wal_recovered_total",
+            "boots that found (and replayed) a non-empty WAL sidecar")
+    histogram("sd_boot_integrity_check_seconds",
+              "latency of one boot-time quick_check pass")
+    counter("sd_recovery_repairs_total",
+            "repair-ladder actions taken on a corrupt library DB",
+            labels=("action",))
+    counter("sd_recovery_cold_resumed_jobs_total",
+            "interrupted jobs revived from their checkpoints at boot")
+    counter("sd_recovery_disk_full_total",
+            "ENOSPC hits absorbed by graceful degradation, per site",
+            labels=("site",))
+    counter("sd_p2p_throttled_sessions_total",
+            "inbound sessions refused by the per-peer accept-layer token "
+            "bucket", labels=("peer",))
 
 
 _declare_core()

@@ -141,6 +141,22 @@ def default_rules() -> list[AlertRule]:
             description="no device-engine routing rate has ever been "
                         "published — the relay is still down and device "
                         "numbers remain unmeasured"),
+        AlertRule(
+            name="db-quick-check-failed", kind=THRESHOLD,
+            series="sd_boot_integrity_checks_total",
+            labels={"outcome": "corrupt"}, op="gt", value=0.0, for_s=0.0,
+            severity="critical",
+            description="a library DB failed PRAGMA quick_check at boot — "
+                        "the repair ladder quarantined it and restored the "
+                        "newest backup (or recreated it fresh); inspect "
+                        "libraries/quarantine/"),
+        AlertRule(
+            name="disk-full", kind=RATE,
+            series="sd_recovery_disk_full_total", op="gt", value=0.01,
+            window_s=60.0, for_s=0.0, severity="critical",
+            description="ENOSPC is being absorbed by graceful degradation "
+                        "(quarantined gathers, skipped thumbnails, ring-only "
+                        "telemetry, paused commits) — free disk space"),
     ]
 
 

@@ -322,19 +322,33 @@ def traces_dir(base_dir: str | Path) -> Path:
 
 
 def export_trace(trace: Trace, base_dir: str | Path) -> str | None:
-    """Write one JSONL file per trace; best-effort (a full disk must not
-    fail a job)."""
+    """Write one JSONL file per trace — atomically (tempfile→fsync→rename,
+    utils/atomic), so a kill mid-export can never leave a torn file under
+    the export name. Best-effort: a full disk (the ``trace_export`` chaos
+    seam rehearses it) degrades to the in-memory ring only, and must not
+    fail the job that owns the trace."""
     try:
+        from ..utils.atomic import atomic_write_text
+
+        from .. import faults
+
+        faults.inject("trace_export", key=trace.trace_id)
         out_dir = traces_dir(base_dir)
         out_dir.mkdir(parents=True, exist_ok=True)
         path = out_dir / f"{trace.trace_id}.jsonl"
-        with open(path, "w", encoding="utf-8") as fh:
-            for record in trace.records():
-                fh.write(json.dumps({"trace_id": trace.trace_id, **record},
-                                    default=str) + "\n")
+        atomic_write_text(path, "".join(
+            json.dumps({"trace_id": trace.trace_id, **record}, default=str)
+            + "\n" for record in trace.records()))
         return str(path)
-    except OSError:
-        logger.exception("could not export trace %s", trace.trace_id)
+    except OSError as e:
+        import errno as _errno
+
+        if getattr(e, "errno", None) == _errno.ENOSPC:
+            from ..recovery import note_disk_full
+
+            note_disk_full("trace_export")
+        logger.exception("could not export trace %s (serving from the "
+                         "in-memory ring only)", trace.trace_id)
         return None
 
 
@@ -345,15 +359,36 @@ _TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._-]+$")
 
 def load_trace_tree(trace_id: str, base_dir: str | Path) -> dict[str, Any] | None:
     """Rebuild an exported trace's tree (the jobTrace fallback after the
-    in-memory ring evicted it or the process restarted)."""
+    in-memory ring evicted it or the process restarted).
+
+    Tolerates torn lines: a crash mid-append (pre-atomic exports, or a
+    file truncated by a full disk) leaves a final line cut mid-record —
+    that line is skipped with a warning instead of poisoning the whole
+    export. Only a file with NO decodable record reads as missing."""
     if not _TRACE_ID_RE.match(trace_id) or ".." in trace_id:
         return None
     path = traces_dir(base_dir) / f"{trace_id}.jsonl"
     try:
-        records = [json.loads(line) for line in
-                   path.read_text().splitlines() if line.strip()]
-    except (OSError, json.JSONDecodeError):
+        lines = path.read_text().splitlines()
+    except OSError:
         return None
+    records = []
+    dropped = 0
+    for line in lines:
+        if not line.strip():
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            dropped += 1
+            continue
+        if isinstance(record, dict) and "span_id" in record:
+            records.append(record)
+        else:
+            dropped += 1
+    if dropped:
+        logger.warning("trace %s: skipped %d torn/garbage line(s) in %s",
+                       trace_id, dropped, path.name)
     if not records:
         return None
     return build_tree(trace_id, records)

@@ -1,0 +1,133 @@
+"""Crash-safe artifact writes: the tempfile → fsync → rename discipline.
+
+A user-visible artifact (backup, thumbnail, trace export, config sidecar)
+must never be observable half-written: a process kill or a full disk
+mid-`write()` would otherwise leave a torn file that poisons every later
+reader (a backup that fails validation, a thumbnail that renders as
+garbage, a JSONL export whose tail line is cut mid-record).
+
+The discipline, applied by every writer in this module:
+
+1. write the complete payload to a temporary file **in the destination
+   directory** (same filesystem, so the rename is atomic);
+2. ``fsync`` the temp file (the data is durable before the name exists);
+3. ``os.replace`` it over the destination (atomic on POSIX);
+4. best-effort ``fsync`` the directory (the *rename* is durable too).
+
+A kill at any point leaves either the old artifact or the new one —
+never a hybrid — plus at worst one stale ``*.sd-tmp*`` file, which
+:func:`cleanup_stale_tmp` sweeps on the next boot.
+
+The sdlint ``durability-discipline`` pass keeps artifact writers in
+objects|backups|telemetry|preferences on this helper (or explicitly
+waived) — see docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import uuid
+from pathlib import Path
+from typing import Iterator
+
+logger = logging.getLogger(__name__)
+
+#: infix every temp file carries so stale ones are recognizable at boot
+TMP_MARK = ".sd-tmp"
+
+
+def _fsync_dir(directory: Path) -> None:
+    """Durable rename: fsync the directory entry (best-effort — some
+    filesystems refuse O_RDONLY dir fds; the file data is already safe)."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_path(dest: str | Path) -> Iterator[Path]:
+    """Yield a temp path next to ``dest``; on clean exit fsync it and
+    rename it into place, on exception unlink it. For writers that need a
+    *path* (PIL ``save``, native encoders), not a file object."""
+    dest = Path(dest)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    tmp = dest.parent / f"{dest.name}{TMP_MARK}.{uuid.uuid4().hex[:8]}"
+    try:
+        yield tmp
+        if tmp.exists():
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        os.replace(tmp, dest)
+        _fsync_dir(dest.parent)
+    except BaseException:
+        tmp.unlink(missing_ok=True)
+        raise
+
+
+def atomic_write_bytes(dest: str | Path, data: bytes) -> None:
+    dest = Path(dest)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    tmp = dest.parent / f"{dest.name}{TMP_MARK}.{uuid.uuid4().hex[:8]}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        # chaos seam AT the discipline's crash window: the temp is fully
+        # written and durable but the destination name does not exist yet —
+        # a kill/enospc here is the exact torn-write moment the
+        # tempfile→rename contract defends against
+        from .. import faults
+
+        faults.inject("artifact_write", key=dest.name)
+        os.replace(tmp, dest)
+        _fsync_dir(dest.parent)
+    except BaseException:
+        tmp.unlink(missing_ok=True)
+        raise
+
+
+def atomic_write_text(dest: str | Path, text: str,
+                      encoding: str = "utf-8") -> None:
+    atomic_write_bytes(dest, text.encode(encoding))
+
+
+def cleanup_stale_tmp(directory: str | Path) -> int:
+    """Remove ``*.sd-tmp*`` leftovers a kill stranded mid-write (called at
+    boot for artifact dirs); returns how many were removed. Scans the
+    directory AND one subdirectory level — sharded artifact dirs (the
+    thumbnail cache's 2-hex shards) keep their temps one level down."""
+    directory = Path(directory)
+    removed = 0
+    try:
+        entries = list(directory.glob(f"*{TMP_MARK}*")) \
+            + list(directory.glob(f"*/*{TMP_MARK}*"))
+    except OSError:
+        return 0
+    for stale in entries:
+        try:
+            if stale.is_dir():
+                import shutil
+
+                shutil.rmtree(stale, ignore_errors=True)
+            else:
+                stale.unlink(missing_ok=True)
+            removed += 1
+        except OSError:
+            logger.debug("could not remove stale temp %s", stale)
+    if removed:
+        logger.info("removed %d stale temp artifact(s) under %s",
+                    removed, directory)
+    return removed

@@ -18,7 +18,6 @@ Usage::
 from __future__ import annotations
 
 import json
-import os
 from pathlib import Path
 from typing import Any, Callable, ClassVar
 
@@ -108,10 +107,21 @@ class VersionedConfig:
         return cfg
 
     def save(self) -> None:
-        tmp = self.path.with_suffix(self.path.suffix + ".tmp")
-        self.path.parent.mkdir(parents=True, exist_ok=True)
-        tmp.write_text(json.dumps(self.data, indent=2, sort_keys=True))
-        os.replace(tmp, self.path)
+        # full tempfile→fsync→rename discipline (utils/atomic): config
+        # sidecars are the one artifact whose torn write can make a whole
+        # library unloadable, and the old tmp+rename skipped the fsync —
+        # a power cut could rename an empty tmp into place
+        from .atomic import atomic_write_text
+
+        try:
+            atomic_write_text(self.path, json.dumps(self.data, indent=2,
+                                                    sort_keys=True))
+        except OSError as e:
+            from ..recovery import is_disk_full, note_disk_full
+
+            if is_disk_full(e):
+                note_disk_full("config")
+            raise
 
     # -- dict-ish access ----------------------------------------------------
     def __getitem__(self, key: str) -> Any:

@@ -483,6 +483,48 @@ def test_queue_discipline_scoped_and_waivable(tmp_path):
         "queue-discipline") == []
 
 
+# -- pass 13: durability-discipline -------------------------------------------
+
+def test_durability_discipline_flags_in_place_artifact_writes(tmp_path):
+    """ISSUE 9 fixture: bare write-mode opens and Path write methods in the
+    artifact subsystems are torn-write hazards."""
+    bad = run_on(tmp_path, "objects/bad.py", (
+        "def save(path, out, data):\n"
+        "    with open(path, 'w') as fh:\n"
+        "        fh.write(data)\n"
+        "    with open(path, mode='ab') as fh:\n"
+        "        fh.write(data)\n"
+        "    out.write_bytes(data)\n"
+        "    out.write_text('x')\n"), "durability-discipline")
+    assert [f.lineno for f in bad] == [2, 4, 6, 7]
+    assert "torn" in bad[0].message
+
+
+def test_durability_discipline_allows_tmp_reads_and_out_of_scope(tmp_path):
+    # the tempfile half of tempfile+rename, reads, and x/r+ modes are fine
+    assert run_on(tmp_path, "backups.py", (
+        "def save(dest, tmp_path, data):\n"
+        "    tmp_path.write_bytes(data)\n"
+        "    with open(dest) as fh:\n"
+        "        fh.read()\n"
+        "    with open(dest, 'rb') as fh:\n"
+        "        fh.read()\n"
+        "    with open(dest, 'x') as fh:\n"
+        "        fh.write(data)\n"), "durability-discipline") == []
+    # non-artifact subsystems stream freely
+    assert run_on(tmp_path, "sync/stream.py", (
+        "def f(path, data):\n"
+        "    with open(path, 'w') as fh:\n"
+        "        fh.write(data)\n"), "durability-discipline") == []
+
+
+def test_durability_discipline_waivable(tmp_path):
+    assert run_on(tmp_path, "objects/waived.py", (
+        "def f(dst, data):\n"
+        "    with open(dst, 'wb') as fh:  # lint: ok(durability-discipline)\n"
+        "        fh.write(data)\n"), "durability-discipline") == []
+
+
 # -- waivers ------------------------------------------------------------------
 
 def test_scoped_waiver_silences_only_named_pass(tmp_path):

@@ -1,0 +1,511 @@
+"""Crash-consistent durability gates (ISSUE 9): the process-kill torture
+matrix, boot-time integrity + repair ladder, ENOSPC degradation at every
+wired seam, crash-safe artifact writes, torn-JSONL tolerance, and the
+session-accept token bucket.
+
+The kill matrix spawns REAL node subprocesses (tests/crash_harness.py),
+SIGKILLs them at seeded seam-driven points, restarts the same data dir,
+and gates that the restart passes ``PRAGMA quick_check``, cold-resumes
+from the durable checkpoint, and converges to a state byte-identical
+(structural snapshot: rows + CRDT op order) to an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+
+import pytest
+
+from spacedrive_tpu import backups, faults, recovery, telemetry
+from spacedrive_tpu.faults.spec import FaultPlan, FaultSpecError
+from spacedrive_tpu.models import Tag
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.utils import atomic
+
+from . import crash_harness as ch
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    faults.clear()
+    yield
+    faults.clear()
+    telemetry.reset()
+    telemetry.reload_enabled()
+
+
+# ---------------------------------------------------------------------------
+# the kill matrix (tentpole gate)
+# ---------------------------------------------------------------------------
+
+
+#: ≥6 seeded kill points across scan / sync / backup workloads (shared
+#: with ``bench.py --crash`` — the harness owns them); skipN pins each to
+#: an exact seam hit (deterministic workload ⇒ deterministic death point)
+SCAN_KILLS = ch.SCAN_KILLS
+SYNC_KILLS = ch.SYNC_KILLS
+BACKUP_KILLS = ch.BACKUP_KILLS
+
+
+def test_kill_matrix(tmp_path):
+    """Every kill point: crash run dies by SIGKILL, restart passes the
+    boot integrity check, cold-resumes, and ends byte-identical to the
+    uninterrupted reference run of the same workload."""
+    tree = ch.make_tree(tmp_path / "tree")
+    ops = ch.gen_ops_file(tmp_path / "ops.jsonl")
+    scan_args = {"tree": str(tree)}
+    sync_args = {"ops_file": str(ops)}
+
+    _rc, scan_ref = ch.run_child("scan", tmp_path / "scan-ref", scan_args)
+    _rc, sync_ref = ch.run_child("sync", tmp_path / "sync-ref", sync_args)
+    _rc, bk_ref = ch.run_child("backup", tmp_path / "bk-ref", {})
+
+    survived = []
+    for spec in SCAN_KILLS:
+        res = ch.run_kill_point(tmp_path, "scan", spec, scan_args)
+        boot = res["boot"]
+        assert boot["quick_check_ok"], (spec, boot)
+        assert boot["integrity_ok"] >= 1 and boot["integrity_corrupt"] == 0
+        assert boot["cold_resumed"] >= 1, \
+            f"{spec}: the killed job was not cold-resumed"
+        # the interrupted job row must carry a RUNNING checkpoint the
+        # restart resumed from
+        pre = [j for j in res["pre_jobs"].values()
+               if j["name"] == "file_identifier"]
+        assert pre and pre[0]["status"] == 1, (spec, res["pre_jobs"])
+        if spec.startswith("commit"):
+            # the kill landed AFTER at least one durable group: the crash
+            # checkpoint must prove mid-run persistence, not a step-0 rerun
+            assert pre[0]["checkpoint_step"] and pre[0]["checkpoint_step"] > 0
+        assert res["snapshot"] == scan_ref["snapshot"], \
+            f"{spec}: restarted scan diverged from the uninterrupted run"
+        survived.append(spec)
+
+    for spec in SYNC_KILLS:
+        res = ch.run_kill_point(tmp_path, "sync", spec, sync_args)
+        assert res["boot"]["quick_check_ok"], (spec, res["boot"])
+        # the ingest floor contract: every op lost to the kill was
+        # re-served and the final op-log is identical — order included
+        assert res["oplog"] == sync_ref["oplog"], \
+            f"{spec}: op-log diverged after the kill (floors skipped ops?)"
+        survived.append(spec)
+
+    for spec in BACKUP_KILLS:
+        res = ch.run_kill_point(tmp_path, "backup", spec, {})
+        assert res["boot"]["quick_check_ok"]
+        # atomic backup writes: a kill mid-backup — after the tar, or
+        # inside the write discipline with the temp already durable —
+        # leaves NO .bkp at all, and the restart's re-backup validates
+        # end-to-end with any stranded temp swept at boot
+        assert res["validity"] and all(res["validity"].values()), \
+            f"{spec}: torn backup survived the kill: {res['validity']}"
+        assert res["snapshot"] == bk_ref["snapshot"]
+        data_dir = tmp_path / f"backup-{spec.replace(':', '_')}"
+        assert not list((data_dir / "backups").glob(f"*{atomic.TMP_MARK}*"))
+        survived.append(spec)
+
+    assert len(survived) >= 6
+
+
+def test_kill_during_restore_leaves_library_intact(tmp_path):
+    """Satellite: restore goes temp-dir → validate → atomic rename, so a
+    SIGKILL mid-restore leaves the old library untouched; a clean restore
+    afterwards lands exactly the backup content."""
+    data_dir = tmp_path / "node"
+    _rc, seeded = ch.run_child("backup", data_dir, {"post_rows": 50})
+    rc, _ = ch.run_child(
+        "restore", data_dir,
+        {"backup_path": seeded["backup_path"],
+         "faults": "restore:kill:once"}, expect_kill=True)
+    assert rc == -signal.SIGKILL
+    _rc, survivor = ch.run_child("inspect", data_dir,
+                                 {"lib_id": ch.BK_LIB_ID})
+    assert survivor["boot"]["quick_check_ok"]
+    # 400 seeded + 50 post-backup rows: the mutated LIVE state survived
+    assert len(survivor["snapshot"]["tags"]) == 450
+    _rc, restored = ch.run_child("restore", data_dir,
+                                 {"backup_path": seeded["backup_path"]})
+    assert len(restored["snapshot"]["tags"]) == 400  # backup content
+    # no stranded temp debris after the inspect boot's sweep
+    assert not list((data_dir / "libraries").glob(f"*{atomic.TMP_MARK}*"))
+
+
+# ---------------------------------------------------------------------------
+# boot integrity + the repair ladder (in-process)
+# ---------------------------------------------------------------------------
+
+
+def _corrupt(db_path):
+    with open(db_path, "r+b") as fh:
+        fh.seek(4096)
+        fh.write(b"\xde\xad\xbe\xef" * 2048)
+
+
+def test_corrupt_db_repairs_from_backup(tmp_path):
+    node = Node(tmp_path / "n", probe_accelerator=False,
+                watch_locations=False)
+    lib = node.libraries.create("repair-me")
+    lib_id = lib.id
+    lib.db.insert_many(Tag, [{"pub_id": f"t-{i}", "name": f"n{i}"}
+                             for i in range(300)])
+    backups.do_backup(node, lib_id)
+    lib.db.insert(Tag, {"pub_id": "post-backup", "name": "lost"})
+    node.shutdown()
+
+    _corrupt(tmp_path / "n" / "libraries" / f"{lib_id}.db")
+
+    node2 = Node(tmp_path / "n", probe_accelerator=False,
+                 watch_locations=False)
+    try:
+        lib2 = node2.libraries.get(lib_id)  # BOOTED — not a boot failure
+        assert lib2.db.quick_check() == []
+        assert lib2.db.count(Tag) == 300  # backup content; post-backup gone
+        assert telemetry.value("sd_boot_integrity_checks_total",
+                               outcome="corrupt") == 1
+        assert telemetry.value("sd_recovery_repairs_total",
+                               action="quarantine") == 1
+        assert telemetry.value("sd_recovery_repairs_total",
+                               action="restore_backup") == 1
+        quarantined = list(
+            (tmp_path / "n" / "libraries" / "quarantine").glob("*.corrupt-*"))
+        assert quarantined, "damaged file was not preserved"
+        # the stock alert fires on the corrupt outcome
+        from spacedrive_tpu.telemetry.alerts import AlertEvaluator
+
+        state = {s["name"]: s
+                 for s in AlertEvaluator(interval_s=999).evaluate_once()}
+        assert state["db-quick-check-failed"]["firing"]
+    finally:
+        node2.shutdown()
+
+
+def test_corrupt_db_without_backup_starts_fresh(tmp_path):
+    node = Node(tmp_path / "n", probe_accelerator=False,
+                watch_locations=False)
+    lib = node.libraries.create("no-backup")
+    lib_id = lib.id
+    lib.db.insert(Tag, {"pub_id": "gone", "name": "gone"})
+    node.shutdown()
+    _corrupt(tmp_path / "n" / "libraries" / f"{lib_id}.db")
+
+    node2 = Node(tmp_path / "n", probe_accelerator=False,
+                 watch_locations=False)
+    try:
+        lib2 = node2.libraries.get(lib_id)
+        assert lib2.db.quick_check() == []
+        assert lib2.db.count(Tag) == 0  # fresh DB, quarantined remains kept
+        assert telemetry.value("sd_recovery_repairs_total",
+                               action="fresh_db") == 1
+    finally:
+        node2.shutdown()
+
+
+def test_wal_recovery_is_counted(tmp_path):
+    """A non-empty WAL sidecar at boot (durable-but-uncheckpointed work
+    from a killed process) is replayed and counted."""
+    import sqlite3
+
+    node = Node(tmp_path / "n", probe_accelerator=False,
+                watch_locations=False)
+    lib = node.libraries.create("wal")
+    lib_id = lib.id
+    lib.db.insert(Tag, {"pub_id": "walrow", "name": "w"})
+    # leave the WAL in place: no checkpoint, no clean close (simulating a
+    # kill after a durable commit) — a raw second connection with
+    # journal_mode already WAL appends without truncating
+    node.shutdown()
+    conn = sqlite3.connect(tmp_path / "n" / "libraries" / f"{lib_id}.db")
+    conn.execute("PRAGMA journal_mode=WAL")
+    conn.execute("INSERT INTO tag (pub_id, name) VALUES ('walrow2', 'x')")
+    conn.commit()
+    # skip conn.close(): abandoning the handle leaves the -wal populated
+    wal = tmp_path / "n" / "libraries" / f"{lib_id}.db-wal"
+    assert wal.exists() and wal.stat().st_size > 0
+    node2 = Node(tmp_path / "n", probe_accelerator=False,
+                 watch_locations=False)
+    try:
+        lib2 = node2.libraries.get(lib_id)
+        assert lib2.db.count(Tag) == 2  # WAL rows survived
+        assert telemetry.value("sd_boot_integrity_wal_recovered_total") == 1
+    finally:
+        conn.close()
+        node2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# backup validation (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def backed_up_node(tmp_path):
+    node = Node(tmp_path / "bk", probe_accelerator=False,
+                watch_locations=False)
+    lib = node.libraries.create("valid")
+    lib.db.insert_many(Tag, [{"pub_id": f"v-{i}", "name": f"v{i}"}
+                             for i in range(20)])
+    backup_id = backups.do_backup(node, lib.id)
+    yield node, lib, backups.backups_dir(node) / f"{backup_id}.bkp"
+    node.shutdown()
+
+
+def test_validate_backup_rejects_garbage(backed_up_node, tmp_path):
+    node, lib, bkp = backed_up_node
+    header = backups.validate_backup(bkp)  # the real one validates
+    assert header["library_id"] == lib.id
+
+    bad_magic = tmp_path / "bad_magic.bkp"
+    bad_magic.write_bytes(b"NOTABACK" + bkp.read_bytes()[8:])
+    with pytest.raises(ValueError, match="header"):
+        backups.validate_backup(bad_magic)
+
+    truncated = tmp_path / "truncated.bkp"
+    truncated.write_bytes(bkp.read_bytes()[:-200])
+    with pytest.raises(ValueError, match="corrupt archive|missing member"):
+        backups.validate_backup(truncated)
+
+    with pytest.raises(ValueError, match="does not match"):
+        backups.validate_backup(bkp, expect_library_id="someone-else")
+
+    # a flipped byte inside the gzip body fails the CRC walk
+    body = bytearray(bkp.read_bytes())
+    body[len(body) // 2] ^= 0xFF
+    flipped = tmp_path / "flipped.bkp"
+    flipped.write_bytes(bytes(body))
+    with pytest.raises(ValueError):
+        backups.validate_backup(flipped)
+
+
+def test_restore_refuses_wrong_library(backed_up_node):
+    node, lib, bkp = backed_up_node
+    other = node.libraries.create("other")
+    with pytest.raises(ValueError, match="does not match"):
+        backups.restore_files(bkp, other.id, node.libraries.dir)
+    assert other.db.count(Tag) == 0  # untouched
+
+
+def test_backup_write_is_atomic_under_enospc(backed_up_node):
+    node, lib, _bkp = backed_up_node
+    before = {p.name for p in backups.backups_dir(node).glob("*")}
+    # the artifact_write seam fires INSIDE the atomic discipline, after
+    # the temp is fully written — the failure path must unlink it
+    faults.install("artifact_write:enospc:once", seed=0)
+    with pytest.raises(OSError):
+        backups.do_backup(node, lib.id)
+    after = {p.name for p in backups.backups_dir(node).glob("*")}
+    assert after == before  # no torn .bkp, no stranded temp
+    assert telemetry.value("sd_recovery_disk_full_total", site="backup") == 1
+
+
+# ---------------------------------------------------------------------------
+# ENOSPC degradation at each wired seam (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_enospc_scan_completes_with_quarantine(tmp_path):
+    from spacedrive_tpu.jobs import JobStatus
+    from spacedrive_tpu.models import JobRow
+
+    from .test_pipeline import _seed_library
+    from .test_faults import _identify
+
+    tree = ch.make_tree(tmp_path / "tree", n_files=60)
+    node, lib, loc_id = _seed_library(tmp_path / "scan", tree, "enospc")
+    faults.install("gather:enospc:5", seed=0)
+    jid = _identify(node, lib, loc_id)
+    row = lib.db.find_one(JobRow, {"id": jid})
+    assert row["status"] == JobStatus.COMPLETED_WITH_ERRORS
+    assert row["errors_text"].count("quarantined") == 5
+    assert telemetry.value("sd_quarantined_files_total") == 5
+    assert telemetry.value("sd_recovery_disk_full_total", site="gather") == 5
+    node.shutdown()
+
+
+def test_enospc_commit_pauses_then_resumes_identically(tmp_path):
+    from spacedrive_tpu.jobs import JobStatus
+    from spacedrive_tpu.models import JobRow
+    from spacedrive_tpu.objects import file_identifier as fi
+
+    from .test_pipeline import _seed_library
+
+    tree = ch.make_tree(tmp_path / "tree", n_files=60)
+    node_a, lib_a, loc_a = _seed_library(tmp_path / "clean", tree, "ref")
+    node_a.jobs.spawn(lib_a, [fi.FileIdentifierJob({"location_id": loc_a})])
+    assert node_a.jobs.wait_idle(120)
+    ref = ch.snapshot_library(lib_a.db)
+    node_a.shutdown()
+
+    node, lib, loc_id = _seed_library(tmp_path / "full", tree, "full")
+    faults.install("commit:enospc", seed=0)  # every txn: the disk is full
+    jid = node.jobs.spawn(lib, [fi.FileIdentifierJob(
+        {"location_id": loc_id})])
+    assert node.jobs.wait_idle(120)
+    row = lib.db.find_one(JobRow, {"id": jid})
+    # never a wedged/FAILED job: an ENOSPC commit checkpoint-pauses
+    assert row["status"] == JobStatus.PAUSED, row["errors_text"]
+    assert "full disk" in (row["errors_text"] or "")
+    assert telemetry.value("sd_recovery_disk_full_total", site="commit") >= 1
+    # space frees up → resume → byte-identical completion
+    faults.clear()
+    assert node.jobs.resume(lib, jid)
+    assert node.jobs.wait_idle(120)
+    row = lib.db.find_one(JobRow, {"id": jid})
+    assert row["status"] == JobStatus.COMPLETED_WITH_ERRORS  # pause note
+    assert ch.snapshot_library(lib.db) == ref
+    node.shutdown()
+
+
+def test_enospc_thumbnail_skips_and_logs(tmp_path):
+    pil = pytest.importorskip("PIL.Image")
+    from spacedrive_tpu.objects.media.thumbnail import generate_thumbnail
+
+    src = tmp_path / "pic.png"
+    pil.new("RGB", (64, 64), (10, 200, 30)).save(src)
+    faults.install("thumbnail:enospc:once", seed=0)
+    assert generate_thumbnail(src, tmp_path / "data", "cafe0001") is None
+    assert telemetry.value("sd_recovery_disk_full_total",
+                           site="thumbnail") == 1
+    # the disk "recovers": same call now produces the artifact atomically
+    out = generate_thumbnail(src, tmp_path / "data", "cafe0001")
+    assert out is not None and out.exists()
+    assert not list(out.parent.glob(f"*{atomic.TMP_MARK}*"))
+
+
+def test_enospc_trace_export_degrades_to_ring(tmp_path):
+    from spacedrive_tpu.telemetry import spans as tspans
+
+    trace = telemetry.start_trace("job.t", trace_id="ring-only")
+    with trace.span("step"):
+        pass
+    faults.install("trace_export:enospc", seed=0)
+    summary = telemetry.finish_trace(trace, export_dir=tmp_path)
+    assert summary is not None and "file" not in summary  # no JSONL landed
+    assert not list(tspans.traces_dir(tmp_path).glob("*")) \
+        or not (tspans.traces_dir(tmp_path) / "ring-only.jsonl").exists()
+    assert telemetry.value("sd_recovery_disk_full_total",
+                           site="trace_export") == 1
+    # the in-memory ring still serves the tree
+    tree = telemetry.job_trace("ring-only")
+    assert tree is not None and tree["trace_id"] == "ring-only"
+
+
+# ---------------------------------------------------------------------------
+# atomic artifact writes + torn JSONL (satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_write_helpers(tmp_path):
+    dest = tmp_path / "artifact.json"
+    atomic.atomic_write_text(dest, '{"ok": 1}')
+    assert json.loads(dest.read_text()) == {"ok": 1}
+    atomic.atomic_write_bytes(dest, b"v2")
+    assert dest.read_bytes() == b"v2"
+    assert not list(tmp_path.glob(f"*{atomic.TMP_MARK}*"))
+
+    with pytest.raises(RuntimeError):
+        with atomic.atomic_path(dest) as tmp:
+            tmp.write_bytes(b"torn")
+            raise RuntimeError("kill mid-write")
+    assert dest.read_bytes() == b"v2"  # old artifact intact
+    assert not list(tmp_path.glob(f"*{atomic.TMP_MARK}*"))
+
+    (tmp_path / f"stale{atomic.TMP_MARK}.dead").write_bytes(b"x")
+    assert atomic.cleanup_stale_tmp(tmp_path) == 1
+    assert dest.exists()
+
+
+def test_torn_trace_jsonl_line_is_skipped(tmp_path):
+    from spacedrive_tpu.telemetry import spans as tspans
+
+    out = tspans.traces_dir(tmp_path)
+    out.mkdir(parents=True)
+    good_root = json.dumps({"trace_id": "t1", "span_id": 0,
+                            "parent_id": None, "name": "job.x",
+                            "start_unix": 1.0, "duration_s": 2.0})
+    good_child = json.dumps({"trace_id": "t1", "span_id": 1,
+                             "parent_id": 0, "name": "step",
+                             "start_unix": 1.1, "duration_s": 0.5})
+    # crash mid-append: the trailing record is cut mid-JSON
+    (out / "t1.jsonl").write_text(
+        good_root + "\n" + good_child + "\n" + good_child[: len(good_child) // 2])
+    tree = tspans.load_trace_tree("t1", tmp_path)
+    assert tree is not None and tree["name"] == "job.x"
+    assert [c["name"] for c in tree["children"]] == ["step"]
+    # a fully-garbage file still reads as missing, not a crash
+    (out / "t2.jsonl").write_text("not json at all\n{torn")
+    assert tspans.load_trace_tree("t2", tmp_path) is None
+
+
+# ---------------------------------------------------------------------------
+# fault-spec extensions + throttle (satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_skip_trigger_semantics():
+    plan = FaultPlan("gather:eio:skip3", seed=0)
+    fired = 0
+    for _ in range(5):
+        try:
+            plan.check("gather")
+        except OSError:
+            fired += 1
+    assert fired == 2  # hits 4 and 5
+    with pytest.raises(FaultSpecError):
+        FaultPlan("gather:eio:skipx", seed=0)
+    with pytest.raises(FaultSpecError):
+        FaultPlan("gather:kill:skip-1", seed=0)
+
+
+def test_session_throttle_token_bucket():
+    from spacedrive_tpu.p2p.throttle import SessionThrottle
+    from spacedrive_tpu.telemetry import mesh
+
+    clock = [0.0]
+    throttle = SessionThrottle(rate=1.0, burst=3.0,
+                               clock=lambda: clock[0])
+    flooder, polite = "flooder-identity", "polite-identity"
+    # burst drains after 3 back-to-back sessions; the 4th+ are refused
+    assert [throttle.admit(flooder) for _ in range(5)] == \
+        [True, True, True, False, False]
+    # a different peer has its own bucket
+    assert throttle.admit(polite)
+    # tokens refill at `rate`: one second buys one session
+    clock[0] = 1.0
+    assert throttle.admit(flooder)
+    assert not throttle.admit(flooder)
+    assert throttle.retry_after_s(flooder) > 0
+    assert telemetry.value("sd_p2p_throttled_sessions_total",
+                           peer=mesh.peer_label(flooder)) == 3
+    status = throttle.status()
+    assert status["throttled_sessions"] == 3
+    assert status["tracked_peers"] == 2
+
+
+def test_session_throttle_bounded_peer_map():
+    from spacedrive_tpu.p2p.throttle import SessionThrottle
+
+    throttle = SessionThrottle(rate=1.0, burst=1.0)
+    for i in range(SessionThrottle.MAX_PEERS + 50):
+        throttle.admit(f"peer-{i}")
+    assert throttle.status()["tracked_peers"] <= SessionThrottle.MAX_PEERS
+
+
+def test_enospc_kind_registered():
+    import errno
+    import sqlite3
+
+    plan = FaultPlan("backup:enospc:once", seed=0)
+    with pytest.raises(OSError) as exc_info:
+        plan.check("backup")
+    assert exc_info.value.errno == errno.ENOSPC
+    assert recovery.is_disk_full(exc_info.value)
+    assert not recovery.is_disk_full(OSError(errno.EIO, "io"))
+    # SQLite reports a full disk as SQLITE_FULL, not an OSError — a real
+    # ENOSPC mid-commit surfaces THIS way and must classify identically
+    assert recovery.is_disk_full(
+        sqlite3.OperationalError("database or disk is full"))
+    assert not recovery.is_disk_full(
+        sqlite3.OperationalError("database is locked"))
