@@ -44,6 +44,13 @@ if "--fleet" in sys.argv[1:]:
 #: emits the record to BENCH_crash.json
 if "--crash" in sys.argv[1:]:
     MODE = "crash"
+#: ``--serve``: the concurrent read-path load bench (ISSUE 10) — client
+#: threads driving search + directory listing + thumbnail/range fetches
+#: over real HTTP against a mounted router DURING an active pipelined
+#: scan; per-procedure p50/p95/p99 from the sd_rspc_* histograms, to
+#: BENCH_serve.json
+if "--serve" in sys.argv[1:]:
+    MODE = "serve"
 REPEATS = int(os.environ.get("SD_BENCH_REPEATS", "3"))
 #: ``--faults`` (or SD_BENCH_FAULTS=1): bench_scan adds a chaos pass under
 #: an injected fault storm and reports recovery overhead alongside
@@ -990,6 +997,260 @@ def bench_fleet() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _rspc_histogram_deltas(telemetry, before: dict) -> dict:
+    """Per-procedure (bucket_counts, sum, count) deltas of
+    sd_rspc_request_seconds since ``before`` (same helper's output) —
+    the serve bench's quantiles are computed over ITS window, not the
+    process lifetime."""
+    from spacedrive_tpu.telemetry.requests import REQUEST_BUCKETS
+
+    fam = telemetry.histogram("sd_rspc_request_seconds",
+                              labels=("proc",), buckets=REQUEST_BUCKETS)
+    out = {}
+    for labels, series in fam.series_items():
+        counts, total, n = series.read()
+        b_counts, b_total, b_n = before.get(
+            labels["proc"], ([0] * len(counts), 0.0, 0))
+        out[labels["proc"]] = (
+            [c - b for c, b in zip(counts, b_counts)],
+            total - b_total, n - b_n)
+    return out
+
+
+def bench_serve() -> dict:
+    """Serving-tier load bench (ISSUE 10): N client threads drive
+    concurrent ``search.paths`` (substring search + directory listings)
+    + ``search.pathsCount`` + ranged file fetches + thumbnail misses
+    over real HTTP against the shell WHILE a pipelined identify scan
+    runs. Per-procedure p50/p95/p99 and error rates are read from the
+    new ``sd_rspc_*`` histograms (window deltas); a post-scan fixed
+    window A/Bs telemetry on vs off (the 0.95× overhead gate, extended
+    to the read path). Writes BENCH_serve.json."""
+    import random
+    import shutil
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from spacedrive_tpu import telemetry
+    from spacedrive_tpu.locations import create_location
+    from spacedrive_tpu.locations.indexer_job import IndexerJob
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.objects.file_identifier import FileIdentifierJob
+    from spacedrive_tpu.objects.media.processor import MediaProcessorJob
+    from spacedrive_tpu.server.shell import Server
+    from spacedrive_tpu.telemetry.registry import estimate_quantiles
+    from spacedrive_tpu.telemetry.requests import REQUEST_BUCKETS
+
+    n_files = int(os.environ.get("SD_BENCH_SERVE_FILES", "20000"))
+    clients = int(os.environ.get("SD_BENCH_SERVE_CLIENTS", "8"))
+    ab_window_s = float(os.environ.get("SD_BENCH_SERVE_AB_S", "8"))
+    fixture = _ensure_scan_fixture(n_files)
+    telemetry.set_enabled(True)
+    tmp = Path(tempfile.mkdtemp(prefix="sd_bench_serve_"))
+    server = None
+    node = None
+    try:
+        node = Node(tmp, probe_accelerator=False, watch_locations=False)
+        node.thumbnail_remover.stop()
+        lib = node.libraries.create("serve")
+        lib.orphan_remover.stop()
+        loc = create_location(lib, str(fixture), hasher="cpu")
+        args = {"location_id": loc["id"]}
+        # index first: the read path needs rows to serve; identify+media
+        # run DURING the traffic window below (the north-star scenario)
+        node.jobs.spawn(lib, [IndexerJob(dict(args))],
+                        action="scan_location")
+        assert node.jobs.wait_idle(3600)
+        fp_ids = [r["id"] for r in lib.db.query(
+            "SELECT id FROM file_path WHERE is_dir=0 ORDER BY id LIMIT 512")]
+        dirs = [r["materialized_path"] for r in lib.db.query(
+            "SELECT DISTINCT materialized_path FROM file_path "
+            "WHERE is_dir=0 LIMIT 64")]
+        server = Server(node, port=0)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+
+        def rspc(key: str, arg: dict) -> None:
+            body = json.dumps({"library_id": lib.id, "arg": arg}).encode()
+            req = urllib.request.Request(
+                f"{base}/rspc/{key}", data=body,
+                headers={"content-type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+
+        def one_request(rng: random.Random, counts: dict) -> None:
+            roll = rng.random()
+            try:
+                if roll < 0.35:       # substring search
+                    rspc("search.paths",
+                         {"search": f"f{rng.randrange(n_files):06d}"[:5],
+                          "take": 64})
+                    counts["search"] += 1
+                elif roll < 0.60:     # directory listing (explorer browse)
+                    rspc("search.paths",
+                         {"materialized_path": rng.choice(dirs),
+                          "dirs_first": True, "take": 200})
+                    counts["listing"] += 1
+                elif roll < 0.70:     # count badge
+                    rspc("search.pathsCount", {"location_id": loc["id"]})
+                    counts["count"] += 1
+                elif roll < 0.95:     # ranged file fetch (custom_uri)
+                    fp = rng.choice(fp_ids)
+                    req = urllib.request.Request(
+                        f"{base}/spacedrive/file/{lib.id}/{loc['id']}/{fp}",
+                        headers={"range": "bytes=0-4095"})
+                    with urllib.request.urlopen(req, timeout=30) as resp:
+                        resp.read()
+                    counts["file_range"] += 1
+                else:                 # thumbnail miss path (no media in
+                    cas = "0" * 32    # the fixture; exercises the 404 arm)
+                    try:
+                        urllib.request.urlopen(
+                            f"{base}/spacedrive/thumbnail/{cas[:2]}/"
+                            f"{cas}.webp", timeout=30).read()
+                    except urllib.error.HTTPError:
+                        pass
+                    counts["thumbnail"] += 1
+            except Exception:
+                counts["client_errors"] += 1
+
+        def traffic(stop_when, seed: int) -> dict:
+            counts = {k: 0 for k in ("search", "listing", "count",
+                                     "file_range", "thumbnail",
+                                     "client_errors")}
+            rng = random.Random(seed)
+            while not stop_when():
+                one_request(rng, counts)
+            return counts
+
+        def run_window(stop_when) -> tuple[dict, float]:
+            totals = {k: 0 for k in ("search", "listing", "count",
+                                     "file_range", "thumbnail",
+                                     "client_errors")}
+            results: list[dict] = []
+
+            def worker(i: int) -> None:
+                results.append(traffic(stop_when, seed=i))
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        daemon=True)
+                       for i in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            for r in results:
+                for k, v in r.items():
+                    totals[k] += v
+            return totals, dt
+
+        # -- the measured window: traffic while the scan is LIVE ----------
+        hist_before = _rspc_histogram_deltas(telemetry, {})
+        req_before = {(lbl["proc"], lbl["outcome"]): v for lbl, v in
+                      telemetry.series_values("sd_rspc_requests_total")}
+        node.jobs.spawn(lib, [FileIdentifierJob(dict(args)),
+                              MediaProcessorJob(dict(args))],
+                        action="scan_location")
+        scan_t0 = time.perf_counter()
+        totals, window_dt = run_window(
+            lambda: not node.jobs.is_active()
+            and time.perf_counter() - scan_t0 > 1.0)
+        assert node.jobs.wait_idle(3600)
+        scan_dt = time.perf_counter() - scan_t0
+        n_identified = lib.db.query(
+            "SELECT count(*) c FROM file_path WHERE cas_id IS NOT NULL"
+        )[0]["c"]
+        assert n_identified == n_files, (n_identified, n_files)
+
+        procs = {}
+        for proc, (counts, total, n) in _rspc_histogram_deltas(
+                telemetry, hist_before).items():
+            if n <= 0:
+                continue
+            q = estimate_quantiles(tuple(REQUEST_BUCKETS), counts)
+            errors = sum(
+                v - req_before.get((lbl["proc"], lbl["outcome"]), 0)
+                for lbl, v in
+                telemetry.series_values("sd_rspc_requests_total")
+                if lbl["proc"] == proc and lbl["outcome"] != "ok")
+            procs[proc] = {
+                "count": int(n),
+                "p50_ms": round(q[0.5] * 1000, 2),
+                "p95_ms": round(q[0.95] * 1000, 2),
+                "p99_ms": round(q[0.99] * 1000, 2),
+                "mean_ms": round(total / n * 1000, 2),
+                "errors": int(errors),
+                "error_rate": round(errors / n, 4),
+            }
+        requests_total = sum(totals.values()) - totals["client_errors"]
+        rps_during_scan = requests_total / window_dt if window_dt else 0.0
+
+        # -- same-session A/B on the quiet node: telemetry+profiler on
+        # vs off over a fixed window (the read-path overhead gate) -------
+        def timed_window() -> float:
+            deadline = time.perf_counter() + ab_window_s
+            totals_ab, dt = run_window(
+                lambda: time.perf_counter() > deadline)
+            n_ok = sum(totals_ab.values()) - totals_ab["client_errors"]
+            return n_ok / dt if dt else 0.0
+
+        # interleaved on→off→on→off, best of each PAIR — both sides get
+        # two samples (like the scan bench's A/B), so one unlucky window
+        # on either side can't skew the 0.95× gate
+        rps_on = timed_window()
+        telemetry.set_enabled(False)
+        rps_off = timed_window()
+        telemetry.set_enabled(True)
+        rps_on = max(rps_on, timed_window())
+        telemetry.set_enabled(False)
+        rps_off = max(rps_off, timed_window())
+        telemetry.set_enabled(True)
+        overhead = {
+            "rps_on": round(rps_on, 1),
+            "rps_off": round(rps_off, 1),
+            "on_vs_off": round(rps_on / rps_off, 3) if rps_off else 0.0,
+        }
+
+        record = {
+            "metric": (f"serve_requests_per_sec[{clients}clients,"
+                       f"{n_files}files,during-scan]"),
+            "value": round(rps_during_scan, 1),
+            "unit": "requests/sec",
+            "scan_files_per_sec": round(n_files / scan_dt, 1),
+            "window_s": round(window_dt, 2),
+            "clients": clients,
+            "mix": totals,
+            "procedures": procs,
+            "serve_overhead": overhead,
+        }
+        from spacedrive_tpu.telemetry import requests as rq
+
+        record["slow_requests"] = len(rq.slow_requests())
+        out = Path(__file__).resolve().parent / "BENCH_serve.json"
+        out.write_text(json.dumps(record, indent=1) + "\n")
+        print(f"info: serve {clients} clients over {window_dt:.1f}s "
+              f"during a live scan: {rps_during_scan:,.0f} req/s "
+              f"({requests_total} requests, "
+              f"{totals['client_errors']} client errors) | scan held "
+              f"{n_files / scan_dt:,.0f} files/s | A/B on/off "
+              f"{overhead['on_vs_off']:.3f}x -> {out.name}",
+              file=sys.stderr)
+        for proc, p in sorted(procs.items()):
+            print(f"info:   {proc}: n={p['count']} p50 {p['p50_ms']}ms "
+                  f"p95 {p['p95_ms']}ms p99 {p['p99_ms']}ms err "
+                  f"{p['error_rate']:.2%}", file=sys.stderr)
+        return record
+    finally:
+        if server is not None:
+            server.stop()
+        if node is not None:
+            node.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_crash() -> dict:
     """Crash-recovery headline (ISSUE 9): the seeded kill matrix from
     tests/crash_harness.py — spawn a real node subprocess per workload,
@@ -1165,6 +1426,7 @@ def main() -> int:
     # crash matrix likewise (its children pin JAX_PLATFORMS=cpu).
     platform = ("cpu(fleet: no device work)" if MODE == "fleet"
                 else "cpu(crash: no device work)" if MODE == "crash"
+                else "cpu(serve: no device work)" if MODE == "serve"
                 else _guard_device_init())
     # opportunistic recapture: the combined suite runs for many minutes on
     # the CPU fallback — keep watching the relay in the background and, if
@@ -1193,6 +1455,8 @@ def main() -> int:
         record = bench_fleet()
     elif MODE == "crash":
         record = bench_crash()
+    elif MODE == "serve":
+        record = bench_serve()
     elif MODE == "dedup_1m":
         record = bench_dedup_1m()
     else:  # combined (default): dedup headline + north-star identify record
@@ -1239,7 +1503,7 @@ def main() -> int:
             record["device_recapture"] = str(watcher.out_path)
             print(f"info: relay recovered mid-run — device suite captured "
                   f"to {watcher.out_path}", file=sys.stderr)
-    if MODE == "fleet":
+    if MODE in ("fleet", "serve"):
         # CPU-only by design: no device metrics exist to caveat
         record["platform"] = platform
     elif platform != "device":
@@ -1255,8 +1519,50 @@ def main() -> int:
                                     "below ran on the CPU fallback")
     else:
         record["device_numbers"] = "TPU (relay alive, backend initialized)"
+    _append_history(record)
     print(json.dumps(record))
     return 0
+
+
+def _git_rev() -> str:
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _append_history(record: dict) -> None:
+    """Every bench run (all modes) appends its headline to
+    BENCH_history.jsonl — git rev + mode + metric/value — so the perf
+    trajectory is recorded run-over-run instead of living only in the
+    latest BENCH_*.json snapshot. Concurrent runs are safe: one
+    O_APPEND write per line (utils/atomic.append_line)."""
+    try:
+        from spacedrive_tpu.utils.atomic import append_line
+
+        entry = {
+            "unix": round(time.time(), 1),
+            "rev": _git_rev(),
+            "mode": MODE,
+            "metric": record.get("metric"),
+            "value": record.get("value"),
+            "unit": record.get("unit"),
+        }
+        if record.get("vs_baseline") is not None:
+            entry["vs_baseline"] = record["vs_baseline"]
+        if record.get("platform"):
+            entry["platform"] = record["platform"]
+        append_line(Path(__file__).resolve().parent / "BENCH_history.jsonl",
+                    json.dumps(entry))
+    except Exception as e:  # the headline must print even if history fails
+        print(f"warn: BENCH_history.jsonl append failed: {e}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

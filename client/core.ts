@@ -59,6 +59,26 @@ export interface AlertRuleState {
   labels: Record<string, string>; firing: boolean; pending: boolean;
   live_value: number | null; [key: string]: unknown
 }
+/** Per-procedure serving stats (telemetry.requestStats). Quantiles are
+ * histogram-bucket estimates; `errors` counts api_error + error
+ * outcomes. */
+export interface ProcedureRequestStats {
+  count: number; total_s: number; mean_s: number;
+  p50_s: number; p95_s: number; p99_s: number;
+  errors?: number; bytes_in?: number; bytes_out?: number
+}
+/** One slow-request ring entry: the request plus its full span tree
+ * (SQL / reader-wait / serialize breakdown of a slow search.paths). */
+export interface SlowRequestEntry {
+  proc: string; kind: string; outcome: string; duration_s: number;
+  unix: number; tree: Record<string, unknown>
+}
+/** telemetry.requestStats: the serving-tier observability surface. */
+export interface RequestStats {
+  enabled: boolean; in_flight: number; slow_threshold_ms: number;
+  procedures: Record<string, ProcedureRequestStats>;
+  slow: SlowRequestEntry[]
+}
 /** The node-wide ingest admission budget (sync.fleetStatus). */
 export interface IngestBudgetStatus {
   budget_ops: number; budget_bytes: number; ops_in_flight: number;
@@ -130,6 +150,7 @@ export type Procedures = {
 	{ key: "tags.list", input: null, result: TagRow[] } |
 	{ key: "telemetry.alerts", input: null, result: { rules: AlertRuleState[] } } |
 	{ key: "telemetry.jobTrace", input: string | { job_id: string }, result: Record<string, unknown> | null } |
+	{ key: "telemetry.requestStats", input: { slow_limit?: number } | null, result: RequestStats } |
 	{ key: "telemetry.snapshot", input: null, result: Record<string, unknown> } |
 	{ key: "volumes.list", input: null, result: Record<string, unknown>[] },
   mutations:
@@ -369,6 +390,7 @@ export type NodeProcedureKey =
 	"sync.fleetStatus" |
 	"telemetry.alerts" |
 	"telemetry.jobTrace" |
+	"telemetry.requestStats" |
 	"telemetry.snapshot" |
 	"telemetry.watch" |
 	"toggleFeatureFlag" |
@@ -516,6 +538,7 @@ export const procedures = {
 	"tags.update": { kind: "mutation", scope: "library" },
 	"telemetry.alerts": { kind: "query", scope: "node" },
 	"telemetry.jobTrace": { kind: "query", scope: "node" },
+	"telemetry.requestStats": { kind: "query", scope: "node" },
 	"telemetry.snapshot": { kind: "query", scope: "node" },
 	"telemetry.watch": { kind: "subscription", scope: "node" },
 	"toggleFeatureFlag": { kind: "mutation", scope: "node" },

@@ -562,6 +562,10 @@ window.SD_PROCEDURES = {
   "kind": "query",
   "scope": "node"
  },
+ "telemetry.requestStats": {
+  "kind": "query",
+  "scope": "node"
+ },
  "telemetry.snapshot": {
   "kind": "query",
   "scope": "node"

@@ -15,6 +15,7 @@ from .jax_wedge import JaxWedgePass
 from .legacy import BareExceptPass, DuplicateDefPass, UnusedImportPass
 from .lock_discipline import LockDisciplinePass
 from .pipeline_ordering import PipelineOrderingPass
+from .query_discipline import QueryDisciplinePass
 from .queue_discipline import QueueDisciplinePass
 from .resource_leak import ResourceLeakPass
 from .retry_discipline import RetryDisciplinePass
@@ -38,6 +39,7 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     TelemetryDisciplinePass,
     QueueDisciplinePass,
     DurabilityDisciplinePass,
+    QueryDisciplinePass,
 )
 
 

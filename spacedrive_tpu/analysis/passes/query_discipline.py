@@ -1,0 +1,91 @@
+"""query-discipline: query-scope rspc handlers must be read-only.
+
+The serving tier's whole performance story (ISSUE 10) rests on queries
+riding the WAL *reader* connection — never queueing behind the writer
+lock, never opening transactions. A ``router.query`` handler that writes
+would (a) contend the single-writer discipline from the rspc worker
+pool, (b) break the HTTP GET = side-effect-free contract the shell
+enforces (`server/shell.py` routes GETs to queries only), and (c) make
+request telemetry lie about what the read path costs. Mutations exist
+for exactly this; move the write there.
+
+Mechanics: inside any function decorated ``@<router>.query(...)`` or
+``@<router>.library_query(...)`` (the api/routers mount idiom, including
+helpers nested within the handler), flag
+
+- any ``.transaction(...)`` call — a query has no business being atomic
+  over writes it must not make;
+- write-surface calls (execute/executemany/insert/insert_ignore/
+  insert_many/update/upsert/delete) whose receiver is a DB handle (a
+  name chain ending in ``db``/``database``), so dict ``.update()`` and
+  manager-layer ``.delete()`` calls don't trip it.
+
+Scoped to ``api/`` — the only place rspc handlers live.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+
+QUERY_DECORATORS = ("query", "library_query")
+
+WRITE_ATTRS = {"execute", "executemany", "insert", "insert_ignore",
+               "insert_many", "update", "upsert", "delete"}
+
+
+def _is_db_receiver(chain: str) -> bool:
+    """'db', 'library.db', 'node.library.db', … — the handle naming
+    idiom (same classifier as the pipeline-ordering pass)."""
+    head = chain.rsplit(".", 1)[0] if "." in chain else ""
+    last = head.rsplit(".", 1)[-1] if head else ""
+    return last in ("db", "database")
+
+
+def _query_decorator(node: ast.FunctionDef | ast.AsyncFunctionDef) -> str | None:
+    """The decorator name when this function is a query-scope handler."""
+    for dec in node.decorator_list:
+        call = dec if isinstance(dec, ast.Call) else None
+        func = call.func if call is not None else dec
+        if isinstance(func, ast.Attribute) and func.attr in QUERY_DECORATORS:
+            return func.attr
+    return None
+
+
+class QueryDisciplinePass(AnalysisPass):
+    id = "query-discipline"
+    description = ("DB transactions/writes inside query-scope rspc "
+                   "handlers (queries are read-only; writes belong to "
+                   "mutations)")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dirs("api"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            decorator = _query_decorator(node)
+            if decorator is None:
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call) \
+                        or not isinstance(call.func, ast.Attribute):
+                    continue
+                chain = dotted_name(call.func)
+                if chain is None:
+                    continue
+                attr = call.func.attr
+                if attr == "transaction":
+                    yield ctx.finding(
+                        call.lineno, self.id,
+                        f"'{chain}()' in {decorator} handler "
+                        f"'{node.name}' — queries must not open "
+                        f"transactions (use a mutation)")
+                elif attr in WRITE_ATTRS and _is_db_receiver(chain):
+                    yield ctx.finding(
+                        call.lineno, self.id,
+                        f"DB write '{chain}()' in {decorator} handler "
+                        f"'{node.name}' — queries are read-only (use a "
+                        f"mutation)")

@@ -22,6 +22,9 @@ import inspect
 import logging
 from typing import TYPE_CHECKING, Any, Callable
 
+from .. import faults
+from ..telemetry import requests as _requests
+
 if TYPE_CHECKING:
     from ..events import Subscription
     from ..library import Library
@@ -97,23 +100,40 @@ class Router:
             raise ApiError(f"library {library_id!r} not loaded", code=404) from None
 
     def resolve(self, key: str, arg: Any = None, library_id: str | None = None) -> Any:
-        """Execute a query or mutation. Library-scoped procedures receive
-        (node, library, arg); node-scoped (node, arg)."""
+        """Execute a query or mutation under per-procedure request
+        telemetry (ISSUE 10: ``sd_rspc_*`` families + the slow-request
+        ring). Library-scoped procedures receive (node, library, arg);
+        node-scoped (node, arg)."""
         proc = self._proc(key)
         if proc.kind == SUBSCRIPTION:
             raise ApiError(f"{key} is a subscription; use subscribe()")
-        if proc.scope == "library":
-            return proc.fn(self.node, self._library(library_id), arg)
-        return proc.fn(self.node, arg)
+
+        def dispatch() -> Any:
+            # latency/failure chaos for the serving tier (`rspc:stall`,
+            # `rspc:eio`, ...) — inside the observed scope so injected
+            # slowness lands in the histograms and the slow ring exactly
+            # like organic slowness
+            faults.inject("rspc", key=key)
+            if proc.scope == "library":
+                return proc.fn(self.node, self._library(library_id), arg)
+            return proc.fn(self.node, arg)
+
+        return _requests.observed(key, proc.kind, dispatch)
 
     def subscribe(self, key: str, arg: Any = None,
                   library_id: str | None = None) -> "Subscription":
         proc = self._proc(key)
         if proc.kind != SUBSCRIPTION:
             raise ApiError(f"{key} is not a subscription")
-        if proc.scope == "library":
-            return proc.fn(self.node, self._library(library_id), arg)
-        return proc.fn(self.node, arg)
+
+        def dispatch() -> Any:
+            # counts the subscription SETUP (the stream itself is pumped
+            # by the transport; its lifetime is not a request)
+            if proc.scope == "library":
+                return proc.fn(self.node, self._library(library_id), arg)
+            return proc.fn(self.node, arg)
+
+        return _requests.observed(key, proc.kind, dispatch)
 
     # -- schema export (bindings-codegen analogue) -------------------------
     def schema(self) -> dict[str, Any]:

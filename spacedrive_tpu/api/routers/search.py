@@ -11,9 +11,20 @@ from __future__ import annotations
 
 from typing import Any
 
+from ... import telemetry
 from ...locations.non_indexed import walk_ephemeral
 from ...models import FilePath, Object
+from ...telemetry import spans as _tspans
 from ..router import ApiError
+
+
+def _request_trace():
+    """The ambient request trace when this handler runs under observed
+    rspc dispatch (telemetry/requests.py) — lets the serialize phase
+    show up in the slow-request ring next to the db.query spans; None
+    (a bare timer) in any other context."""
+    trace = _tspans.current_trace()
+    return trace if getattr(trace, "record_db_spans", False) else None
 
 _PATH_ORDERS = {"name", "size_in_bytes", "date_created", "date_modified"}
 
@@ -109,13 +120,16 @@ def mount(router) -> None:
             f"{offset_sql}",
             params + [take + 1] + ([int(arg["skip"])] if offset_sql else []))
         items = []
-        for r in rows[:take]:
-            d = dict(FilePath.decode_row(r) | {
-                "object_pub_id": r["object_pub_id"], "object_kind": r["object_kind"],
-                "favorite": bool(r["favorite"]), "note": r["note"],
-            })
-            d.pop("_order_val", None)
-            items.append(d)
+        with telemetry.span(_request_trace(), "search.serialize",
+                            rows=len(rows)):
+            for r in rows[:take]:
+                d = dict(FilePath.decode_row(r) | {
+                    "object_pub_id": r["object_pub_id"],
+                    "object_kind": r["object_kind"],
+                    "favorite": bool(r["favorite"]), "note": r["note"],
+                })
+                d.pop("_order_val", None)
+                items.append(d)
         next_cursor = None
         if len(rows) > take and items:
             next_cursor = [rows[take - 1]["_order_val"], items[-1]["id"]]

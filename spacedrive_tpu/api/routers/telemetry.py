@@ -48,3 +48,20 @@ def mount(router) -> None:
         """The SLO/alert rule set with live state (telemetry/alerts.py)."""
         evaluator = getattr(node, "alerts", None)
         return {"rules": evaluator.state() if evaluator is not None else []}
+
+    @router.query("telemetry.requestStats")
+    def request_stats(node, arg):
+        """Serving-tier request telemetry (ISSUE 10): per-procedure
+        p50/p95/p99 latency estimates, outcome/payload counts, in-flight,
+        and the slow-request ring with full span trees (arg: optional
+        {"slow_limit": n})."""
+        from ...telemetry import requests as rq
+
+        limit = 16
+        if isinstance(arg, dict):
+            try:
+                limit = max(0, min(int(arg.get("slow_limit", 16)),
+                                   rq.SLOW_RING))
+            except (TypeError, ValueError):
+                raise ApiError("slow_limit must be an integer")
+        return rq.stats(slow_limit=limit)

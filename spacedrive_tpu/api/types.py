@@ -80,6 +80,26 @@ export interface AlertRuleState {
   labels: Record<string, string>; firing: boolean; pending: boolean;
   live_value: number | null; [key: string]: unknown
 }
+/** Per-procedure serving stats (telemetry.requestStats). Quantiles are
+ * histogram-bucket estimates; `errors` counts api_error + error
+ * outcomes. */
+export interface ProcedureRequestStats {
+  count: number; total_s: number; mean_s: number;
+  p50_s: number; p95_s: number; p99_s: number;
+  errors?: number; bytes_in?: number; bytes_out?: number
+}
+/** One slow-request ring entry: the request plus its full span tree
+ * (SQL / reader-wait / serialize breakdown of a slow search.paths). */
+export interface SlowRequestEntry {
+  proc: string; kind: string; outcome: string; duration_s: number;
+  unix: number; tree: Record<string, unknown>
+}
+/** telemetry.requestStats: the serving-tier observability surface. */
+export interface RequestStats {
+  enabled: boolean; in_flight: number; slow_threshold_ms: number;
+  procedures: Record<string, ProcedureRequestStats>;
+  slow: SlowRequestEntry[]
+}
 /** The node-wide ingest admission budget (sync.fleetStatus). */
 export interface IngestBudgetStatus {
   budget_ops: number; budget_bytes: number; ops_in_flight: number;
@@ -204,6 +224,8 @@ TYPES: dict[str, tuple[str, str]] = {
     "telemetry.alerts": ("null", "{ rules: AlertRuleState[] }"),
     "telemetry.jobTrace": ("string | { job_id: string }",
                            "Record<string, unknown> | null"),
+    "telemetry.requestStats": ("{ slow_limit?: number } | null",
+                               "RequestStats"),
     "telemetry.snapshot": ("null", "Record<string, unknown>"),
     "telemetry.watch": ("null", "TelemetryEvent"),
 }

@@ -38,8 +38,10 @@ sequence per seam (each installed seam is hit from a single thread).
 from __future__ import annotations
 
 import errno as _errno
+import os
 import sqlite3
 import threading
+import time
 from dataclasses import dataclass, field
 from random import Random
 from typing import Callable
@@ -98,6 +100,16 @@ INJECTED_ATTR = "sd_injected"
 HANG_S = 3600.0
 
 
+def _stall_s() -> float:
+    """How long a ``stall`` fault sleeps before returning normally — the
+    "slow, not broken" failure mode (cold cache, lock convoy, GC pause).
+    The serving-tier slow-request ring is gated on exactly this shape."""
+    try:
+        return max(0.0, float(os.environ.get("SD_FAULT_STALL_S", "0.3")))
+    except ValueError:
+        return 0.3
+
+
 def _oserror(no: int, msg: str) -> Callable[[str], BaseException]:
     def make(key: str) -> BaseException:
         exc = OSError(no, f"{msg} [injected{': ' + key if key else ''}]")
@@ -128,6 +140,7 @@ KINDS: dict[str, Callable[[str], BaseException]] = {
     "overload": _mk(IngestOverloadError, "ingest overload"),
     "hang": None,  # type: ignore[dict-item]  # blocks, never raises
     "kill": None,  # type: ignore[dict-item]  # SIGKILLs the process
+    "stall": None,  # type: ignore[dict-item]  # sleeps STALL_S, then returns
 }
 
 
@@ -254,6 +267,11 @@ class FaultPlan:
         # live shows WHERE the storm is biting, not just how often
         telemetry.event("fault.fired", seam=fired_rule.seam,
                         kind=fired_rule.kind, key=key)
+        if fired_rule.kind == "stall":
+            # slow-not-broken: sleep a bounded window, then continue — the
+            # call SUCCEEDS late (latency injection for the serving tier)
+            time.sleep(_stall_s())
+            return
         if fired_rule.kind == "hang":
             # the "never returns" failure mode (wedged tunnel, dead NFS):
             # block far past any drain deadline; daemon stage threads die

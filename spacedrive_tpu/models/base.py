@@ -25,11 +25,23 @@ import json
 import os
 import sqlite3
 import threading
+import time
 from pathlib import Path
 from typing import Any, ClassVar, Iterable
 
-from .. import faults
+from .. import faults, telemetry
+from ..telemetry import spans as _tspans
 from ..utils.retry import RetryPolicy, is_sqlite_busy, retry_call
+
+#: reader/writer contention instrument (ISSUE 10): observed only for
+#: CONTENDED reader-lock acquisitions (the uncontended fast path pays one
+#: non-blocking try-acquire, no timing, no observe), so a serving tier
+#: queueing behind a long reader shows up without taxing the common case
+_READER_WAIT = telemetry.histogram(
+    "sd_db_reader_wait_seconds",
+    "time reads spent waiting for the WAL reader connection lock "
+    "(contended acquisitions only — reader/writer contention under "
+    "serving load)")
 
 
 # --------------------------------------------------------------------------
@@ -303,12 +315,35 @@ class Database:
         if self._txn_depth and self._txn_thread == threading.get_ident():
             with self._lock:
                 return self._conn.execute(sql, params).fetchall()
-        with self._read_lock:
-            reader = self._reader()
-            if reader is not None:
-                return reader.execute(sql, params).fetchall()
-        with self._lock:
-            return self._conn.execute(sql, params).fetchall()
+        # request traces (telemetry/requests.py) opt into per-SELECT spans
+        # so a slow rspc query shows its SQL/reader-wait breakdown; job
+        # traces never set record_db_spans — their per-batch recording
+        # discipline stays intact
+        trace = _tspans.current_trace()
+        sp = (trace.span("db.query", sql=sql[:120])
+              if trace is not None
+              and getattr(trace, "record_db_spans", False) else None)
+        try:
+            if sp is not None:
+                sp.__enter__()
+            if not self._read_lock.acquire(blocking=False):
+                t0 = time.perf_counter()
+                self._read_lock.acquire()
+                wait_s = time.perf_counter() - t0
+                _READER_WAIT.observe(wait_s)
+                if sp is not None:
+                    sp.set(reader_wait_s=round(wait_s, 6))
+            try:
+                reader = self._reader()
+                if reader is not None:
+                    return reader.execute(sql, params).fetchall()
+            finally:
+                self._read_lock.release()
+            with self._lock:
+                return self._conn.execute(sql, params).fetchall()
+        finally:
+            if sp is not None:
+                sp.__exit__(None, None, None)
 
     def transaction(self):
         """Context manager for an atomic multi-statement write (the analogue of

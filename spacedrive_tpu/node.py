@@ -213,6 +213,16 @@ class Node:
             notify=_alert_notify)
         self.alerts.start()
 
+        # serving-tier observability (ISSUE 10): the process resource
+        # watcher always runs (cheap slow ticker — sd_proc_* gauges plus
+        # the request-p99 gauges the alert rules read); the span-tagged
+        # sampling profiler only when SD_PROFILE_HZ is set (zero overhead
+        # when off), exporting its folded stacks at shutdown
+        from .telemetry.profiler import ResourceWatcher, SamplingProfiler
+
+        self.resources = ResourceWatcher().start()
+        self.profiler = SamplingProfiler().start()
+
         # api::mount last — validates the invalidation-key contract
         # (api/mod.rs:102, invalidate.rs:82)
         from .api.router import mount as api_mount
@@ -253,6 +263,10 @@ class Node:
         from . import telemetry
 
         self.alerts.stop()
+        self.resources.stop()
+        if self.profiler is not None:
+            self.profiler.stop()
+            self.profiler.export(self.data_dir)
         telemetry.remove_event_hook(self._telemetry_event_hook)
         if self.relay_recapture is not None:
             self.relay_recapture.stop()

@@ -21,7 +21,16 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, AsyncIterator
 
+from .. import telemetry
+
 logger = logging.getLogger(__name__)
+
+#: websocket rspc traffic volume (ISSUE 10) — message counts per
+#: direction; per-procedure attribution lives in the sd_rspc_* families
+_WS_MESSAGES = telemetry.counter(
+    "sd_http_ws_messages_total",
+    "websocket text messages by direction (in = client frames, out = "
+    "responses/subscription events)", labels=("direction",))
 
 MAX_HEADER_BYTES = 64 * 1024
 MAX_BODY_BYTES = 64 * 1024 * 1024
@@ -204,6 +213,7 @@ class WebSocket:
     async def send_text(self, text: str) -> None:
         if self.closed:
             return
+        _WS_MESSAGES.inc(direction="out")
         await self._send_frame(0x1, text.encode())
 
     async def _send_frame(self, opcode: int, payload: bytes) -> None:
@@ -261,6 +271,7 @@ class WebSocket:
             message.write(bytes(payload))
             if fin:
                 data = message.getvalue()
+                _WS_MESSAGES.inc(direction="in")
                 if opcode_in_progress == 0x1:
                     return data.decode("utf-8", errors="replace")
                 return data.decode("latin-1")  # binary surfaced as text rpc

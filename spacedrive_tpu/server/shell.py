@@ -43,7 +43,9 @@ from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import TYPE_CHECKING, Any
 
+from .. import telemetry
 from ..api.router import ApiError
+from ..telemetry.requests import REQUEST_BUCKETS, record_payload
 from .http import (
     HttpError,
     Request,
@@ -61,6 +63,32 @@ logger = logging.getLogger(__name__)
 
 #: cap for spooled remote-over-p2p serves (see _serve_remote)
 MAX_REMOTE_SPOOL = 64 * 1024 * 1024
+
+#: HTTP-layer families (ISSUE 10): the route label is a small CLOSED set
+#: (the shell's own top-level routes), never the raw path — cardinality
+#: stays bounded no matter what clients request
+_HTTP_ROUTES = {"health", "metrics", "info", "rspc", "schema", "client",
+                "spacedrive"}
+_HTTP_REQUESTS = telemetry.counter(
+    "sd_http_requests_total",
+    "HTTP requests served by the shell, by route class and status",
+    labels=("route", "status"))
+_HTTP_SECONDS = telemetry.histogram(
+    "sd_http_request_seconds", "HTTP request latency per route class",
+    labels=("route",), buckets=REQUEST_BUCKETS)
+_HTTP_BYTES = telemetry.counter(
+    "sd_http_response_bytes_total",
+    "response payload bytes per route class (file/range streams count "
+    "the streamed window)", labels=("route",))
+
+
+def _route_class(path: str) -> str:
+    head = path.split("/", 2)[1] if path.startswith("/") else path
+    if path == "/telemetry/stream":
+        return "stream"
+    if not head:
+        return "root"
+    return head if head in _HTTP_ROUTES else "other"
 
 
 class Server:
@@ -82,6 +110,12 @@ class Server:
         self._thumb_miss: dict[str, float] = {}
         #: cas_id → future resolved when its in-flight remote fetch ends
         self._thumb_fetch: dict[str, asyncio.Future] = {}
+        #: live SSE tails: (stop event, pump thread, bus subscription) per
+        #: open /telemetry/stream — stop() closes and JOINS them, so a
+        #: shell shutdown never strands pump threads parked on the bus
+        #: (ISSUE 10 satellite; the threads were daemon-and-forgotten)
+        self._sse_tails: set[tuple[threading.Event, threading.Thread, Any]] = set()
+        self._sse_lock = threading.Lock()
         self._ready = threading.Event()
 
     # -- lifecycle -----------------------------------------------------------
@@ -120,6 +154,21 @@ class Server:
                     lambda: [t.cancel() for t in asyncio.all_tasks(loop)])
             except RuntimeError:
                 pass  # loop already closed (shutdown race) — nothing to stop
+        # SSE pump threads park on the event bus for up to their poll
+        # timeout — stop and JOIN them (closing the subscription wakes the
+        # blocking get immediately), so shutdown leaves no tail behind
+        with self._sse_lock:
+            tails = list(self._sse_tails)
+        for stop_event, thread, sub in tails:
+            stop_event.set()
+            sub.close()
+        for _stop_event, thread, _sub in tails:
+            # is_alive() also guards the registered-but-not-yet-started
+            # window: join() on an unstarted thread raises and would
+            # abort the rest of shutdown (the woken pump exits on its
+            # first stop/closed check either way)
+            if thread.is_alive():
+                thread.join(timeout=5)
         if self._thread is not None:
             self._thread.join(timeout=5)
         self._pool.shutdown(wait=False)
@@ -154,6 +203,7 @@ class Server:
                         await write_response(writer, req,
                                              Response.error(e.status, str(e)))
                     break
+                t0 = time.perf_counter()
                 try:
                     resp = await self._route(req)
                 except HttpError as e:
@@ -166,6 +216,7 @@ class Server:
                 except Exception:
                     logger.exception("request failed: %s %s", req.method, req.path)
                     resp = Response.error(500)
+                _observe_http(req, resp, time.perf_counter() - t0)
                 await write_response(writer, req, resp)
                 if req.header("connection", "").lower() == "close":
                     break
@@ -267,8 +318,17 @@ class Server:
         try:
             result = await self._resolve(key, arg, library_id)
         except ApiError as e:
-            return Response.json({"error": str(e)}, 400)
-        return Response.json({"result": result})
+            resp = Response.json({"error": str(e)}, 400)
+            if key in self.node.router.procedures:
+                # MOUNTED keys only: a client-supplied ghost key must not
+                # mint unbounded label cardinality
+                record_payload(key, len(req.body), len(resp.body))
+            return resp
+        resp = Response.json({"result": result})
+        # wire payload sizes per procedure (the router's observed() can't
+        # see serialization — only the transport knows wire bytes)
+        record_payload(key, len(req.body), len(resp.body))
+        return resp
 
     async def _resolve(self, key: str, arg: Any, library_id: str | None) -> Any:
         if self.auth is None:
@@ -519,16 +579,24 @@ class Server:
                     continue
                 else:
                     frame = self._sse_frame(event.payload or {})
-                fut = asyncio.run_coroutine_threadsafe(send(frame), loop)
                 try:
+                    # scheduling itself can raise once the loop is closed
+                    # (shutdown race) — that's teardown, not a crash
+                    fut = asyncio.run_coroutine_threadsafe(send(frame), loop)
                     fut.result(10)
                 except Exception:
                     return  # client went away — the normal end of a tail
+        thread = threading.Thread(target=pump, daemon=True,
+                                  name="sse-telemetry")
+        tail = (stop, thread, sub)
         try:
             writer.write(b"HTTP/1.1 200 OK\r\n"
                          b"content-type: text/event-stream\r\n"
                          b"cache-control: no-cache\r\n"
                          b"connection: close\r\n\r\n")
+            # counted at accept (the stream is long-lived — it never
+            # reaches the per-request observation in the route loop)
+            _HTTP_REQUESTS.inc(route="stream", status="200")
             # replay: everything in the bounded ring the tail has not seen
             # (subscribed BEFORE the replay read, so no gap in between —
             # an event landing during replay is at worst duplicated, and
@@ -537,8 +605,8 @@ class Server:
                     limit=256, after_seq=after if after >= 0 else None):
                 writer.write(self._sse_frame(record))
             await writer.drain()
-            thread = threading.Thread(target=pump, daemon=True,
-                                      name="sse-telemetry")
+            with self._sse_lock:
+                self._sse_tails.add(tail)
             thread.start()
             # hold the handler open until the client hangs up (EOF) — SSE
             # clients send nothing, so any read completing means teardown
@@ -549,6 +617,13 @@ class Server:
         finally:
             stop.set()
             sub.close()
+            with self._sse_lock:
+                self._sse_tails.discard(tail)
+            # NO join here: this finally runs ON the event loop, and the
+            # pump may be waiting on a send scheduled onto this very loop
+            # — joining would deadlock-then-timeout, freezing every other
+            # client for the duration. The closed subscription wakes the
+            # pump immediately (daemon; stop() owns the blocking join)
 
     @staticmethod
     def _sse_frame(record: dict) -> bytes:
@@ -663,6 +738,25 @@ class Server:
                         "result": {"type": "stopped"}})
         else:
             await reply_error(400, f"unknown method {method!r}")
+
+
+def _observe_http(req: Request, resp: Response, duration_s: float) -> None:
+    """Per-route HTTP accounting (label set bounded by _route_class).
+    File/range responses count the streamed window, not the whole file."""
+    if not telemetry.enabled():
+        return
+    route = _route_class(req.path)
+    _HTTP_REQUESTS.inc(route=route, status=str(resp.status))
+    _HTTP_SECONDS.observe(duration_s, route=route)
+    if resp.file_path is not None:
+        try:
+            size = resp.file_path.stat().st_size
+        except OSError:
+            size = 0
+        start, end = resp.file_range or (0, size)
+        _HTTP_BYTES.inc(max(0, end - start), route=route)
+    elif resp.body:
+        _HTTP_BYTES.inc(len(resp.body), route=route)
 
 
 def _split_library_args(input_: Any) -> tuple[str | None, Any]:

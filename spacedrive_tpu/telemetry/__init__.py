@@ -35,6 +35,7 @@ from . import spans as _spans
 from .registry import (
     DEFAULT_BUCKETS,
     METRIC_NAME_RE,
+    REQUEST_BUCKETS,
     Registry,
     enabled,
     reload_enabled,
@@ -43,7 +44,8 @@ from .registry import (
 from .spans import Span, Trace
 
 __all__ = [
-    "DEFAULT_BUCKETS", "METRIC_NAME_RE", "Registry", "Span", "Trace",
+    "DEFAULT_BUCKETS", "METRIC_NAME_RE", "REQUEST_BUCKETS", "Registry",
+    "Span", "Trace",
     "add_event_hook", "counter", "enabled", "event", "finish_trace",
     "gauge", "histogram", "job_trace", "recent_events", "registry",
     "reload_enabled", "remove_event_hook", "render_prometheus", "reset",
@@ -220,12 +222,15 @@ def snapshot() -> dict[str, Any]:
 
 
 def reset() -> None:
-    """Tests: zero every series, drop traces and events (the declared
-    vocabulary survives)."""
+    """Tests: zero every series, drop traces, events and the slow-request
+    ring (the declared vocabulary survives)."""
     _REGISTRY.reset()
     _spans.clear_traces()
     with _EVENTS_LOCK:
         _EVENTS.clear()
+    from . import requests as _requests  # local: requests imports this module
+
+    _requests.clear_slow_requests()
     _declare_core()
 
 
@@ -369,6 +374,52 @@ def _declare_core() -> None:
     counter("sd_p2p_throttled_sessions_total",
             "inbound sessions refused by the per-peer accept-layer token "
             "bucket", labels=("peer",))
+    # serving-tier observability (ISSUE 10): per-procedure request
+    # telemetry, HTTP-layer families, the span-tagged sampling profiler
+    # and the process resource watcher (telemetry/requests.py,
+    # telemetry/profiler.py, server/shell.py, models/base.py hold the
+    # matching module handles)
+    counter("sd_rspc_requests_total",
+            "rspc procedure dispatches by procedure, kind and outcome",
+            labels=("proc", "kind", "outcome"))
+    histogram("sd_rspc_request_seconds",
+              "rspc dispatch latency per procedure", labels=("proc",),
+              buckets=REQUEST_BUCKETS)
+    gauge("sd_rspc_in_flight", "rspc dispatches currently executing")
+    counter("sd_rspc_payload_bytes_total",
+            "transport payload bytes per procedure and direction (in = "
+            "request body, out = serialized response)",
+            labels=("proc", "direction"))
+    counter("sd_rspc_slow_requests_total",
+            "requests slower than SD_SLOW_REQUEST_MS (each keeps its span "
+            "tree in the slow-request ring)", labels=("proc",))
+    gauge("sd_rspc_request_p99_seconds",
+          "estimated p99 of sd_rspc_request_seconds per procedure "
+          "(published by the resource-watcher tick; alert target — "
+          "histograms are not rule targets)", labels=("proc",))
+    counter("sd_http_requests_total",
+            "HTTP requests served by the shell, by route class and status",
+            labels=("route", "status"))
+    histogram("sd_http_request_seconds",
+              "HTTP request latency per route class", labels=("route",),
+              buckets=REQUEST_BUCKETS)
+    counter("sd_http_response_bytes_total",
+            "response payload bytes per route class (file/range streams "
+            "count the streamed window)", labels=("route",))
+    counter("sd_http_ws_messages_total",
+            "websocket text messages by direction (in = client frames, "
+            "out = responses/subscription events)", labels=("direction",))
+    counter("sd_profile_samples_total",
+            "wall-clock profiler samples attributed per active span name "
+            "('other' = the sampled thread had no open span)",
+            labels=("span",))
+    gauge("sd_proc_rss_bytes", "resident set size of this process")
+    gauge("sd_proc_open_fds", "open file descriptors of this process")
+    gauge("sd_proc_threads", "live Python threads in this process")
+    histogram("sd_db_reader_wait_seconds",
+              "time reads spent waiting for the WAL reader connection "
+              "lock (contended acquisitions only — reader/writer "
+              "contention under serving load)")
 
 
 _declare_core()

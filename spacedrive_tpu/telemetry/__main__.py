@@ -73,7 +73,31 @@ def _fmt_value(v: Any) -> str:
     return f"{v:.6g}"
 
 
-def print_snapshot(snap: dict[str, Any], out=sys.stdout) -> None:
+def _bucket_quantiles(buckets: dict[str, Any]) -> dict[float, float] | None:
+    """p50/p95/p99 estimates from a snapshot's bucket dict (keys are the
+    stringified boundaries + '+Inf'); None when the shape is unusable —
+    the pretty-printer must render any snapshot, never raise."""
+    try:
+        pairs = sorted((float(k), int(v)) for k, v in buckets.items()
+                       if k != "+Inf")
+        if not pairs:
+            return None
+        boundaries = tuple(b for b, _ in pairs)
+        counts = [c for _, c in pairs] + [int(buckets.get("+Inf", 0))]
+        if sum(counts) == 0:
+            return None
+        from .registry import estimate_quantiles
+
+        return estimate_quantiles(boundaries, counts)
+    except (TypeError, ValueError):
+        return None
+
+
+def print_snapshot(snap: dict[str, Any], out=None) -> None:
+    # out resolved at CALL time, never bound at import (a def-time
+    # sys.stdout freezes whatever stream was active when this module
+    # first imported — pytest capture objects die between tests)
+    out = out if out is not None else sys.stdout
     print(f"telemetry {'ENABLED' if snap.get('enabled') else 'OFF'}",
           file=out)
     metrics = snap.get("metrics", {})
@@ -94,8 +118,14 @@ def print_snapshot(snap: dict[str, Any], out=sys.stdout) -> None:
                 count = s.get("count", 0)
                 total = s.get("sum", 0.0)
                 mean = total / count if count else 0.0
+                quantiles = ""
+                q = _bucket_quantiles(s.get("buckets") or {})
+                if q is not None:
+                    quantiles = (f" p50={q[0.5]:.4f}s p95={q[0.95]:.4f}s "
+                                 f"p99={q[0.99]:.4f}s")
                 print(f"  {lbl or '(all)':40s} count={count} "
-                      f"sum={_fmt_value(total)}s mean={mean:.4f}s", file=out)
+                      f"sum={_fmt_value(total)}s mean={mean:.4f}s"
+                      f"{quantiles}", file=out)
             else:
                 print(f"  {lbl or '(all)':40s} "
                       f"{_fmt_value(s.get('value'))}", file=out)
@@ -116,7 +146,8 @@ def print_snapshot(snap: dict[str, Any], out=sys.stdout) -> None:
                   f"spans)", file=out)
 
 
-def print_tree(node: dict[str, Any], depth: int = 0, out=sys.stdout) -> None:
+def print_tree(node: dict[str, Any], depth: int = 0, out=None) -> None:
+    out = out if out is not None else sys.stdout  # call-time, like above
     pad = "  " * depth
     marker = "·" if node.get("event") else "—"
     attrs = node.get("attrs") or {}
@@ -161,6 +192,53 @@ def _follow(url: str, auth: str | None = None, after: int | None = None,
     return 0
 
 
+def _print_profile(target: str, data_dir: str, top: int = 20,
+                   out=None) -> int:
+    """``--profile <job_id|span>``: top folded stacks for a span name (or
+    prefix), or per-span sample totals for a trace/job id prefix — read
+    from the ``.folded``/``.traces.json`` exports under
+    ``<data-dir>/logs/profiles/`` (telemetry/profiler.py)."""
+    from .profiler import load_folded, load_trace_totals
+
+    # resolved at CALL time: an ``out=sys.stdout`` default would freeze
+    # whatever stdout was at first import (pytest capture objects die
+    # between tests)
+    out = out if out is not None else sys.stdout
+
+    folded = load_folded(data_dir)
+    if not folded:
+        print(f"no profile exports under {data_dir!r} (run with "
+              f"SD_PROFILE_HZ set; exports land at shutdown)",
+              file=sys.stderr)
+        return 1
+    by_span = [(key, n) for key, n in folded.items()
+               if key.split(";", 1)[0].startswith(target)]
+    if by_span:
+        total = sum(n for _k, n in by_span)
+        print(f"{len(by_span)} stacks, {total} samples under span "
+              f"'{target}*':", file=out)
+        for key, n in sorted(by_span, key=lambda kv: -kv[1])[:top]:
+            span, _, stack = key.partition(";")
+            frames = stack.split(";")
+            tail = ";".join(frames[-4:]) if len(frames) > 4 else stack
+            print(f"  {n:6d}  [{span}] …{tail}", file=out)
+        return 0
+    traces = load_trace_totals(data_dir)
+    matches = {t: spans for t, spans in traces.items()
+               if t.startswith(target)}
+    if matches:
+        for trace_id, spans_ in sorted(matches.items()):
+            total = sum(spans_.values())
+            print(f"trace {trace_id}: {total} samples", file=out)
+            for span, n in sorted(spans_.items(), key=lambda kv: -kv[1]):
+                print(f"  {n:6d}  {span} ({n / total:.0%})", file=out)
+        return 0
+    known = sorted({k.split(';', 1)[0] for k in folded})
+    print(f"no span or trace matching {target!r}; spans seen: "
+          f"{', '.join(known)}", file=sys.stderr)
+    return 1
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m spacedrive_tpu.telemetry",
@@ -189,9 +267,18 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--after", type=int, default=None, metavar="SEQ",
                         help="with --follow: replay ring events newer "
                              "than this sequence number first")
+    parser.add_argument("--profile", default=None, metavar="JOB_OR_SPAN",
+                        help="pretty-print top folded stacks for a span "
+                             "name/prefix (e.g. pipeline.hash), or "
+                             "per-span sample totals for a trace/job id "
+                             "prefix — reads the SD_PROFILE_HZ exports "
+                             "under <data-dir>/logs/profiles/")
     args = parser.parse_args(argv)
 
     from . import job_trace, render_prometheus, snapshot
+
+    if args.profile:
+        return _print_profile(args.profile, args.data_dir or ".")
 
     if args.follow:
         if not args.url:

@@ -157,6 +157,25 @@ def default_rules() -> list[AlertRule]:
             description="ENOSPC is being absorbed by graceful degradation "
                         "(quarantined gathers, skipped thumbnails, ring-only "
                         "telemetry, paused commits) — free disk space"),
+        # serving tier (ISSUE 10): the p99 gauge is published per
+        # procedure by the resource-watcher tick (histograms are not rule
+        # targets); errors ride the outcome label on the request counter
+        AlertRule(
+            name="rspc-query-p99", kind=THRESHOLD,
+            series="sd_rspc_request_p99_seconds", op="gt", value=2.0,
+            for_s=30.0,
+            description="a procedure's estimated p99 dispatch latency "
+                        "stayed above 2 s — the read path is melting under "
+                        "load (check the slow-request ring for the span "
+                        "breakdown)"),
+        AlertRule(
+            name="rspc-error-rate", kind=RATE,
+            series="sd_rspc_requests_total",
+            labels={"outcome": "error"}, op="gt", value=1.0,
+            window_s=60.0, for_s=0.0, severity="critical",
+            description="unexpected rspc dispatch failures above 1/s over "
+                        "the last minute (api_error rejections do not "
+                        "count) — a handler is crashing under traffic"),
     ]
 
 

@@ -44,6 +44,14 @@ HISTOGRAM = "histogram"
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
 
+#: serving-shaped buckets (ISSUE 10): finer at the low end, capped at the
+#: 30 s a client would ever wait. THE one definition — _declare_core,
+#: telemetry/requests.py and server/shell.py all declare their request
+#: histograms from this constant (a drifted copy would raise the
+#: fixed-boundary re-declaration error at import)
+REQUEST_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
 
 def _env_enabled() -> bool:
     return os.environ.get("SD_TELEMETRY", "on").strip().lower() not in (
@@ -335,6 +343,34 @@ class Registry:
         declared so the vocabulary survives)."""
         for fam in self.families():
             fam._reset()
+
+
+def estimate_quantiles(boundaries: tuple[float, ...],
+                       bucket_counts: list[int],
+                       qs: tuple[float, ...] = (0.5, 0.95, 0.99),
+                       ) -> dict[float, float]:
+    """Classic Prometheus-style quantile estimate from fixed buckets:
+    linear interpolation inside the bucket the target rank lands in. The
+    +Inf bucket clamps to the last finite boundary (the estimate cannot
+    exceed what the buckets resolve). Zero observations → all zeros."""
+    total = sum(bucket_counts)
+    out: dict[float, float] = {}
+    if total == 0:
+        return {q: 0.0 for q in qs}
+    for q in qs:
+        target = q * total
+        cum = 0
+        lo = 0.0
+        value = boundaries[-1] if boundaries else 0.0
+        for i, count in enumerate(bucket_counts):
+            hi = boundaries[i] if i < len(boundaries) else boundaries[-1]
+            if count and cum + count >= target:
+                value = lo + (hi - lo) * ((target - cum) / count)
+                break
+            cum += count
+            lo = hi
+        out[q] = value
+    return out
 
 
 def _fmt(value: float) -> str:

@@ -45,9 +45,25 @@ ROOT_SPAN_ID = 0
 #: the trace context to propagate without threading it through every call
 _CURRENT = threading.local()
 
+#: the innermost OPEN span per thread id, as (trace_id, span name) — the
+#: cross-thread mirror of the thread-local above. The sampling profiler
+#: (telemetry/profiler.py) attributes wall samples from ITS thread to the
+#: sampled thread's active span, and a thread-local cannot be read from
+#: another thread. Plain dict ops are atomic under the GIL; entries are
+#: removed when a thread's span stack empties, so the dict stays bounded
+#: by live threads.
+_ACTIVE_BY_THREAD: dict[int, tuple[str, str]] = {}
+
 
 def current_trace() -> "Trace | None":
     return getattr(_CURRENT, "trace", None)
+
+
+def active_span(tid: int) -> tuple[str, str] | None:
+    """(trace_id, span name) of the innermost open span on thread ``tid``,
+    or None while that thread has no span open — the profiler's
+    attribution read (any thread may call this about any other)."""
+    return _ACTIVE_BY_THREAD.get(tid)
 
 
 class Span:
@@ -153,6 +169,10 @@ class Trace:
         span.span_id = next(self._ids)
         stack.append(span)
         _CURRENT.trace = self
+        # deliberately lock-free: each thread writes only ITS OWN key and
+        # single dict ops are GIL-atomic; the profiler's cross-thread read
+        # tolerates a stale entry (one mis-attributed sample)
+        _ACTIVE_BY_THREAD[threading.get_ident()] = (self.trace_id, span.name)  # lint: ok(lock-discipline)
 
     def current_span_id(self) -> int:
         """Id of the calling thread's innermost open span (the root when
@@ -166,6 +186,12 @@ class Trace:
             stack.pop()
         elif span in stack:  # mismatched nesting: drop back to it
             del stack[stack.index(span):]
+        tid = threading.get_ident()
+        # lock-free per-thread key writes, like _enter (GIL-atomic)
+        if stack:
+            _ACTIVE_BY_THREAD[tid] = (self.trace_id, stack[-1].name)  # lint: ok(lock-discipline)
+        else:
+            _ACTIVE_BY_THREAD.pop(tid, None)  # lint: ok(lock-discipline)
         if not stack and getattr(_CURRENT, "trace", None) is self:
             _CURRENT.trace = None
         record = {

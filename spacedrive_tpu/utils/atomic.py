@@ -104,6 +104,26 @@ def atomic_write_text(dest: str | Path, text: str,
     atomic_write_bytes(dest, text.encode(encoding))
 
 
+def append_line(dest: str | Path, line: str) -> None:
+    """Concurrent-writer-safe JSONL append: one ``O_APPEND`` ``write()``
+    plus fsync. POSIX serializes O_APPEND writes — the kernel moves the
+    offset and writes atomically per call — so two bench runs appending
+    to ``BENCH_history.jsonl`` at once interleave whole lines, never
+    bytes. The tempfile→rename discipline above is wrong for appends (it
+    would clobber the other writer's line); this is the append-shaped
+    half of the same crash-safety contract: a kill mid-call loses at
+    most this one line, never corrupts earlier ones."""
+    dest = Path(dest)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    data = (line.rstrip("\n") + "\n").encode()
+    fd = os.open(dest, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def cleanup_stale_tmp(directory: str | Path) -> int:
     """Remove ``*.sd-tmp*`` leftovers a kill stranded mid-write (called at
     boot for artifact dirs); returns how many were removed. Scans the

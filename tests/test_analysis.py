@@ -525,6 +525,56 @@ def test_durability_discipline_waivable(tmp_path):
         "        fh.write(data)\n"), "durability-discipline") == []
 
 
+# -- pass 14: query-discipline ------------------------------------------------
+
+def test_query_discipline_flags_writes_and_txns_in_query_handlers(tmp_path):
+    """ISSUE 10 fixture: query-scope rspc handlers are the read path —
+    a db write or transaction inside one contends the single-writer
+    discipline from the rspc pool and breaks the GET=side-effect-free
+    contract."""
+    bad = run_on(tmp_path, "api/routers/bad.py", (
+        "def mount(router):\n"
+        "    @router.library_query('search.broken')\n"
+        "    def broken(node, library, arg):\n"
+        "        with library.db.transaction():\n"
+        "            library.db.update(None, {}, {})\n"
+        "        return []\n"
+        "    @router.query('nodes.broken')\n"
+        "    def broken2(node, arg):\n"
+        "        node.library.db.insert(None, {})\n"), "query-discipline")
+    assert [f.lineno for f in bad] == [4, 5, 9]
+    assert "read-only" in bad[2].message
+
+
+def test_query_discipline_allows_reads_mutations_and_dict_update(tmp_path):
+    # reads in queries, writes in MUTATIONS, and non-db receivers are fine
+    assert run_on(tmp_path, "api/routers/good.py", (
+        "def mount(router):\n"
+        "    @router.library_query('search.ok')\n"
+        "    def ok(node, library, arg):\n"
+        "        arg.update({'x': 1})\n"           # dict, not a db
+        "        return library.db.query('SELECT 1')\n"
+        "    @router.library_mutation('files.write')\n"
+        "    def write(node, library, arg):\n"
+        "        with library.db.transaction():\n"
+        "            library.db.update(None, {}, {})\n"), "query-discipline") == []
+    # out of scope: the same shape outside api/ is other passes' business
+    assert run_on(tmp_path, "sync/handlers.py", (
+        "def mount(router):\n"
+        "    @router.query('x')\n"
+        "    def q(node, arg):\n"
+        "        node.db.insert(None, {})\n"), "query-discipline") == []
+
+
+def test_query_discipline_waivable(tmp_path):
+    assert run_on(tmp_path, "api/routers/waived.py", (
+        "def mount(router):\n"
+        "    @router.query('x')\n"
+        "    def q(node, arg):\n"
+        "        node.db.delete(None, {})  # lint: ok(query-discipline)\n"),
+        "query-discipline") == []
+
+
 # -- waivers ------------------------------------------------------------------
 
 def test_scoped_waiver_silences_only_named_pass(tmp_path):

@@ -1,0 +1,621 @@
+"""Serving-tier observability gate (ISSUE 10): per-procedure request
+telemetry, the slow-request ring's span trees, the span-tagged sampling
+profiler, the process resource watcher, reader-wait contention, the
+HTTP-layer families, concurrent-scrape safety during a live pipelined
+scan, and the SSE-tail shutdown regression.
+
+The load-bench twin (real HTTP, during-scan traffic, BENCH_serve.json)
+is ``bench.py --serve``; these tests gate the instruments themselves at
+tier-1 scale.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from spacedrive_tpu import faults, telemetry
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.objects import file_identifier as fi
+from spacedrive_tpu.telemetry import profiler as tprofiler
+from spacedrive_tpu.telemetry import requests as trequests
+from spacedrive_tpu.telemetry.registry import estimate_quantiles
+
+from .test_faults import _identify
+from .test_pipeline import _seed_library
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    yield
+    faults.clear()
+    telemetry.reset()
+    telemetry.reload_enabled()
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(tmp_path / "node", probe_accelerator=False,
+             watch_locations=False)
+    yield n
+    n.shutdown()
+
+
+def _tree(tmp_path, n=60, size=400):
+    import random
+
+    rng = random.Random(7)
+    tree = tmp_path / "tree"
+    tree.mkdir(exist_ok=True)
+    for i in range(n):
+        (tree / f"f{i:03d}.dat").write_bytes(rng.randbytes(size + i))
+    return tree
+
+
+def _span_names(tree_node, acc=None):
+    acc = set() if acc is None else acc
+    acc.add(tree_node["name"])
+    for child in tree_node.get("children", []):
+        _span_names(child, acc)
+    return acc
+
+
+# -- request telemetry ---------------------------------------------------------
+
+
+def test_request_families_count_outcomes_and_latency(node):
+    lib = node.libraries.create("req")
+    for _ in range(5):
+        node.router.resolve("search.paths", {"take": 5}, library_id=lib.id)
+    # a well-formed rejection (ApiError): dirs_first cannot combine with
+    # a cursor — the api_error outcome, distinct from a handler crash
+    from spacedrive_tpu.api.router import ApiError
+
+    with pytest.raises(ApiError):
+        node.router.resolve("search.paths",
+                            {"dirs_first": True, "cursor": [0, 0]},
+                            library_id=lib.id)
+    assert telemetry.value("sd_rspc_requests_total", proc="search.paths",
+                           kind="query", outcome="ok") == 5.0
+    assert telemetry.value("sd_rspc_requests_total", proc="search.paths",
+                           kind="query", outcome="api_error") == 1.0
+    assert telemetry.value("sd_rspc_in_flight") == 0.0
+
+    stats = node.router.resolve("telemetry.requestStats")
+    row = stats["procedures"]["search.paths"]
+    assert row["count"] == 6
+    assert row["errors"] == 1
+    assert 0.0 <= row["p50_s"] <= row["p95_s"] <= row["p99_s"]
+    # the requestStats call itself was counted in flight while running
+    assert stats["in_flight"] == 1.0
+
+
+def test_in_flight_survives_runtime_toggle_mid_request(node):
+    """Review fix: a set_enabled() toggle landing while a request is in
+    flight must not strand the gauge (the dec pairs with the inc
+    unconditionally, below the enabled gate)."""
+    lib = node.libraries.create("toggle")
+
+    def toggling():
+        telemetry.set_enabled(False)
+        return node.router.resolve("search.paths", {"take": 1},
+                                   library_id=lib.id)
+
+    # the outer request starts with telemetry ON; the toggle lands
+    # before its finally runs
+    trequests.observed("outer.test", "query", toggling)
+    telemetry.set_enabled(True)
+    assert telemetry.value("sd_rspc_in_flight") == 0.0
+
+
+def test_p99_gauge_is_windowed_and_resolves(node, monkeypatch):
+    """Review fix: the published p99 covers the window since the last
+    tick, so a transient slow episode cannot pin the alert firing — an
+    idle window publishes 0."""
+    h = telemetry.histogram("sd_rspc_request_seconds", labels=("proc",),
+                            buckets=trequests.REQUEST_BUCKETS)
+    series = h.labels(proc="search.paths")
+    for _ in range(20):
+        series.observe(4.0)                       # the slow episode
+    trequests.publish_quantiles()
+    assert telemetry.value("sd_rspc_request_p99_seconds",
+                           proc="search.paths") > 2.0
+    trequests.publish_quantiles()                 # idle window: no data
+    assert telemetry.value("sd_rspc_request_p99_seconds",
+                           proc="search.paths") == 0.0
+    for _ in range(50):
+        series.observe(0.002)                     # recovered traffic
+    trequests.publish_quantiles()
+    assert 0.0 < telemetry.value("sd_rspc_request_p99_seconds",
+                                 proc="search.paths") < 0.1
+
+
+def test_request_telemetry_off_is_a_bare_call(node, monkeypatch):
+    lib = node.libraries.create("off")
+    telemetry.set_enabled(False)
+    monkeypatch.setenv("SD_SLOW_REQUEST_MS", "0")
+    node.router.resolve("search.paths", {"take": 5}, library_id=lib.id)
+    telemetry.set_enabled(True)
+    assert telemetry.value("sd_rspc_requests_total", proc="search.paths",
+                           kind="query", outcome="ok") == 0.0
+    assert trequests.slow_requests() == []
+
+
+def test_slow_request_ring_captures_span_breakdown(node, monkeypatch):
+    """Acceptance: an artificially slowed search.paths lands in the ring
+    WITH its span tree — the db.query spans (SQL + reader-wait
+    attribution) and the serialize span are all visible."""
+    lib = node.libraries.create("slow")
+    monkeypatch.setenv("SD_SLOW_REQUEST_MS", "40")
+    monkeypatch.setenv("SD_FAULT_STALL_S", "0.08")
+    faults.install("rspc:stall:once")
+    try:
+        node.router.resolve("search.paths", {"take": 10},
+                            library_id=lib.id)
+    finally:
+        faults.clear()
+    slow = trequests.slow_requests()
+    assert len(slow) == 1
+    entry = slow[0]
+    assert entry["proc"] == "search.paths"
+    assert entry["duration_s"] >= 0.04
+    names = _span_names(entry["tree"])
+    assert "rspc.search.paths" in names          # the trace root
+    assert "db.query" in names                   # SQL breakdown
+    assert "search.serialize" in names           # row-decode breakdown
+    # the ring narrates on the flight recorder (SSE / telemetry.watch)
+    events = [e for e in telemetry.recent_events()
+              if e["name"] == "rspc.slow"]
+    assert events and events[-1]["proc"] == "search.paths"
+    assert events[-1]["duration_ms"] >= 40.0
+    # ... and serves over the rspc surface with the tree intact
+    stats = node.router.resolve("telemetry.requestStats",
+                                {"slow_limit": 4})
+    assert stats["slow"][0]["proc"] == "search.paths"
+    assert "db.query" in _span_names(stats["slow"][0]["tree"])
+
+
+def test_fast_requests_never_enter_the_ring(node, monkeypatch):
+    lib = node.libraries.create("fast")
+    monkeypatch.setenv("SD_SLOW_REQUEST_MS", "60000")
+    for _ in range(3):
+        node.router.resolve("search.paths", {"take": 2},
+                            library_id=lib.id)
+    assert trequests.slow_requests() == []
+    # counted anyway — the ring is a lens, not the ledger
+    assert telemetry.value("sd_rspc_requests_total", proc="search.paths",
+                           kind="query", outcome="ok") == 3.0
+
+
+def test_reader_wait_observed_only_under_contention(node):
+    lib = node.libraries.create("wait")
+    db = lib.db
+
+    def _count():
+        snap = telemetry.snapshot()["metrics"]["sd_db_reader_wait_seconds"]
+        return snap["series"][0]["count"]
+
+    before = _count()
+    db.query("SELECT 1")                 # uncontended: no observation
+    assert _count() == before
+    held = threading.Event()
+    release = threading.Event()
+
+    def hold_lock():
+        with db._read_lock:
+            held.set()
+            release.wait(5)
+
+    t = threading.Thread(target=hold_lock, daemon=True)
+    t.start()
+    assert held.wait(5)
+    done = threading.Event()
+    waited = []
+
+    def contended_read():
+        db.query("SELECT 1")
+        waited.append(True)
+        done.set()
+
+    t2 = threading.Thread(target=contended_read, daemon=True)
+    t2.start()
+    time.sleep(0.05)
+    release.set()
+    assert done.wait(5)
+    t.join(5)
+    t2.join(5)
+    assert _count() == before + 1        # exactly the contended read
+
+
+# -- profiler + resource watcher -----------------------------------------------
+
+
+def test_profiler_attributes_cpu_bound_scan_to_pipeline_spans(
+        tmp_path, monkeypatch):
+    """Acceptance: ≥80% of span-attributed wall samples of a CPU-bound
+    pipelined scan land in the job/pipeline span family."""
+    monkeypatch.setattr(fi, "BATCH_SIZE", 64)
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    tree = _tree(tmp_path, n=900, size=1200)
+    node, lib, loc_id = _seed_library(tmp_path / "prof", tree, "prof")
+    profiler = tprofiler.SamplingProfiler(hz=200.0)
+    assert profiler.start() is not None
+    try:
+        _identify(node, lib, loc_id)
+    finally:
+        profiler.stop()
+        node.shutdown()
+    by_span = profiler.totals_by_span()
+    attributed = {k: v for k, v in by_span.items() if k != "other"}
+    total_attributed = sum(attributed.values())
+    assert total_attributed >= 20, by_span
+    pipeline_families = ("pipeline.", "identifier.", "job.", "db.")
+    in_pipeline = sum(v for k, v in attributed.items()
+                     if k.startswith(pipeline_families))
+    assert in_pipeline / total_attributed >= 0.8, by_span
+    # folded stacks carry the span prefix and real frames
+    folded = profiler.folded()
+    assert folded
+    assert any(key.split(";", 1)[0].startswith(("pipeline.", "identifier."))
+               for key, _n in folded)
+    # per-trace attribution (the --profile <job_id> view)
+    traces = profiler.totals_by_trace()
+    assert any(sum(spans.values()) > 0 for spans in traces.values())
+    # samples also ride the registry family (drift-gated)
+    assert sum(v for _lbl, v in
+               telemetry.series_values("sd_profile_samples_total")) \
+        == profiler.samples
+
+
+def test_profiler_off_by_default_and_export_roundtrip(tmp_path, node):
+    assert node.profiler is None         # SD_PROFILE_HZ unset: nothing runs
+    profiler = tprofiler.SamplingProfiler(hz=100.0)
+    profiler.start()
+    trace = telemetry.start_trace("prof.export")
+    stop = threading.Event()
+
+    def spin():
+        with telemetry.span(trace, "export.spin"):
+            while not stop.is_set():
+                sum(i * i for i in range(2000))
+
+    t = threading.Thread(target=spin, daemon=True)
+    t.start()
+    time.sleep(0.4)
+    stop.set()
+    t.join(5)
+    profiler.stop()
+    assert profiler.samples > 0
+    path = profiler.export(tmp_path)
+    assert path is not None and path.exists()
+    merged = tprofiler.load_folded(tmp_path)
+    assert any(key.startswith("export.spin;") for key in merged)
+    totals = tprofiler.load_trace_totals(tmp_path)
+    assert trace.trace_id in totals
+    assert totals[trace.trace_id].get("export.spin", 0) > 0
+
+
+def test_profile_cli_prints_spans_and_traces(tmp_path, capsys):
+    from spacedrive_tpu.telemetry.__main__ import main as telemetry_cli
+
+    profiles = tmp_path / "logs" / "profiles"
+    profiles.mkdir(parents=True)
+    (profiles / "p.folded").write_text(
+        "pipeline.hash;worker:run;hasher:hash_batch 41\n"
+        "pipeline.page;worker:run;cas:gather 7\n")
+    (profiles / "p.traces.json").write_text(json.dumps(
+        {"job-1234": {"pipeline.hash": 41, "pipeline.page": 7}}))
+    rc = telemetry_cli(["--profile", "pipeline.hash",
+                        "--data-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "41" in out and "hasher:hash_batch" in out
+    rc = telemetry_cli(["--profile", "job-12", "--data-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "job-1234" in out and "pipeline.hash" in out
+    rc = telemetry_cli(["--profile", "nope", "--data-dir", str(tmp_path)])
+    assert rc == 1
+
+
+def test_resource_watcher_publishes_process_gauges_and_p99(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("SD_RESOURCE_INTERVAL_S", "0.1")
+    node = Node(tmp_path / "res", probe_accelerator=False,
+                watch_locations=False)
+    try:
+        lib = node.libraries.create("res")
+        # keep traffic flowing while polling: the p99 gauge is WINDOWED
+        # (an idle tick legitimately publishes 0), so the loop must see
+        # a tick whose window contained requests
+        p99_seen = 0.0
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            node.router.resolve("search.paths", {"take": 2},
+                                library_id=lib.id)
+            p99_seen = max(p99_seen, telemetry.value(
+                "sd_rspc_request_p99_seconds", proc="search.paths"))
+            if (telemetry.value("sd_proc_rss_bytes") > 0
+                    and telemetry.value("sd_proc_threads") > 0
+                    and p99_seen > 0):
+                break
+            time.sleep(0.05)
+        assert telemetry.value("sd_proc_rss_bytes") > 1_000_000
+        assert telemetry.value("sd_proc_open_fds") > 0
+        assert telemetry.value("sd_proc_threads") >= 2
+        assert p99_seen > 0
+    finally:
+        node.shutdown()
+
+
+def test_quantile_estimator_brackets_true_values():
+    from spacedrive_tpu.telemetry.requests import REQUEST_BUCKETS
+
+    h = telemetry.histogram("sd_t_q_seconds", buckets=REQUEST_BUCKETS)
+    series = h.labels()
+    for _ in range(90):
+        series.observe(0.004)
+    for _ in range(10):
+        series.observe(0.4)
+    counts, _total, n = series.read()
+    q = estimate_quantiles(h.buckets, counts)
+    assert n == 100
+    assert 0.0025 <= q[0.5] <= 0.005      # inside the p50 bucket
+    assert 0.25 <= q[0.95] <= 0.5         # the slow tail bucket
+    assert q[0.99] <= 0.5
+    assert estimate_quantiles(h.buckets, [0] * len(counts)) \
+        == {0.5: 0.0, 0.95: 0.0, 0.99: 0.0}
+
+
+# -- concurrency gate (satellite): scrape + stats during a live scan -----------
+
+
+def test_concurrent_scrape_and_stats_during_pipelined_scan(
+        tmp_path, monkeypatch):
+    """8 client threads hammer GET /metrics + telemetry.requestStats +
+    search.paths over real HTTP while a pipelined identify runs: no
+    exceptions, counters stay monotonic, histogram bucket sums stay
+    consistent with their _count lines."""
+    from spacedrive_tpu.server.shell import Server
+
+    monkeypatch.setattr(fi, "BATCH_SIZE", 64)
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    tree = _tree(tmp_path, n=600, size=900)
+    node, lib, loc_id = _seed_library(tmp_path / "conc", tree, "conc")
+    server = Server(node, port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    errors: list[str] = []
+    seen_totals: list[float] = []
+    stop = threading.Event()
+
+    def client(i: int) -> None:
+        prev_total = -1.0
+        try:
+            while not stop.is_set():
+                if i % 3 == 0:
+                    with urllib.request.urlopen(f"{base}/metrics",
+                                                timeout=15) as r:
+                        body = r.read().decode()
+                    total = sum(
+                        float(line.rsplit(" ", 1)[1])
+                        for line in body.splitlines()
+                        if line.startswith("sd_rspc_requests_total{"))
+                    if total < prev_total:
+                        errors.append(f"counter went backwards: "
+                                      f"{total} < {prev_total}")
+                    prev_total = total
+                    seen_totals.append(total)
+                elif i % 3 == 1:
+                    req = urllib.request.Request(
+                        f"{base}/rspc/telemetry.requestStats",
+                        data=b'{"arg": null}',
+                        headers={"content-type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=15) as r:
+                        json.loads(r.read().decode())["result"]
+                else:
+                    req = urllib.request.Request(
+                        f"{base}/rspc/search.paths",
+                        data=json.dumps({"library_id": lib.id,
+                                         "arg": {"take": 32}}).encode(),
+                        headers={"content-type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=15) as r:
+                        payload = json.loads(r.read().decode())
+                    if "error" in payload:
+                        errors.append(f"search error: {payload}")
+        except Exception as e:  # noqa: BLE001 — the gate IS no-exceptions
+            errors.append(f"client {i}: {e!r}")
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    done = -1
+    try:
+        _identify(node, lib, loc_id)
+        time.sleep(0.5)  # a beat of post-scan traffic too
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        # read the scan outcome BEFORE teardown closes the DB
+        done = lib.db.query("SELECT count(*) c FROM file_path "
+                            "WHERE cas_id IS NOT NULL")[0]["c"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        server.stop()
+        node.shutdown()
+    assert not errors, errors[:5]
+    assert seen_totals and seen_totals[-1] > 0
+    # histogram internal consistency: +Inf cumulative == _count, and the
+    # snapshot's bucket sum == count for every rspc series
+    snap = telemetry.snapshot()["metrics"]["sd_rspc_request_seconds"]
+    for series in snap["series"]:
+        assert sum(series["buckets"].values()) == series["count"]
+    # the scan completed untouched by the traffic
+    assert done == len(list(tree.glob("*.dat")))
+
+
+# -- HTTP-layer families -------------------------------------------------------
+
+
+def test_http_route_families_and_payload_bytes(tmp_path, node):
+    from spacedrive_tpu.server.shell import Server
+
+    lib = node.libraries.create("http")
+    server = Server(node, port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        urllib.request.urlopen(f"{base}/health", timeout=10).read()
+        urllib.request.urlopen(f"{base}/metrics", timeout=10).read()
+        req = urllib.request.Request(
+            f"{base}/rspc/search.paths",
+            data=json.dumps({"library_id": lib.id,
+                             "arg": {"take": 4}}).encode(),
+            headers={"content-type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).read()
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=10)
+    finally:
+        server.stop()
+    assert telemetry.value("sd_http_requests_total", route="health",
+                           status="200") == 1.0
+    assert telemetry.value("sd_http_requests_total", route="metrics",
+                           status="200") == 1.0
+    assert telemetry.value("sd_http_requests_total", route="rspc",
+                           status="200") == 1.0
+    assert telemetry.value("sd_http_requests_total", route="other",
+                           status="404") == 1.0
+    assert telemetry.value("sd_http_response_bytes_total",
+                           route="metrics") > 1000
+    # transport payload accounting per procedure (in = body, out = JSON)
+    assert telemetry.value("sd_rspc_payload_bytes_total",
+                           proc="search.paths", direction="in") > 0
+    assert telemetry.value("sd_rspc_payload_bytes_total",
+                           proc="search.paths", direction="out") > 0
+
+
+# -- SSE tail shutdown (satellite bugfix) --------------------------------------
+
+
+def _sse_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "sse-telemetry" and t.is_alive()]
+
+
+def test_sse_tail_threads_stopped_on_server_stop(tmp_path, node):
+    """Regression (PR 7 moved SSE tails to dedicated threads; shutdown
+    was untested): server.stop() must stop AND join every live tail —
+    no sse-telemetry thread may outlive the shell."""
+    from spacedrive_tpu.server.shell import Server
+
+    before = len(_sse_threads())
+    server = Server(node, port=0)
+    server.start()
+    sock = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+    sock.sendall(b"GET /telemetry/stream HTTP/1.1\r\n"
+                 b"host: x\r\n\r\n")
+    # the stream is live once the headers + ring replay arrive
+    sock.settimeout(10)
+    assert b"200 OK" in sock.recv(4096)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(_sse_threads()) <= before:
+        time.sleep(0.02)
+    assert len(_sse_threads()) == before + 1
+    with server._sse_lock:
+        assert len(server._sse_tails) == 1
+    server.stop()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(_sse_threads()) > before:
+        time.sleep(0.05)
+    assert len(_sse_threads()) == before, (
+        "SSE pump thread leaked past server.stop()")
+    sock.close()
+
+
+def test_sse_tail_unregisters_on_client_disconnect(tmp_path, node):
+    from spacedrive_tpu.server.shell import Server
+
+    server = Server(node, port=0)
+    server.start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", server.port),
+                                        timeout=10)
+        sock.sendall(b"GET /telemetry/stream HTTP/1.1\r\nhost: x\r\n\r\n")
+        sock.settimeout(10)
+        assert b"200 OK" in sock.recv(4096)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with server._sse_lock:
+                if server._sse_tails:
+                    break
+            time.sleep(0.02)
+        sock.close()  # client hangs up: the tail must reap itself
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with server._sse_lock:
+                if not server._sse_tails:
+                    break
+            time.sleep(0.05)
+        with server._sse_lock:
+            assert not server._sse_tails
+    finally:
+        server.stop()
+        assert not _sse_threads()
+
+
+# -- history append (satellite) ------------------------------------------------
+
+
+def test_append_line_survives_concurrent_writers(tmp_path):
+    from spacedrive_tpu.utils.atomic import append_line
+
+    dest = tmp_path / "BENCH_history.jsonl"
+    n_threads, n_lines = 8, 40
+
+    def writer(i: int) -> None:
+        for j in range(n_lines):
+            append_line(dest, json.dumps({"w": i, "j": j}))
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = dest.read_text().splitlines()
+    assert len(lines) == n_threads * n_lines
+    rows = [json.loads(line) for line in lines]  # every line intact JSON
+    assert {(r["w"], r["j"]) for r in rows} \
+        == {(i, j) for i in range(n_threads) for j in range(n_lines)}
+
+
+# -- stock alert rules ---------------------------------------------------------
+
+
+def test_serving_alert_rules_fire_on_p99_and_error_rate():
+    from spacedrive_tpu.telemetry import alerts
+
+    rules = {r.name: r for r in alerts.default_rules()}
+    assert "rspc-query-p99" in rules and "rspc-error-rate" in rules
+    ev = alerts.AlertEvaluator([rules["rspc-query-p99"],
+                                rules["rspc-error-rate"]])
+    telemetry.gauge("sd_rspc_request_p99_seconds", "",
+                    labels=("proc",)).set(3.5, proc="search.paths")
+    st = {s["name"]: s for s in ev.evaluate_once(now=0.0)}
+    assert not st["rspc-query-p99"]["firing"]    # for_s hold
+    st = {s["name"]: s for s in ev.evaluate_once(now=31.0)}
+    assert st["rspc-query-p99"]["firing"]
+    errs = telemetry.counter("sd_rspc_requests_total", "",
+                             labels=("proc", "kind", "outcome"))
+    errs.inc(200, proc="x", kind="query", outcome="error")
+    st = {s["name"]: s for s in ev.evaluate_once(now=40.0)}
+    assert st["rspc-error-rate"]["firing"]
