@@ -1124,14 +1124,22 @@ def bench_serve() -> dict:
                 one_request(rng, counts)
             return counts
 
+        window_nonce = [0]
+
         def run_window(stop_when) -> tuple[dict, float]:
             totals = {k: 0 for k in ("search", "listing", "count",
                                      "file_range", "thumbnail",
                                      "client_errors")}
             results: list[dict] = []
+            # distinct request streams per window: a replayed seed would
+            # let the serve-pool page cache (ISSUE 11) answer the whole
+            # window from memory, and every A/B would then measure cache
+            # warm-up drift instead of the thing it toggles
+            window_nonce[0] += 1
+            nonce = window_nonce[0] * 10_000
 
             def worker(i: int) -> None:
-                results.append(traffic(stop_when, seed=i))
+                results.append(traffic(stop_when, seed=nonce + i))
 
             threads = [threading.Thread(target=worker, args=(i,),
                                         daemon=True)
@@ -1197,9 +1205,23 @@ def bench_serve() -> dict:
             n_ok = sum(totals_ab.values()) - totals_ab["client_errors"]
             return n_ok / dt if dt else 0.0
 
+        # untimed warmup: reach steady state (page caches, OS buffers,
+        # thread pools) BEFORE any A/B window — otherwise monotonic
+        # warm-up drift systematically advantages whichever side runs
+        # later, regardless of what the A/B toggles
+        timed_window()
+
         # interleaved on→off→on→off, best of each PAIR — both sides get
         # two samples (like the scan bench's A/B), so one unlucky window
-        # on either side can't skew the 0.95× gate
+        # on either side can't skew the 0.95× gate. The telemetry A/B
+        # runs on the IN-PROCESS path (pool bypassed): each A/B toggles
+        # exactly one variable on a stable substrate — with the pool in
+        # the loop, per-window page-cache hit-mix variance (±40% on this
+        # container) would drown the few-percent telemetry cost it
+        # exists to bound
+        pool = node.reader_pool
+        if pool is not None:
+            pool.set_enabled(False)
         rps_on = timed_window()
         telemetry.set_enabled(False)
         rps_off = timed_window()
@@ -1208,11 +1230,34 @@ def bench_serve() -> dict:
         telemetry.set_enabled(False)
         rps_off = max(rps_off, timed_window())
         telemetry.set_enabled(True)
+        if pool is not None:
+            pool.set_enabled(True)
         overhead = {
             "rps_on": round(rps_on, 1),
             "rps_off": round(rps_off, 1),
             "on_vs_off": round(rps_on / rps_off, 3) if rps_off else 0.0,
         }
+
+        # -- pool-vs-in-process A/B (ISSUE 11): same session, same quiet
+        # node — the pool bypass toggles per window, so both sides see
+        # identical caches/pages. SD_SERVE_WORKERS=0 keeps the whole
+        # bench on the degraded in-process path (pool_ab = None then).
+        pool_ab = None
+        if pool is not None:
+            rps_pool = timed_window()
+            pool.set_enabled(False)
+            rps_inproc = timed_window()
+            pool.set_enabled(True)
+            rps_pool = max(rps_pool, timed_window())
+            pool.set_enabled(False)
+            rps_inproc = max(rps_inproc, timed_window())
+            pool.set_enabled(True)
+            pool_ab = {
+                "rps_pool": round(rps_pool, 1),
+                "rps_inproc": round(rps_inproc, 1),
+                "pool_vs_inproc": (round(rps_pool / rps_inproc, 3)
+                                   if rps_inproc else 0.0),
+            }
 
         record = {
             "metric": (f"serve_requests_per_sec[{clients}clients,"
@@ -1225,18 +1270,31 @@ def bench_serve() -> dict:
             "mix": totals,
             "procedures": procs,
             "serve_overhead": overhead,
+            "serve_pool_ab": pool_ab,
+            "serve_pool": pool.status() if pool is not None else None,
         }
         from spacedrive_tpu.telemetry import requests as rq
 
         record["slow_requests"] = len(rq.slow_requests())
         out = Path(__file__).resolve().parent / "BENCH_serve.json"
         out.write_text(json.dumps(record, indent=1) + "\n")
+        if pool_ab is not None:
+            # the degraded-mode headline rides the history too, so the
+            # trajectory shows BOTH serving modes run-over-run
+            _append_history({
+                "metric": (f"serve_requests_per_sec[{clients}clients,"
+                           f"{n_files}files,inprocess-quiet]"),
+                "value": pool_ab["rps_inproc"],
+                "unit": "requests/sec",
+            })
         print(f"info: serve {clients} clients over {window_dt:.1f}s "
               f"during a live scan: {rps_during_scan:,.0f} req/s "
               f"({requests_total} requests, "
               f"{totals['client_errors']} client errors) | scan held "
               f"{n_files / scan_dt:,.0f} files/s | A/B on/off "
-              f"{overhead['on_vs_off']:.3f}x -> {out.name}",
+              f"{overhead['on_vs_off']:.3f}x | pool/inproc "
+              f"{pool_ab['pool_vs_inproc'] if pool_ab else 'n/a'}x "
+              f"-> {out.name}",
               file=sys.stderr)
         for proc, p in sorted(procs.items()):
             print(f"info:   {proc}: n={p['count']} p50 {p['p50_ms']}ms "

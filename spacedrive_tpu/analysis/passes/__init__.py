@@ -21,6 +21,7 @@ from .resource_leak import ResourceLeakPass
 from .retry_discipline import RetryDisciplinePass
 from .swallowed import SwallowedExceptionPass
 from .telemetry_discipline import TelemetryDisciplinePass
+from .worker_purity import WorkerPurityPass
 
 REGISTRY: tuple[type[AnalysisPass], ...] = (
     # legacy hygiene gates (formerly utils/lint.py)
@@ -40,6 +41,7 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     QueueDisciplinePass,
     DurabilityDisciplinePass,
     QueryDisciplinePass,
+    WorkerPurityPass,
 )
 
 

@@ -1,0 +1,128 @@
+"""worker-purity: pool-dispatched rspc handlers must be process-pure.
+
+The multi-process reader pool (ISSUE 11, server/pool.py) runs
+``pool=True`` query handlers inside forked worker processes against a
+:class:`_ReaderNode` surrogate that carries ONLY ``libraries`` and
+``data_dir``, and :class:`_ReaderLibrary` objects that carry ONLY ``id``
+and a read-only ``db``. A marked handler that touches node-held mutable
+state — the job manager, sync actors, the p2p manager, the event bus,
+write connections — would work in-process, silently fail over out of the
+pool (masking the perf win), and drift the two dispatch paths apart.
+This pass makes the surrogate surface a static contract:
+
+- inside any function decorated ``@<router>.query(..., pool=True)`` /
+  ``@<router>.library_query(..., pool=True)``, attribute access on the
+  **node parameter** (first positional) is limited to ``.libraries`` and
+  ``.data_dir``;
+- attribute access on the **library parameter** (second positional of a
+  library-scoped handler) is limited to ``.db`` and ``.id``;
+- ``.transaction(...)`` and write-surface calls on a DB receiver are
+  findings here too (the worker's connection is ``mode=ro`` — the write
+  would die at runtime; query-discipline already bans it for all query
+  handlers, this pass names the pool contract).
+
+Passing the parameters whole to a helper (``tags_for_object(library,
+id)``) is allowed — the pass is module-local like its siblings; helpers
+that reach beyond ``library.db`` fail at runtime in the worker and fall
+over to in-process dispatch, which the ``sd_serve_worker_requests_total
+{outcome="failover"}`` series makes visible.
+
+Scoped to ``api/`` — the only place rspc handlers live.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+from .query_discipline import WRITE_ATTRS, _is_db_receiver
+
+#: the _ReaderNode surface (server/pool.py)
+NODE_ALLOWED = frozenset({"libraries", "data_dir"})
+#: the _ReaderLibrary surface
+LIBRARY_ALLOWED = frozenset({"db", "id"})
+
+QUERY_DECORATORS = ("query", "library_query")
+
+
+def _pool_decorator(node: ast.FunctionDef | ast.AsyncFunctionDef
+                    ) -> tuple[str, bool] | None:
+    """(decorator name, library-scoped) when this is a ``pool=True``
+    query handler; None otherwise."""
+    for dec in node.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        func = dec.func
+        if not isinstance(func, ast.Attribute) \
+                or func.attr not in QUERY_DECORATORS:
+            continue
+        pool = any(kw.arg == "pool"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True
+                   for kw in dec.keywords)
+        if not pool:
+            continue
+        # scope may arrive as the keyword OR the second positional of
+        # router.query(key, scope, ...) — both must bind library_param
+        library_scoped = func.attr == "library_query" or any(
+            kw.arg == "scope" and isinstance(kw.value, ast.Constant)
+            and kw.value.value == "library" for kw in dec.keywords) or (
+            len(dec.args) >= 2 and isinstance(dec.args[1], ast.Constant)
+            and dec.args[1].value == "library")
+        return func.attr, library_scoped
+    return None
+
+
+class WorkerPurityPass(AnalysisPass):
+    id = "worker-purity"
+    description = ("pool-dispatched query handlers touching node-held "
+                   "mutable state (workers see only node.libraries/"
+                   "node.data_dir and library.db/library.id)")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dirs("api"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            marked = _pool_decorator(node)
+            if marked is None:
+                continue
+            decorator, library_scoped = marked
+            params = [a.arg for a in node.args.args]
+            node_param = params[0] if params else None
+            library_param = (params[1]
+                             if library_scoped and len(params) > 1 else None)
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Attribute) \
+                        and isinstance(inner.value, ast.Name):
+                    owner = inner.value.id
+                    if owner == node_param \
+                            and inner.attr not in NODE_ALLOWED:
+                        yield ctx.finding(
+                            inner.lineno, self.id,
+                            f"'{owner}.{inner.attr}' in pool-dispatched "
+                            f"{decorator} handler '{node.name}' — workers "
+                            f"see only node.libraries/node.data_dir "
+                            f"(node-held state stays in the node process)")
+                    elif owner == library_param \
+                            and inner.attr not in LIBRARY_ALLOWED:
+                        yield ctx.finding(
+                            inner.lineno, self.id,
+                            f"'{owner}.{inner.attr}' in pool-dispatched "
+                            f"{decorator} handler '{node.name}' — worker "
+                            f"libraries carry only .db (read-only) and .id")
+                if isinstance(inner, ast.Call) \
+                        and isinstance(inner.func, ast.Attribute):
+                    chain = dotted_name(inner.func)
+                    if chain is None:
+                        continue
+                    attr = inner.func.attr
+                    if attr == "transaction" or (attr in WRITE_ATTRS
+                                                 and _is_db_receiver(chain)):
+                        yield ctx.finding(
+                            inner.lineno, self.id,
+                            f"'{chain}()' in pool-dispatched {decorator} "
+                            f"handler '{node.name}' — the worker's "
+                            f"connection is read-only (mode=ro)")

@@ -50,6 +50,12 @@ class Procedure:
     scope: str           # node | library
     fn: Callable
     doc: str = ""
+    #: pool-eligible (ISSUE 11): a PURE reader — touches nothing but
+    #: ``library.db`` / ``node.libraries`` / ``node.data_dir``, so its
+    #: dispatch may run in a serve-pool worker process against that
+    #: process's read-only SQLite connection. The sdlint ``worker-purity``
+    #: pass statically enforces the contract on every marked handler.
+    pool: bool = False
 
 
 class Router:
@@ -58,15 +64,22 @@ class Router:
         self.procedures: dict[str, Procedure] = {}
 
     # -- registration -------------------------------------------------------
-    def _register(self, key: str, kind: str, scope: str, fn: Callable) -> Callable:
+    def _register(self, key: str, kind: str, scope: str, fn: Callable,
+                  pool: bool = False) -> Callable:
         if key in self.procedures:
             raise ValueError(f"duplicate procedure key {key!r}")
+        if pool and (kind != QUERY or scope != "library"):
+            # node-scoped results have no library to key the worker page
+            # cache on — watermark bumps are strictly per-library, so a
+            # cached node-scope response could never be invalidated
+            raise ValueError(f"{key}: only library-scoped queries may be "
+                             f"pool-dispatched")
         self.procedures[key] = Procedure(key, kind, scope, fn,
-                                         inspect.getdoc(fn) or "")
+                                         inspect.getdoc(fn) or "", pool=pool)
         return fn
 
-    def query(self, key: str, scope: str = "node"):
-        return lambda fn: self._register(key, QUERY, scope, fn)
+    def query(self, key: str, scope: str = "node", pool: bool = False):
+        return lambda fn: self._register(key, QUERY, scope, fn, pool=pool)
 
     def mutation(self, key: str, scope: str = "node"):
         return lambda fn: self._register(key, MUTATION, scope, fn)
@@ -75,8 +88,8 @@ class Router:
         return lambda fn: self._register(key, SUBSCRIPTION, scope, fn)
 
     # library-scoped sugar
-    def library_query(self, key: str):
-        return self.query(key, scope="library")
+    def library_query(self, key: str, pool: bool = False):
+        return self.query(key, scope="library", pool=pool)
 
     def library_mutation(self, key: str):
         return self.mutation(key, scope="library")
@@ -103,7 +116,15 @@ class Router:
         """Execute a query or mutation under per-procedure request
         telemetry (ISSUE 10: ``sd_rspc_*`` families + the slow-request
         ring). Library-scoped procedures receive (node, library, arg);
-        node-scoped (node, arg)."""
+        node-scoped (node, arg).
+
+        Pool-marked queries (ISSUE 11) dispatch to the multi-process
+        reader pool when one is running: the worker resolves the same
+        handler against its own read-only SQLite connection, so heavy
+        read traffic escapes this process's GIL and writer-lock
+        pressure. Any pool failure (no pool, worker crash, saturation)
+        fails over to the in-process path below — queries are read-only,
+        so re-running one is always safe."""
         proc = self._proc(key)
         if proc.kind == SUBSCRIPTION:
             raise ApiError(f"{key} is a subscription; use subscribe()")
@@ -115,7 +136,17 @@ class Router:
             # like organic slowness
             faults.inject("rspc", key=key)
             if proc.scope == "library":
-                return proc.fn(self.node, self._library(library_id), arg)
+                library = self._library(library_id)
+            pool = getattr(self.node, "reader_pool", None)
+            if proc.pool and pool is not None:
+                from ..server.pool import PoolUnavailable
+
+                try:
+                    return pool.dispatch(proc.key, arg, library_id)
+                except PoolUnavailable:
+                    pass  # counted by the pool; serve in-process below
+            if proc.scope == "library":
+                return proc.fn(self.node, library, arg)
             return proc.fn(self.node, arg)
 
         return _requests.observed(key, proc.kind, dispatch)

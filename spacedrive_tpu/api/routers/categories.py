@@ -7,7 +7,7 @@ from ...objects.tags import CATEGORIES
 
 
 def mount(router) -> None:
-    @router.library_query("categories.list")
+    @router.library_query("categories.list", pool=True)
     def list_categories(node, library, _arg):
         counts = {r["kind"]: r["n"] for r in library.db.query(
             "SELECT kind, COUNT(*) n FROM object GROUP BY kind")}

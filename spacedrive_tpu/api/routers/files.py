@@ -23,7 +23,7 @@ def _object_of(library, object_id: int) -> dict:
 
 
 def mount(router) -> None:
-    @router.library_query("files.get")
+    @router.library_query("files.get", pool=True)
     def get(node, library, arg):
         """Object + its file_paths by object id or file_path id."""
         db = library.db
@@ -38,12 +38,12 @@ def mount(router) -> None:
         paths = db.find(FilePath, {"object_id": obj["id"]}) if obj else ([fp] if fp else [])
         return {"object": obj, "file_paths": paths}
 
-    @router.library_query("files.getPath")
+    @router.library_query("files.getPath", pool=True)
     def get_path(node, library, file_path_id: int):
         _row, path = file_path_abs(library.db, file_path_id)
         return str(path)
 
-    @router.library_query("files.getMediaData")
+    @router.library_query("files.getMediaData", pool=True)
     def get_media_data(node, library, object_id: int):
         return library.db.find_one(MediaData, {"object_id": object_id})
 
@@ -73,11 +73,16 @@ def mount(router) -> None:
     def update_access_time(node, library, object_id: int):
         library.db.update(Object, {"id": object_id},
                           {"date_accessed": utc_now()})
+        # invalidate like every sibling write: files.get responses are
+        # pool-cached (ISSUE 11) — a write with no event would be served
+        # stale until an unrelated bump
+        invalidate_query(library, "files.get")
         return None
 
     @router.library_mutation("files.removeAccessTime")
     def remove_access_time(node, library, object_id: int):
         library.db.update(Object, {"id": object_id}, {"date_accessed": None})
+        invalidate_query(library, "files.get")
         return None
 
     @router.library_mutation("files.renameFile")

@@ -22,14 +22,14 @@ def mount(router) -> None:
             r["online"] = r["id"] in online
         return rows
 
-    @router.library_query("locations.get")
+    @router.library_query("locations.get", pool=True)
     def get(node, library, location_id: int):
         row = library.db.find_one(Location, {"id": location_id})
         if row is None:
             raise ApiError("location not found", code=404)
         return row
 
-    @router.library_query("locations.getWithRules")
+    @router.library_query("locations.getWithRules", pool=True)
     def get_with_rules(node, library, location_id: int):
         row = library.db.find_one(Location, {"id": location_id})
         if row is None:
@@ -130,14 +130,14 @@ def mount(router) -> None:
         seed_rules(library.db)
         return library.db.find(IndexerRule, order_by="name")
 
-    @router.library_query("locations.indexer_rules.get")
+    @router.library_query("locations.indexer_rules.get", pool=True)
     def rules_get(node, library, rule_id: int):
         row = library.db.find_one(IndexerRule, {"id": rule_id})
         if row is None:
             raise ApiError("rule not found", code=404)
         return row
 
-    @router.library_query("locations.indexer_rules.listForLocation")
+    @router.library_query("locations.indexer_rules.listForLocation", pool=True)
     def rules_for_loc(node, library, location_id: int):
         return [{"name": s.name, "rules": s.rules, "default": s.default}
                 for s in rules_for_location(library.db, location_id)]
@@ -146,7 +146,11 @@ def mount(router) -> None:
     def rules_create(node, library, arg):
         spec = IndexerRuleSpec(name=arg["name"], default=False,
                                rules={int(k): v for k, v in arg["rules"].items()})
-        return library.db.insert(IndexerRule, spec.to_row())
+        rule_id = library.db.insert(IndexerRule, spec.to_row())
+        # rules reads are pool-cached (ISSUE 11): a write with no event
+        # would serve stale rule rows until an unrelated bump
+        invalidate_query(library, "locations.indexer_rules.list")
+        return rule_id
 
     @router.library_mutation("locations.indexer_rules.delete")
     def rules_delete(node, library, rule_id: int):
@@ -155,4 +159,9 @@ def mount(router) -> None:
             raise ApiError("cannot delete a system rule")
         library.db.delete(IndexerRulesInLocation, {"indexer_rule_id": rule_id})
         library.db.delete(IndexerRule, {"id": rule_id})
+        invalidate_query(library, "locations.indexer_rules.list")
+        # the delete also removed per-location assignments — refresh the
+        # key-routed frontend caches of both rule views
+        invalidate_query(library, "locations.indexer_rules.listForLocation")
+        invalidate_query(library, "locations.getWithRules")
         return None

@@ -15,6 +15,6 @@ def mount(router) -> None:
             node.config.write(**updates)
         return None
 
-    @router.library_query("nodes.listLocations")
+    @router.library_query("nodes.listLocations", pool=True)
     def list_locations(node, library, _arg):
         return library.db.find(Location, order_by="name")

@@ -64,4 +64,10 @@ def mount(router) -> None:
                                    rq.SLOW_RING))
             except (TypeError, ValueError):
                 raise ApiError("slow_limit must be an integer")
-        return rq.stats(slow_limit=limit)
+        out = rq.stats(slow_limit=limit)
+        # serve-pool fold-in (ISSUE 11): the multi-process reader pool's
+        # worker/cache/restart state, when one is running (null in the
+        # degraded in-process mode)
+        pool = getattr(node, "reader_pool", None)
+        out["serve_pool"] = pool.status() if pool is not None else None
+        return out

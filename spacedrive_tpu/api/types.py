@@ -94,11 +94,19 @@ export interface SlowRequestEntry {
   proc: string; kind: string; outcome: string; duration_s: number;
   unix: number; tree: Record<string, unknown>
 }
+/** Multi-process reader-pool state (telemetry.requestStats.serve_pool);
+ * null while the node serves in the degraded in-process mode. */
+export interface ServePoolStatus {
+  workers: number; alive: number; idle: number; enabled: boolean;
+  running: boolean; restarts: number; failovers: number;
+  cache_hits: number; cache_misses: number; watermarks: number;
+  per_worker: Record<string, Record<string, number>>
+}
 /** telemetry.requestStats: the serving-tier observability surface. */
 export interface RequestStats {
   enabled: boolean; in_flight: number; slow_threshold_ms: number;
   procedures: Record<string, ProcedureRequestStats>;
-  slow: SlowRequestEntry[]
+  slow: SlowRequestEntry[]; serve_pool: ServePoolStatus | null
 }
 /** The node-wide ingest admission budget (sync.fleetStatus). */
 export interface IngestBudgetStatus {

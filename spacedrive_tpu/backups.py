@@ -248,6 +248,13 @@ def do_restore(node: "Node", backup_path: str | Path) -> str:
     restore_files(backup_path, library_id, node.libraries.dir,
                   pre_validated=True)
     node.libraries._load(library_id)
+    # the DB FILE was swapped (os.replace): long-lived readers — the
+    # serve-pool workers' read-only connections (ISSUE 11) — still hold
+    # the old inode, so a watermark bump alone cannot help; this event
+    # advances the library's reader EPOCH, forcing every worker to
+    # close and reopen before serving another read
+    node.emit("library.reload", {"source": "restore"},
+              library_id=library_id)
     return library_id
 
 

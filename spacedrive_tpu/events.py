@@ -101,6 +101,16 @@ class EventBus:
         with self._lock:
             self._hooks.append(hook)
 
+    def off(self, hook: Callable[[CoreEvent], None]) -> None:
+        """Remove a hook registered with :meth:`on` (the serve pool
+        unhooks its watermark bump at stop so a stopped pool is not kept
+        alive by the bus)."""
+        with self._lock:
+            try:
+                self._hooks.remove(hook)
+            except ValueError:
+                pass
+
     def emit(self, event: CoreEvent) -> None:
         with self._lock:
             subs = list(self._subs)

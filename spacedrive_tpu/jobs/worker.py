@@ -213,6 +213,17 @@ class Worker:
             self._finish_telemetry()
             r.upsert(self.library.db)
             self._emit_progress()
+            # serve-pool invalidation (ISSUE 11): every job exit emits a
+            # final post-commit signal. Mid-run, pipelined jobs emit
+            # db.commit per group and sequential/non-pipelined jobs ride
+            # the job_progress bump — but progress is THROTTLED, so the
+            # last batch's emit can be suppressed and a worker page cached
+            # just before it would otherwise stay stale until some
+            # unrelated event bumped the library. The job's writes are
+            # durable here (autocommit steps / the executor committed
+            # before returning), so the bump can never precede its commit.
+            self.library.emit("db.commit", {"source": "job.exit",
+                                            "job": r.name})
             logger.info("job %s -> %s (total run time %.3fs)",
                         r.name, JobStatus.NAMES[r.status], run_time)
             self.manager.complete(self.library, self, next_job)

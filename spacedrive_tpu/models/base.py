@@ -132,9 +132,14 @@ class Model:
             cols.append(f"UNIQUE ({quoted})")
         stmts = [f"CREATE TABLE IF NOT EXISTS {cls.TABLE} ({', '.join(cols)})"]
         for idx in cls.INDEXES:
-            quoted = ", ".join(f'"{c}"' for c in idx)
+            # an entry with a space carries SQL modifiers ("materialized_path
+            # COLLATE NOCASE") and passes through unquoted; the index name
+            # folds the modifiers in so it can never collide with the plain
+            # index over the same columns
+            quoted = ", ".join(f'"{c}"' if " " not in c else c for c in idx)
+            name = "_".join("_".join(c.lower().split()) for c in idx)
             stmts.append(
-                f"CREATE INDEX IF NOT EXISTS idx_{cls.TABLE}_{'_'.join(idx)} "
+                f"CREATE INDEX IF NOT EXISTS idx_{cls.TABLE}_{name} "
                 f"ON {cls.TABLE} ({quoted})"
             )
         return stmts
@@ -204,8 +209,30 @@ class Database:
     while the committer holds a multi-page group-commit transaction.
     """
 
-    def __init__(self, path: str | Path, models: Iterable[type[Model]]) -> None:
+    def __init__(self, path: str | Path, models: Iterable[type[Model]],
+                 readonly: bool = False) -> None:
         self.path = str(path)
+        self.readonly = readonly
+        if readonly:
+            # per-process reader bootstrap (ISSUE 11): the serve-pool
+            # workers open each library with ONE read-only connection —
+            # no writer, no migrate (the node process owns DDL), every
+            # SELECT a fresh WAL snapshot. ``mode=ro`` + ``query_only``
+            # is defense in depth: a write attempt raises instead of
+            # contending the node's single-writer discipline.
+            self.models = list(models)
+            self._lock = threading.RLock()
+            self._conn = sqlite3.connect(
+                f"file:{self.path}?mode=ro", uri=True,
+                check_same_thread=False, cached_statements=512)
+            self._conn.row_factory = sqlite3.Row
+            self._conn.execute("PRAGMA query_only=ON")
+            self._txn_depth = 0
+            self._txn_thread = None
+            self._read_conn = self._conn
+            self._read_lock = threading.Lock()
+            self._closed = False
+            return
         if self.path != ":memory:":
             Path(self.path).parent.mkdir(parents=True, exist_ok=True)
         self.models = list(models)
@@ -273,10 +300,16 @@ class Database:
 
     # -- low-level ----------------------------------------------------------
     def execute(self, sql: str, params: tuple | list = ()) -> sqlite3.Cursor:
+        if self.readonly:
+            raise sqlite3.ProgrammingError(
+                "read-only database handle (serve-pool reader)")
         with self._lock:
             return self._conn.execute(sql, params)
 
     def executemany(self, sql: str, seq: list[tuple]) -> None:
+        if self.readonly:
+            raise sqlite3.ProgrammingError(
+                "read-only database handle (serve-pool reader)")
         with self._lock:
             if self._txn_depth:
                 self._conn.executemany(sql, seq)
@@ -348,6 +381,9 @@ class Database:
     def transaction(self):
         """Context manager for an atomic multi-statement write (the analogue of
         prisma's ``_batch`` used by sync write_ops, manager.rs:62-99)."""
+        if self.readonly:
+            raise sqlite3.ProgrammingError(
+                "read-only database handle (serve-pool reader)")
         return _Txn(self)
 
     def quick_check(self) -> list[str]:

@@ -199,7 +199,18 @@ class FilePath(Model):
         ("location_id", "materialized_path", "name", "extension"),
         ("location_id", "inode", "device"),
     )
-    INDEXES = (("location_id",), ("location_id", "materialized_path"), ("cas_id",), ("object_id",))
+    # serving-tier read-path indexes (ISSUE 11 satellite): the explorer's
+    # directory listing filters on materialized_path WITHOUT a location
+    # (plain prefix index), the watcher/identifier/rename sweeps run
+    # ``location_id = ? AND materialized_path LIKE 'prefix%'`` (the NOCASE
+    # collation is what lets SQLite's LIKE optimization turn the default
+    # case-insensitive LIKE into an index range scan), and the pathsCount
+    # badge COUNTs over (location_id, hidden) — covering, index-only
+    INDEXES = (("location_id",), ("location_id", "materialized_path"),
+               ("cas_id",), ("object_id",),
+               ("materialized_path", "is_dir", "name"),
+               ("location_id", "materialized_path COLLATE NOCASE"),
+               ("location_id", "hidden"))
 
 
 class Object(Model):

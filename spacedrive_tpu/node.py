@@ -132,6 +132,12 @@ class Node:
 
         self.thumbnail_remover = ThumbnailRemoverActor(self)
 
+        # multi-process reader pool (ISSUE 11): attached by the server
+        # shell (or tests) via server/pool.ReaderPool — None means every
+        # query resolves in-process (the degraded mode). The router reads
+        # this attribute on each pool-marked query dispatch.
+        self.reader_pool = None
+
         accel = None
         if probe_accelerator:
             # inventory only — deliberately NOT seeding the jax guard: the
@@ -259,6 +265,11 @@ class Node:
     def shutdown(self) -> None:
         """Graceful: checkpoint all jobs, stop watchers, close DBs
         (Node::shutdown, lib.rs:196)."""
+        pool = getattr(self, "reader_pool", None)
+        if pool is not None:
+            # defensive: the owning shell normally stops it first
+            pool.stop()
+            self.reader_pool = None
         self.jobs.shutdown()
         from . import telemetry
 

@@ -373,6 +373,16 @@ class PipelineExecutor:
             # upsert resumes one group early, and re-running a committed
             # group is idempotent (its rows are no longer orphans).
             self._persist_checkpoint()
+            # serve-pool invalidation (ISSUE 11): the group is durable —
+            # bump the library's read watermark so a pool worker can
+            # never serve a directory page cached before this commit.
+            # Emitted AFTER COMMIT by construction (we are past the retry
+            # block), per-txn not per-page, and a node-less library
+            # (unit-test contexts) makes it a no-op.
+            library = getattr(self.ctx, "library", None)
+            if library is not None and hasattr(library, "emit"):
+                library.emit("db.commit", {"source": "pipeline",
+                                           "txns": self._txns})
 
         try:
             while True:

@@ -1,0 +1,769 @@
+"""Multi-process reader pool: rspc query dispatch off the node's GIL.
+
+ISSUE 11 tentpole. PR 10's instruments proved one Python process cannot
+serve heavy read traffic while indexing — during a live 20k-file scan
+the read path collapsed to 9 req/s with multi-second p99 tails, and the
+slow-request span trees attributed the tail to reader-lock wait plus
+the scan's GIL/commit pressure. SQLite WAL already permits multi-process
+readers and the sdlint ``query-discipline`` pass guarantees query
+handlers are read-only, so the process boundary is enforceable: this
+module forks N worker processes, each holding its OWN read-only SQLite
+connection per library (``Database(readonly=True)`` — the per-process
+reader bootstrap in models/base), and routes pool-marked queries
+(``@router.query(..., pool=True)``, statically vetted by the sdlint
+``worker-purity`` pass) to them. Writes, mutations, jobs, sync and
+subscriptions never leave the node process.
+
+Topology (docs/architecture/serving.md):
+
+- **Dispatch**: ``Router.resolve`` hands a pool-marked query to
+  :meth:`ReaderPool.dispatch`; one worker serves one request at a time
+  (checkout from an idle list), replies are pickled over a pipe. Any
+  pool failure raises :class:`PoolUnavailable` and the router re-runs
+  the query in-process — the degradation ladder pool → in-process is
+  always safe because queries are read-only.
+- **Invalidation**: the pool keeps a per-library integer *watermark*
+  bumped by a synchronous event-bus hook on every data-changing event
+  (``db.commit`` from the pipeline group committer and the CRDT-ingest
+  session, ``invalidate_query`` from mutations, ``sync_message``).
+  Every dispatch carries the current watermark; a worker's
+  hot-directory-page LRU entry only hits when its stored watermark
+  equals the request's, and each SELECT on the read-only connection
+  starts a fresh WAL read transaction — so a read dispatched after a
+  commit at watermark W can never return pre-W rows.
+- **Supervision**: a supervisor thread health-checks idle workers every
+  ``SD_SERVE_HEALTH_S`` (the ping doubles as the watermark/stats sync),
+  reaps and respawns dead ones, and a dispatcher that finds its worker
+  dead (or unresponsive past ``SD_SERVE_REQUEST_TIMEOUT_S``) retires it
+  and fails the in-flight request over to the in-process path.
+
+``SD_SERVE_WORKERS=0`` disables the pool entirely (the degraded mode
+``bench.py --serve`` A/Bs against); unset defaults to
+``min(4, cpu_count)``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import multiprocessing
+import os
+import threading
+import time
+from collections import OrderedDict
+from pathlib import Path
+from typing import TYPE_CHECKING, Any
+
+from .. import faults, telemetry
+from ..telemetry.registry import REQUEST_BUCKETS
+
+if TYPE_CHECKING:
+    from ..node import Node
+
+logger = logging.getLogger(__name__)
+
+#: event kinds that mean "committed rows changed" for a library — the
+#: watermark bump set. Conservative by design: over-bumping only costs
+#: cache hits, under-bumping would serve stale pages.
+BUMP_KINDS = frozenset({"db.commit", "invalidate_query", "sync.newMessage",
+                        "job_progress"})
+
+#: event kinds that mean "the DB FILE was swapped" (backup restore, repair)
+#: — a watermark bump is not enough: a worker's open read-only connection
+#: still points at the old inode, so these advance the library's reader
+#: EPOCH and every worker closes + reopens before its next read
+RELOAD_KINDS = frozenset({"library.reload"})
+
+# module handles only — the families (and their help text, the single
+# copy) are declared in telemetry._declare_core, which ran when the
+# telemetry package imported above; these are get-or-create lookups
+_REQUESTS = telemetry.counter("sd_serve_worker_requests_total",
+                              labels=("worker", "outcome"))
+_SECONDS = telemetry.histogram("sd_serve_worker_request_seconds",
+                               labels=("worker",), buckets=REQUEST_BUCKETS)
+_CACHE = telemetry.counter("sd_serve_worker_cache_total",
+                           labels=("worker", "result"))
+_RESTARTS = telemetry.counter("sd_serve_worker_restarts_total",
+                              labels=("worker", "reason"))
+_LIVE = telemetry.gauge("sd_serve_workers")
+_INVALIDATIONS = telemetry.counter("sd_serve_invalidations_total")
+
+
+class PoolUnavailable(Exception):
+    """The pool could not serve this dispatch (not running, disabled,
+    saturated, or the worker died mid-request) — the router falls back
+    to the in-process path, which is always safe for read-only queries."""
+
+
+def configured_workers() -> int:
+    """``SD_SERVE_WORKERS`` (0 disables the pool); defaults to
+    ``min(4, cpu_count)``."""
+    raw = os.environ.get("SD_SERVE_WORKERS", "").strip()
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            pass
+    return min(4, os.cpu_count() or 1)
+
+
+def _env_float(name: str, default: float) -> float:
+    """Knob parse that can never take the pool down: a malformed value
+    degrades to the default (``configured_workers`` sets the precedent —
+    a typo'd knob must not abort Server.start or crash-loop a worker)."""
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+# ---------------------------------------------------------------------------
+# worker side (runs in the forked child)
+# ---------------------------------------------------------------------------
+
+
+class _PageCache:
+    """Watermark-keyed LRU over query responses. An entry hits only when
+    its stored watermark equals the request's current one for that
+    library, so invalidation is a watermark bump — no explicit delete
+    races with reads."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = max(0, capacity)
+        self._entries: OrderedDict[tuple, tuple[int, Any]] = OrderedDict()
+        self._watermarks: dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def sync(self, watermarks: dict[str, int]) -> None:
+        """Fold the node's watermark map in and drop stale entries (the
+        between-requests eviction; the per-request check is authoritative)."""
+        for lib, wm in watermarks.items():
+            if wm > self._watermarks.get(lib, 0):
+                self._watermarks[lib] = wm
+        stale = [k for k, (wm, _r) in self._entries.items()
+                 if wm != self._watermarks.get(k[0], 0)]
+        for k in stale:
+            del self._entries[k]
+
+    def lookup(self, lib: str, proc: str, arg: Any, wm: int):
+        """(hit, key, result): the key is reused for :meth:`store`."""
+        if wm > self._watermarks.get(lib, 0):
+            self._watermarks[lib] = wm
+        try:
+            key = (lib, proc, json.dumps(arg, sort_keys=True, default=str))
+        except (TypeError, ValueError):
+            return False, None, None
+        entry = self._entries.get(key)
+        if entry is not None and entry[0] == wm:
+            self._entries.move_to_end(key)
+            return True, key, entry[1]
+        if entry is not None and wm > entry[0]:
+            del self._entries[key]  # genuinely stale entry
+        elif entry is not None:
+            # straggler: this REQUEST is older than the cached page (its
+            # watermark was read before a bump) — serve it fresh from
+            # SQLite but neither evict nor overwrite the newer entry
+            return False, None, None
+        return False, key, None
+
+    def drop_library(self, lib: str) -> None:
+        """Epoch change: every cached page of this library is void."""
+        for key in [k for k in self._entries if k[0] == lib]:
+            del self._entries[key]
+
+    def store(self, key: tuple | None, wm: int, result: Any) -> None:
+        if key is None or self.capacity == 0:
+            return
+        self._entries[key] = (wm, result)
+        self._entries.move_to_end(key)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+
+
+class _ReaderLibrary:
+    """What a pool-pure handler may touch of a Library: ``id`` and a
+    read-only ``db``. No sync manager, no node backref — the worker-
+    purity pass keeps handlers inside this surface."""
+
+    __slots__ = ("id", "db")
+
+    def __init__(self, lib_id: str, db: Any) -> None:
+        self.id = lib_id
+        self.db = db
+
+
+class _ReaderLibraries:
+    """Per-process reader bootstrap: opens ``libraries/<id>.db`` with a
+    read-only connection on first use. Lazy, so libraries created after
+    the fork are visible; a vanished file surfaces as the same 404 the
+    router raises for an unloaded library."""
+
+    def __init__(self, libraries_dir: Path) -> None:
+        self.dir = libraries_dir
+        self._open: dict[str, _ReaderLibrary] = {}
+        self._epochs: dict[str, int] = {}
+
+    def get(self, lib_id: str, epoch: int = 0) -> _ReaderLibrary:
+        import sqlite3
+
+        from ..api.router import ApiError
+        from ..models import ALL_MODELS, Database
+
+        if epoch > self._epochs.get(lib_id, 0):
+            # the node swapped the DB file (restore/repair): the open
+            # connection points at the old inode — close and reopen
+            self._epochs[lib_id] = epoch
+            stale = self._open.pop(lib_id, None)
+            if stale is not None:
+                try:
+                    stale.db.close()
+                except Exception:
+                    pass
+        lib = self._open.get(lib_id)
+        if lib is not None:
+            return lib
+        # the id becomes a filename — same hygiene as the trace exports
+        if not lib_id or any(c in lib_id for c in "/\\") or ".." in lib_id \
+                or len(lib_id) > 64:
+            raise ApiError(f"library {lib_id!r} not loaded", code=404)
+        path = self.dir / f"{lib_id}.db"
+        if not path.is_file():
+            raise ApiError(f"library {lib_id!r} not loaded", code=404)
+        try:
+            db = Database(path, ALL_MODELS, readonly=True)
+        except sqlite3.Error as e:
+            raise ApiError(f"library {lib_id!r} unreadable: {e}",
+                           code=404) from None
+        lib = _ReaderLibrary(lib_id, db)
+        self._open[lib_id] = lib
+        return lib
+
+
+class _ReaderNode:
+    """The node surrogate handlers see inside a worker: libraries +
+    data_dir and nothing else. A handler reaching for node-held mutable
+    state (jobs, sync, p2p, events) gets an AttributeError — which the
+    worker reports and the dispatcher fails over; the sdlint
+    ``worker-purity`` pass makes that unreachable for marked handlers."""
+
+    def __init__(self, data_dir: Path) -> None:
+        self.data_dir = Path(data_dir)
+        self.libraries = _ReaderLibraries(self.data_dir / "libraries")
+        self.reader_pool = None  # a worker never nests a pool
+
+
+def _serve_one(runtime_node, router, cache: _PageCache, msg: dict) -> dict:
+    from ..api.router import QUERY, ApiError
+
+    key = msg.get("proc", "")
+    arg = msg.get("arg")
+    library_id = msg.get("library_id")
+    wm = int(msg.get("wm") or 0)
+    epoch = int(msg.get("epoch") or 0)
+    try:
+        # chaos seam: `serve_worker:kill` is the worker-death drill the
+        # crash harness arms (the plan is inherited across the fork)
+        faults.inject("serve_worker", key=key)
+        proc = router.procedures.get(key)
+        if proc is None or proc.kind != QUERY or not proc.pool:
+            raise ApiError(f"{key} is not pool-dispatchable")
+        if epoch > runtime_node.libraries._epochs.get(library_id or "", 0):
+            cache.drop_library(library_id or "")
+        hit, cache_key, cached = cache.lookup(
+            library_id or "", key, arg, wm)
+        if hit:
+            return {"ok": True, "result": cached, "hit": True}
+        if proc.scope == "library":
+            result = proc.fn(
+                runtime_node,
+                runtime_node.libraries.get(library_id, epoch=epoch), arg)
+        else:
+            result = proc.fn(runtime_node, arg)
+        cache.store(cache_key, wm, result)
+        return {"ok": True, "result": result, "hit": False}
+    except ApiError as e:
+        return {"ok": False, "api": True, "error": str(e), "code": e.code}
+    except Exception as e:  # 500-class, exactly like an in-process crash
+        return {"ok": False, "api": False,
+                "error": f"{type(e).__name__}: {e}"}
+
+
+def _worker_main(conn, data_dir: str, slot: int) -> None:
+    """Forked worker loop: requests and control messages over one pipe.
+    First move is disabling telemetry — the child registry is invisible
+    to /metrics, and skipping it sidesteps any lock a fork could have
+    caught mid-increment. Per-request stats travel back in the reply and
+    are folded into the node-process ``sd_serve_worker_*`` families."""
+    from .. import telemetry as _telemetry
+    from ..api.router import mount as api_mount
+
+    _telemetry.set_enabled(False)
+    node = _ReaderNode(Path(data_dir))
+    router = api_mount(node)
+    cache = _PageCache(_env_int("SD_SERVE_CACHE", 256))
+    served = 0
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError, KeyboardInterrupt):
+            break
+        except Exception:
+            # a garbled frame means the parent-side state is unknowable;
+            # exit and let the supervisor respawn a clean process
+            break
+        if not isinstance(msg, dict):
+            continue
+        ctl = msg.get("ctl")
+        if ctl == "shutdown":
+            break
+        if ctl == "sync":
+            cache.sync(msg.get("watermarks") or {})
+            reply: dict[str, Any] = {"ok": True, "pong": True,
+                                     "served": served,
+                                     "cache_entries": len(cache)}
+        else:
+            reply = _serve_one(node, router, cache, msg)
+            served += 1
+        try:
+            conn.send(reply)
+        except (BrokenPipeError, OSError):
+            break
+        except Exception as e:  # unpicklable result — report, don't die
+            try:
+                conn.send({"ok": False, "api": False,
+                           "error": f"unpicklable response: {e}"})
+            except Exception:
+                break
+
+
+# ---------------------------------------------------------------------------
+# node side
+# ---------------------------------------------------------------------------
+
+
+class _Worker:
+    __slots__ = ("slot", "proc", "conn", "generation", "dead")
+
+    def __init__(self, slot: int, proc, conn, generation: int) -> None:
+        self.slot = slot
+        self.proc = proc
+        self.conn = conn
+        self.generation = generation
+        self.dead = False
+
+
+class ReaderPool:
+    def __init__(self, node: "Node", workers: int | None = None) -> None:
+        self.node = node
+        self.workers = configured_workers() if workers is None else workers
+        self._ctx = multiprocessing.get_context("fork")
+        self._slots: list[_Worker | None] = [None] * self.workers
+        self._idle: list[_Worker] = []
+        self._cv = threading.Condition()
+        self._wm_lock = threading.Lock()
+        self._watermarks: dict[str, int] = {}
+        self._epochs: dict[str, int] = {}
+        self._enabled = True
+        self._cache_hits = 0
+        self._cache_misses = 0
+        self._running = False
+        self._generation = 0
+        self._restarts = 0
+        self._failovers = 0
+        self._worker_stats: dict[int, dict] = {}
+        self._respawn_wake = threading.Event()
+        self._supervisor: threading.Thread | None = None
+        self.health_s = _env_float("SD_SERVE_HEALTH_S", 1.0)
+        self.request_timeout_s = _env_float("SD_SERVE_REQUEST_TIMEOUT_S",
+                                            30.0)
+        self.queue_wait_s = _env_float("SD_SERVE_QUEUE_WAIT_S", 2.0)
+
+    @classmethod
+    def maybe_start(cls, node: "Node") -> "ReaderPool | None":
+        """The shell's entry point: None when ``SD_SERVE_WORKERS=0``
+        keeps the node in the degraded in-process mode."""
+        n = configured_workers()
+        if n <= 0:
+            return None
+        return cls(node, workers=n).start()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ReaderPool":
+        if self.workers <= 0:
+            raise ValueError("ReaderPool needs at least one worker")
+        self._running = True
+        try:
+            for slot in range(self.workers):
+                self._spawn(slot)
+        except BaseException:
+            # partial boot (fork/pipe failure mid-loop): tear down the
+            # slots already spawned — the caller never gets a pool handle,
+            # so nothing else could ever stop them
+            self.stop()
+            raise
+        self.node.events.on(self._on_event)
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="sd-serve-supervisor", daemon=True)
+        self._supervisor.start()
+        logger.info("reader pool started: %d workers", self.workers)
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        self._respawn_wake.set()
+        try:
+            self.node.events.off(self._on_event)
+        except Exception:
+            pass
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5)
+        with self._cv:
+            workers = [w for w in self._slots if w is not None]
+            # a worker NOT in the idle list is checked out by a dispatch
+            # thread that may be mid-send/recv on its pipe right now —
+            # multiprocessing.Connection is not thread-safe, so those get
+            # a kill (the dispatcher sees EOF and fails over) instead of
+            # a second writer interleaving frames on the same conn
+            idle = set(self._idle)
+            self._slots = [None] * self.workers
+            self._idle.clear()
+            self._cv.notify_all()
+        for w in workers:
+            if w not in idle:
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+                continue
+            try:
+                w.conn.send({"ctl": "shutdown"})
+            except (BrokenPipeError, OSError):
+                pass
+        deadline = time.monotonic() + 2.0
+        for w in workers:
+            w.proc.join(timeout=max(0.0, deadline - time.monotonic()))
+            if w.proc.is_alive():
+                w.proc.kill()
+                w.proc.join(timeout=2)
+            try:
+                w.conn.close()
+            except OSError:
+                pass
+        _LIVE.set(0.0)
+
+    def set_enabled(self, value: bool) -> None:
+        """Runtime bypass (the serve bench's pool-vs-in-process A/B):
+        disabled, every dispatch raises PoolUnavailable and the router
+        serves in-process; workers stay warm."""
+        self._enabled = bool(value)
+        with self._cv:
+            self._cv.notify_all()  # parked checkouts re-check the gate
+
+    # -- invalidation --------------------------------------------------------
+    def _on_event(self, event) -> None:
+        """Synchronous bus hook (runs in the committing thread, after the
+        durable commit that emitted the event): bump the library's
+        watermark so every LATER dispatch carries a fresher key than any
+        cached pre-commit page. No pipe IO here — the hot path only pays
+        a dict update; eviction rides the supervisor's next sync."""
+        lib_id = getattr(event, "library_id", None)
+        if not lib_id:
+            return
+        if event.kind in RELOAD_KINDS:
+            with self._wm_lock:
+                self._epochs[lib_id] = self._epochs.get(lib_id, 0) + 1
+                self._watermarks[lib_id] = \
+                    self._watermarks.get(lib_id, 0) + 1
+            _INVALIDATIONS.inc()
+            return
+        if event.kind not in BUMP_KINDS:
+            return
+        with self._wm_lock:
+            self._watermarks[lib_id] = self._watermarks.get(lib_id, 0) + 1
+        _INVALIDATIONS.inc()
+
+    def watermark(self, lib_id: str | None) -> tuple[int, int]:
+        """(watermark, epoch) for a library — the freshness pair every
+        dispatch carries."""
+        if not lib_id:
+            return 0, 0
+        with self._wm_lock:
+            return (self._watermarks.get(lib_id, 0),
+                    self._epochs.get(lib_id, 0))
+
+    def _count_failover(self) -> None:
+        with self._wm_lock:  # int += is not atomic across threads
+            self._failovers += 1
+
+    # -- dispatch ------------------------------------------------------------
+    def dispatch(self, key: str, arg: Any, library_id: str | None) -> Any:
+        """Run one pool-marked query on a worker. Raises ApiError exactly
+        as the in-process handler would; raises PoolUnavailable when the
+        caller should fail over in-process — including on non-Api worker
+        errors, where the in-process re-run reproduces the handler's
+        original exception with full fidelity."""
+        if not (self._running and self._enabled):
+            raise PoolUnavailable("pool not running")
+        try:
+            worker = self._checkout()
+        except PoolUnavailable:
+            # saturation/stopping spills are failovers too — an operator
+            # tuning SD_SERVE_QUEUE_WAIT_S or the worker count needs them
+            # visible (`worker="pool"`: no slot was ever involved)
+            self._count_failover()
+            _REQUESTS.inc(worker="pool", outcome="failover")
+            raise
+        label = str(worker.slot)
+        wm, epoch = self.watermark(library_id)
+        req = {"proc": key, "arg": arg, "library_id": library_id,
+               "wm": wm, "epoch": epoch}
+        t0 = time.perf_counter()
+        try:
+            worker.conn.send(req)
+            if not worker.conn.poll(self.request_timeout_s):
+                raise TimeoutError(
+                    f"no reply in {self.request_timeout_s:.0f}s")
+            reply = worker.conn.recv()
+        except TimeoutError as e:
+            self._retire(worker, reason="timeout")
+            self._count_failover()
+            _REQUESTS.inc(worker=label, outcome="failover")
+            raise PoolUnavailable(f"worker {label} wedged: {e}") from None
+        except Exception as e:
+            # EOF/broken pipe (worker died), but also anything else the
+            # pipe can throw mid-frame (UnpicklingError on a garbled
+            # stream, MemoryError on a huge reply): the connection state
+            # is unknowable, so the worker must be retired either way —
+            # returning nothing here would leak the checked-out slot
+            # forever (the supervisor only respawns DEAD processes)
+            self._retire(worker, reason="crash")
+            self._count_failover()
+            _REQUESTS.inc(worker=label, outcome="failover")
+            raise PoolUnavailable(f"worker {label} died: {e}") from None
+        if not isinstance(reply, dict):
+            # protocol violation: the framing survived but the payload is
+            # garbage — retire the worker and fail over
+            self._retire(worker, reason="crash")
+            self._count_failover()
+            _REQUESTS.inc(worker=label, outcome="failover")
+            raise PoolUnavailable(f"malformed worker reply: {type(reply)}")
+        self._checkin(worker)
+        _SECONDS.observe(time.perf_counter() - t0, worker=label)
+        if "hit" in reply:
+            _CACHE.inc(worker=label,
+                       result="hit" if reply["hit"] else "miss")
+            with self._wm_lock:  # int += is not atomic across threads
+                if reply["hit"]:
+                    self._cache_hits += 1
+                else:
+                    self._cache_misses += 1
+        if reply.get("ok"):
+            _REQUESTS.inc(worker=label, outcome="ok")
+            return reply.get("result")
+        if reply.get("api"):
+            from ..api.router import ApiError
+
+            _REQUESTS.inc(worker=label, outcome="api_error")
+            raise ApiError(str(reply.get("error")),
+                           code=int(reply.get("code") or 400))
+        # non-Api handler failure: fail over to the in-process path — the
+        # documented ladder. A handler that (via a helper) reached beyond
+        # the worker surrogate surface serves fine in-process; a genuinely
+        # broken handler re-raises its ORIGINAL exception there, with
+        # better fidelity than a wrapped worker error. Queries are
+        # read-only, so the re-run is always safe.
+        self._count_failover()
+        _REQUESTS.inc(worker=label, outcome="error")
+        raise PoolUnavailable(
+            f"worker handler error: {reply.get('error')}")
+
+    def _checkout(self) -> _Worker:
+        # the QUEUE wait is deliberately much shorter than the per-request
+        # timeout: when every worker is busy (burst or wedge), spilling to
+        # the in-process path in ~a health interval keeps tail latency
+        # bounded — parking for the full 30 s request budget would invert
+        # the degradation ladder under exactly the overload it exists for
+        deadline = time.monotonic() + self.queue_wait_s
+        with self._cv:
+            while True:
+                if not (self._running and self._enabled):
+                    raise PoolUnavailable("pool stopping")
+                if self._idle:
+                    return self._idle.pop()
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise PoolUnavailable("pool saturated")
+                self._cv.wait(timeout=remaining)
+
+    def _checkin(self, worker: _Worker) -> None:
+        with self._cv:
+            if worker.dead or self._slots[worker.slot] is not worker:
+                return
+            self._idle.append(worker)
+            self._cv.notify()
+
+    # -- supervision ---------------------------------------------------------
+    def _spawn(self, slot: int) -> None:
+        """Spawn a worker into ``slot``. The fork (page-table copy of a
+        JAX-loaded interpreter — tens of ms) happens OUTSIDE the pool
+        lock so dispatch checkouts never stall behind a respawn; only
+        the slot install takes ``self._cv``."""
+        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        proc = self._ctx.Process(
+            target=_worker_main,
+            args=(child_conn, str(self.node.data_dir), slot),
+            name=f"sd-serve-w{slot}", daemon=True)
+        proc.start()
+        child_conn.close()
+        with self._cv:
+            if not self._running or self._slots[slot] is not None:
+                # stopped (or lost a race) while forking: discard cleanly
+                installed = False
+            else:
+                self._generation += 1
+                worker = _Worker(slot, proc, parent_conn, self._generation)
+                self._slots[slot] = worker
+                self._idle.append(worker)
+                self._cv.notify()
+                installed = True
+            live = float(sum(1 for w in self._slots
+                             if w is not None and w.proc.is_alive()))
+        if not installed:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+            try:
+                parent_conn.close()
+            except OSError:
+                pass
+            return
+        _LIVE.set(live)
+
+    def _retire(self, worker: _Worker, reason: str) -> None:
+        """Drop a dead/wedged worker and wake the supervisor to respawn
+        its slot. Never blocks on the process — the dispatcher calling
+        this has a client waiting on the failover."""
+        with self._cv:
+            if worker.dead:
+                return
+            worker.dead = True
+            if self._slots[worker.slot] is worker:
+                self._slots[worker.slot] = None
+            if worker in self._idle:
+                self._idle.remove(worker)
+        with self._wm_lock:  # int += is not atomic across threads
+            self._restarts += 1
+        _RESTARTS.inc(worker=str(worker.slot), reason=reason)
+        try:
+            worker.proc.kill()
+        except Exception:
+            pass
+        try:
+            worker.conn.close()
+        except OSError:
+            pass
+        with self._cv:
+            _LIVE.set(float(sum(1 for w in self._slots
+                                if w is not None and w.proc.is_alive())))
+        self._respawn_wake.set()
+
+    def _supervise(self) -> None:
+        """Every ``health_s``: respawn empty slots, reap silently-dead
+        idle workers, and ping the rest (the ping carries the watermark
+        map for cache eviction and returns worker stats)."""
+        while self._running:
+            self._respawn_wake.wait(timeout=self.health_s)
+            self._respawn_wake.clear()
+            if not self._running:
+                return
+            empty: list[int] = []
+            with self._cv:
+                for slot in range(self.workers):
+                    w = self._slots[slot]
+                    if w is not None and not w.proc.is_alive():
+                        # died while idle (SIGKILL drill, OOM): no
+                        # dispatcher saw it — reap here
+                        w.dead = True
+                        if w in self._idle:
+                            self._idle.remove(w)
+                        self._slots[slot] = None
+                        with self._wm_lock:
+                            self._restarts += 1
+                        _RESTARTS.inc(worker=str(slot), reason="crash")
+                        w = None
+                    if w is None:
+                        empty.append(slot)
+            for slot in empty:
+                if not self._running:
+                    break
+                try:
+                    self._spawn(slot)  # forks outside the pool lock
+                except Exception as e:
+                    # transient fork/pipe failure (EAGAIN under pid or
+                    # memory pressure): the supervisor must survive it —
+                    # the slot stays empty and the next tick retries
+                    logger.warning("worker %d respawn failed: %s", slot, e)
+                    break
+            self._ping_idle_workers()
+
+    def _ping_idle_workers(self) -> None:
+        with self._wm_lock:
+            watermarks = dict(self._watermarks)
+        for slot in range(self.workers):
+            with self._cv:
+                w = self._slots[slot]
+                if w is None or w not in self._idle:
+                    continue  # busy or empty: the dispatcher supervises it
+                self._idle.remove(w)
+            try:
+                w.conn.send({"ctl": "sync", "watermarks": watermarks})
+                if not w.conn.poll(min(5.0, self.request_timeout_s)):
+                    raise TimeoutError("ping timed out")
+                pong = w.conn.recv()
+                if isinstance(pong, dict) and pong.get("pong"):
+                    self._worker_stats[slot] = {
+                        "served": pong.get("served", 0),
+                        "cache_entries": pong.get("cache_entries", 0),
+                        "pid": w.proc.pid,
+                    }
+                self._checkin(w)
+            except Exception:
+                # same breadth as dispatch: ANY pipe failure (incl. a
+                # garbled pong frame) retires the checked-out worker —
+                # letting it escape would leak the slot AND kill the
+                # supervisor thread
+                self._retire(w, reason="health")
+
+    # -- introspection -------------------------------------------------------
+    def status(self) -> dict[str, Any]:
+        """What ``telemetry.requestStats`` folds in as ``serve_pool``."""
+        with self._cv:
+            live = [w for w in self._slots if w is not None]
+            alive = sum(1 for w in live if w.proc.is_alive())
+            idle = len(self._idle)
+        return {
+            "workers": self.workers,
+            "alive": alive,
+            "idle": idle,
+            "enabled": self._enabled,
+            "running": self._running,
+            "restarts": self._restarts,
+            "failovers": self._failovers,
+            # instance counters, NOT the process-global _CACHE family: a
+            # restarted shell's fresh pool must report its own traffic,
+            # not the previous pool's accumulated totals
+            "cache_hits": self._cache_hits,
+            "cache_misses": self._cache_misses,
+            "watermarks": len(self._watermarks),
+            "per_worker": dict(self._worker_stats),
+        }

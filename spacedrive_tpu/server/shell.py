@@ -117,10 +117,25 @@ class Server:
         self._sse_tails: set[tuple[threading.Event, threading.Thread, Any]] = set()
         self._sse_lock = threading.Lock()
         self._ready = threading.Event()
+        self._owns_pool = False
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
-        """Run the accept loop on a dedicated thread; returns once bound."""
+        """Run the accept loop on a dedicated thread; returns once bound.
+
+        Starting the shell is what makes this node a SERVING node, so it
+        also brings up the multi-process reader pool (ISSUE 11) unless
+        one is already attached or ``SD_SERVE_WORKERS=0`` keeps the
+        degraded in-process mode. Forking happens here, before the
+        accept loop exists — workers inherit the loaded interpreter, not
+        the server socket traffic."""
+        if getattr(self.node, "reader_pool", None) is None:
+            from .pool import ReaderPool
+
+            pool = ReaderPool.maybe_start(self.node)
+            if pool is not None:
+                self.node.reader_pool = pool
+                self._owns_pool = True
         self._thread = threading.Thread(target=self._run, name="sd-server",
                                         daemon=True)
         self._thread.start()
@@ -172,6 +187,11 @@ class Server:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self._pool.shutdown(wait=False)
+        if self._owns_pool and getattr(self.node, "reader_pool", None) \
+                is not None:
+            self.node.reader_pool.stop()
+            self.node.reader_pool = None
+            self._owns_pool = False
 
     # -- connection handling -------------------------------------------------
     async def _client(self, reader: asyncio.StreamReader,

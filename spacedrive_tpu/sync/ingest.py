@@ -159,6 +159,12 @@ class Ingester:
         """
         with self.library.db.transaction():
             yield
+        # serve-pool invalidation (ISSUE 11): the grouped windows are
+        # durable NOW — the per-receive() bump below skips itself while a
+        # session transaction is open, so this is the one post-commit
+        # signal for the whole flush
+        if hasattr(self.library, "emit"):
+            self.library.emit("db.commit", {"source": "sync.session"})
 
     # -- history helpers -----------------------------------------------------
     def _history(self, t: SharedOp) -> list[dict[str, Any]]:
@@ -513,6 +519,15 @@ class Ingester:
             apply_span.set(applied=applied)
             apply_span.__exit__(*sys.exc_info())
         self._ops_applied.inc(applied)
+        # serve-pool invalidation (ISSUE 11): bump the read watermark only
+        # once the window is DURABLE. Inside a session() the outer
+        # transaction is still open here (txn_depth > 0) — the commit
+        # lands at session exit, which emits instead; bumping early would
+        # let a pool worker cache pre-commit rows under the new watermark
+        # and serve them stale after the real commit.
+        if db._txn_depth == 0 and hasattr(self.library, "emit"):
+            self.library.emit("db.commit", {"source": "sync.ingest",
+                                            "ops": len(decoded)})
         # convergence lag + end-to-end delay, from the envelope and the
         # ops' own HLC stamps (per-op observe is a bisect+lock; the window
         # is the unit of everything else). Delay counts only ops durably

@@ -420,6 +420,28 @@ def _declare_core() -> None:
               "time reads spent waiting for the WAL reader connection "
               "lock (contended acquisitions only — reader/writer "
               "contention under serving load)")
+    # multi-process reader pool (ISSUE 11): per-worker serving telemetry,
+    # recorded in the NODE process at the dispatch seam (worker children
+    # run with telemetry disabled; their stats ride the reply pipe) —
+    # server/pool.py holds the matching module handles
+    counter("sd_serve_worker_requests_total",
+            "pool-dispatched query requests per worker slot and outcome "
+            "(failover = the request was re-run in-process)",
+            labels=("worker", "outcome"))
+    histogram("sd_serve_worker_request_seconds",
+              "round-trip latency of pool-dispatched queries per worker "
+              "slot", labels=("worker",), buckets=REQUEST_BUCKETS)
+    counter("sd_serve_worker_cache_total",
+            "worker hot-directory-page LRU lookups by result (hit entries "
+            "are watermark-checked — a stale page can never hit)",
+            labels=("worker", "result"))
+    counter("sd_serve_worker_restarts_total",
+            "worker respawns by reason (crash = process died, timeout = "
+            "unresponsive past SD_SERVE_REQUEST_TIMEOUT_S, health = "
+            "failed ping)", labels=("worker", "reason"))
+    gauge("sd_serve_workers", "live reader-pool worker processes")
+    counter("sd_serve_invalidations_total",
+            "per-library watermark bumps pushed to the worker page caches")
 
 
 _declare_core()

@@ -27,6 +27,12 @@ import pytest  # noqa: E402
 # Watchers are opt-in per test (Node(watch_locations=True)); keeping them off
 # by default stops every location-creating test from spawning inotify threads.
 os.environ.setdefault("SD_NO_WATCHER", "1")
+# The serve pool is likewise opt-in per test: every Server(...) would
+# otherwise fork SD_SERVE_WORKERS reader processes of this JAX-loaded
+# interpreter. tests/test_serving_pool.py and the crash harness's serve
+# mode construct ReaderPool explicitly (or re-set this env) — the rest of
+# the suite runs the shell in the degraded in-process mode it always had.
+os.environ.setdefault("SD_SERVE_WORKERS", "0")
 
 
 def pytest_configure(config):

@@ -481,6 +481,95 @@ def _child_restore(data_dir: Path, args: dict) -> dict:
     return result
 
 
+#: the serve-worker kill point (ISSUE 11 satellite): ``skipN`` pins the
+#: SIGKILL to the (N+1)th request a given pool worker serves — the seam
+#: lives in the worker request loop, so the fault plan armed in the node
+#: process is inherited across the fork and every respawned worker dies
+#: again after another N requests (a standing worker-death storm)
+SERVE_KILL = "serve_worker:kill:skip5"
+SERVE_REQUESTS = 30
+SERVE_WORKERS = 2
+
+
+def _child_serve(data_dir: Path, args: dict) -> dict:
+    """Serve-worker SIGKILL drill: a reader pool serves a fixed request
+    sequence while the armed ``serve_worker:kill`` seam SIGKILLs workers
+    mid-load AND a real identify scan runs in the node process. The
+    CHILD process must survive (only workers die): every response must
+    be byte-identical to the in-process result, the pool must end
+    recovered, and the scan must complete untouched."""
+    from spacedrive_tpu import faults
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.objects import file_identifier as fi
+    from spacedrive_tpu.server.pool import ReaderPool
+
+    lib_id = args.get("lib_id", SCAN_LIB_ID)
+    fi.BATCH_SIZE = int(args.get("batch_size", SCAN_BATCH))
+    os.environ["SD_SERVE_HEALTH_S"] = "0.3"
+    t0 = time.perf_counter()
+    node = Node(data_dir, probe_accelerator=False, watch_locations=False)
+    lib, loc_id = _seed_scan_library(node, lib_id, args["tree"])
+    if args.get("faults"):
+        faults.install(args["faults"], seed=0)
+    pool = ReaderPool(node, workers=int(args.get("workers",
+                                                 SERVE_WORKERS))).start()
+    node.reader_pool = pool
+    node.jobs.spawn(lib, [fi.FileIdentifierJob({"location_id": loc_id})])
+    mismatches = 0
+    request_errors = []
+    n_requests = int(args.get("requests", SERVE_REQUESTS))
+    for i in range(n_requests):
+        arg = {"take": 10, "skip": (i % 5) * 10}
+        try:
+            via_pool = node.router.resolve("search.paths", arg, lib_id)
+            try:
+                pool.set_enabled(False)
+                in_proc = node.router.resolve("search.paths", arg, lib_id)
+            finally:
+                # a raise here must not leave the pool bypassed for the
+                # rest of the drill — the kill seam would stop firing
+                pool.set_enabled(True)
+            # compare shape-stable columns: the scan is live, so cas_id
+            # columns legitimately change between the two reads
+            key = [(it["pub_id"], it["name"]) for it in via_pool["items"]]
+            ref = [(it["pub_id"], it["name"]) for it in in_proc["items"]]
+            if key != ref:
+                mismatches += 1
+        except Exception as e:
+            request_errors.append(repr(e))
+    assert node.jobs.wait_idle(150), "scan did not finish under worker kills"
+    # the LAST request may have killed its worker microseconds ago —
+    # "recovers within the health-check interval" is the contract, so
+    # give the supervisor a few intervals before reading final strength
+    deadline = time.perf_counter() + 3.0
+    status = pool.status()
+    while status["alive"] < status["workers"] \
+            and time.perf_counter() < deadline:
+        time.sleep(0.05)
+        status = pool.status()
+    identified = lib.db.query(
+        "SELECT COUNT(*) c FROM file_path WHERE cas_id IS NOT NULL")[0]["c"]
+    total = lib.db.query(
+        "SELECT COUNT(*) c FROM file_path WHERE is_dir = 0")[0]["c"]
+    result = {
+        "requests": n_requests,
+        "request_errors": request_errors,
+        "mismatches": mismatches,
+        "worker_restarts": status["restarts"],
+        "failovers": status["failovers"],
+        "pool_alive": status["alive"],
+        "pool_workers": status["workers"],
+        "scan_identified": identified,
+        "scan_total": total,
+        "snapshot": snapshot_library(lib.db),
+        "total_s": round(time.perf_counter() - t0, 3),
+    }
+    pool.stop()
+    node.reader_pool = None
+    node.shutdown()
+    return result
+
+
 def _child_inspect(data_dir: Path, args: dict) -> dict:
     """Boot + report only (no workload): how the matrix asserts that a
     crashed-and-not-yet-recovered dir still boots clean, and how the
@@ -506,6 +595,7 @@ CHILD_MODES = {
     "sync": _child_sync,
     "backup": _child_backup,
     "restore": _child_restore,
+    "serve": _child_serve,
     "inspect": _child_inspect,
 }
 

@@ -575,6 +575,66 @@ def test_query_discipline_waivable(tmp_path):
         "query-discipline") == []
 
 
+# -- pass 15: worker-purity ---------------------------------------------------
+
+def test_worker_purity_flags_node_state_in_pool_handlers(tmp_path):
+    """ISSUE 11 fixture: a pool=True handler runs in a forked reader
+    worker whose node surrogate has ONLY libraries/data_dir and whose
+    library has ONLY db/id — touching anything else would silently fail
+    over out of the pool."""
+    bad = run_on(tmp_path, "api/routers/bad.py", (
+        "def mount(router):\n"
+        "    @router.library_query('search.broken', pool=True)\n"
+        "    def broken(node, library, arg):\n"
+        "        node.jobs.is_active()\n"
+        "        library.sync.get_ops(None, 1)\n"
+        "        with library.db.transaction():\n"
+        "            pass\n"
+        "        return library.db.query('SELECT 1')\n"
+        "    @router.query('nodes.broken', pool=True)\n"
+        "    def broken2(node, arg):\n"
+        "        return node.events\n"), "worker-purity")
+    assert [f.lineno for f in bad] == [4, 5, 6, 11]
+    assert "node.libraries" in bad[0].message
+    assert "read-only" in bad[2].message
+
+
+def test_worker_purity_allows_pure_readers_and_unmarked_handlers(tmp_path):
+    # the allowed surrogate surface, helper pass-through, and handlers
+    # WITHOUT pool=True (query-discipline's business, not this pass's)
+    assert run_on(tmp_path, "api/routers/good.py", (
+        "def helper(library, object_id):\n"
+        "    return library.db.query('SELECT 1')\n"
+        "def mount(router):\n"
+        "    @router.library_query('search.ok', pool=True)\n"
+        "    def ok(node, library, arg):\n"
+        "        node.libraries.get(library.id)\n"
+        "        p = node.data_dir\n"
+        "        return helper(library, arg)\n"
+        "    @router.library_query('search.inproc')\n"
+        "    def inproc(node, library, arg):\n"
+        "        return node.jobs.is_active()\n"
+        "    @router.library_mutation('files.write')\n"
+        "    def write(node, library, arg):\n"
+        "        with library.db.transaction():\n"
+        "            library.db.update(None, {}, {})\n"), "worker-purity") == []
+    # out of scope: api/ only
+    assert run_on(tmp_path, "sync/handlers.py", (
+        "def mount(router):\n"
+        "    @router.query('x', pool=True)\n"
+        "    def q(node, arg):\n"
+        "        return node.jobs\n"), "worker-purity") == []
+
+
+def test_worker_purity_waivable(tmp_path):
+    assert run_on(tmp_path, "api/routers/waived.py", (
+        "def mount(router):\n"
+        "    @router.query('x', pool=True)\n"
+        "    def q(node, arg):\n"
+        "        return node.config  # lint: ok(worker-purity)\n"),
+        "worker-purity") == []
+
+
 # -- waivers ------------------------------------------------------------------
 
 def test_scoped_waiver_silences_only_named_pass(tmp_path):

@@ -133,6 +133,30 @@ def test_kill_during_restore_leaves_library_intact(tmp_path):
     assert not list((data_dir / "libraries").glob(f"*{atomic.TMP_MARK}*"))
 
 
+def test_serve_worker_kill_point(tmp_path):
+    """ISSUE 11 satellite: the ``serve_worker:kill`` seam SIGKILLs pool
+    workers mid-load while an identify scan runs in the node process.
+    The node process survives (rc 0), every request either failed over
+    or returned the correct rows (zero mismatches, zero request errors),
+    the pool ends recovered at full strength, and the scan completes —
+    its final snapshot byte-identical to a drill-free reference run."""
+    tree = ch.make_tree(tmp_path / "tree")
+    args = {"tree": str(tree)}
+    _rc, ref = ch.run_child("serve", tmp_path / "serve-ref", args)
+    assert ref["worker_restarts"] == 0  # no faults: the quiet baseline
+    rc, res = ch.run_child("serve", tmp_path / "serve-kill",
+                           {**args, "faults": ch.SERVE_KILL})
+    assert rc == 0, "worker kills must never take the node down"
+    assert res["worker_restarts"] >= 1, \
+        "the serve_worker kill seam never fired"
+    assert res["request_errors"] == [], res["request_errors"][:3]
+    assert res["mismatches"] == 0
+    assert res["pool_alive"] == res["pool_workers"]  # recovered
+    assert res["scan_total"] == ch.SCAN_FILES
+    assert res["scan_identified"] == ref["scan_identified"]
+    assert res["snapshot"] == ref["snapshot"]
+
+
 # ---------------------------------------------------------------------------
 # boot integrity + the repair ladder (in-process)
 # ---------------------------------------------------------------------------

@@ -196,3 +196,99 @@ def test_instance_delete_restricted_by_oplog(db):
                                    "data": {}, "instance_id": inst})
     with pytest.raises(sqlite3.IntegrityError):  # op log must survive unpairing
         db.delete(Instance, {"id": inst})
+
+
+# -- serving-tier read-path indexes (ISSUE 11 satellite) ----------------------
+
+def _plan(db, sql, params=()):
+    return " | ".join(r["detail"] for r in
+                      db.query(f"EXPLAIN QUERY PLAN {sql}", params))
+
+
+def test_paths_count_shape_uses_covering_index(db):
+    """The search.pathsCount badge COUNT (the 9.6 s p99 in
+    BENCH_serve.json) must run index-only over (location_id, hidden) —
+    never a rowid lookup per file_path row."""
+    plan = _plan(db, "SELECT COUNT(*) n FROM file_path fp WHERE 1=1 AND "
+                     "fp.location_id = ? AND "
+                     "(fp.hidden IS NULL OR fp.hidden = 0)", (1,))
+    assert "COVERING INDEX idx_file_path_location_id_hidden" in plan, plan
+
+
+def test_materialized_path_like_prefix_uses_index_range(db):
+    """The watcher/identifier/media sweeps run ``location_id = ? AND
+    materialized_path LIKE 'prefix%'``: the NOCASE-collated index turns
+    SQLite's (default case-insensitive) LIKE into a range scan instead
+    of a full location scan."""
+    plan = _plan(db, "SELECT id, pub_id FROM file_path WHERE "
+                     "location_id = ? AND materialized_path LIKE ?",
+                 (1, "/photos/%"))
+    assert "idx_file_path_location_id_materialized_path_collate_nocase" \
+        in plan, plan
+    assert "materialized_path>" in plan, plan  # range, not filter-per-row
+
+
+def test_directory_listing_shape_searches_not_scans(db):
+    """The explorer's directory listing filters on materialized_path
+    WITHOUT a location id; the plain prefix index must make it a SEARCH
+    (the 20k-row SCAN per request was the serve bench's listing tail)."""
+    plan = _plan(db, "SELECT fp.*, o.pub_id AS opub FROM file_path fp "
+                     "LEFT JOIN object o ON fp.object_id = o.id "
+                     "WHERE fp.materialized_path = ? AND "
+                     "(fp.hidden IS NULL OR fp.hidden = 0) "
+                     "ORDER BY fp.is_dir DESC, COALESCE(fp.name, '') ASC, "
+                     "fp.id ASC LIMIT 201", ("/photos/",))
+    # substring-match the index name only: SQLite >= 3.36 renders plans
+    # as "SEARCH fp USING INDEX ..." (no "TABLE", no "AS"), older as
+    # "SEARCH TABLE file_path AS fp USING INDEX ..."
+    assert "USING INDEX idx_file_path_materialized_path_is_dir_name" \
+        in plan, plan
+    import re as _re
+
+    assert not _re.search(r"SCAN (TABLE )?file_path", plan), plan
+
+
+def test_index_migration_applies_to_existing_database(tmp_path):
+    """The new indexes are a boot-time migration: a database created
+    before them (simulated by dropping) gains them on the next open."""
+    path = tmp_path / "old.db"
+    d = Database(path, ALL_MODELS)
+    d.execute("DROP INDEX idx_file_path_location_id_hidden")
+    d.execute(
+        "DROP INDEX idx_file_path_location_id_materialized_path_collate_nocase")
+    d.close()
+    d2 = Database(path, ALL_MODELS)
+    names = {r["name"] for r in d2.query(
+        "SELECT name FROM sqlite_master WHERE type='index'")}
+    d2.close()
+    assert "idx_file_path_location_id_hidden" in names
+    assert "idx_file_path_location_id_materialized_path_collate_nocase" in names
+
+
+def test_readonly_database_reads_and_refuses_writes(tmp_path):
+    """The serve-pool per-process reader bootstrap: reads see committed
+    rows, every write surface raises."""
+    path = tmp_path / "ro.db"
+    rw = Database(path, ALL_MODELS)
+    loc = rw.insert(Location, {"pub_id": "l", "name": "l", "path": "/x"})
+    rw.insert(FilePath, {"pub_id": "p", "location_id": loc,
+                         "materialized_path": "/", "name": "a",
+                         "extension": "txt", "inode": 1, "device": 1})
+    ro = Database(path, ALL_MODELS, readonly=True)
+    assert ro.count(FilePath) == 1
+    assert ro.find_one(FilePath, {"name": "a"})["extension"] == "txt"
+    with pytest.raises(sqlite3.ProgrammingError):
+        ro.insert(FilePath, {"pub_id": "q"})
+    with pytest.raises(sqlite3.ProgrammingError):
+        ro.transaction()
+    with pytest.raises(sqlite3.ProgrammingError):
+        ro.execute("DELETE FROM file_path")
+    # a write committed AFTER the reader opened is visible to the next
+    # SELECT (fresh WAL snapshot per statement — the invalidation
+    # protocol's correctness rests on this)
+    rw.insert(FilePath, {"pub_id": "p2", "location_id": loc,
+                         "materialized_path": "/", "name": "b",
+                         "extension": "txt", "inode": 2, "device": 1})
+    assert ro.count(FilePath) == 2
+    ro.close()
+    rw.close()

@@ -1,0 +1,328 @@
+"""Multi-process reader pool (ISSUE 11 tentpole): byte-identity vs
+in-process dispatch, watermark invalidation under live CRDT ingest and a
+live pipelined scan, worker-SIGKILL chaos with failover, the degraded
+in-process mode, and the requestStats fold-in."""
+
+import json
+import os
+import signal
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from spacedrive_tpu.api.router import ApiError
+from spacedrive_tpu.models import FilePath, Location
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.server.pool import ReaderPool, configured_workers
+
+
+def _canon(value) -> str:
+    return json.dumps(value, sort_keys=True, default=str)
+
+
+def _hlc(unix: float) -> int:
+    sec = int(unix)
+    frac = int((unix - sec) * (1 << 32))
+    return (sec << 32) | (frac & 0xFFFFFFFF)
+
+
+@pytest.fixture()
+def node(tmp_path, monkeypatch):
+    monkeypatch.setenv("SD_P2P_DISABLED", "1")
+    monkeypatch.setenv("SD_SERVE_HEALTH_S", "0.3")
+    n = Node(tmp_path / "data", probe_accelerator=False,
+             watch_locations=False)
+    yield n
+    n.shutdown()  # stops a still-attached pool defensively
+
+
+def _seed_library(node, n_files=80):
+    lib = node.libraries.create("pool")
+    loc_id = lib.db.insert(Location, {
+        "pub_id": "loc-pool", "name": "pool", "path": "/nonexistent",
+        "instance_id": lib.instance_id})
+    lib.db.insert_many(FilePath, [
+        {"pub_id": f"fp-{i:04d}", "location_id": loc_id,
+         "materialized_path": "/" if i % 3 else "/sub/",
+         "name": f"f{i:04d}", "extension": "dat", "is_dir": 0,
+         "size_in_bytes": i * 10} for i in range(n_files)])
+    return lib, loc_id
+
+
+def _start_pool(node, workers=2) -> ReaderPool:
+    pool = ReaderPool(node, workers=workers).start()
+    node.reader_pool = pool
+    return pool
+
+
+def test_pool_results_byte_identical_to_in_process(node):
+    """Acceptance: every pool-marked procedure returns byte-identical
+    results through a worker and through the in-process path, including
+    typed ApiError parity."""
+    lib, loc_id = _seed_library(node)
+    pool = _start_pool(node)
+    cases = [
+        ("search.paths", {"take": 50}),
+        ("search.paths", {"materialized_path": "/sub/",
+                          "dirs_first": True, "take": 200}),
+        ("search.paths", {"search": "f00", "take": 64}),
+        ("search.pathsCount", {"location_id": loc_id}),
+        ("search.pathsCount", None),
+        ("search.objects", {}),
+        ("search.objectsCount", None),
+        ("search.duplicates", {}),
+        ("tags.list", None),
+        ("categories.list", None),
+        ("nodes.listLocations", None),
+        ("locations.get", loc_id),
+        ("files.get", {"file_path_id": 1}),
+    ]
+    for key, arg in cases:
+        via_pool = node.router.resolve(key, arg, lib.id)
+        pool.set_enabled(False)
+        in_proc = node.router.resolve(key, arg, lib.id)
+        pool.set_enabled(True)
+        assert _canon(via_pool) == _canon(in_proc), key
+    # every case above actually crossed the process boundary
+    assert pool.status()["cache_misses"] >= len(cases)
+    # typed-error parity: the worker's ApiError surfaces as the same
+    # ApiError the in-process handler raises
+    with pytest.raises(ApiError) as pool_err:
+        node.router.resolve("locations.get", 999_999, lib.id)
+    pool.set_enabled(False)
+    with pytest.raises(ApiError) as in_err:
+        node.router.resolve("locations.get", 999_999, lib.id)
+    assert str(pool_err.value) == str(in_err.value)
+
+
+def test_ingest_invalidation_never_serves_pre_watermark_rows(node):
+    """Acceptance: a read served AFTER a CRDT ingest at watermark W never
+    returns pre-W rows, with concurrent reads keeping the worker page
+    cache hot the whole time."""
+    from spacedrive_tpu.sync.ingest import Ingester
+
+    lib, _loc = _seed_library(node, n_files=10)
+    pool = _start_pool(node)
+    ingester = Ingester(lib)
+    stop = threading.Event()
+    reader_errors: list[str] = []
+
+    def hammer():
+        # keeps pages cached between commits so a stale hit WOULD happen
+        # if the watermark protocol had a hole
+        while not stop.is_set():
+            try:
+                node.router.resolve("tags.list", None, lib.id)
+            except Exception as e:  # surfaced below
+                reader_errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    base = time.time() - 200.0  # inside the HLC drift bound
+    try:
+        for i in range(40):
+            ingester.receive([{
+                "instance": "pool-peer", "timestamp": _hlc(base + i * 0.01),
+                "id": f"pool-op-{i:04d}",
+                "typ": {"_t": "shared", "model": "tag",
+                        "record_id": f"pool-tag-{i:04d}", "kind": "c",
+                        "data": {"name": f"t{i:04d}"}}}])
+            # receive() committed and emitted db.commit — THIS read is
+            # "after watermark W" and must see the new tag
+            names = {t["name"] for t in
+                     node.router.resolve("tags.list", None, lib.id)}
+            assert f"t{i:04d}" in names, f"stale read after ingest {i}"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not reader_errors, reader_errors[:3]
+    status = pool.status()
+    assert status["cache_hits"] > 0  # the LRU engaged between commits
+    assert status["restarts"] == 0
+
+
+def test_scan_commit_invalidation_and_convergence(node, tmp_path,
+                                                  monkeypatch):
+    """A pipelined identify scan runs while pool reads hammer the
+    library; once the scan is idle the pool serves the exact post-scan
+    state (no cached pre-commit page survives the final watermark)."""
+    from spacedrive_tpu.locations import create_location
+    from spacedrive_tpu.objects import file_identifier as fi
+    from spacedrive_tpu.objects.file_identifier import FileIdentifierJob
+
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    monkeypatch.setattr(fi, "BATCH_SIZE", 32)
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    for i in range(180):
+        (tree / f"g{i:03d}.dat").write_bytes(bytes([i % 251]) * (100 + i))
+    lib = node.libraries.create("scanpool")
+    loc = create_location(lib, str(tree), hasher="cpu")
+    from spacedrive_tpu.locations.indexer_job import IndexerJob
+
+    node.jobs.spawn(lib, [IndexerJob({"location_id": loc["id"]})])
+    assert node.jobs.wait_idle(120)
+    pool = _start_pool(node)
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                node.router.resolve(
+                    "search.pathsCount", {"location_id": loc["id"]}, lib.id)
+                node.router.resolve(
+                    "search.paths", {"take": 40}, lib.id)
+            except Exception as e:
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    node.jobs.spawn(lib, [FileIdentifierJob({"location_id": loc["id"]})])
+    assert node.jobs.wait_idle(180)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors[:3]
+    # post-scan: pool vs in-process byte-identical (a stale cached page
+    # from mid-scan would differ in cas_id columns)
+    via_pool = node.router.resolve("search.paths", {"take": 500}, lib.id)
+    pool.set_enabled(False)
+    in_proc = node.router.resolve("search.paths", {"take": 500}, lib.id)
+    pool.set_enabled(True)
+    assert _canon(via_pool) == _canon(in_proc)
+    assert all(item["cas_id"] for item in via_pool["items"]
+               if not item["is_dir"])
+
+
+def test_worker_sigkill_failover_and_recovery(node):
+    """Acceptance: SIGKILL of a pool worker mid-load never drops the
+    node, never corrupts a response, and the pool recovers within the
+    health-check interval."""
+    lib, loc_id = _seed_library(node)
+    pool = _start_pool(node, workers=2)
+    expected = _canon(node.router.resolve("search.paths", {"take": 7},
+                                          lib.id))
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                got = node.router.resolve("search.paths", {"take": 7},
+                                          lib.id)
+                if _canon(got) != expected:
+                    errors.append("response drift")
+            except Exception as e:
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=traffic, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    victim = next(w for w in pool._slots if w is not None)
+    os.kill(victim.proc.pid, signal.SIGKILL)
+    deadline = time.monotonic() + 5 * pool.health_s + 2.0
+    while time.monotonic() < deadline:
+        st = pool.status()
+        if st["alive"] == 2 and st["restarts"] >= 1:
+            break
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    st = pool.status()
+    assert st["alive"] == 2, st          # respawned
+    assert st["restarts"] >= 1, st
+    assert not errors, errors[:3]        # every response correct
+    # the node itself kept serving everything else
+    assert node.router.resolve("search.pathsCount",
+                               {"location_id": loc_id}, lib.id) == 80
+
+
+def test_degraded_mode_and_env_gate(node, monkeypatch):
+    """SD_SERVE_WORKERS=0 keeps the node in-process (maybe_start returns
+    None) and a pool-marked query still resolves."""
+    lib, loc_id = _seed_library(node, n_files=5)
+    monkeypatch.setenv("SD_SERVE_WORKERS", "0")
+    assert configured_workers() == 0
+    assert ReaderPool.maybe_start(node) is None
+    assert node.reader_pool is None
+    assert node.router.resolve("search.pathsCount",
+                               {"location_id": loc_id}, lib.id) == 5
+    monkeypatch.setenv("SD_SERVE_WORKERS", "3")
+    assert configured_workers() == 3
+
+
+def test_request_stats_folds_pool_state(node):
+    lib, _loc = _seed_library(node, n_files=5)
+    stats = node.router.resolve("telemetry.requestStats", None)
+    assert stats["serve_pool"] is None  # degraded mode: explicit null
+    pool = _start_pool(node)
+    node.router.resolve("search.paths", {"take": 3}, lib.id)
+    stats = node.router.resolve("telemetry.requestStats", None)
+    sp = stats["serve_pool"]
+    assert sp is not None and sp["workers"] == 2 and sp["running"]
+    assert sp["cache_hits"] + sp["cache_misses"] >= 1
+
+
+def test_shell_owns_pool_lifecycle(node, monkeypatch):
+    """Server.start brings the pool up (SD_SERVE_WORKERS default) and
+    Server.stop tears it down; SD_SERVE_WORKERS=0 keeps it off."""
+    from spacedrive_tpu.server.shell import Server
+
+    monkeypatch.setenv("SD_SERVE_WORKERS", "1")
+    srv = Server(node, port=0)
+    srv.start()
+    try:
+        assert node.reader_pool is not None
+        assert node.reader_pool.status()["alive"] == 1
+    finally:
+        srv.stop()
+    assert node.reader_pool is None
+    monkeypatch.setenv("SD_SERVE_WORKERS", "0")
+    srv2 = Server(node, port=0)
+    srv2.start()
+    try:
+        assert node.reader_pool is None
+    finally:
+        srv2.stop()
+
+
+def test_restore_advances_reader_epoch(node):
+    """A backup restore swaps the DB file (os.replace): a watermark bump
+    alone cannot help a worker whose read-only connection still holds the
+    old inode — the library.reload event advances the reader EPOCH and
+    the worker reopens before its next read."""
+    from spacedrive_tpu import backups
+    from spacedrive_tpu.models import Tag
+
+    lib, _loc = _seed_library(node, n_files=3)
+    lib.db.insert(Tag, {"pub_id": "t-base", "name": "base"})
+    backup_id = backups.do_backup(node, lib.id)
+    pool = _start_pool(node)
+
+    def pool_tags():
+        return {t["name"] for t in
+                node.router.resolve("tags.list", None, lib.id)}
+
+    assert pool_tags() == {"base"}  # worker now has the pre-restore inode
+    lib.db.insert(Tag, {"pub_id": "t-post", "name": "post"})
+    lib.emit("db.commit", {"source": "test"})
+    assert pool_tags() == {"base", "post"}
+    backups.do_restore(node,
+                       backups.backups_dir(node) / f"{backup_id}.bkp")
+    # post-restore reads must serve the RESTORED content; a stale inode
+    # (or a stale cached page) would still show "post"
+    assert pool_tags() == {"base"}
+    assert pool.status()["restarts"] == 0  # reopen, not respawn
