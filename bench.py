@@ -39,6 +39,21 @@ MODE = os.environ.get("SD_BENCH_MODE", "combined")
 #: so the trajectory file exists for future PRs
 if "--fleet" in sys.argv[1:]:
     MODE = "fleet"
+#: ``--wan <profile>`` (ISSUE 13, implies ``--fleet``): run the fleet
+#: soak across a modeled WAN — ``lan`` / ``wan`` / ``flaky-wan`` topology
+#: matrices from faults/net.py PROFILES (the same matrices the
+#: tests/test_wan.py soak gates arm), with the accept-layer throttle +
+#: auto-ban armed and one scripted BUSY-ignoring flooder on flaky-wan.
+#: Headline: converged ops/s + heal-to-lag-zero seconds, to
+#: BENCH_fleet_wan.json and BENCH_history.jsonl.
+WAN_PROFILE = None
+if "--wan" in sys.argv[1:]:
+    MODE = "fleet"
+    _wan_i = sys.argv.index("--wan")
+    WAN_PROFILE = (sys.argv[_wan_i + 1]
+                   if len(sys.argv) > _wan_i + 1
+                   and not sys.argv[_wan_i + 1].startswith("-")
+                   else "flaky-wan")
 #: ``--crash``: the process-kill torture matrix (ISSUE 9) — SIGKILL real
 #: node subprocesses at seeded seam hits, restart, and measure recovery;
 #: emits the record to BENCH_crash.json
@@ -935,35 +950,79 @@ def bench_fleet() -> dict:
     ``fleet{peers, ops_per_sec_total, p99_apply_delay_s, shed_ops,
     peak_rss_mb, max_peer_lag_ops}`` and writes the record to
     BENCH_fleet.json — the trajectory file future fleet PRs measure
-    against."""
+    against.
+
+    With ``--wan <profile>`` (ISSUE 13) the same storm crosses a modeled
+    WAN: the faults/net.py topology matrix named by the profile (shared
+    with tests/test_wan.py), relation-heavy workloads, pipelined lane
+    submissions, the accept-layer throttle + auto-ban, and — on
+    flaky-wan — one scripted BUSY-ignoring flooder. Adds the
+    heal-to-lag-zero headline (seconds from the last scheduled partition
+    heal until every peer's lag gauge read 0) and the ban ledger; writes
+    BENCH_fleet_wan.json instead so the wire-perfect trajectory file
+    stays comparable run-over-run."""
     import shutil
 
     from spacedrive_tpu import telemetry
+    from spacedrive_tpu.faults import net
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from tests.fleet_harness import Fleet
+    from tests.fleet_harness import WAN_RETRY, Fleet
 
-    peers = int(os.environ.get("SD_BENCH_FLEET_PEERS", "8"))
-    ops_per_peer = int(os.environ.get("SD_BENCH_FLEET_OPS", "5000"))
+    wan = WAN_PROFILE
+    peers = int(os.environ.get("SD_BENCH_FLEET_PEERS",
+                               "64" if wan else "8"))
+    ops_per_peer = int(os.environ.get("SD_BENCH_FLEET_OPS",
+                                      "96" if wan else "5000"))
     lanes = int(os.environ.get("SD_BENCH_FLEET_LANES", "4"))
     telemetry.set_enabled(True)
     tmp = Path(tempfile.mkdtemp(prefix="sd_bench_fleet_"))
+    model = None
     try:
-        fleet = Fleet(tmp, peers=peers, lanes=lanes)
+        if wan:
+            from spacedrive_tpu.p2p.throttle import AutoBan, SessionThrottle
+
+            model = net.install(net.profile_plan(wan),
+                                seed=int(os.environ.get("SD_NET_SEED", "13")))
+            fleet = Fleet(tmp, peers=peers, lanes=lanes,
+                          flooder=(wan == "flaky-wan"), pipeline=2,
+                          throttle=SessionThrottle(rate=20.0, burst=12.0),
+                          ban=AutoBan(strikes=6, window_s=5.0, ban_s=2.0,
+                                      max_ban_s=8.0),
+                          retry=WAN_RETRY)
+        else:
+            fleet = Fleet(tmp, peers=peers, lanes=lanes)
         try:
             res = fleet.run_storm(ops_per_peer=ops_per_peer, batch=500,
-                                  emit_chunks=2, hash_traffic=True,
-                                  query_traffic=True)
-            fleet.drain()
+                                  emit_chunks=4 if wan else 2,
+                                  hash_traffic=True, query_traffic=True,
+                                  rich=bool(wan),
+                                  # paced WAN bursts span the partition
+                                  # schedule on any machine speed
+                                  burst_gap_s=2.6 if wan else 0.0)
+            storm_end = time.monotonic()
+            drain_s = fleet.drain()
+            heal_to_lag_zero_s = None
+            if model is not None and model.last_heal_s() > 0:
+                # lag hit 0 when the drain finished; the last heal was
+                # last_heal_s after the storm-relative epoch (profiles
+                # without partition windows have no heal to anchor on)
+                heal_wall = (storm_end - res["elapsed_s"]
+                             + model.last_heal_s())
+                heal_to_lag_zero_s = round(
+                    max(0.0, storm_end + drain_s - heal_wall), 3)
             converged_target = len(
                 fleet.target_lib.db.query(
                     "SELECT id FROM shared_operation")) \
+                + len(fleet.target_lib.db.query(
+                    "SELECT id FROM relation_operation")) \
                 == peers * ops_per_peer
         finally:
             fleet.shutdown()
         record = {
             "metric": (f"fleet_ops_per_sec[{peers}peers,"
-                       f"{ops_per_peer}ops,{lanes}lanes]"),
+                       f"{ops_per_peer}ops,{lanes}lanes"
+                       + (f",wan={wan}" if wan else "") + "]"),
             "value": res["ops_per_sec_total"],
             "unit": "ops/sec",
             "fleet": {
@@ -986,14 +1045,42 @@ def bench_fleet() -> dict:
             "errors": res["errors"],
             "converged": converged_target,
         }
-        out = Path(__file__).resolve().parent / "BENCH_fleet.json"
+        if wan:
+            record["wan"] = {
+                "profile": wan,
+                "plan": net.profile_plan(wan),
+                "heal_to_lag_zero_s": heal_to_lag_zero_s,
+                "net": res["net"],
+                "ban": res["ban"],
+                "ban_ledger": res["ban_ledger"],
+                "flooder": res["flooder"],
+                "max_banned_peers": res["max_banned_peers"],
+                "pipeline": 2,
+            }
+        out = Path(__file__).resolve().parent / (
+            "BENCH_fleet_wan.json" if wan else "BENCH_fleet.json")
         out.write_text(json.dumps(record, indent=1) + "\n")
         print(f"info: fleet {peers} peers x {ops_per_peer} ops, {lanes} "
-              f"lanes: {res['ops_per_sec_total']:,.0f} ops/s total, "
+              f"lanes{f', wan={wan}' if wan else ''}: "
+              f"{res['ops_per_sec_total']:,.0f} ops/s total, "
               f"{res['shed_ops']} ops shed, peak RSS "
               f"{res['peak_rss_mb']:.0f}MB -> {out.name}", file=sys.stderr)
+        if wan and heal_to_lag_zero_s is not None:
+            # the second WAN headline rides the history too (standing
+            # invariant: every bench mode appends its headlines)
+            _append_history({
+                "metric": f"fleet_heal_to_lag_zero_s[{peers}peers,"
+                          f"wan={wan}]",
+                "value": heal_to_lag_zero_s,
+                "unit": "s",
+            })
+            print(f"info: heal-to-lag-zero {heal_to_lag_zero_s:.2f}s, "
+                  f"bans {len(res['ban_ledger'])} ledger entries",
+                  file=sys.stderr)
         return record
     finally:
+        if model is not None:
+            net.clear()
         shutil.rmtree(tmp, ignore_errors=True)
 
 

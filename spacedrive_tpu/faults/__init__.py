@@ -107,3 +107,7 @@ try:
 except FaultSpecError:
     logger.exception("SD_FAULTS spec rejected; fault injection DISARMED")
     _PLAN = None
+
+# the link-level network fault model is a sibling dimension (SD_NET_PLAN);
+# importing it here arms it from the environment alongside SD_FAULTS
+from . import net  # noqa: E402  (import-time arming is the point)

@@ -107,10 +107,12 @@ class P2PManager:
         self.nlm = NetworkedLibraries(self)
         # accept-layer per-peer token bucket (throttle.py): a peer that
         # ignores BUSY gets its substreams RESET before any session
-        # machinery runs
-        from .throttle import SessionThrottle
+        # machinery runs; AutoBan escalates sustained throttling or
+        # BUSY-ignoring re-dials into a timed ban at the same layer
+        from .throttle import AutoBan, SessionThrottle
 
         self.session_throttle = SessionThrottle()
+        self.auto_ban = AutoBan()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._stop: asyncio.Event | None = None
@@ -529,11 +531,21 @@ class P2PManager:
     async def _dispatch_substream(self, sub, peer: Peer) -> None:
         """One inbound substream = one header-tagged exchange
         (protocol.rs:13-27 dispatch, previously one-per-connection)."""
-        # accept-layer throttle: one token per inbound exchange. A peer
-        # that ignores BUSY/backoff and floods sessions is refused HERE —
-        # before the header parse, the responder coroutine, or the
-        # admission budget spend — with a RESET so its dial fails fast.
+        # accept-layer ban, then throttle: one token per inbound exchange.
+        # A peer serving a ban is refused before even the token-bucket
+        # spend; a peer that ignores BUSY/backoff and floods sessions is
+        # refused at the bucket — and each refusal is a strike toward a
+        # timed ban — all BEFORE the header parse, the responder
+        # coroutine, or the admission budget spend, with a RESET so its
+        # dial fails fast.
+        ban_left = self.auto_ban.check(peer.identity)
+        if ban_left is not None:
+            logger.warning("p2p substream from %s refused: banned for "
+                           "another %.1fs", peer.identity[:8], ban_left)
+            sub.reset()
+            return
         if not self.session_throttle.admit(peer.identity):
+            self.auto_ban.strike(peer.identity, "throttled")
             logger.warning("p2p substream from %s throttled at accept "
                            "(token bucket empty)", peer.identity[:8])
             sub.reset()
@@ -550,6 +562,15 @@ class P2PManager:
             elif header.kind == H_PAIR:
                 await self.pairing.responder(sub, sub, peer)
             elif header.kind == H_SYNC:
+                # BUSY compliance is judged HERE, on the protocol that was
+                # shed — a sync re-dial before the deadline our BUSY frame
+                # carried is a strike (pings/hash/file exchanges never are)
+                if self.auto_ban.judge_busy_compliance(
+                        peer.identity) is not None:
+                    logger.warning("p2p sync substream from %s refused: "
+                                   "ignored BUSY into a ban",
+                                   peer.identity[:8])
+                    return  # `failed` stays True: the finally RESETs
                 await self.nlm.responder(sub, sub, header.payload, peer)
             elif header.kind == H_SPACEDROP:
                 await self._spacedrop_receive(sub, sub, header.payload, peer)

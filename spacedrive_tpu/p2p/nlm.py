@@ -24,7 +24,7 @@ import uuid
 from typing import TYPE_CHECKING, Any
 
 from .. import faults, telemetry
-from ..faults import PeerBusyError
+from ..faults import PeerBusyError, net
 from ..telemetry import mesh
 from ..utils.retry import RetryPolicy, is_transient
 from .identity import remote_identity_of
@@ -296,11 +296,28 @@ class NetworkedLibraries:
                     mesh.record_busy_backoff(delay)
                 await asyncio.sleep(delay)
 
+    async def _net_link(self, src: str, dst: str, nbytes: int = 0) -> None:
+        """The ``p2p_link`` inject point, loop-safe: the armed NetModel
+        DECIDES synchronously (lock + seeded RNG, microseconds) and the
+        modeled delay rides ``asyncio.sleep`` — a slow link neither parks
+        the shared p2p event loop nor occupies a default-executor thread
+        per message under fan-out; LinkCut/LinkDropped propagate to the
+        caller as the transient flaps they model."""
+        model = net.active()
+        if model is None:
+            return
+        delay = model.decide(src, dst, nbytes)
+        if delay > 0.0:
+            await asyncio.sleep(delay)
+
     async def _originate_to(self, library: "Library", peer_id: str) -> None:
         # chaos seam for the sync-session dial (raising kinds only; `flap`
         # simulates the mesh's connection churn) — the fleet-soak gate's
-        # p2p_send:flap rides this alongside the hash-batch seam
+        # p2p_send:flap rides this alongside the hash-batch seam, and the
+        # link-level net model (partitions, loss, latency) bites here too
         faults.inject("p2p_send", key=peer_id)
+        self_id = self.manager.remote_identity.encode()
+        await self._net_link(self_id, peer_id, 64)
         origin = str(self.node.config.get().get("id") or "")
         reader, writer, _meta = await self.manager.open_stream(peer_id)
         # one mesh trace per push session, created only once the dial
@@ -358,7 +375,12 @@ class NetworkedLibraries:
                             trace.trace_id, span.span_id, origin,
                             hlc=library.sync.clock.last,
                             pending=pending).to_wire()
-                    writer.write(operations_frame(ops, has_more, ctx=ctx))
+                    frame = operations_frame(ops, has_more, ctx=ctx)
+                    # every serving window crosses the modeled link (a
+                    # partition or drop here mid-session surfaces as the
+                    # transient the retry wrapper resumes from)
+                    await self._net_link(self_id, peer_id, len(frame))
+                    writer.write(frame)
                     await writer.drain()
                 windows += 1
                 served += len(ops)
@@ -414,6 +436,12 @@ class NetworkedLibraries:
             await writer.drain()
             batch, nbytes = await read_json_sized(reader)
             ops = batch.get("ops") or []
+            # inbound half of the p2p_link seam: the peer's frame crosses
+            # the modeled link toward us (loss/partition ends the session;
+            # the peer's originate retry resumes from our durable clocks)
+            await self._net_link(peer.identity,
+                                 self.manager.remote_identity.encode(),
+                                 nbytes)
             # the sender's trace-context envelope: stitches our apply spans
             # under its serving spans and carries the lag signal
             ctx = mesh.TraceContext.from_wire(batch.get("ctx"))
@@ -431,6 +459,10 @@ class NetworkedLibraries:
                     verdict = budget.try_admit(label, len(ops), nbytes)
                     if isinstance(verdict, Busy):
                         mesh.record_busy_sent(label)
+                        # arm BUSY-compliance: a re-dial before this
+                        # deadline is a strike toward an accept-layer ban
+                        self.manager.auto_ban.note_busy(
+                            peer.identity, verdict.retry_after_ms)
                         writer.write(main_request_busy(
                             verdict.retry_after_ms, clocks))
                         await writer.drain()

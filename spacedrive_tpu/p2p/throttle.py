@@ -17,6 +17,19 @@ series an operator (or a future auto-ban rung) watches.
 
 Buckets are per-peer and bounded in number (LRU past ``MAX_PEERS``), so
 an identity-churning flooder cannot balloon the map.
+
+:class:`AutoBan` (ISSUE 13) is the escalation rung above the bucket: a
+peer that keeps hitting the throttle, or that ignores an explicit BUSY
+answer and re-dials before its ``retry_after_ms`` elapsed, accumulates
+**strikes**; enough strikes inside the strike window escalate to a timed
+**ban** enforced at the same accept layer — banned substreams are RESET
+before the header parse, the responder coroutine, or any admission spend.
+Bans walk a ladder (each repeat offense doubles the duration up to a cap)
+and expire on their own; every ban/unban lands in the flight-recorder
+event ring and the :meth:`AutoBan.ledger`, and ``sd_p2p_banned_peers`` /
+``sd_p2p_bans_total{reason}`` expose the live state. Well-behaved peers
+can never reach a ban: honoring BUSY and the session rate keeps the
+strike count at zero.
 """
 
 from __future__ import annotations
@@ -31,10 +44,26 @@ from ..telemetry import mesh
 DEFAULT_RATE = float(os.environ.get("SD_P2P_SESSION_RATE", "10"))
 DEFAULT_BURST = float(os.environ.get("SD_P2P_SESSION_BURST", "30"))
 
+#: strikes inside the window that escalate to a ban
+DEFAULT_BAN_STRIKES = int(os.environ.get("SD_P2P_BAN_STRIKES", "8"))
+#: sliding strike window (seconds)
+DEFAULT_BAN_WINDOW_S = float(os.environ.get("SD_P2P_BAN_WINDOW_S", "10"))
+#: first ban duration; doubles per repeat offense (the ladder)
+DEFAULT_BAN_S = float(os.environ.get("SD_P2P_BAN_S", "30"))
+#: ladder cap
+DEFAULT_BAN_MAX_S = float(os.environ.get("SD_P2P_BAN_MAX_S", "600"))
+
 _THROTTLED = telemetry.counter(
     "sd_p2p_throttled_sessions_total",
     "inbound sessions refused by the per-peer accept-layer token bucket",
     labels=("peer",))
+_BANNED_PEERS = telemetry.gauge(
+    "sd_p2p_banned_peers",
+    "peers currently serving an accept-layer ban")
+_BANS_TOTAL = telemetry.counter(
+    "sd_p2p_bans_total",
+    "accept-layer bans imposed, by triggering reason",
+    labels=("reason",))
 
 
 class SessionThrottle:
@@ -83,3 +112,216 @@ class SessionThrottle:
             return {"rate_per_s": self.rate, "burst": self.burst,
                     "tracked_peers": len(self._buckets),
                     "throttled_sessions": self._throttled}
+
+
+class PeerBannedError(ConnectionError):
+    """This node is serving an accept-layer ban to the peer (or: a peer is
+    serving one to us). Transient — an honest peer that somehow earned a
+    ban backs off ``retry_after_ms`` and resumes from its watermark like a
+    BUSY; a flooder that ignores it keeps getting reset for free."""
+
+    sd_transient = True
+
+    def __init__(self, msg: str, retry_after_ms: int = 1000) -> None:
+        super().__init__(msg)
+        self.retry_after_ms = retry_after_ms
+
+
+class AutoBan:
+    """Strike accounting + the timed ban ladder at the accept layer.
+
+    Call order per inbound exchange (manager._dispatch_substream and the
+    fleet harness's wire-less responder half):
+
+    1. ``check(peer)`` — returns remaining ban seconds (reject cheaply)
+       or ``None``; expires due bans (emitting the unban event).
+    2. on a token-bucket refusal — ``strike(peer, "throttled")``.
+    3. after answering BUSY on a sync session —
+       ``note_busy(peer, retry_after_ms)``; the next **sync** substream
+       from that peer is judged by ``judge_busy_compliance(peer)`` — an
+       early return is a ``busy_ignored`` strike. Compliance is scoped to
+       the protocol that was shed: an honest peer's concurrent pings or
+       hash batches must never strike (the manager judges only in its
+       ``H_SYNC`` arm, after the header parse).
+
+    Thread-safe; ``clock`` injectable for deterministic ladder tests. All
+    per-peer maps are bounded: strike/deadline/offense state is LRU-capped
+    like the token buckets, and ban entries are swept on expiry (plus a
+    hard cap evicting the soonest-to-expire), so identity churn cannot
+    balloon any of them.
+    """
+
+    MAX_PEERS = 1024
+    #: compliance slack: arrivals this close to the BUSY deadline are not
+    #: strikes (timer granularity, not abuse)
+    BUSY_GRACE_S = 0.005
+
+    def __init__(self, strikes: int = DEFAULT_BAN_STRIKES,
+                 window_s: float = DEFAULT_BAN_WINDOW_S,
+                 ban_s: float = DEFAULT_BAN_S,
+                 max_ban_s: float = DEFAULT_BAN_MAX_S,
+                 clock=time.monotonic) -> None:
+        self.strikes = max(1, int(strikes))
+        self.window_s = max(0.1, float(window_s))
+        self.ban_s = max(0.1, float(ban_s))
+        self.max_ban_s = max(self.ban_s, float(max_ban_s))
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: peer id -> strike timestamps inside the sliding window
+        self._strikes: dict[str, list[float]] = {}
+        #: peer id -> ban expiry stamp
+        self._bans: dict[str, float] = {}
+        #: peer id -> prior ban count (the ladder rung)
+        self._offenses: dict[str, int] = {}
+        #: peer id -> earliest allowed return after our last BUSY answer
+        self._busy_until: dict[str, float] = {}
+        #: [{event, peer, reason?, t, duration_s?}] — the ban ledger the
+        #: WAN soak diffs against the flooder script
+        self._ledger: list[dict] = []
+
+    # -- the accept-path entry points ----------------------------------------
+    def _sweep_locked(self, now: float) -> list[str]:
+        """Drop every expired ban (caller holds the lock); returns the
+        unbanned labels so the caller can emit events outside the lock.
+        Keeps ``_bans`` bounded by churn and the gauge honest — a banned
+        identity that never re-dials must not count as banned forever."""
+        expired = [p for p, until in self._bans.items() if now >= until]
+        labels = []
+        for peer_id in expired:
+            del self._bans[peer_id]
+            label = mesh.peer_label(peer_id)
+            labels.append(label)
+            self._ledger.append({"event": "unban", "peer": label, "t": now})
+        if expired:
+            _BANNED_PEERS.set(len(self._bans))
+        return labels
+
+    def check(self, peer_id: str) -> float | None:
+        """Remaining ban seconds for ``peer_id``, or None when admissible.
+        Sweeps due bans (emitting unban events). Ban ENFORCEMENT only —
+        BUSY compliance is judged separately, per shed protocol, by
+        :meth:`judge_busy_compliance`."""
+        now = self._clock()
+        with self._lock:
+            unbanned = self._sweep_locked(now)
+            until = self._bans.get(peer_id)
+            remaining = until - now if until is not None else None
+        for label in unbanned:
+            telemetry.event("p2p.unban", peer=label)
+        return remaining
+
+    def judge_busy_compliance(self, peer_id: str) -> float | None:
+        """Judge an arrival on the protocol we previously answered BUSY:
+        earlier than the deadline → a ``busy_ignored`` strike, which may
+        escalate to a ban (the fresh ban's remaining seconds are
+        returned; None means proceed). Call only on the shed protocol's
+        substreams (the manager's ``H_SYNC`` arm / the harness sessions)
+        so unrelated honest traffic can never strike."""
+        now = self._clock()
+        with self._lock:
+            deadline = self._busy_until.pop(peer_id, None)
+        if deadline is None or now >= deadline - self.BUSY_GRACE_S:
+            return None
+        if self.strike(peer_id, "busy_ignored"):
+            with self._lock:
+                until = self._bans.get(peer_id)
+                if until is not None and now < until:
+                    return until - now
+        return None
+
+    def strike(self, peer_id: str, reason: str) -> bool:
+        """Record one strike; returns True when it escalated to a ban."""
+        now = self._clock()
+        label = mesh.peer_label(peer_id)
+        banned_for = None
+        with self._lock:
+            self._sweep_locked(now)
+            if peer_id in self._bans:
+                return False  # already serving one; don't extend per hit
+            # pop+reinsert = LRU touch (the token-bucket discipline): an
+            # actively-striking peer moves to the back of the eviction
+            # order, so identity churn evicts idle entries, never the
+            # live abuser's strike state
+            log = self._strikes.pop(peer_id, [])
+            log.append(now)
+            cutoff = now - self.window_s
+            while log and log[0] < cutoff:
+                log.pop(0)
+            self._strikes[peer_id] = log
+            if len(log) >= self.strikes:
+                rung = self._offenses.pop(peer_id, 0)
+                banned_for = min(self.max_ban_s, self.ban_s * (2 ** rung))
+                self._offenses[peer_id] = rung + 1
+                self._bans[peer_id] = now + banned_for
+                self._strikes.pop(peer_id, None)
+                self._busy_until.pop(peer_id, None)
+                self._ledger.append({"event": "ban", "peer": label,
+                                     "reason": reason, "t": now,
+                                     "duration_s": banned_for})
+                _BANNED_PEERS.set(len(self._bans))
+            self._prune_locked()
+        if banned_for is not None:
+            _BANS_TOTAL.inc(reason=reason)
+            telemetry.event("p2p.ban", peer=label, reason=reason,
+                            duration_s=banned_for)
+            return True
+        return False
+
+    def note_busy(self, peer_id: str, retry_after_ms: int) -> None:
+        """Remember the deadline we just handed the peer in a BUSY answer;
+        an arrival before it is a ``busy_ignored`` strike."""
+        if retry_after_ms <= 0:
+            return
+        with self._lock:
+            self._busy_until.pop(peer_id, None)  # LRU touch on re-arm
+            self._busy_until[peer_id] = (self._clock()
+                                         + retry_after_ms / 1000.0)
+            self._prune_locked()
+
+    # -- introspection -------------------------------------------------------
+    def is_banned(self, peer_id: str) -> bool:
+        with self._lock:
+            until = self._bans.get(peer_id)
+            return until is not None and self._clock() < until
+
+    def ledger(self) -> list[dict]:
+        """Chronological ban/unban entries (labels, not raw identities).
+        Lazy expiry means a still-banned-at-shutdown peer has no unban
+        entry — callers ``check()`` first if they need the edge."""
+        with self._lock:
+            return [dict(e) for e in self._ledger]
+
+    def status(self) -> dict:
+        now = self._clock()
+        with self._lock:
+            self._sweep_locked(now)
+            return {
+                "banned_peers": len(self._bans),
+                "strike_threshold": self.strikes,
+                "window_s": self.window_s,
+                "base_ban_s": self.ban_s,
+                "bans_imposed": sum(1 for e in self._ledger
+                                    if e["event"] == "ban"),
+            }
+
+    def _prune_locked(self) -> None:
+        # identity churn must not balloon the maps (same argument as the
+        # token buckets); active bans are additionally swept on expiry —
+        # past the hard cap the soonest-to-expire go first (the closest
+        # to leaving anyway), each with its unban edge recorded so every
+        # ban in the ledger stays paired and the gauge stays honest
+        for m in (self._strikes, self._busy_until, self._offenses):
+            while len(m) > self.MAX_PEERS:
+                m.pop(next(iter(m)))
+        evicted = False
+        while len(self._bans) > self.MAX_PEERS:
+            soonest = min(self._bans, key=self._bans.__getitem__)
+            del self._bans[soonest]
+            self._ledger.append({"event": "unban",
+                                 "peer": mesh.peer_label(soonest),
+                                 "t": self._clock()})
+            evicted = True
+        if evicted:
+            _BANNED_PEERS.set(len(self._bans))
+        if len(self._ledger) > 4096:
+            del self._ledger[:-2048]

@@ -85,6 +85,74 @@ def _update_field(kind: str) -> str | None:
     return kind[len(UPDATE_PREFIX):] if kind.startswith(UPDATE_PREFIX) else None
 
 
+class PoisonCaps:
+    """Library-wide sticky floor caps for unhealed poison ops (ISSUE 13).
+
+    The per-pass ``poison_cap`` in :meth:`Ingester._ingest_pass` only
+    protects the floor inside the window that SAW the poison. Any
+    transport that does not immediately re-serve the poisoned op — a
+    pipelined session whose cursor ran ahead, a session resuming after a
+    partition heal, a *different* peer forwarding later ops from the same
+    origin instance — could then advance the instance floor past the
+    unapplied op in a later window, losing it forever. This registry
+    makes the cap STICKY and library-scoped: every poisoned op holds its
+    origin instance's floor below itself across windows, ingesters, and
+    lanes until the op durably logs (heal), so the transport keeps
+    re-serving it no matter which path delivers the next window.
+
+    Bounded like the per-ingester poison memory: past ``MAX_OPS`` the
+    oldest half is evicted — an evicted entry means a still-unhealed op
+    loses its floor protection, the same degradation the id-set eviction
+    already accepts, and only reachable under an adversarial poison storm.
+    """
+
+    MAX_OPS = 4096
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: op id -> (origin instance pub_id, op timestamp)
+        self._ops: dict[str, tuple[str, int]] = {}
+
+    def add(self, op_id: str, instance: Any, ts: Any) -> None:
+        if not isinstance(instance, str) or not isinstance(ts, int):
+            return  # unattributable — no floor movement for it at all
+        with self._lock:
+            self._ops[op_id] = (instance, ts)
+            if len(self._ops) > self.MAX_OPS:
+                for k in list(self._ops)[: self.MAX_OPS // 2]:
+                    del self._ops[k]
+
+    def heal(self, op_id: str) -> bool:
+        if not self._ops:  # common case: nothing poisoned, no lock
+            return False
+        with self._lock:
+            return self._ops.pop(op_id, None) is not None
+
+    def floor_caps(self) -> dict[str, int]:
+        """Per-instance floor cap (strictly below the oldest unhealed
+        poison op of that instance); empty when nothing is poisoned —
+        the common case, one lock + len check."""
+        with self._lock:
+            if not self._ops:
+                return {}
+            caps: dict[str, int] = {}
+            for instance, ts in self._ops.values():
+                cap = ts - 1
+                if cap < caps.get(instance, cap + 1):
+                    caps[instance] = cap
+            return caps
+
+
+def shared_poison_caps(library: "Library") -> PoisonCaps:
+    """The library's one sticky-cap registry (every ingester of a library
+    shares it — poison in one lane/peer path caps the floor for all)."""
+    caps = library.__dict__.get("_sync_poison_caps")
+    if caps is None:
+        caps = library.__dict__.setdefault("_sync_poison_caps",
+                                           PoisonCaps())
+    return caps
+
+
 class Ingester:
     """Synchronous core (usable inline); Actor wraps it in a thread."""
 
@@ -116,6 +184,10 @@ class Ingester:
         #: ROUND) and the whole batch skips the optimistic pass (a known
         #: poison would abort it every time — pure wasted savepoint work)
         self._poison_seen: dict[str, int] = {}
+        #: library-wide sticky floor caps (shared across every ingester of
+        #: this library): an unhealed poison op caps its instance's floor
+        #: in EVERY window, not just the one that saw it fail
+        self._sticky_caps = shared_poison_caps(library)
         #: lane mode (set by sync/lanes.py): receive() skips floor
         #: persistence and window-level mesh recording, accumulating the
         #: observed clocks/caps for the dispatcher to merge across lanes
@@ -574,11 +646,21 @@ class Ingester:
         #   A beyond-drift timestamp sorts after all sane ops anyway, so it
         #   rides the window tail without blocking floor advancement.
         poison_cap: dict[str, int] = {}
+        # pass-start snapshot of the library-wide sticky caps: ops that
+        # poisoned in EARLIER windows (possibly other lanes/peers) keep
+        # holding their instance's floor down even when this window does
+        # not contain them — without this, a window of later ops from the
+        # same instance would advance the floor past the unapplied poison
+        # and it could never be re-served (divergence)
+        sticky = self._sticky_caps.floor_caps()
 
         def _advance(instance: str, ts: int) -> None:
             cap = poison_cap.get(instance)
             if cap is not None:
                 ts = min(ts, cap)
+            s_cap = sticky.get(instance)
+            if s_cap is not None:
+                ts = min(ts, s_cap)
             if ts > seen_clocks.get(instance, 0):
                 seen_clocks[instance] = ts
 
@@ -605,6 +687,12 @@ class Ingester:
                 continue  # our own op reflected back
             if self._already_logged(op):
                 # duplicate delivery — already durable, safe to advance
+                # (and if it was ever sticky-poisoned, some path logged it:
+                # the cap must lift or the floor would stall forever)
+                if op.id in self._poison_seen or sticky:
+                    self._poison_seen.pop(op.id, None)
+                    if self._sticky_caps.heal(op.id):
+                        sticky = self._sticky_caps.floor_caps()
                 _advance(op.instance, op.timestamp)
                 continue
             if not careful:
@@ -632,6 +720,9 @@ class Ingester:
             if replayed:
                 if replay_budget <= 0:
                     _poison(op.instance, op.timestamp)
+                    # re-register the sticky cap (eviction-proofing): the
+                    # deferred replay stays floor-protected
+                    self._sticky_caps.add(op.id, op.instance, op.timestamp)
                     self._shed_replays.inc()
                     continue
                 replay_budget -= 1
@@ -691,11 +782,16 @@ class Ingester:
                 sync._instance_ids.pop(op.instance, None)
                 _poison(op.instance, op.timestamp)
                 self._remember_poison(op.id)
+                # sticky: this op holds its instance's floor below itself
+                # across FUTURE windows too, until it durably logs
+                self._sticky_caps.add(op.id, op.instance, op.timestamp)
                 logger.exception("sync ingest skipped poison op %s", op.id)
                 continue
             db.execute("RELEASE ingest_op")
             if replayed:
                 self._poison_seen.pop(op.id, None)  # healed
+                if self._sticky_caps.heal(op.id):
+                    sticky = self._sticky_caps.floor_caps()
             self._cache_logged(op)
             self._fresh_ts.append(op.timestamp)
             # advance the clock floor only once the op is durably logged
@@ -704,6 +800,13 @@ class Ingester:
                 applied += 1
         if pending_log:
             sync.log_ops(pending_log)
+        # hand the dispatcher the LIVE sticky caps too: in lane mode the
+        # poisoned op may sit in a different lane's ingester than the one
+        # applying this instance's later ops — the cross-lane floor merge
+        # must see the cap regardless of which lane returned it
+        for instance, cap in self._sticky_caps.floor_caps().items():
+            if cap < poison_cap.get(instance, cap + 1):
+                poison_cap[instance] = cap
         return applied, seen_clocks, poison_cap
 
     def _remember_poison(self, op_id: str) -> None:

@@ -50,7 +50,7 @@ from .. import telemetry
 from ..models import Instance
 from ..telemetry import mesh
 from .crdt import is_ref
-from .ingest import _WINDOW_SECONDS, Ingester
+from .ingest import _WINDOW_SECONDS, Ingester, shared_poison_caps
 
 if TYPE_CHECKING:
     from ..library import Library
@@ -131,11 +131,62 @@ class _LaneTask:
     error: BaseException | None = None
 
 
+class Submission:
+    """Handle for one in-flight lane submission (ROADMAP fleet rung (b)).
+
+    ``submit()`` returns immediately after the lane shards are enqueued,
+    so lane K of window N overlaps window N+1's decode/enqueue — the
+    merger thread completes submissions strictly in submission order
+    (wave-2 apply, then the cross-lane floor merge), preserving the
+    ordering rule floors depend on. ``wait()`` blocks until this
+    submission's floors persisted (or raises its first error) — exactly
+    the old barrier semantics, now opt-out per submission."""
+
+    __slots__ = ("windows", "peer", "label", "tasks", "wave2", "t0",
+                 "applied", "advanced", "error", "_done", "_fanout_done")
+
+    def __init__(self, windows, peer: str | None, label: str) -> None:
+        self.windows = windows
+        self.peer = peer
+        self.label = label
+        self.tasks: list[tuple[int, _LaneTask]] = []
+        self.wave2: list[tuple[list[dict[str, Any]], Any]] = []
+        self.t0 = time.perf_counter()
+        self.applied = 0
+        self.advanced = False
+        self.error: BaseException | None = None
+        self._done = threading.Event()
+        self._fanout_done = threading.Event()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: float | None = None) -> tuple[int, bool]:
+        if not self._done.wait(timeout):
+            raise TimeoutError("lane submission still in flight")
+        if self.error is not None:
+            raise self.error
+        return self.applied, self.advanced
+
+    def _finish(self, error: BaseException | None = None) -> None:
+        # first finisher wins: the close()/submit ticket race can have
+        # both the merger and the submitter trying to settle one handle
+        if self._done.is_set():
+            return
+        self.error = error
+        self._done.set()
+
+
 class IngestLanes:
     """K apply lanes over one library. ``receive``/``receive_many`` block
     until the submission is durable and the merged clock floors are
-    persisted — the submitter (a p2p session, the Actor round) keeps its
-    at-most-one-window-in-flight admission semantics."""
+    persisted; ``submit`` returns a :class:`Submission` handle instead, so
+    a pipelining submitter (the fleet harness's WAN sessions) can overlap
+    window N+1's decode with window N's apply. Submissions COMPLETE in
+    submission order regardless (the merger thread), so the cross-lane
+    floor-merge ordering rule — floors persist only after every lane txn
+    of that submission committed, and never out of order — holds under
+    pipelining exactly as under the barrier."""
 
     def __init__(self, library: "Library", lanes: int | None = None,
                  depth: int | None = None) -> None:
@@ -146,7 +197,7 @@ class IngestLanes:
         #: (peer, lane index) -> Ingester — an ingester's batch caches and
         #: poison memory are single-threaded state, so each is owned by
         #: exactly one lane thread (plus one wave-2 ingester per peer,
-        #: used only on submitter threads under _wave2_lock)
+        #: used only on the merger thread under _wave2_lock)
         self._ingesters: dict[tuple[str | None, int], Ingester] = {}
         self._queues: list[queue.Queue[_LaneTask | None]] = []
         self._threads: list[threading.Thread] = []
@@ -154,6 +205,9 @@ class IngestLanes:
         self._closed = False
         self._windows = 0
         self._submissions = 0
+        self._merge_q: queue.Queue[Submission | None] = queue.Queue(
+            maxsize=max(2, self._depth))
+        self._merger: threading.Thread | None = None
         if self.lanes > 1:
             for i in range(self.lanes):
                 q: queue.Queue[_LaneTask | None] = queue.Queue(
@@ -164,6 +218,10 @@ class IngestLanes:
                 self._queues.append(q)
                 self._threads.append(t)
                 t.start()
+            self._merger = threading.Thread(
+                target=self._merge_loop, daemon=True,
+                name=f"sync-merge-{library.id[:8]}")
+            self._merger.start()
         _LANE_COUNT.set(self.lanes)
 
     # -- public entry points -------------------------------------------------
@@ -175,18 +233,38 @@ class IngestLanes:
     def receive_many(self, windows: list[tuple[list[dict[str, Any]], Any]],
                      peer: str | None = None) -> tuple[int, bool]:
         """Apply several buffered windows (the Actor's flush group) as one
-        submission. Window order is preserved within every lane."""
+        submission and BLOCK until its floors persisted — the pre-pipeline
+        barrier semantics, kept for the p2p responder and the Actor."""
         if not windows:
             return 0, False
         if self.lanes <= 1:
             return self._receive_serial(windows, peer)
-        t0 = time.perf_counter()
+        return self.submit(windows, peer=peer).wait()
+
+    def submit(self, windows: list[tuple[list[dict[str, Any]], Any]],
+               peer: str | None = None) -> Submission:
+        """Enqueue one submission's lane shards and return its handle
+        WITHOUT waiting for the apply: lane K starts on window N while the
+        submitter decodes/admits window N+1 (ROADMAP fleet rung (b)).
+        Backpressure is intact — bounded lane queues block this call, and
+        the bounded merge queue caps how many submissions can be in flight
+        at once. Window order is preserved within every lane (per-lane
+        FIFO) and across submissions (one merger, submission order)."""
+        if self.lanes <= 1:
+            # serial path has no lanes to overlap: complete synchronously
+            sub = Submission(windows, peer, mesh.peer_label(peer))
+            try:
+                sub.applied, sub.advanced = self._receive_serial(
+                    windows, peer)
+                sub._finish()
+            except BaseException as e:  # noqa: BLE001 — surfaced by wait()
+                sub._finish(e)
+            return sub
+        sub = Submission(windows, peer, mesh.peer_label(peer))
         self._submissions += 1
-        label = mesh.peer_label(peer)
         # shard every window; wave-2 ops keep original (window, op) order
         lane_parts: list[list[tuple[list[dict[str, Any]], Any]]] = [
             [] for _ in range(self.lanes)]
-        wave2: list[tuple[list[dict[str, Any]], Any]] = []
         for ops, ctx in windows:
             shards: list[list[dict[str, Any]]] = [
                 [] for _ in range(self.lanes)]
@@ -201,39 +279,123 @@ class IngestLanes:
                 if shard:
                     lane_parts[i].append((shard, ctx))
             if deferred:
-                wave2.append((deferred, ctx))
+                sub.wave2.append((deferred, ctx))
 
-        # wave 1: fan out, barrier on every lane (bounded queues: a
-        # saturated lane blocks the submitter — backpressure, not buffering)
-        tasks: list[tuple[int, _LaneTask]] = []
-        for i, parts in enumerate(lane_parts):
-            if not parts:
+        # enqueue the merge ticket FIRST: the merger completes submissions
+        # strictly in ticket order, so a later submit can never merge its
+        # floors ahead of this one (bounded: caps in-flight submissions)
+        while True:
+            if self._closed:
+                raise RuntimeError("ingest lane pool is closed")
+            try:
+                self._merge_q.put(sub, timeout=1.0)
+                break
+            except queue.Full:
                 continue
-            task = _LaneTask(self._ingester(peer, i), parts)
-            while True:
-                if self._closed:
-                    raise RuntimeError("ingest lane pool is closed")
-                try:
-                    self._queues[i].put(task, timeout=1.0)
-                    break
-                except queue.Full:
+        if self._closed:
+            # close() may have sentineled + drained between our closed
+            # check and the put — the ticket would sit unserviced forever;
+            # settle the handle ourselves (first-finisher-wins: a merger
+            # that DID race us to it already settled it, we no-op).
+            # Finish BEFORE releasing the fan-out event: a merger still
+            # draining tickets must see done() and skip, never complete a
+            # submission whose shards were never enqueued.
+            err = RuntimeError("ingest lane pool is closed")
+            sub._finish(err)
+            sub._fanout_done.set()
+            raise err
+        # fan the shards out (bounded queues: a saturated lane blocks the
+        # submitter — backpressure, not buffering). A failure mid-fanout
+        # FAILS the whole submission before releasing the merger: merging
+        # the enqueued subset's floors could advance past the ops of a
+        # shard that never made it to its lane.
+        try:
+            for i, parts in enumerate(lane_parts):
+                if not parts:
                     continue
-            _LANE_DEPTH.set(self._queues[i].qsize(), lane=str(i))
-            tasks.append((i, task))
-        for _i, task in tasks:
+                task = _LaneTask(self._ingester(peer, i), parts)
+                while True:
+                    if self._closed:
+                        raise RuntimeError("ingest lane pool is closed")
+                    try:
+                        self._queues[i].put(task, timeout=1.0)
+                        break
+                    except queue.Full:
+                        continue
+                _LANE_DEPTH.set(self._queues[i].qsize(), lane=str(i))
+                sub.tasks.append((i, task))
+        except BaseException as e:
+            # lanes that DID get their shards may log ops while the floor
+            # merge is skipped; protect this submission's ops from being
+            # floor-leapfrogged by other in-flight submissions
+            self._protect_unpersisted(sub)
+            sub._finish(e)            # before the fan-out event: the
+            sub._fanout_done.set()    # merger's done() check sees it
+            raise
+        # the merger may already be waiting on this ticket; mark the shard
+        # fan-out complete so it knows the task list is final
+        sub._fanout_done.set()
+        return sub
+
+    def _protect_unpersisted(self, sub: Submission) -> None:
+        """A FAILED submission persists no floors, but some of its ops may
+        sit durably logged in lanes that committed — and submissions still
+        in flight behind it (a pipelining session, another peer forwarding
+        the same origin instances) may carry HIGHER timestamps whose floor
+        merge would silently leapfrog the failed submission's never-logged
+        ops (lost forever: the retry pulls from durable floors). Register
+        every op of the failed submission in the library-wide sticky caps:
+        every later floor merge stays capped below them until each op is
+        durably logged on re-delivery (the heal paths in
+        Ingester._ingest_pass), exactly the poison-op discipline."""
+        caps = shared_poison_caps(self.library)
+        for ops, _ctx in sub.windows:
+            for wire in ops:
+                op_id = wire.get("id")
+                if isinstance(op_id, str):
+                    caps.add(op_id, wire.get("instance"),
+                             wire.get("timestamp"))
+
+    # -- the ordered merger ---------------------------------------------------
+    def _merge_loop(self) -> None:
+        while True:
+            sub = self._merge_q.get()
+            if sub is None:
+                return
+            try:
+                self._complete(sub)
+            except BaseException as e:  # noqa: BLE001 — handed to wait()
+                sub._finish(e)
+
+    def _complete(self, sub: Submission) -> None:
+        """Barrier on one submission's lane tasks, run its wave 2, merge +
+        persist floors, record mesh windows — in merger-thread order."""
+        # the submitter enqueues the merge ticket before the lane shards;
+        # wait for the fan-out to finish so sub.tasks is complete
+        while not sub._fanout_done.wait(timeout=0.2):
+            if self._closed:
+                self._protect_unpersisted(sub)
+                sub._finish(RuntimeError("ingest lane pool closed with a "
+                                         "submission in flight"))
+                return
+        if sub.done():
+            return  # the submitter failed the fan-out; persist nothing
+        for _i, task in sub.tasks:
             while not task.done.wait(timeout=1.0):
                 # close() fails drained tasks; a task that raced in after
-                # the drain would otherwise strand this submitter forever
+                # the drain would otherwise strand the merger forever
                 if self._closed and not task.done.wait(timeout=2.0):
-                    raise RuntimeError(
+                    self._protect_unpersisted(sub)
+                    sub._finish(RuntimeError(
                         "ingest lane pool closed with a submission "
-                        "in flight")
+                        "in flight"))
+                    return
 
-        applied = sum(t.applied for _i, t in tasks)
+        applied = sum(t.applied for _i, t in sub.tasks)
         merged_clocks: dict[str, int] = {}
         merged_caps: dict[str, int] = {}
         first_error: BaseException | None = None
-        for _i, task in tasks:
+        for _i, task in sub.tasks:
             if task.error is not None:
                 first_error = first_error or task.error
                 continue
@@ -244,13 +406,13 @@ class IngestLanes:
                 merged_caps[pub_id] = min(merged_caps.get(pub_id, cap), cap)
 
         # wave 2: ops that read other records apply AFTER the barrier, in
-        # original order, on the submitter thread (serialized per pool so
-        # two sessions' wave-2 shards cannot interleave one ingester)
-        if wave2 and first_error is None:
-            w2 = self._ingester(peer, -1)
+        # original order, on the merger thread (one merger per pool, so
+        # two submissions' wave-2 shards can never interleave an ingester)
+        if sub.wave2 and first_error is None:
+            w2 = self._ingester(sub.peer, -1)
             try:
                 with self._wave2_lock, w2.session():
-                    for ops, ctx in wave2:
+                    for ops, ctx in sub.wave2:
                         applied += w2.receive(ops, ctx, defer_clocks=True)
                 clocks, caps = self._take_deferred(w2)
                 for pub_id, ts in clocks.items():
@@ -273,9 +435,23 @@ class IngestLanes:
         # committed, and advancing the floor past them would lose them
         # forever (the committed lanes' ops are durably LOGGED, so the
         # idempotent re-pull skips them as duplicates — floors catch up
-        # on the retry).
+        # on the retry). Under pipelining that is not enough: LATER
+        # submissions already in flight may carry higher timestamps of
+        # the same instances, and THEIR floor merges would leapfrog this
+        # submission's never-logged ops — sticky-cap them first.
         if first_error is not None:
-            raise first_error
+            self._protect_unpersisted(sub)
+            sub._finish(first_error)
+            return
+        # clamp with the LIVE library-wide sticky caps too: a lane task of
+        # this submission may have computed its end-of-pass caps BEFORE an
+        # earlier submission's merger-time failure registered protection
+        # for the same instances (the tasks run concurrently; only the
+        # merger is ordered) — re-reading here, in merger order, closes
+        # that window
+        for pub_id, cap in shared_poison_caps(self.library) \
+                .floor_caps().items():
+            merged_caps[pub_id] = min(merged_caps.get(pub_id, cap), cap)
         for pub_id, cap in merged_caps.items():
             if merged_clocks.get(pub_id, 0) > cap:
                 merged_clocks[pub_id] = cap
@@ -287,19 +463,21 @@ class IngestLanes:
         # submission's wall time is split across its windows — count
         # matches the serial path's one-observe-per-window and the _sum
         # stays the real wall time, not windows× it.
-        elapsed = time.perf_counter() - t0
-        window_seconds = _WINDOW_SECONDS.labels(peer=label)
-        per_window_s = elapsed / len(windows)
-        for ops, ctx in windows:
+        elapsed = time.perf_counter() - sub.t0
+        window_seconds = _WINDOW_SECONDS.labels(peer=sub.label)
+        per_window_s = elapsed / len(sub.windows)
+        for ops, ctx in sub.windows:
             max_ts = max((w.get("timestamp") for w in ops
                           if isinstance(w.get("timestamp"), int)),
                          default=0)
-            mesh.record_ingest_window(label, ctx, max_ts)
+            mesh.record_ingest_window(sub.label, ctx, max_ts)
             window_seconds.observe(per_window_s)
             self._windows += 1
         logger.debug("lane ingest: %d windows, %d applied in %.3fs",
-                     len(windows), applied, time.perf_counter() - t0)
-        return applied, advanced
+                     len(sub.windows), applied, elapsed)
+        sub.applied = applied
+        sub.advanced = advanced
+        sub._finish()
 
     def _receive_serial(self, windows, peer: str | None) -> tuple[int, bool]:
         """K=1: the exact pre-lane path (session-grouped windows)."""
@@ -393,6 +571,19 @@ class IngestLanes:
                 if task is not None and not task.done.is_set():
                     task.error = RuntimeError("ingest lane pool closed")
                     task.done.set()
+        # stop the merger and fail any submission still ticketed so a
+        # pipelining submitter's wait() unblocks with an error
+        if self._merger is not None:
+            self._merge_q.put(None)
+            self._merger.join(timeout=5)
+            while True:
+                try:
+                    sub = self._merge_q.get_nowait()
+                except queue.Empty:
+                    break
+                if sub is not None and not sub.done():
+                    self._protect_unpersisted(sub)
+                    sub._finish(RuntimeError("ingest lane pool closed"))
 
     def status(self) -> dict[str, Any]:
         return {

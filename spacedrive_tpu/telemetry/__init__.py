@@ -374,6 +374,26 @@ def _declare_core() -> None:
     counter("sd_p2p_throttled_sessions_total",
             "inbound sessions refused by the per-peer accept-layer token "
             "bucket", labels=("peer",))
+    # WAN survival (ISSUE 13): the link-level network fault model
+    # (faults/net.py) + accept-layer auto-ban (p2p/throttle.py hold the
+    # matching module handles)
+    net_msgs = counter(
+        "sd_net_link_messages_total",
+        "messages that crossed the modeled network, by verdict "
+        "(ok | drop | cut)", labels=("verdict",))
+    for verdict in ("ok", "drop", "cut"):
+        net_msgs.labels(verdict=verdict)
+    counter("sd_net_link_bytes_total",
+            "payload bytes delivered across the modeled network")
+    counter("sd_net_link_delay_seconds_total",
+            "injected link delay (latency + jitter + serialization)")
+    gauge("sd_net_link_partitions_active",
+          "partition windows currently cutting at least one link")
+    gauge("sd_p2p_banned_peers",
+          "peers currently serving an accept-layer ban")
+    counter("sd_p2p_bans_total",
+            "accept-layer bans imposed, by triggering reason",
+            labels=("reason",))
     # serving-tier observability (ISSUE 10): per-procedure request
     # telemetry, HTTP-layer families, the span-tagged sampling profiler
     # and the process resource watcher (telemetry/requests.py,

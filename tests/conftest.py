@@ -36,6 +36,10 @@ os.environ.setdefault("SD_SERVE_WORKERS", "0")
 
 
 def pytest_configure(config):
+    # tier-1 runs with `-m 'not slow'`: the slow marker holds the long
+    # soaks (the 64-peer WAN chaos soak) that run explicitly / via bench
+    config.addinivalue_line(
+        "markers", "slow: long soaks excluded from the tier-1 sweep")
     # persistent XLA compilation cache keeps repeat suite runs fast
     try:
         import jax

@@ -34,12 +34,15 @@ import random
 import threading
 import time
 import uuid
+from collections import deque
 from pathlib import Path
 
 from spacedrive_tpu import faults, telemetry
-from spacedrive_tpu.faults import PeerBusyError
-from spacedrive_tpu.models import Tag
+from spacedrive_tpu.faults import PeerBusyError, net
+from spacedrive_tpu.models import Object, Tag, TagOnObject
 from spacedrive_tpu.node import Node
+from spacedrive_tpu.p2p.throttle import (AutoBan, PeerBannedError,
+                                         SessionThrottle)
 from spacedrive_tpu.sync.admission import Busy, IngestBudget
 from spacedrive_tpu.sync.ingest import Ingester
 from spacedrive_tpu.sync.lanes import IngestLanes, get_lane_pool
@@ -49,6 +52,27 @@ from spacedrive_tpu.utils.retry import RetryPolicy, is_transient
 #: fleet sessions retry fast (test-sized mirror of nlm.ORIGINATE_RETRY)
 SESSION_RETRY = RetryPolicy(attempts=50, base_s=0.02, max_s=0.25,
                             budget_s=120.0)
+#: WAN storms ride partitions measured in seconds: more attempts at the
+#: same fast cadence so a 2–3s cut never exhausts a session's retries
+WAN_RETRY = RetryPolicy(attempts=400, base_s=0.02, max_s=0.25,
+                        budget_s=300.0)
+
+#: the target node's identity on the modeled network (net-plan partition
+#: groups and link patterns match against these)
+TARGET_IDENTITY = "fleet-target"
+
+
+class PeerThrottledError(ConnectionError):
+    """The wire-less analog of the accept-layer RESET the real manager
+    answers a throttled substream with: transient, carries the bucket's
+    refill estimate so an honest (if chatty) peer backs off instead of
+    striking again."""
+
+    sd_transient = True
+
+    def __init__(self, msg: str, retry_after_ms: int = 100) -> None:
+        super().__init__(msg)
+        self.retry_after_ms = retry_after_ms
 
 
 def op_log(lib) -> list[tuple]:
@@ -122,26 +146,126 @@ class FleetPeer:
                 ops, lambda db, rows=rows: [db.insert(Tag, r) for r in rows])
         self.emitted += n
 
+    def emit_rich(self, n: int, chunk: int = 150) -> None:
+        """Relation-heavy emission (the WAN soak's workload): ops come in
+        triples — tag create + object create + a ``tag_on_object`` link
+        whose application READS both endpoints, so it defers to the lane
+        pool's wave 2. ``n`` counts OPS (remainder below a full triple
+        emits plain tags), keeping ``emitted`` comparable to :meth:`emit`."""
+        lib = self.library
+        done = 0
+        while done < n:
+            take = min(chunk, n - done)
+            ops: list = []
+            triples: list[tuple[str, str, int]] = []
+            tags: list[str] = []
+            i = 0
+            while i < take:
+                idx = self.emitted + done + i
+                if take - i >= 3:
+                    tp = f"p{self.index:02d}-rt{idx}"
+                    op = f"p{self.index:02d}-ro{idx}"
+                    ops.append(lib.sync.shared_create(
+                        Tag, tp, {"name": f"rt{self.index}-{idx}"}))
+                    ops.append(lib.sync.shared_create(
+                        Object, op, {"kind": idx % 7}))
+                    ops.append(lib.sync.relation_create(TagOnObject, tp, op))
+                    triples.append((tp, op, idx))
+                    i += 3
+                else:
+                    tp = f"p{self.index:02d}-t{idx}"
+                    ops.append(lib.sync.shared_create(
+                        Tag, tp, {"name": f"n{self.index}-{idx}"}))
+                    tags.append(tp)
+                    i += 1
+
+            def _mat(db, triples=triples, tags=tags) -> None:
+                for tp, op, idx in triples:
+                    db.insert(Tag, {"pub_id": tp,
+                                    "name": f"rt{self.index}-{idx}"})
+                    db.insert(Object, {"pub_id": op, "kind": idx % 7})
+                    tid = db.find_one(Tag, {"pub_id": tp})["id"]
+                    oid = db.find_one(Object, {"pub_id": op})["id"]
+                    db.insert(TagOnObject, {"tag_id": tid, "object_id": oid})
+                for tp in tags:
+                    db.insert(Tag, {"pub_id": tp, "name": tp})
+
+            lib.sync.write_ops(ops, _mat)
+            done += take
+        self.emitted += n
+
     # -- the push session (wire-less nlm mirror) -----------------------------
+    def _accept(self) -> None:
+        """The target's accept layer, in dial order: the modeled link
+        (p2p_link inject point — a partition or drop kills the dial), the
+        ban check, then the session token bucket. Mirrors
+        manager._dispatch_substream's RESET-before-any-machinery shape."""
+        fleet = self.fleet
+        faults.inject("p2p_send", key=self.identity)
+        net.link(self.identity, TARGET_IDENTITY, 64)  # the dial frame
+        if fleet.ban is not None:
+            remaining = fleet.ban.check(self.identity)
+            if remaining is None:
+                # every harness session IS a sync session: judge the BUSY
+                # deadline here, exactly the manager's H_SYNC arm
+                remaining = fleet.ban.judge_busy_compliance(self.identity)
+            if remaining is not None:
+                raise PeerBannedError(
+                    f"{self.identity} banned at accept",
+                    retry_after_ms=int(remaining * 1000) + 1)
+        if fleet.throttle is not None \
+                and not fleet.throttle.admit(self.identity):
+            if fleet.ban is not None:
+                fleet.ban.strike(self.identity, "throttled")
+            raise PeerThrottledError(
+                f"{self.identity} throttled at accept",
+                retry_after_ms=int(
+                    fleet.throttle.retry_after_s(self.identity) * 1000) + 1)
+
     def _session(self, batch: int) -> None:
         """One originate→responder round: serve get_ops windows from the
-        target's durable clocks until drained, through admission. A shed
-        window raises PeerBusyError (the BUSY frame); a flap raises out
-        of the dial seam."""
+        target's durable clocks until drained, through the accept layer
+        and admission. A shed window raises PeerBusyError (the BUSY
+        frame); a flap/drop/partition raises out of the dial or window
+        seams. With ``fleet.pipeline > 1`` (and lanes), up to that many
+        lane submissions stay in flight while the next window is decoded
+        and admitted — a session-local cursor keeps each op served once
+        (the durable floors lag the in-flight windows by design)."""
         fleet = self.fleet
-        # the dial: chaos seam keyed by this peer, exactly nlm's
-        faults.inject("p2p_send", key=self.identity)
+        self._accept()
         self.sessions += 1
         origin = str(self.node.config.get().get("id") or "")
         trace = mesh.new_trace(
             "sync.push", origin,
             f"sync-{self.library.id[:8]}-{uuid.uuid4().hex[:12]}",
             library_id=self.library.id, peer=self.label)
+        pipeline = fleet.pipeline if fleet.lanes > 1 else 1
+        #: (submission, admission token, op count) in submit order
+        inflight: deque = deque()
+        #: session cursor: durable floors ∨ in-flight windows (only-raise)
+        cursor: dict[str, int] = {}
+
+        def complete_oldest(swallow: bool = False) -> None:
+            sub, verdict, nops = inflight.popleft()
+            try:
+                sub.wait()
+                self.windows_served += 1
+                self.ops_served += nops
+            except BaseException:
+                if not swallow:
+                    raise
+            finally:
+                verdict.release()
+
         try:
             while True:
-                clocks = fleet.target_lib.sync.timestamps()
-                ops, has_more = self.library.sync.get_ops(clocks, batch)
+                for pub, ts in fleet.target_lib.sync.timestamps().items():
+                    if ts > cursor.get(pub, 0):
+                        cursor[pub] = ts
+                ops, has_more = self.library.sync.get_ops(cursor, batch)
                 if not ops:
+                    while inflight:
+                        complete_oldest()
                     if not has_more:
                         # nothing newer than the watermark: declare the
                         # drained backlog so the lag gauge settles to 0
@@ -152,7 +276,7 @@ class FleetPeer:
                                 pending=0), 0)
                     return
                 nbytes = len(json.dumps(ops, separators=(",", ":")))
-                pending = (max(0, self.library.sync.ops_pending(clocks)
+                pending = (max(0, self.library.sync.ops_pending(cursor)
                                - len(ops)) if has_more else 0)
                 with telemetry.span(trace, "sync.window") as span:
                     span.set(ops=len(ops), has_more=has_more,
@@ -160,34 +284,68 @@ class FleetPeer:
                     ctx = mesh.TraceContext(
                         trace.trace_id, span.span_id, origin,
                         hlc=self.library.sync.clock.last, pending=pending)
+                    # the window's two wire legs cross the modeled link:
+                    # the GetOperations request toward us, the ops frame
+                    # toward the target
+                    net.link(TARGET_IDENTITY, self.identity, 96)
+                    net.link(self.identity, TARGET_IDENTITY, nbytes)
                     # responder half: admission, then the lane pool (or
                     # this peer's serial ingester)
                     verdict = fleet.budget.try_admit(self.label, len(ops),
                                                      nbytes)
                     if isinstance(verdict, Busy):
                         mesh.record_busy_sent(self.label)
+                        if fleet.ban is not None:
+                            fleet.ban.note_busy(self.identity,
+                                                verdict.retry_after_ms)
                         self.busy_seen += 1
+                        while inflight:
+                            complete_oldest()
                         raise PeerBusyError(
                             f"{self.identity} shed",
                             retry_after_ms=verdict.retry_after_ms)
                     try:
-                        fleet.apply(self, ops, ctx)
-                    finally:
+                        sub = fleet.apply_async(self, ops, ctx)
+                    except BaseException:
+                        verdict.release()  # failed apply frees the budget
+                        raise
+                    if sub is None:  # applied synchronously
                         verdict.release()
-                self.windows_served += 1
-                self.ops_served += len(ops)
+                        self.windows_served += 1
+                        self.ops_served += len(ops)
+                    else:
+                        inflight.append((sub, verdict, len(ops)))
+                    # advance the session cursor past what we just served
+                    # (durability catches up at completion; an aborted
+                    # session rebuilds from the durable floors)
+                    for w in ops:
+                        inst, ts = w.get("instance"), w.get("timestamp")
+                        if isinstance(inst, str) and isinstance(ts, int) \
+                                and ts > cursor.get(inst, 0):
+                            cursor[inst] = ts
+                while len(inflight) >= max(1, pipeline):
+                    complete_oldest()
                 if not has_more:
+                    while inflight:
+                        complete_oldest()
                     return
         finally:
+            # an aborted session must not leak admission tokens or leave
+            # submissions unobserved (their errors surface on the session
+            # that spawned them, not here)
+            while inflight:
+                complete_oldest(swallow=True)
             telemetry.finish_trace(trace, export_dir=self.node.data_dir)
 
     def push_until_drained(self, batch: int = 500) -> None:
         """nlm._originate_with_retry, thread-shaped: retry transient
-        session failures (flap, BUSY) with jittered backoff, honoring a
-        BUSY frame's retry_after_ms, resuming from the target's durable
-        clocks (the acknowledged watermark) every time."""
+        session failures (flap, BUSY, link drop/partition, throttle/ban)
+        with jittered backoff, honoring an explicit retry_after_ms,
+        resuming from the target's durable clocks (the acknowledged
+        watermark) every time."""
+        policy = self.fleet.retry
         rng = random.Random(0xF1EE7 + self.index)
-        deadline = time.monotonic() + SESSION_RETRY.budget_s
+        deadline = time.monotonic() + policy.budget_s
         retries = 0
         while True:
             try:
@@ -198,19 +356,105 @@ class FleetPeer:
                     self.error = e
                     raise
                 retries += 1
-                if retries >= SESSION_RETRY.attempts \
+                if retries >= policy.attempts \
                         or time.monotonic() > deadline:
                     self.error = e
                     raise
-                delay = SESSION_RETRY.delay(retries - 1, rng)
+                delay = policy.delay(retries - 1, rng)
                 if isinstance(e, PeerBusyError):
                     delay = max(delay, e.retry_after_ms / 1000.0)
                     mesh.record_busy_received(self.label)
                     mesh.record_busy_backoff(delay)
+                elif isinstance(e, (PeerBannedError, PeerThrottledError)):
+                    # the accept layer told us when to come back; honest
+                    # peers comply (the flooder overrides this path)
+                    delay = max(delay, e.retry_after_ms / 1000.0)
                 time.sleep(delay)
 
     def shutdown(self) -> None:
         self.node.shutdown()
+
+
+class FlooderPeer(FleetPeer):
+    """The scripted BUSY-ignoring abuser (ISSUE 13): same Node/Library as
+    an honest peer, but its driver IGNORES every backoff contract — a
+    BUSY's retry_after_ms, a throttle RESET, even the ban itself — and
+    re-dials in a tight loop. The accept layer must absorb it: strikes
+    escalate to a timed ban, banned dials are refused for ~free, and the
+    honest fleet converges undisturbed. The script's own event log
+    (``script_log``) is what the soak diffs against ``AutoBan.ledger``."""
+
+    def __init__(self, fleet: "Fleet", index: int, data_dir: Path) -> None:
+        super().__init__(fleet, index, data_dir)
+        self.identity = f"fleet-flooder-{index:02d}"
+        self.label = mesh.peer_label(self.identity)
+        self.script_log: list[tuple[str, float]] = []
+        self.flood_attempts = 0
+        self.rejections: dict[str, int] = {}
+
+    def _note(self, event: str) -> None:
+        self.script_log.append((event, time.monotonic()))
+
+    def flood_until_banned(self, batch: int = 200,
+                           deadline_s: float = 60.0) -> bool:
+        """Phase 1: hammer sessions with zero backoff until the accept
+        layer bans us. Every transient rejection is ignored and retried
+        immediately — the abuse the ban ladder exists for."""
+        self._note("flood_start")
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            self.flood_attempts += 1
+            try:
+                self._session(batch)
+            except PeerBannedError:
+                self.rejections["banned"] = \
+                    self.rejections.get("banned", 0) + 1
+                self._note("banned")
+                return True
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if not is_transient(e):
+                    self.error = e
+                    raise
+                kind = ("busy" if isinstance(e, PeerBusyError) else
+                        "throttled" if isinstance(e, PeerThrottledError)
+                        else "net")
+                self.rejections[kind] = self.rejections.get(kind, 0) + 1
+                continue  # NO sleep, NO retry_after: the abuse
+        return False
+
+    def wait_unbanned(self, deadline_s: float = 60.0) -> bool:
+        """Phase 2: keep dialing while banned (the refusals must stay
+        cheap), observing the scheduled unban edge."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if self.fleet.ban is None \
+                    or not self.fleet.ban.is_banned(self.identity):
+                self._note("unbanned")
+                return True
+            try:
+                self._session(1)
+            except BaseException as e:  # noqa: BLE001 — rejection expected
+                if not is_transient(e):
+                    self.error = e
+                    raise
+                self.rejections["banned"] = \
+                    self.rejections.get("banned", 0) + 1
+            time.sleep(0.02)
+        return False
+
+    def run_script(self, ops: int, batch: int = 200) -> None:
+        """The whole scripted arc: emit a backlog, flood until banned,
+        ride out the ban, then drain HONESTLY (backoff-compliant) so the
+        flooder still converges with the fleet by the end."""
+        self.emit(ops)
+        if not self.flood_until_banned(batch):
+            raise AssertionError(
+                f"flooder was never banned after {self.flood_attempts} "
+                f"attempts ({self.rejections})")
+        if not self.wait_unbanned():
+            raise AssertionError("flooder's ban never expired")
+        self._note("honest_drain")
+        self.push_until_drained(batch)
 
 
 class Fleet:
@@ -219,12 +463,25 @@ class Fleet:
 
     def __init__(self, root: Path, peers: int = 8, lanes: int = 1,
                  budget_ops: int | None = None,
-                 budget_bytes: int | None = None) -> None:
+                 budget_bytes: int | None = None,
+                 throttle: SessionThrottle | None = None,
+                 ban: AutoBan | None = None,
+                 flooder: bool = False,
+                 pipeline: int = 1,
+                 retry: RetryPolicy | None = None) -> None:
         self.root = Path(root)
         self.target = Node(self.root / "target", probe_accelerator=False,
                            watch_locations=False)
         self.target_lib = self.target.libraries.create("fleet-target")
         self.lanes = lanes
+        #: >1 = keep that many lane submissions in flight per session
+        #: (ROADMAP fleet rung (b); effective only with lanes > 1)
+        self.pipeline = max(1, pipeline)
+        self.retry = retry or SESSION_RETRY
+        #: accept layer (both optional so pre-WAN gates keep their exact
+        #: behavior): the per-peer session token bucket and the ban ladder
+        self.throttle = throttle
+        self.ban = ban
         # the fleet admits through the target node's own budget so the
         # rspc fleet-status surface and the gauges show THIS traffic
         if budget_ops is not None or budget_bytes is not None:
@@ -235,7 +492,8 @@ class Fleet:
         self.pool: IngestLanes = get_lane_pool(self.target_lib, lanes=lanes)
         self.peers: list[FleetPeer] = []
         for i in range(peers):
-            peer = FleetPeer(self, i, self.root / f"peer{i:02d}")
+            cls = FlooderPeer if (flooder and i == 0) else FleetPeer
+            peer = cls(self, i, self.root / f"peer{i:02d}")
             self.target_lib.add_remote_instance(peer.library.instance())
             peer.library.add_remote_instance(self.target_lib.instance())
             self.peers.append(peer)
@@ -245,9 +503,19 @@ class Fleet:
             "max_admission_ops": 0.0, "max_admission_bytes": 0.0,
             "max_lane_depth": 0.0, "max_peer_lag_ops": 0.0,
             "max_rss_mb": 0.0, "start_rss_mb": _rss_mb(),
+            "max_banned_peers": 0.0,
         }
         self.query_errors: list[str] = []
         self.hash_batches = 0
+
+    @property
+    def honest_peers(self) -> list[FleetPeer]:
+        return [p for p in self.peers if not isinstance(p, FlooderPeer)]
+
+    @property
+    def flooder(self) -> FlooderPeer | None:
+        return next((p for p in self.peers
+                     if isinstance(p, FlooderPeer)), None)
 
     # -- the apply half every session shares ---------------------------------
     def apply(self, peer: FleetPeer, ops, ctx) -> None:
@@ -258,6 +526,14 @@ class Fleet:
                 peer._ingester = Ingester(self.target_lib,
                                           peer=peer.identity)
             peer._ingester.receive(ops, ctx)
+
+    def apply_async(self, peer: FleetPeer, ops, ctx):
+        """Pipelined apply: a Submission handle when lanes are pipelining,
+        else None after the synchronous apply (pipeline depth 1)."""
+        if self.lanes > 1 and self.pipeline > 1:
+            return self.pool.submit([(ops, ctx)], peer=peer.identity)
+        self.apply(peer, ops, ctx)
+        return None
 
     # -- side traffic ---------------------------------------------------------
     def _hash_traffic(self, stop: threading.Event, msg_bytes: int = 4096,
@@ -318,17 +594,26 @@ class Fleet:
                     telemetry.value("sd_sync_peer_lag_ops",
                                     peer=peer.label))
             s["max_rss_mb"] = max(s["max_rss_mb"], _rss_mb())
+            s["max_banned_peers"] = max(
+                s["max_banned_peers"],
+                telemetry.value("sd_p2p_banned_peers"))
             stop.wait(0.05)
 
     # -- orchestration --------------------------------------------------------
     def run_storm(self, ops_per_peer: int, batch: int = 500,
                   emit_chunks: int = 4, hash_traffic: bool = False,
-                  query_traffic: bool = False,
-                  on_tick=None) -> dict:
+                  query_traffic: bool = False, rich: bool = False,
+                  burst_gap_s: float = 0.0, on_tick=None) -> dict:
         """The storm: every peer emits in ``emit_chunks`` bursts, pushing
-        a full session after each burst, all peers concurrent. Returns
-        the result dict (throughput, sheds, maxima)."""
+        a full session after each burst, all peers concurrent (a
+        FlooderPeer runs its abuse script instead). Returns the result
+        dict (throughput, sheds, maxima)."""
         stop = self._stop
+        # partition windows are storm-relative: re-base the armed net
+        # model's epoch on 'now', not on when the plan was installed
+        model = net.active()
+        if model is not None:
+            model.reset_epoch()
         self._threads = [threading.Thread(
             target=self._sampler, args=(stop,), daemon=True,
             name="fleet-sampler")]
@@ -344,16 +629,24 @@ class Fleet:
             t.start()
 
         def drive(peer: FleetPeer) -> None:
-            per_burst = max(1, ops_per_peer // emit_chunks)
-            done = 0
             try:
+                if isinstance(peer, FlooderPeer):
+                    peer.run_script(ops_per_peer, batch)
+                    return
+                per_burst = max(1, ops_per_peer // emit_chunks)
+                done = 0
                 while done < ops_per_peer:
                     n = min(per_burst, ops_per_peer - done)
-                    peer.emit(n)
+                    (peer.emit_rich if rich else peer.emit)(n)
                     done += n
                     peer.push_until_drained(batch)
                     if on_tick is not None:
                         on_tick()
+                    # paced bursts: a WAN storm must SPAN its partition
+                    # schedule (a fast box would otherwise finish before
+                    # the modeled windows ever open)
+                    if burst_gap_s > 0 and done < ops_per_peer:
+                        self._stop.wait(burst_gap_s)
             except BaseException as e:  # noqa: BLE001 — surfaced in result
                 peer.error = peer.error or e
 
@@ -373,7 +666,19 @@ class Fleet:
 
         total = sum(p.emitted for p in self.peers)
         status = self.budget.status()
+        model = net.active()
+        flooder = self.flooder
         return {
+            "net": model.status() if model is not None else None,
+            "ban": self.ban.status() if self.ban is not None else None,
+            "ban_ledger": (self.ban.ledger()
+                           if self.ban is not None else []),
+            "flooder": ({
+                "attempts": flooder.flood_attempts,
+                "rejections": flooder.rejections,
+                "script": [e for e, _t in flooder.script_log],
+            } if flooder is not None else None),
+            "max_banned_peers": self.samples["max_banned_peers"],
             "peers": len(self.peers),
             "lanes": self.lanes,
             "ops_total": total,
@@ -396,11 +701,15 @@ class Fleet:
             "max_lane_depth": self.samples["max_lane_depth"],
         }
 
-    def drain(self, batch: int = 1000) -> None:
+    def drain(self, batch: int = 1000) -> float:
         """Push every peer's remaining backlog (fault-free tail) so lag
-        gauges settle to 0."""
+        gauges settle to 0; returns the drain's wall time (the
+        convergence-gate scale factor — PR 11 showed absolute wall-clock
+        bounds are machine-phase fiction)."""
+        t0 = time.perf_counter()
         for peer in self.peers:
             peer.push_until_drained(batch)
+        return time.perf_counter() - t0
 
     def mirror_back(self, batch: int = 2000, timeout_s: float = 300.0
                     ) -> None:
