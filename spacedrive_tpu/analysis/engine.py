@@ -26,13 +26,18 @@ Architecture
   ``--update-baseline`` — so the debt only ratchets down.
 
 Run: ``python -m spacedrive_tpu.analysis`` (exit 0 = no new findings).
+``--json`` renders the same verdict machine-readably (editor/CI
+tooling); ``--changed`` scopes the scan to files the working tree
+touches vs HEAD (plus untracked) — the fast pre-commit form.
 See docs/static-analysis.md for the pass list and workflow.
 """
 
 from __future__ import annotations
 
 import ast
+import json
 import re
+import subprocess
 from collections import Counter
 from dataclasses import dataclass
 from pathlib import Path
@@ -58,6 +63,11 @@ class Finding:
 
     def render(self) -> str:
         return f"{self.path}:{self.lineno}: [{self.pass_id}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"path": self.path, "relpath": self.relpath,
+                "line": self.lineno, "pass": self.pass_id,
+                "message": self.message}
 
     @property
     def baseline_key(self) -> str:
@@ -185,6 +195,72 @@ class PassManager:
             findings.extend(self.check_file(path))
         return findings
 
+    def check_changed(self) -> tuple[list[Finding], list[str]]:
+        """Scan only the ``*.py`` files under the root that git reports
+        as modified vs HEAD or untracked — the fast pre-commit scope.
+        Returns (findings, scanned-relpaths)."""
+        paths = sorted(set(changed_files(self.root)))
+        findings: list[Finding] = []
+        scanned: list[str] = []
+        for path in paths:
+            if any(part in SKIP_PARTS for part in path.parts):
+                continue
+            findings.extend(self.check_file(path))
+            try:
+                scanned.append(path.resolve().relative_to(
+                    self.root.resolve()).as_posix())
+            except ValueError:
+                scanned.append(path.name)
+        return findings, scanned
+
+
+def changed_files(root: Path) -> list[Path]:
+    """``*.py`` files under ``root`` the working tree touches: modified
+    or added vs HEAD plus untracked (a brand-new module must not escape
+    its own pre-commit run). Raises SystemExit outside a git checkout —
+    --changed has no meaning there."""
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         cwd=str(root), capture_output=True, text=True)
+    repo = Path(top.stdout.strip()) if top.returncode == 0 else root
+    # each command's output is anchored by ITS convention — `diff` prints
+    # repo-toplevel-relative paths regardless of cwd, `ls-files --others`
+    # prints cwd-relative ones. Resolving each against its own anchor
+    # (instead of probing both) keeps a root-relative untracked name from
+    # aliasing a same-named file at the repo toplevel (which would make
+    # a brand-new module silently escape its own pre-commit run).
+    cmds = (
+        (repo, ["git", "diff", "--name-only", "-z", "HEAD", "--", "*.py"]),
+        (root, ["git", "ls-files", "--others", "--exclude-standard", "-z",
+                "--", "*.py"]),
+    )
+    paths: set[Path] = set()
+    for anchor, cmd in cmds:
+        try:
+            # -z: NUL-separated, UNQUOTED names — without it git's
+            # core.quotepath octal-escapes any non-ASCII filename and the
+            # mangled path would silently fail the exists() check below
+            proc = subprocess.run(cmd, cwd=str(root), capture_output=True,
+                                  timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise SystemExit(f"--changed: cannot run git: {e}")
+        if proc.returncode != 0:
+            raise SystemExit("--changed needs a git checkout: "
+                             + proc.stderr.decode(errors="replace").strip())
+        for raw in proc.stdout.split(b"\0"):
+            name = raw.decode("utf-8", errors="surrogateescape").strip()
+            if name:
+                paths.add((anchor / name).resolve())
+    out: list[Path] = []
+    for path in sorted(paths):
+        if not path.exists():
+            continue  # deleted files have no tree to scan
+        try:
+            path.relative_to(root.resolve())
+        except ValueError:
+            continue  # outside the scan root (tests/, bench.py, docs)
+        out.append(path)
+    return out
+
 
 # -- baseline ratchet ---------------------------------------------------------
 
@@ -275,6 +351,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--passes", default=None,
                         help="comma-separated pass ids to run (default: all)")
     parser.add_argument("--list-passes", action="store_true")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable verdict on stdout (findings, "
+                             "new, stale keys); exit code unchanged")
+    parser.add_argument("--changed", action="store_true",
+                        help="scan only *.py files modified vs HEAD or "
+                             "untracked (git-scoped pre-commit run); the "
+                             "ratchet still applies, stale entries for "
+                             "unscanned files are not reported")
     args = parser.parse_args(argv)
 
     from .passes import all_passes
@@ -290,21 +374,56 @@ def main(argv: list[str] | None = None) -> int:
     pass_ids = ([p.strip() for p in args.passes.split(",") if p.strip()]
                 if args.passes else None)
     manager = build_manager(root, pass_ids)
-    findings = manager.check_tree()
+    scanned: list[str] | None = None
+    if args.changed:
+        if args.update_baseline:
+            raise SystemExit("--update-baseline needs the full tree "
+                             "(a --changed subset would DROP every "
+                             "baselined finding outside it)")
+        findings, scanned = manager.check_changed()
+    else:
+        findings = manager.check_tree()
 
     if args.update_baseline:
         save_baseline(baseline_path, findings)
-        print(f"baseline rewritten: {len(findings)} finding(s) -> "
-              f"{baseline_path}")
+        if args.as_json:
+            print(json.dumps({"baseline": str(baseline_path),
+                              "rewritten": len(findings)}, indent=2))
+        else:
+            print(f"baseline rewritten: {len(findings)} finding(s) -> "
+                  f"{baseline_path}")
         return 0
 
     if args.no_baseline:
-        for f in findings:
-            print(f.render())
-        print(f"{len(findings)} finding(s)")
+        if args.as_json:
+            print(json.dumps({
+                "root": str(root), "baseline": None,
+                "scanned": scanned,
+                "findings": [f.as_dict() for f in findings],
+                "new": [f.as_dict() for f in findings], "stale": [],
+            }, indent=2))
+        else:
+            for f in findings:
+                print(f.render())
+            print(f"{len(findings)} finding(s)")
         return 1 if findings else 0
 
     new, stale = ratchet(findings, load_baseline(baseline_path))
+    if scanned is not None:
+        # a changed-scope run never visits most files, so their baseline
+        # entries look "stale" — only report staleness the scan can see
+        scanned_set = set(scanned)
+        stale = Counter({k: v for k, v in stale.items()
+                         if k.split("::", 1)[0] in scanned_set})
+    if args.as_json:
+        print(json.dumps({
+            "root": str(root), "baseline": str(baseline_path),
+            "scanned": scanned,
+            "findings": [f.as_dict() for f in findings],
+            "new": [f.as_dict() for f in new],
+            "stale": sorted(stale.elements()),
+        }, indent=2))
+        return 1 if new else 0
     for f in new:
         print(f.render())
     print(f"{len(findings)} finding(s): {len(new)} new, "

@@ -14,6 +14,7 @@ from .durability_discipline import DurabilityDisciplinePass
 from .jax_wedge import JaxWedgePass
 from .legacy import BareExceptPass, DuplicateDefPass, UnusedImportPass
 from .lock_discipline import LockDisciplinePass
+from .lockset import LocksetPass
 from .pipeline_ordering import PipelineOrderingPass
 from .query_discipline import QueryDisciplinePass
 from .queue_discipline import QueueDisciplinePass
@@ -32,6 +33,7 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     JaxWedgePass,
     AsyncBlockingPass,
     LockDisciplinePass,
+    LocksetPass,
     ResourceLeakPass,
     SwallowedExceptionPass,
     PipelineOrderingPass,

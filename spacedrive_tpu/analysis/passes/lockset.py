@@ -1,0 +1,407 @@
+"""lockset: interprocedural per-class race + self-deadlock detection.
+
+The RacerD idea scoped to this codebase's one instance-locking idiom: a
+class creates ``self._lock = threading.Lock()`` (or ``SdLock(...)``,
+utils/locks.py) in ``__init__`` and guards its mutable ``self._x``
+attributes with ``with self._lock:`` blocks. The ``lock-discipline``
+pass covers the *module*-level ``_STATE`` twin of this shape; until
+ISSUE 14 the ~47 instance locks had no checker at all — and the two
+worst shipped concurrency bugs lived exactly there (the PR 8
+``IngestBudget`` self-deadlock, the PR 12 merger races).
+
+Per class, the pass:
+
+1. collects its **locks**: ``self.X = Lock()/RLock()/SdLock()/SdRLock()/
+   Condition()`` assignments anywhere in the class (Condition bundles an
+   RLock; both R-forms are reentrant);
+2. tracks, per method, the **lexically held** lock set at every
+   statement: ``with self.X:`` holds X for the block;
+   ``self.X.acquire(...)`` holds X for the rest of the function (the
+   models/base try/finally idiom — deliberately credited past its
+   ``release()``, trading false negatives for zero false positives);
+   nested ``def``/``lambda`` bodies get NO credit (deferred execution);
+3. propagates guard state through **intra-class helper calls** with the
+   jax-wedge fixpoint: a method whose every ``self.helper()`` call site
+   holds X is analyzed as entered-with-X-held (``_shed_locked``-style
+   helpers); a method nobody in the class calls is an entry point and
+   gets no credit;
+4. infers the **guarded attribute set**: ``self._y`` is guarded by X
+   when any method mutates it with X held (``__init__`` excluded —
+   single-threaded construction);
+5. flags every mutation (assignment, augmented/compound
+   read-modify-write, subscript store/delete, mutating method call) of
+   a guarded attribute at a point where NONE of its guarding locks is
+   held — the classic lost-update window;
+6. flags **re-acquisition of a non-reentrant lock already held on the
+   same call path**: ``with self.X:`` (or ``.acquire()``) inside a
+   lexical X-hold, or in a method reachable (ANY-call-site, transitive)
+   from an X-hold — the exact PR 8 bug (``try_admit`` held the lock and
+   called ``_shed``, which re-acquired it: silent self-deadlock), which
+   no other pass can see.
+
+Deliberate single-writer / GIL-atomic idioms (status counters bumped by
+one owning thread, benign gauges) carry scoped waivers with a written
+argument — see docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+
+#: constructor leaves that make a ``self.X = <call>`` a lock attribute
+LOCK_FACTORIES = {"Lock": False, "SdLock": False,
+                  "RLock": True, "SdRLock": True, "Condition": True}
+
+MUTATOR_METHODS = {
+    "add", "append", "appendleft", "extend", "insert", "update",
+    "setdefault", "pop", "popitem", "popleft", "clear", "remove",
+    "discard",
+}
+
+
+class _Mutation:
+    __slots__ = ("attr", "lineno", "held", "method", "rmw")
+
+    def __init__(self, attr: str, lineno: int, held: frozenset[str],
+                 method: "_Method", rmw: bool = False) -> None:
+        self.attr = attr
+        self.lineno = lineno
+        self.held = held
+        self.method = method
+        #: compound read-modify-write (augmented assignment): not atomic
+        #: even under the GIL, unlike a single dict/attr store
+        self.rmw = rmw
+
+
+class _Acquire:
+    __slots__ = ("lock", "lineno", "held", "method")
+
+    def __init__(self, lock: str, lineno: int, held: frozenset[str],
+                 method: "_Method") -> None:
+        self.lock = lock
+        self.lineno = lineno
+        self.held = held
+        self.method = method
+
+
+class _Call:
+    __slots__ = ("callee", "lineno", "held", "method")
+
+    def __init__(self, callee: str, lineno: int, held: frozenset[str],
+                 method: "_Method") -> None:
+        self.callee = callee
+        self.lineno = lineno
+        self.held = held
+        self.method = method
+
+
+class _Method:
+    __slots__ = ("name", "node", "mutations", "acquires", "calls",
+                 "entry_all", "entry_any")
+
+    def __init__(self, name: str, node: ast.AST) -> None:
+        self.name = name
+        self.node = node
+        self.mutations: list[_Mutation] = []
+        self.acquires: list[_Acquire] = []
+        self.calls: list[_Call] = []
+        #: locks held at EVERY intra-class call site (guard credit)
+        self.entry_all: frozenset[str] = frozenset()
+        #: locks held at SOME intra-class call site (hazard propagation)
+        self.entry_any: frozenset[str] = frozenset()
+
+
+class _ClassInfo:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        #: lock attr name -> reentrant?
+        self.locks: dict[str, bool] = {}
+        self.methods: list[_Method] = []
+
+
+class LocksetPass(AnalysisPass):
+    id = "lockset"
+    description = ("instance state mutated outside the lock that guards "
+                   "it elsewhere, and non-reentrant self-lock "
+                   "re-acquisition on one call path")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    # -- collection ----------------------------------------------------------
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        info = _ClassInfo(cls.name)
+        self._collect_locks(cls, info)
+        if not info.locks:
+            return
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                method = _Method(stmt.name, stmt)
+                info.methods.append(method)
+                self._scan_body(stmt.body, info, method, frozenset())
+        self._propagate(info)
+        yield from self._report(ctx, info)
+
+    def _collect_locks(self, cls: ast.ClassDef, info: _ClassInfo) -> None:
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            leaf = (dotted_name(value.func) or "").split(".")[-1]
+            if leaf not in LOCK_FACTORIES:
+                continue
+            for target in targets:
+                if isinstance(target, ast.Attribute) \
+                        and isinstance(target.value, ast.Name) \
+                        and target.value.id == "self":
+                    info.locks[target.attr] = LOCK_FACTORIES[leaf]
+
+    def _self_attr(self, node: ast.AST) -> str | None:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _lock_in_expr(self, expr: ast.AST, info: _ClassInfo) -> str | None:
+        attr = self._self_attr(expr)
+        return attr if attr in info.locks else None
+
+    def _scan(self, node: ast.AST, info: _ClassInfo, method: _Method,
+              held: frozenset[str]) -> None:
+        """Source-order walk of one method tracking the lexical hold set.
+        ``held`` is immutable per recursion level; ``.acquire()`` credit
+        extends to the remaining SIBLING statements via the return-value
+        threading in _scan_body."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # deferred execution: the body runs when the lock state is
+            # whatever the CALLER of the closure holds, not this scope's
+            for child in ast.iter_child_nodes(node):
+                self._scan(child, info, method, frozenset())
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                lock = self._lock_in_expr(item.context_expr, info)
+                if lock is not None:
+                    method.acquires.append(
+                        _Acquire(lock, item.context_expr.lineno, held,
+                                 method))
+                    inner = inner | {lock}
+                else:
+                    # non-lock context managers may carry calls/mutations
+                    self._scan(item.context_expr, info, method, inner)
+            self._scan_body(node.body, info, method, inner)
+            return
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                lock = self._lock_in_expr(func.value, info)
+                if lock is not None and func.attr in ("acquire", "release"):
+                    if func.attr == "acquire":
+                        method.acquires.append(
+                            _Acquire(lock, node.lineno, held, method))
+                    # both fall through: no mutation/call bookkeeping
+                    for arg in node.args:
+                        self._scan(arg, info, method, held)
+                    return
+                callee_root = func.value
+                if isinstance(callee_root, ast.Name) \
+                        and callee_root.id == "self":
+                    method.calls.append(
+                        _Call(func.attr, node.lineno, held, method))
+            mutation = self._mutation_in_call(node)
+            if mutation is not None:
+                method.mutations.append(
+                    _Mutation(mutation, node.lineno, held, method))
+            for child in ast.iter_child_nodes(node):
+                self._scan(child, info, method, held)
+            return
+        mutated = self._mutation_in_stmt(node)
+        for attr, lineno, rmw in mutated:
+            method.mutations.append(
+                _Mutation(attr, lineno, held, method, rmw=rmw))
+        if hasattr(node, "body") and isinstance(getattr(node, "body"), list):
+            # compound statements: walk each block with sibling threading
+            for field in ("body", "orelse", "finalbody"):
+                block = getattr(node, field, None)
+                if isinstance(block, list) and block \
+                        and isinstance(block[0], ast.stmt):
+                    self._scan_body(block, info, method, held)
+            for handler in getattr(node, "handlers", []):
+                self._scan_body(handler.body, info, method, held)
+            # non-statement children (test exprs, iterators, with items)
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, (ast.stmt, ast.ExceptHandler)):
+                    self._scan(child, info, method, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._scan(child, info, method, held)
+
+    def _scan_body(self, body: list[ast.stmt], info: _ClassInfo,
+                   method: _Method, held: frozenset[str]) -> None:
+        """Statement list with `.acquire()` credit: an explicit acquire
+        extends the hold set for the remaining statements of the block
+        (and, via recursion, everything nested under them)."""
+        for stmt in body:
+            # the statement ITSELF is scanned with the pre-acquire set:
+            # `if not X.acquire(False): X.acquire()` is ONE statement
+            # whose two acquires are alternatives, not a re-acquisition
+            self._scan(stmt, info, method, held)
+            held = held | self._explicit_acquires(stmt, info)
+
+    def _explicit_acquires(self, stmt: ast.stmt,
+                           info: _ClassInfo) -> frozenset[str]:
+        """Locks `.acquire()`d anywhere inside this statement — credited
+        to the FOLLOWING siblings (the statement itself is scanned with
+        the pre-acquire set, which is conservative for mutations that
+        share a line with the acquire: none do in this tree)."""
+        out: set[str] = set()
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                lock = self._lock_in_expr(node.func.value, info)
+                if lock is not None:
+                    out.add(lock)
+        return frozenset(out)
+
+    # -- mutation classification --------------------------------------------
+    def _mutation_in_stmt(self,
+                          node: ast.AST) -> list[tuple[str, int, bool]]:
+        out: list[tuple[str, int, bool]] = []
+
+        def target_attr(t: ast.AST) -> str | None:
+            attr = self._self_attr(t)
+            if attr is not None:
+                return attr
+            if isinstance(t, ast.Subscript):
+                return self._self_attr(t.value)
+            return None
+
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                targets = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                    else [t]
+                for sub in targets:
+                    attr = target_attr(sub)
+                    if attr is not None:
+                        out.append((attr, node.lineno, False))
+        elif isinstance(node, ast.AugAssign):
+            attr = target_attr(node.target)
+            if attr is not None:
+                out.append((attr, node.lineno, True))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = target_attr(t)
+                if attr is not None:
+                    out.append((attr, node.lineno, False))
+        return out
+
+    def _mutation_in_call(self, call: ast.Call) -> str | None:
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in MUTATOR_METHODS:
+            return self._self_attr(call.func.value)
+        return None
+
+    # -- interprocedural fixpoints -------------------------------------------
+    def _propagate(self, info: _ClassInfo) -> None:
+        """Two fixpoints over intra-class call sites. ``entry_all``
+        (every call site holds X → guard credit) mirrors jax-wedge's
+        helper rule; ``entry_any`` (some call site holds X → hazard
+        reachability) powers the re-acquisition check."""
+        by_name: dict[str, list[_Method]] = {}
+        for m in info.methods:
+            by_name.setdefault(m.name, []).append(m)
+        sites: dict[str, list[_Call]] = {}
+        for m in info.methods:
+            for call in m.calls:
+                if call.callee in by_name:
+                    sites.setdefault(call.callee, []).append(call)
+
+        changed = True
+        while changed:
+            changed = False
+            for name, methods in by_name.items():
+                call_sites = sites.get(name)
+                if not call_sites:
+                    continue  # entry point: no credit, no hazard inherit
+                eff_all = frozenset.intersection(
+                    *[c.held | c.method.entry_all for c in call_sites])
+                eff_any = frozenset().union(
+                    *[c.held | c.method.entry_any for c in call_sites])
+                for m in methods:
+                    if eff_all - m.entry_all:
+                        m.entry_all = m.entry_all | eff_all
+                        changed = True
+                    if eff_any - m.entry_any:
+                        m.entry_any = m.entry_any | eff_any
+                        changed = True
+
+    # -- reporting -----------------------------------------------------------
+    def _report(self, ctx: FileContext,
+                info: _ClassInfo) -> Iterator[Finding]:
+        # guarded set: attr -> locks it was ever mutated under
+        guarded: dict[str, set[str]] = {}
+        for m in info.methods:
+            if m.name == "__init__":
+                continue
+            for mut in m.mutations:
+                for lock in mut.held | m.entry_all:
+                    guarded.setdefault(mut.attr, set()).add(lock)
+        # a lock attribute itself is never "state"
+        for lock in info.locks:
+            guarded.pop(lock, None)
+
+        findings: list[tuple[int, Finding]] = []
+        for m in info.methods:
+            if m.name == "__init__":
+                continue
+            for mut in m.mutations:
+                eff = mut.held | m.entry_all
+                if mut.attr in guarded:
+                    if not (guarded[mut.attr] & eff):
+                        locks = "/".join(sorted(guarded[mut.attr]))
+                        findings.append((mut.lineno, ctx.finding(
+                            mut.lineno, self.id,
+                            f"{info.name}.{mut.attr} is guarded by "
+                            f"self.{locks} elsewhere but mutated here in "
+                            f"'{m.name}' without it — lost-update race")))
+                elif mut.rmw and not eff:
+                    # never-guarded compound RMW in a lock-bearing class:
+                    # += is read-then-write, NOT atomic under the GIL —
+                    # two threads bumping it lose updates even though each
+                    # single dict/attr store would be safe
+                    findings.append((mut.lineno, ctx.finding(
+                        mut.lineno, self.id,
+                        f"{info.name}.{mut.attr}: compound "
+                        f"read-modify-write in '{m.name}' outside any "
+                        f"lock of a lock-bearing class — += is not "
+                        f"GIL-atomic (lost updates across threads)")))
+            for acq in m.acquires:
+                if info.locks.get(acq.lock):
+                    continue  # reentrant: re-acquisition is legal
+                path = acq.held | m.entry_any
+                if acq.lock in path:
+                    findings.append((acq.lineno, ctx.finding(
+                        acq.lineno, self.id,
+                        f"{info.name}.'{m.name}' re-acquires non-reentrant "
+                        f"self.{acq.lock} already held on this call path "
+                        f"— guaranteed self-deadlock (the PR 8 "
+                        f"IngestBudget shape)")))
+        for _lineno, finding in sorted(findings, key=lambda p: p[0]):
+            yield finding

@@ -29,6 +29,7 @@ from typing import TYPE_CHECKING
 
 from .. import telemetry
 from ..models import JobRow
+from ..utils.locks import SdRLock
 from .error import JobAlreadyRunning
 from .job import DynJob, StatefulJob
 from .report import JobReport, JobStatus
@@ -49,7 +50,9 @@ _QUEUED = telemetry.gauge("sd_jobs_queued",
 
 class Jobs:
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        # re-entrant: complete() holds it while ingest()ing the chained
+        # next job, which takes it again on the same thread
+        self._lock = SdRLock("jobs.manager")
         self._running: dict[str, Worker] = {}  # job id -> worker
         # the overflow queue is deliberately unbounded IN MEMORY but bounded
         # in practice by job-hash dedup (one entry per distinct job) and

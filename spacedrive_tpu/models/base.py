@@ -31,6 +31,7 @@ from typing import Any, ClassVar, Iterable
 
 from .. import faults, telemetry
 from ..telemetry import spans as _tspans
+from ..utils.locks import SdLock, SdRLock
 from ..utils.retry import RetryPolicy, is_sqlite_busy, retry_call
 
 #: reader/writer contention instrument (ISSUE 10): observed only for
@@ -221,7 +222,7 @@ class Database:
             # is defense in depth: a write attempt raises instead of
             # contending the node's single-writer discipline.
             self.models = list(models)
-            self._lock = threading.RLock()
+            self._lock = SdRLock("db.writer")
             self._conn = sqlite3.connect(
                 f"file:{self.path}?mode=ro", uri=True,
                 check_same_thread=False, cached_statements=512)
@@ -230,13 +231,15 @@ class Database:
             self._txn_depth = 0
             self._txn_thread = None
             self._read_conn = self._conn
-            self._read_lock = threading.Lock()
+            self._read_lock = SdLock("db.reader")
             self._closed = False
             return
         if self.path != ":memory:":
             Path(self.path).parent.mkdir(parents=True, exist_ok=True)
         self.models = list(models)
-        self._lock = threading.RLock()
+        # re-entrant: _Txn join + the upsert → find_one → query chain
+        # re-enter on the owning thread (named for the sanitizer soaks)
+        self._lock = SdRLock("db.writer")
         # autocommit mode; transactions are managed explicitly by _Txn so a
         # single connection can serve both one-shot writes and atomic batches.
         # cached_statements: the sync-ingest hot loop cycles through dozens of
@@ -258,7 +261,7 @@ class Database:
         # holding the writer lock. ":memory:" databases get no reader — a
         # second :memory: connection would be a different database.
         self._read_conn: sqlite3.Connection | None = None
-        self._read_lock = threading.Lock()
+        self._read_lock = SdLock("db.reader")
         self._closed = False
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")

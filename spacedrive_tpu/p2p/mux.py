@@ -158,7 +158,10 @@ class MuxConn:
         if self.closed.is_set():
             raise MuxError("connection is closed")
         stream_id = self._next_id
-        self._next_id += 2
+        # single event-loop thread: every open_substream runs on the loop,
+        # and _write_lock is an asyncio.Lock serializing FRAME interleave,
+        # not thread concurrency — the += can never race itself
+        self._next_id += 2  # lint: ok(lockset)
         sub = Substream(self, stream_id)
         self._streams[stream_id] = sub
         self._queue_control(T_OPEN, stream_id)

@@ -35,11 +35,11 @@ strike count at zero.
 from __future__ import annotations
 
 import os
-import threading
 import time
 
 from .. import telemetry
 from ..telemetry import mesh
+from ..utils.locks import SdLock
 
 DEFAULT_RATE = float(os.environ.get("SD_P2P_SESSION_RATE", "10"))
 DEFAULT_BURST = float(os.environ.get("SD_P2P_SESSION_BURST", "30"))
@@ -77,7 +77,7 @@ class SessionThrottle:
         self.rate = max(0.1, float(rate))
         self.burst = max(1.0, float(burst))
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = SdLock("p2p.throttle.buckets")
         #: peer id -> (tokens, last refill stamp); insertion-ordered for LRU
         self._buckets: dict[str, tuple[float, float]] = {}
         self._throttled = 0
@@ -166,7 +166,9 @@ class AutoBan:
         self.ban_s = max(0.1, float(ban_s))
         self.max_ban_s = max(self.ban_s, float(max_ban_s))
         self._clock = clock
-        self._lock = threading.Lock()
+        # non-reentrant: judge_busy_compliance deliberately releases it
+        # before calling strike() — the lockset pass enforces that shape
+        self._lock = SdLock("p2p.throttle.autoban")
         #: peer id -> strike timestamps inside the sliding window
         self._strikes: dict[str, list[float]] = {}
         #: peer id -> ban expiry stamp

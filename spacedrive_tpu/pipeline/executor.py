@@ -31,6 +31,7 @@ from typing import TYPE_CHECKING, Any
 
 from .. import telemetry
 from ..recovery import is_disk_full, note_disk_full
+from ..utils.locks import SdLock
 from ..utils.retry import RetryPolicy, is_device_wedge, is_transient, retry_call
 
 if TYPE_CHECKING:
@@ -153,8 +154,12 @@ class PipelineExecutor:
         #: stage spans pin the run() wall span as their parent
         self.trace = getattr(dyn_job, "trace", None)
         self._wall_sp = None
-        # per-stage wall time, read off the stage spans; each attribute is
-        # written by exactly one thread
+        # per-stage wall time, read off the stage spans. Three different
+        # threads accumulate here (prefetcher, dispatcher, committer);
+        # the per-batch lock hold replaces the old "each attribute is
+        # written by exactly one thread" convention with an invariant the
+        # lockset pass and the runtime sanitizer can actually check
+        self._stats_lock = SdLock("pipeline.executor.stats")
         self._page_s = 0.0
         self._hash_s = 0.0
         self._commit_s = 0.0
@@ -213,7 +218,8 @@ class PipelineExecutor:
                                     parent=self._wall_sp) as sp:
                     payload = self.spec.page(self.ctx, self.state.data,
                                              scratch)
-                self._page_s += sp.duration_s
+                with self._stats_lock:
+                    self._page_s += sp.duration_s
                 _BUSY.inc(sp.duration_s, stage="page")
                 if payload is None:
                     break
@@ -243,7 +249,8 @@ class PipelineExecutor:
                                     parent=self._wall_sp) as sp:
                     result = self.spec.process(self.ctx, self.state.data,
                                                item)
-                self._hash_s += sp.duration_s
+                with self._stats_lock:
+                    self._hash_s += sp.duration_s
                 _BUSY.inc(sp.duration_s, stage="hash")
                 t0 = time.perf_counter()
                 ok = self._put(self._results, result)
@@ -349,14 +356,16 @@ class PipelineExecutor:
                         self._batches)
                     raise JobPaused(self.dyn_job.serialize_state(),
                                     errors=self.errors) from e
-            self._commit_s += sp.duration_s
+            with self._stats_lock:
+                self._commit_s += sp.duration_s
+                self._txns += 1
             _BUSY.inc(sp.duration_s, stage="commit")
-            self._txns += 1
             _COMMIT_TXNS.inc()
             _COMMIT_PAGES.inc(len(pending))
             pending.clear()
             for result in results:
-                self._batches += 1
+                with self._stats_lock:
+                    self._batches += 1
                 if result.more_steps:
                     raise JobError(
                         f"{self.dyn_job.job.NAME}: pipelined jobs cannot "

@@ -56,6 +56,7 @@ from typing import TYPE_CHECKING, Any
 
 from .. import faults, telemetry
 from ..telemetry.registry import REQUEST_BUCKETS
+from ..utils.locks import SdLock
 
 if TYPE_CHECKING:
     from ..node import Node
@@ -369,7 +370,7 @@ class ReaderPool:
         self._slots: list[_Worker | None] = [None] * self.workers
         self._idle: list[_Worker] = []
         self._cv = threading.Condition()
-        self._wm_lock = threading.Lock()
+        self._wm_lock = SdLock("serve.pool.watermarks")
         self._watermarks: dict[str, int] = {}
         self._epochs: dict[str, int] = {}
         self._enabled = True

@@ -31,10 +31,10 @@ BUSY/backoff/resume loop deterministically.
 from __future__ import annotations
 
 import os
-import threading
 from dataclasses import dataclass
 
 from .. import faults, telemetry
+from ..utils.locks import SdLock
 
 #: default ops admitted-but-not-yet-durable across all peers (≈ four
 #: production pull windows); bytes default sized for JSON-framed windows
@@ -112,7 +112,9 @@ class IngestBudget:
                  max_bytes: int = DEFAULT_BUDGET_BYTES) -> None:
         self.max_ops = max(1, int(max_ops))
         self.max_bytes = max(1, int(max_bytes))
-        self._lock = threading.Lock()
+        # non-reentrant by design: _shed_locked exists precisely because
+        # re-acquiring this lock from a helper WAS the PR 8 self-deadlock
+        self._lock = SdLock("sync.admission.budget")
         self._ops = 0
         self._bytes = 0
         #: peer label -> (ops, bytes) currently in flight

@@ -49,6 +49,7 @@ from typing import TYPE_CHECKING, Any
 from .. import telemetry
 from ..models import Instance
 from ..telemetry import mesh
+from ..utils.locks import SdLock
 from .crdt import is_ref
 from .ingest import _WINDOW_SECONDS, Ingester, shared_poison_caps
 
@@ -193,7 +194,7 @@ class IngestLanes:
         self.library = library
         self.lanes = lanes if lanes is not None else lane_count()
         self._depth = depth if depth is not None else _lane_depth()
-        self._lock = threading.Lock()
+        self._lock = SdLock("sync.lanes.state")
         #: (peer, lane index) -> Ingester — an ingester's batch caches and
         #: poison memory are single-threaded state, so each is owned by
         #: exactly one lane thread (plus one wave-2 ingester per peer,
@@ -201,7 +202,7 @@ class IngestLanes:
         self._ingesters: dict[tuple[str | None, int], Ingester] = {}
         self._queues: list[queue.Queue[_LaneTask | None]] = []
         self._threads: list[threading.Thread] = []
-        self._wave2_lock = threading.Lock()
+        self._wave2_lock = SdLock("sync.lanes.wave2")
         self._closed = False
         self._windows = 0
         self._submissions = 0
@@ -261,7 +262,8 @@ class IngestLanes:
                 sub._finish(e)
             return sub
         sub = Submission(windows, peer, mesh.peer_label(peer))
-        self._submissions += 1
+        with self._lock:  # concurrent submitters: += is read-then-write
+            self._submissions += 1
         # shard every window; wave-2 ops keep original (window, op) order
         lane_parts: list[list[tuple[list[dict[str, Any]], Any]]] = [
             [] for _ in range(self.lanes)]
@@ -472,7 +474,8 @@ class IngestLanes:
                          default=0)
             mesh.record_ingest_window(sub.label, ctx, max_ts)
             window_seconds.observe(per_window_s)
-            self._windows += 1
+            with self._lock:  # merger thread races K=1 submitters
+                self._windows += 1
         logger.debug("lane ingest: %d windows, %d applied in %.3fs",
                      len(sub.windows), applied, elapsed)
         sub.applied = applied
@@ -486,8 +489,9 @@ class IngestLanes:
         with ing.session():
             for ops, ctx in windows:
                 applied += ing.receive(ops, ctx)
-        self._windows += len(windows)
-        self._submissions += 1
+        with self._lock:  # K=1 serial windows arrive from many threads
+            self._windows += len(windows)
+            self._submissions += 1
         return applied, ing.last_floor_advanced
 
     # -- internals -----------------------------------------------------------
@@ -595,7 +599,7 @@ class IngestLanes:
         }
 
 
-_POOL_LOCK = threading.Lock()
+_POOL_LOCK = SdLock("sync.lanes.pool")
 
 
 def get_lane_pool(library: "Library", lanes: int | None = None) -> IngestLanes:

@@ -34,6 +34,7 @@ from typing import Any
 from . import spans as _spans
 from .registry import (
     DEFAULT_BUCKETS,
+    LOCK_BUCKETS,
     METRIC_NAME_RE,
     REQUEST_BUCKETS,
     Registry,
@@ -44,7 +45,8 @@ from .registry import (
 from .spans import Span, Trace
 
 __all__ = [
-    "DEFAULT_BUCKETS", "METRIC_NAME_RE", "REQUEST_BUCKETS", "Registry",
+    "DEFAULT_BUCKETS", "LOCK_BUCKETS", "METRIC_NAME_RE", "REQUEST_BUCKETS",
+    "Registry",
     "Span", "Trace",
     "add_event_hook", "counter", "enabled", "event", "finish_trace",
     "gauge", "histogram", "job_trace", "recent_events", "registry",
@@ -462,6 +464,14 @@ def _declare_core() -> None:
     gauge("sd_serve_workers", "live reader-pool worker processes")
     counter("sd_serve_invalidations_total",
             "per-library watermark bumps pushed to the worker page caches")
+    # concurrency sanitizer (ISSUE 14): named-lock contention telemetry,
+    # recorded only on SD_LOCK_SANITIZER=1 runs (disabled, SdLock returns
+    # the bare threading primitive). ONE definition: utils/locks.py owns
+    # the declarations and records through the same memoized handles —
+    # calling it here makes the vocabulary scrape-visible from boot.
+    from ..utils.locks import declare_metrics as _declare_lock_metrics
+
+    _declare_lock_metrics()
 
 
 _declare_core()

@@ -31,6 +31,8 @@ import re
 import threading
 from typing import Any, Iterable
 
+from ..utils.locks import SdLock
+
 #: the one metric-name vocabulary (sdlint telemetry-discipline enforces it)
 METRIC_NAME_RE = re.compile(r"^sd_[a-z0-9_]+$")
 _LABEL_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
@@ -51,6 +53,13 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 #: fixed-boundary re-declaration error at import)
 REQUEST_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+#: lock-shaped buckets (ISSUE 14): sanitized-lock waits/holds live in the
+#: µs band, with the multi-second tail being exactly the convoy a soak
+#: needs to see. THE one definition — _declare_core and utils/locks.py
+#: both declare the sd_lock_* histograms from this constant
+LOCK_BUCKETS = (0.00001, 0.0001, 0.0005, 0.001, 0.005, 0.025, 0.1,
+                0.5, 2.5)
 
 
 def _env_enabled() -> bool:
@@ -168,7 +177,12 @@ class Family:
         self.type = typ
         self.label_names = label_names
         self.buckets = tuple(sorted(buckets)) if typ == HISTOGRAM else ()
-        self._lock = threading.Lock()
+        # the per-SERIES locks below stay raw threading.Locks: they are
+        # per-instance data-cell latches on the hottest path in the
+        # process, and under the sanitizer they are exactly where its own
+        # bookkeeping re-enters (the busy-flag bypass in utils/locks).
+        # The family/registry structure locks are the shared-state ones.
+        self._lock = SdLock("telemetry.family")
         self._series: dict[tuple[str, ...], Any] = {}
         if not label_names:
             # label-less families expose their zero sample immediately, so
@@ -227,7 +241,7 @@ class Registry:
     """All families of one process; the scrape and snapshot surface."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = SdLock("telemetry.registry")
         self._families: dict[str, Family] = {}
 
     # -- declaration ---------------------------------------------------------

@@ -1,0 +1,424 @@
+"""Named locks with an opt-in runtime concurrency sanitizer (ISSUE 14).
+
+Every one of this system's worst production bugs has been a concurrency
+bug found late: the PR 8 self-deadlock (``IngestBudget._shed``
+re-acquiring the non-reentrant lock ``try_admit`` already held) shipped
+and was only caught in review; the PR 12 merger races took a 64-peer
+soak to flush out. The static half of the discipline is the sdlint
+``lockset`` pass (analysis/passes/lockset.py); this module is the
+dynamic half: the hot shared-state modules name their locks
+(``SdLock("db.writer")``), and an opt-in sanitizer turns every chaos
+soak into a deadlock detector.
+
+Zero-cost disabled fast path
+----------------------------
+``SdLock(name)`` / ``SdRLock(name)`` are FACTORIES: with
+``SD_LOCK_SANITIZER`` unset they return the bare
+``threading.Lock()``/``RLock()`` — not a wrapper, the real object — so
+the production acquire/release path pays literally nothing for the
+naming (the ``lock_overhead`` A/B in bench.py scan mode keeps this
+honest). The enablement is read at lock CREATION time: processes opt in
+by setting the env var before start (the chaos harnesses inherit it
+into their node subprocesses).
+
+The sanitizer (``SD_LOCK_SANITIZER=1``)
+---------------------------------------
+Enabled, the factories return instrumented locks feeding three
+process-wide structures:
+
+- **per-thread held-lock stacks**: every sanitized acquire pushes
+  (lock, name, acquisition stack); release pops. A same-thread
+  re-acquisition of a non-reentrant lock raises
+  :class:`LockReacquireError` carrying BOTH acquisition stacks —
+  an immediate diagnostic instead of the silent hang the PR 8 bug
+  produced (``threading.Lock`` blocks forever, no error, no log).
+- **a global lock-order graph**: acquiring B while holding A records
+  the edge A→B (keyed by lock NAME — the role, not the instance — with
+  the first-witness stacks on both sides). An edge that closes a cycle
+  raises :class:`LockOrderError` BEFORE blocking, so the classic
+  two-thread ABBA reports (with both threads' stacks) instead of
+  deadlocking. Same-name edges are skipped: two instances of the same
+  role taken in sequence (per-library DB handles) are a hierarchy, not
+  an inversion — the same-instance case is covered by the re-acquisition
+  check above.
+- **contention telemetry**: ``sd_lock_wait_seconds{name}`` (contended
+  acquisitions only — the uncontended path pays one non-blocking try),
+  ``sd_lock_hold_seconds{name}`` and ``sd_lock_contended_total{name}``.
+
+Every violation also lands in a process-wide ledger
+(:func:`violations`) so a soak can assert "no cycles, no re-acquisitions"
+after the fact even where the raise was swallowed by a worker's
+error handling.
+
+Re-entrancy guard: the sanitizer's own bookkeeping records telemetry,
+and the telemetry registry's family locks are themselves sanitized —
+a thread-local ``busy`` flag makes nested sanitized acquires inside the
+bookkeeping degrade to raw acquires, terminating the recursion.
+
+Idiom boundary: the sanitizer models the ``with lock:`` /
+acquire-release-on-one-thread discipline every migrated module uses.
+A ``threading.Lock`` released by a DIFFERENT thread than its acquirer
+(the Lock-as-semaphore signal pattern) is legal for the raw primitive
+but outside this model: the acquirer's held-stack entry would go stale
+and its next acquire would misreport a re-acquisition. No migrated
+lock does this — use ``threading.Event``/``Semaphore`` for cross-thread
+signaling, which is what the codebase already does.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Any
+
+SANITIZER_ENV = "SD_LOCK_SANITIZER"
+
+#: frames kept per acquisition stack in reports (innermost last)
+_STACK_DEPTH = 16
+
+
+def sanitizer_enabled() -> bool:
+    return os.environ.get(SANITIZER_ENV, "").strip().lower() in (
+        "1", "on", "true", "yes")
+
+
+class LockSanitizerError(RuntimeError):
+    """Base for sanitizer diagnostics; carries the structured report."""
+
+    def __init__(self, message: str, report: dict[str, Any]) -> None:
+        super().__init__(message)
+        self.report = report
+
+
+class LockReacquireError(LockSanitizerError):
+    """Same thread re-acquired a non-reentrant lock it already holds —
+    with a bare ``threading.Lock`` this is a guaranteed self-deadlock."""
+
+
+class LockOrderError(LockSanitizerError):
+    """This acquisition would close a cycle in the global lock-order
+    graph (the ABBA shape): some thread has taken these locks in the
+    opposite order, so a deadlock is one unlucky interleaving away."""
+
+
+# -- process-wide sanitizer state ---------------------------------------------
+
+_tls = threading.local()
+
+#: guards _EDGES/_VIOLATIONS — a RAW lock, invisible to the sanitizer by
+#: construction (it is never an SdLock)
+_META_LOCK = threading.Lock()
+
+#: held-name -> acquired-name -> first-witness record
+_EDGES: dict[str, dict[str, dict[str, Any]]] = {}
+
+#: every violation observed, raise-or-not (soaks assert this stays [])
+_VIOLATIONS: list[dict[str, Any]] = []
+
+
+def _state():
+    if not hasattr(_tls, "held"):
+        _tls.held = []   # _Held entries, acquisition order
+        _tls.busy = False  # inside sanitizer bookkeeping: degrade to raw
+    return _tls
+
+
+def _stack() -> list[str]:
+    # skip the two sanitizer frames (this helper + acquire)
+    return [ln.rstrip("\n") for ln in
+            traceback.format_stack(limit=_STACK_DEPTH)[:-2]]
+
+
+def violations() -> list[dict[str, Any]]:
+    """Copy of the violation ledger (the soak gates diff against [])."""
+    with _META_LOCK:
+        return [dict(v) for v in _VIOLATIONS]
+
+
+def reset_sanitizer() -> None:
+    """Tests: drop the order graph and the ledger. Per-thread held
+    stacks are untouched (other threads own theirs)."""
+    with _META_LOCK:
+        _EDGES.clear()
+        _VIOLATIONS.clear()
+
+
+def order_graph() -> dict[str, list[str]]:
+    """name -> sorted successor names (introspection/tests)."""
+    with _META_LOCK:
+        return {a: sorted(bs) for a, bs in _EDGES.items()}
+
+
+# -- telemetry handles (lazy: utils must stay importable before telemetry) ----
+
+_FAMS: tuple | None = None
+
+
+def declare_metrics() -> tuple:
+    """Declare (or fetch) the ``sd_lock_*`` families — THE one
+    definition: telemetry._declare_core calls this for the eager
+    scrape-from-boot vocabulary and the sanitizer records through the
+    same memoized handles, so the two can never drift (a divergent copy
+    would raise the registry's re-declaration error instead)."""
+    global _FAMS
+    if _FAMS is None:
+        from .. import telemetry
+        from ..telemetry.registry import LOCK_BUCKETS
+
+        _FAMS = (
+            telemetry.histogram(
+                "sd_lock_wait_seconds",
+                "time contended sanitized-lock acquisitions waited "
+                "(SD_LOCK_SANITIZER=1 runs only)",
+                labels=("name",), buckets=LOCK_BUCKETS),
+            telemetry.histogram(
+                "sd_lock_hold_seconds",
+                "how long each sanitized lock was held per acquisition",
+                labels=("name",), buckets=LOCK_BUCKETS),
+            telemetry.counter(
+                "sd_lock_contended_total",
+                "sanitized-lock acquisitions that found the lock held",
+                labels=("name",)),
+        )
+    return _FAMS
+
+
+_families = declare_metrics
+
+
+class _Held:
+    __slots__ = ("lock", "name", "stack", "count", "t0")
+
+    def __init__(self, lock: "_SanitizedLock", stack: list[str]) -> None:
+        self.lock = lock
+        self.name = lock.name
+        self.stack = stack
+        self.count = 1
+        self.t0 = time.perf_counter()
+
+
+def _record_violation(report: dict[str, Any]) -> None:
+    report["unix"] = round(time.time(), 3)
+    report["thread"] = threading.current_thread().name
+    with _META_LOCK:
+        # bounded: a retry loop hammering the same violation must not
+        # balloon the ledger (the soak gate only needs "non-empty + the
+        # first witnesses"; 4096 distinct reports is already a bonfire)
+        if len(_VIOLATIONS) < 4096:
+            _VIOLATIONS.append(report)
+
+
+class _SanitizedLock:
+    """The sanitizer-on shape behind :func:`SdLock`. Non-reentrant."""
+
+    reentrant = False
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = self._new_raw()
+
+    def _new_raw(self):
+        return threading.Lock()
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _check_before_acquire(self, st) -> list[str]:
+        """Re-acquisition + order-graph checks; returns the captured
+        acquisition stack. Runs BEFORE any blocking so a would-be
+        deadlock raises instead of hanging. Caller set ``st.busy``."""
+        stack = _stack()
+        for h in st.held:
+            if h.lock is self:
+                report = {
+                    "kind": "reacquire", "lock": self.name,
+                    "first_stack": h.stack, "second_stack": stack,
+                }
+                _record_violation(report)
+                raise LockReacquireError(
+                    f"non-reentrant lock '{self.name}' re-acquired by the "
+                    f"thread already holding it (guaranteed self-deadlock "
+                    f"with the sanitizer off)", report)
+        held_names = {h.name: h for h in st.held}
+        for held_name, h in held_names.items():
+            if held_name == self.name:
+                continue  # same-role hierarchy; instance case handled above
+            with _META_LOCK:
+                out = _EDGES.setdefault(held_name, {})
+                if self.name in out:
+                    continue  # edge already witnessed: nothing new to learn
+                cycle = self._find_path(self.name, held_name)
+                if cycle is None:
+                    out[self.name] = {
+                        "held_stack": h.stack, "acquire_stack": stack,
+                        "thread": threading.current_thread().name,
+                    }
+                    continue
+                witness = _EDGES.get(cycle[0], {}).get(cycle[1], {})
+            report = {
+                "kind": "order",
+                "edge": [held_name, self.name],
+                "cycle": [self.name, *cycle[1:]],
+                "held_stack": h.stack,
+                "acquire_stack": stack,
+                "reverse_held_stack": witness.get("held_stack"),
+                "reverse_acquire_stack": witness.get("acquire_stack"),
+                "reverse_thread": witness.get("thread"),
+            }
+            _record_violation(report)
+            raise LockOrderError(
+                f"acquiring '{self.name}' while holding '{held_name}' "
+                f"closes a lock-order cycle "
+                f"({' -> '.join([held_name, self.name, *cycle[1:]])}): "
+                f"another path already takes these locks in the opposite "
+                f"order (both acquisition stacks in .report)", report)
+        return stack
+
+    @staticmethod
+    def _find_path(src: str, dst: str) -> list[str] | None:
+        """DFS over _EDGES (caller holds _META_LOCK): a name path
+        src → … → dst, or None. The graph is bounded by the closed set
+        of lock names, so this stays tiny."""
+        seen = set()
+        todo: list[tuple[str, tuple[str, ...]]] = [(src, (src,))]
+        while todo:
+            node, path = todo.pop()
+            if node == dst:
+                return list(path)
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in _EDGES.get(node, ()):
+                todo.append((nxt, path + (nxt,)))
+        return None
+
+    # -- the lock protocol ---------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        st = _state()
+        if st.busy:
+            # sanitizer-internal re-entry (telemetry's own family locks):
+            # degrade to the raw primitive, no bookkeeping
+            if not blocking:
+                return self._lock.acquire(False)
+            return self._lock.acquire(True, timeout)
+        if not blocking:
+            # a trylock can never deadlock: no re-acquisition or order
+            # checks (raw semantics — a probe of a self-held Lock returns
+            # False, and trylock-while-holding is the standard deadlock
+            # AVOIDANCE pattern), no contention telemetry (a failed probe
+            # is the caller's expected branch, not a convoy). A SUCCESSFUL
+            # probe still pushes the held entry, so the hold is visible as
+            # the held side of later blocking acquisitions' edges.
+            reentered = next((h for h in st.held if h.lock is self), None) \
+                if self.reentrant else None
+            if not self._lock.acquire(False):
+                return False
+            if reentered is not None:
+                reentered.count += 1
+            else:
+                st.busy = True
+                try:
+                    stack = _stack()
+                finally:
+                    st.busy = False
+                st.held.append(_Held(self, stack))
+            return True
+        st.busy = True
+        try:
+            reentered = None
+            if self.reentrant:
+                reentered = next(
+                    (h for h in st.held if h.lock is self), None)
+            stack = None if reentered is not None \
+                else self._check_before_acquire(st)
+        finally:
+            st.busy = False
+        got = self._lock.acquire(False)
+        if not got:
+            st.busy = True
+            try:
+                wait_h, _hold_h, contended_c = _families()
+                contended_c.inc(name=self.name)
+            finally:
+                st.busy = False
+            t0 = time.perf_counter()
+            got = self._lock.acquire(True, timeout)
+            if got:
+                st.busy = True
+                try:
+                    wait_h.observe(time.perf_counter() - t0, name=self.name)
+                finally:
+                    st.busy = False
+        if got:
+            if reentered is not None:
+                reentered.count += 1
+            else:
+                st.held.append(_Held(self, stack))
+        return got
+
+    def release(self) -> None:
+        st = _state()
+        if st.busy:
+            self._lock.release()
+            return
+        entry = None
+        for i in range(len(st.held) - 1, -1, -1):
+            if st.held[i].lock is self:
+                entry = st.held[i]
+                if entry.count > 1:
+                    entry.count -= 1
+                    entry = None
+                else:
+                    del st.held[i]
+                break
+        self._lock.release()
+        if entry is not None:
+            st.busy = True
+            try:
+                _families()[1].observe(
+                    time.perf_counter() - entry.t0, name=self.name)
+            finally:
+                st.busy = False
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class _SanitizedRLock(_SanitizedLock):
+    """Sanitizer-on shape behind :func:`SdRLock`: same-thread
+    re-acquisition is legal (counted, no new edges); everything else —
+    order graph, telemetry — behaves like :class:`_SanitizedLock`."""
+
+    reentrant = True
+
+    def _new_raw(self):
+        return threading.RLock()
+
+    def locked(self) -> bool:  # RLock has no .locked() before 3.14
+        raise AttributeError("SdRLock does not expose locked()")
+
+
+def SdLock(name: str):
+    """A named mutex. Disabled (the default): the bare
+    ``threading.Lock()`` — zero wrapper cost. ``SD_LOCK_SANITIZER=1``
+    (read at creation): a sanitized lock feeding the held-stack /
+    order-graph / telemetry machinery above."""
+    if sanitizer_enabled():
+        return _SanitizedLock(name)
+    return threading.Lock()
+
+
+def SdRLock(name: str):
+    """Named re-entrant mutex; same enablement contract as SdLock."""
+    if sanitizer_enabled():
+        return _SanitizedRLock(name)
+    return threading.RLock()

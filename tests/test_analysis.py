@@ -4,6 +4,7 @@ on a good one; waivers and the baseline ratchet must behave; and the
 whole tree must carry zero findings beyond the checked-in baseline —
 the enforced form of the round-4/5 wedge lesson."""
 
+import os
 from pathlib import Path
 
 import pytest
@@ -633,6 +634,290 @@ def test_worker_purity_waivable(tmp_path):
         "    def q(node, arg):\n"
         "        return node.config  # lint: ok(worker-purity)\n"),
         "worker-purity") == []
+
+
+# -- pass 16: lockset ---------------------------------------------------------
+
+#: the PR 8 bug, verbatim in shape: try_admit holds the non-reentrant
+#: budget lock and calls _shed, which re-acquires it — a silent
+#: self-deadlock that shipped and was only caught in review
+PR8_BUG = (
+    "import threading\n"
+    "class IngestBudget:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._shed_windows = 0\n"
+    "    def try_admit(self, ops):\n"
+    "        with self._lock:\n"
+    "            if ops > 10:\n"
+    "                return self._shed(ops)\n"
+    "            self._shed_windows += 0\n"
+    "        return True\n"
+    "    def _shed(self, ops):\n"
+    "        with self._lock:\n"
+    "            self._shed_windows += 1\n"
+    "        return False\n")
+
+#: the historical fix: the shared bookkeeping moved into a _locked
+#: helper that asserts nothing, and _shed acquires only from UNLOCKED
+#: call sites
+PR8_FIX = (
+    "import threading\n"
+    "class IngestBudget:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._shed_windows = 0\n"
+    "    def try_admit(self, ops):\n"
+    "        with self._lock:\n"
+    "            if ops > 10:\n"
+    "                return self._shed_locked(ops)\n"
+    "            self._shed_windows += 0\n"
+    "        return True\n"
+    "    def _shed_locked(self, ops):\n"
+    "        self._shed_windows += 1\n"
+    "        return False\n"
+    "    def _shed(self, ops):\n"
+    "        with self._lock:\n"
+    "            return self._shed_locked(ops)\n")
+
+
+def test_lockset_reproduces_the_pr8_ingestbudget_deadlock(tmp_path):
+    """The acceptance fixture: the shipped PR 8 shape is RED (flagged at
+    _shed's re-acquisition), the historical fix is GREEN — including the
+    interprocedural part (_shed_locked mutates guarded state with no
+    lexical lock, legal because every call site holds it)."""
+    bad = run_on(tmp_path, "sync/admission.py", PR8_BUG, "lockset")
+    assert len(bad) == 1 and bad[0].lineno == 13
+    assert "re-acquires non-reentrant self._lock" in bad[0].message
+    assert run_on(tmp_path, "sync/admission.py", PR8_FIX, "lockset") == []
+
+
+def test_lockset_flags_guarded_attr_mutated_outside_lock(tmp_path):
+    findings = run_on(tmp_path, "sync/cache.py", (
+        "import threading\n"
+        "class Cache:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = {}\n"
+        "    def put(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._items[k] = v\n"
+        "    def evict(self, k):\n"
+        "        self._items.pop(k, None)\n"       # store race
+        "    def reset(self):\n"
+        "        self._items = {}\n"               # whole-object swap race
+        "    def read(self, k):\n"
+        "        return self._items.get(k)\n"), "lockset")  # reads are fine
+    assert [f.lineno for f in findings] == [10, 12]
+    assert all("lost-update race" in f.message for f in findings)
+
+
+def test_lockset_rlock_reentry_and_acquire_credit_are_legal(tmp_path):
+    """The models/base idioms: RLock re-entry through upsert→execute,
+    the non-blocking-then-blocking acquire pair, and guard credit past an
+    explicit .acquire() (the try/finally reader path) all stay silent."""
+    assert run_on(tmp_path, "models/base.py", (
+        "import threading\n"
+        "class Database:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n"
+        "        self._read_lock = threading.Lock()\n"
+        "        self._conn = None\n"
+        "        self._depth = 0\n"
+        "    def execute(self):\n"
+        "        with self._lock:\n"
+        "            self._depth += 1\n"
+        "    def upsert(self):\n"
+        "        with self._lock:\n"
+        "            self.execute()\n"
+        "    def close(self):\n"
+        "        with self._read_lock:\n"
+        "            self._conn = None\n"
+        "    def query(self):\n"
+        "        if not self._read_lock.acquire(blocking=False):\n"
+        "            self._read_lock.acquire()\n"
+        "        try:\n"
+        "            return self._reader()\n"
+        "        finally:\n"
+        "            self._read_lock.release()\n"
+        "    def _reader(self):\n"
+        "        if self._conn is None:\n"
+        "            self._conn = object()\n"
+        "        return self._conn\n"), "lockset") == []
+
+
+def test_lockset_nested_with_same_lock_is_flagged(tmp_path):
+    findings = run_on(tmp_path, "jobs/m.py", (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"
+        "            with self._lock:\n"
+        "                self._n += 1\n"), "lockset")
+    assert [f.lineno for f in findings] == [9]
+
+
+def test_lockset_flags_unguarded_compound_rmw(tmp_path):
+    """+= is read-then-write even under the GIL: in a lock-bearing class
+    a never-guarded compound RMW is a lost-update hazard (the
+    IngestLanes._windows shape this pass caught live); a single
+    subscript store of an unguarded attr stays legal (GIL-atomic)."""
+    findings = run_on(tmp_path, "sync/stats.py", (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._jobs = {}\n"
+        "        self._count = 0\n"
+        "    def track(self, k):\n"
+        "        with self._lock:\n"
+        "            self._jobs[k] = 1\n"
+        "    def bump(self):\n"
+        "        self._count += 1\n"        # RMW: flagged
+        "    def note(self, k):\n"
+        "        self._seen = k\n"), "lockset")  # plain store: legal
+    assert [f.lineno for f in findings] == [11]
+    assert "not GIL-atomic" in findings[0].message
+
+
+def test_lockset_silent_without_locks_and_waivable(tmp_path):
+    # no lock in the class: single-threaded by construction elsewhere
+    assert run_on(tmp_path, "jobs/plain.py", (
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._n = 0\n"
+        "    def bump(self):\n"
+        "        self._n += 1\n"), "lockset") == []
+    # the GIL-atomic-idiom waiver form (p2p/mux.py event-loop counter)
+    assert run_on(tmp_path, "p2p/mux.py", (
+        "import asyncio\n"
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self._write_lock = asyncio.Lock()\n"
+        "        self._next_id = 1\n"
+        "        self._streams = {}\n"
+        "    async def send(self):\n"
+        "        async with self._write_lock:\n"
+        "            self._streams[1] = 1\n"
+        "    def open(self):\n"
+        "        self._next_id += 2  # lint: ok(lockset)\n"),
+        "lockset") == []
+
+
+# -- CLI: --json / --changed (ISSUE 14 satellites) ----------------------------
+
+def test_cli_json_output_round_trips(tmp_path, capsys):
+    import json
+
+    from spacedrive_tpu.analysis import main
+
+    (tmp_path / "jobs").mkdir()
+    (tmp_path / "jobs" / "bad.py").write_text(
+        "import jax\n"
+        "def execute_step(ctx):\n"
+        "    return jax.devices()\n")
+    rc = main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+               "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["pass"] for f in data["new"]] == ["jax-wedge"]
+    assert data["new"][0]["relpath"] == "jobs/bad.py"
+    assert data["new"][0]["line"] == 3
+    # adopt the baseline: same scan goes green, finding stays visible in
+    # `findings` but leaves `new`
+    assert main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+                 "--update-baseline"]) == 0
+    capsys.readouterr()  # drain the rewrite notice
+    rc = main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+               "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0 and data["new"] == [] and len(data["findings"]) == 1
+
+
+def test_cli_changed_scopes_to_git_diff(tmp_path, capsys):
+    import json
+    import subprocess
+
+    from spacedrive_tpu.analysis import main
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True,
+                       env={"PATH": os.environ["PATH"],
+                            "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+
+    (tmp_path / "jobs").mkdir()
+    committed = tmp_path / "jobs" / "old.py"
+    committed.write_text(
+        "import jax\n"
+        "def execute_step(ctx):\n"
+        "    return jax.devices()\n")
+    git("init"); git("add", "-A"); git("commit", "-m", "seed")
+
+    # untouched tree: nothing scanned, nothing found, exit 0 — even
+    # though the COMMITTED file still has a finding a full run would see
+    assert main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+                 "--changed", "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["scanned"] == [] and data["findings"] == []
+
+    # a modified file and an untracked file are both in scope
+    committed.write_text(committed.read_text() + "\n")
+    (tmp_path / "jobs" / "fresh.py").write_text(
+        "import jax\n"
+        "def execute_step(ctx):\n"
+        "    return jax.devices()\n")
+    rc = main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+               "--changed", "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["scanned"] == ["jobs/fresh.py", "jobs/old.py"]
+    assert {f["relpath"] for f in data["new"]} == {"jobs/fresh.py",
+                                                  "jobs/old.py"}
+    # --changed cannot rewrite the baseline (it would drop every
+    # baselined finding outside the diff)
+    with pytest.raises(SystemExit):
+        main([str(tmp_path), "--changed", "--update-baseline"])
+
+
+def test_cli_changed_untracked_name_colliding_with_repo_root(tmp_path,
+                                                            capsys):
+    """An untracked pkg/x.py whose cwd-relative name collides with a
+    committed repo-toplevel x.py must still be scanned — ls-files output
+    is anchored at the scan root, never probed against the toplevel."""
+    import json
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True,
+                       env={"PATH": os.environ["PATH"],
+                            "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+
+    from spacedrive_tpu.analysis import main
+
+    (tmp_path / "decoy.py").write_text("X = 1\n")  # clean, committed
+    pkg = tmp_path / "pkg" / "jobs"
+    pkg.mkdir(parents=True)
+    (pkg / "seed.py").write_text("Y = 1\n")
+    git("init"); git("add", "-A"); git("commit", "-m", "seed")
+    # the collision: pkg/decoy.py is UNTRACKED and has a finding; its
+    # root-relative name 'decoy.py' aliases the clean toplevel file
+    (tmp_path / "pkg" / "decoy.py").write_text("import os\n")
+    rc = main([str(tmp_path / "pkg"), "--baseline",
+               str(tmp_path / "b.txt"), "--changed", "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["scanned"] == ["decoy.py"]
+    assert [f["pass"] for f in data["new"]] == ["unused-import"]
 
 
 # -- waivers ------------------------------------------------------------------
