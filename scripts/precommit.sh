@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Pre-commit entry point (docs/static-analysis.md "Pre-commit"):
+#
+#   1. sdlint, scoped to the files this commit touches (`--changed` =
+#      modified-vs-HEAD + untracked *.py; `--json` so tooling parses
+#      the verdict instead of scraping prose) — the ratchet still
+#      applies, so a new finding fails the commit;
+#   2. the fast lint fixture suite (tests/test_analysis.py): the
+#      per-pass red/green fixtures plus the whole-tree ratchet gate,
+#      which catches a pass regression the scoped run can't see.
+#
+# Install:  ln -s ../../scripts/precommit.sh .git/hooks/pre-commit
+# Skip once (emergencies only): git commit --no-verify
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "[precommit] sdlint --changed" >&2
+python -m spacedrive_tpu.analysis --changed --json
+
+echo "[precommit] lint fixtures (tests/test_analysis.py)" >&2
+JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q \
+    -p no:cacheprovider -p no:randomly
+
+echo "[precommit] clean" >&2

@@ -139,6 +139,16 @@ class Router:
                 library = self._library(library_id)
             pool = getattr(self.node, "reader_pool", None)
             if proc.pool and pool is not None:
+                # device search engine (ISSUE 15): when the in-process
+                # handler would serve this query from the device-resident
+                # index, skip the pool — workers have no index, and the
+                # engine beats a worker's SQL scan (else it wouldn't be
+                # armed). Stale/ineligible dispatches keep pooling.
+                engine = getattr(self.node, "search_engine", None)
+                if engine is not None and engine.prefers_inprocess(
+                        proc.key, library_id, arg):
+                    pool = None
+            if proc.pool and pool is not None:
                 from ..server.pool import PoolUnavailable
 
                 try:

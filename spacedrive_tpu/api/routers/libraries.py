@@ -3,7 +3,7 @@ delete."""
 
 from __future__ import annotations
 
-from ...statistics import update_statistics
+from ...statistics import compute_statistics
 from ..invalidate import invalidate_query
 
 
@@ -16,10 +16,14 @@ def mount(router) -> None:
                  "instance_pub_id": (lib.instance() or {}).get("pub_id")}
                 for lib in node.libraries.list()]
 
-    @router.library_query("libraries.statistics")
+    @router.library_query("libraries.statistics", pool=True)
     def statistics(node, library, _arg):
-        """Recomputed on query (api/libraries.rs:47)."""
-        row = dict(update_statistics(library))
+        """Recomputed on query (api/libraries.rs:47). Pool-pure (ISSUE 15
+        satellite): a pure read over (library.db, node.data_dir) — the
+        snapshot-row persistence the reference does on query moved to
+        statistics.update_statistics for write-capable callers, so this
+        handler runs in serve-pool workers under the worker-purity lint."""
+        row = dict(compute_statistics(library.db, node.data_dir))
         row.pop("date_captured", None)
         return row
 

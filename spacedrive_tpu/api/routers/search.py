@@ -9,6 +9,7 @@ sizeInBytes|dateCreated|dateModified + direction; cursor = last row id.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 from ... import telemetry
@@ -61,8 +62,41 @@ def _path_filters(arg: dict[str, Any]) -> tuple[str, list[Any], bool]:
     if arg.get("materialized_path"):
         where.append("fp.materialized_path = ?")
         params.append(arg["materialized_path"])
+    if arg.get("date_range"):
+        # [lo, hi], either side None; TEXT comparison under BINARY
+        # collation (ISO-8601 with 'T' — lexicographic == chronological)
+        lo, hi = arg["date_range"]
+        if lo is not None:
+            where.append("fp.date_created >= ?")
+            params.append(lo)
+        if hi is not None:
+            where.append("fp.date_created <= ?")
+            params.append(hi)
+    if arg.get("size_range"):
+        lo, hi = arg["size_range"]
+        if lo is not None:
+            where.append("fp.size_in_bytes >= ?")
+            params.append(lo)
+        if hi is not None:
+            where.append("fp.size_in_bytes <= ?")
+            params.append(hi)
     needs_object = any("o." in clause for clause in where)
     return " AND ".join(where), params, needs_object
+
+
+def _engine(node):
+    """The device search engine when armed (SD_SEARCH_ENGINE=device);
+    None on the default SQL path and inside serve-pool workers."""
+    return getattr(node, "search_engine", None)
+
+
+def _ids_clause(ids) -> str:
+    """The hydration WHERE for an engine-provided candidate set: the ids
+    are our own int64 row ids, inlined (a 20k-id IN list stays far under
+    SQLite's statement limits and parses in ~a millisecond)."""
+    if len(ids) == 0:
+        return "0=1"
+    return f"fp.id IN ({','.join(str(int(i)) for i in ids)})"
 
 
 #: NULL-safe order expressions (keyset cursors need total order)
@@ -105,6 +139,18 @@ def mount(router) -> None:
             if cursor is not None:
                 raise ApiError("dirs_first cannot combine with a cursor")
             order_sql = f"fp.is_dir DESC, {order_sql}"
+        # device query engine (ISSUE 15): the columnar index scores the
+        # FILTER predicates and returns the exact matching id set; the
+        # SELECT below then reproduces ORDER BY/LIMIT/cursor semantics
+        # byte-for-byte over `fp.id IN (...)`. None = serve SQL (engine
+        # off, index stale/refreshing, ineligible predicate, oversized
+        # candidate set) — SQLite stays the oracle.
+        engine = _engine(node)
+        t0 = time.perf_counter()
+        cand = engine.candidate_ids(library, arg) \
+            if engine is not None else None
+        if cand is not None:
+            where, params = _ids_clause(cand), []
         cursor_sql = ""
         if cursor is not None:
             value, last_id = cursor
@@ -137,10 +183,20 @@ def mount(router) -> None:
         next_cursor = None
         if len(rows) > take and items:
             next_cursor = [rows[take - 1]["_order_val"], items[-1]["id"]]
+        if engine is not None and cand is None:
+            engine.note_sqlite_serve(time.perf_counter() - t0)
         return {"items": items, "cursor": next_cursor}
 
     @router.library_query("search.pathsCount", pool=True)
     def paths_count(node, library, arg):
+        engine = _engine(node)
+        t0 = time.perf_counter()
+        if engine is not None:
+            # the count is a pure mask sum on the columnar index — no SQL
+            # at all when the index is fresh and the predicate eligible
+            n = engine.count(library, arg or {})
+            if n is not None:
+                return n
         where, params, needs_object = _path_filters(arg or {})
         # without o.* predicates the COUNT runs index-only over the
         # (location_id, hidden) covering index instead of a rowid lookup
@@ -150,9 +206,12 @@ def mount(router) -> None:
         # never duplicate rows.
         join = ("LEFT JOIN object o ON fp.object_id = o.id "
                 if needs_object else "")
-        return library.db.query(
+        n = library.db.query(
             f"SELECT COUNT(*) n FROM file_path fp {join}WHERE {where}",
             params)[0]["n"]
+        if engine is not None:
+            engine.note_sqlite_serve(time.perf_counter() - t0)
+        return n
 
     @router.library_query("search.objects", pool=True)
     def objects(node, library, arg):

@@ -155,6 +155,17 @@ def do_backup(node: "Node", library_id: str) -> str:
     target = backups_dir(node) / f"{backup_id}.bkp"
     cfg_path = node.libraries.dir / f"{library_id}.sdlibrary"
     db_path = node.libraries.dir / f"{library_id}.db"
+    # persist a statistics snapshot row into the backup (the reference's
+    # update-on-query persistence moved here when libraries.statistics
+    # became a pool-pure reader — the backup is the natural write-capable
+    # moment for an as-of snapshot); best-effort, never blocks the backup
+    try:
+        from .statistics import update_statistics
+
+        update_statistics(library)
+    except Exception:
+        logger.warning("statistics snapshot before backup failed",
+                       exc_info=True)
     # fold the WAL into the main file so the tar'd .db is self-contained
     library.db.execute("PRAGMA wal_checkpoint(TRUNCATE)")
     # chaos seam: enospc degrades gracefully (no torn .bkp thanks to the

@@ -23,6 +23,7 @@ import dataclasses
 import datetime as _dt
 import json
 import os
+import re
 import sqlite3
 import threading
 import time
@@ -194,6 +195,107 @@ def utc_now() -> _dt.datetime:
 
 
 # --------------------------------------------------------------------------
+# row-change journal (the search engine's incremental-refresh feed)
+# --------------------------------------------------------------------------
+
+
+class RowJournal:
+    """Per-table changed-row accounting on a writer Database.
+
+    The device search engine (ISSUE 15, spacedrive_tpu/search/) refreshes
+    its columnar index incrementally: appends ride a ``id > max_id`` scan,
+    everything else needs to know WHICH rows changed. Every model-helper
+    write (insert/update/delete) notes the touched row's ``id`` or
+    ``pub_id`` here; raw SQL writes that bypass the helpers are caught by
+    a table-name sniff in :meth:`Database.execute` and degrade that
+    table to a **flood** (consumer does a full rebuild) — over-noting is
+    always safe, silent under-noting would serve stale rows.
+
+    Notes made inside an open transaction are buffered per-thread and
+    published when the outermost transaction closes: the consumer reads
+    the last COMMITTED snapshot, so a note must never be drainable before
+    its rows are visible (a drained-then-invisible note would be lost to
+    the next refresh). Publishing on rollback too is deliberate — a
+    re-select of an unchanged row is idempotent.
+
+    Bounded: past ``CAP`` noted rows per table the journal floods that
+    table instead of growing.
+    """
+
+    CAP = 8192
+    _WRITE_VERB = re.compile(r"^\s*(insert|update|delete|replace)\b", re.I)
+
+    def __init__(self, tables: Iterable[str],
+                 flood_on_delete: Iterable[str] = ()) -> None:
+        self.tables = frozenset(tables)
+        #: tables whose DELETEs flood instead of noting the row: an FK
+        #: cascade (``ON DELETE SET NULL`` on file_path.object_id) mutates
+        #: OTHER tracked rows the statement never names
+        self.flood_on_delete = frozenset(flood_on_delete)
+        self._lock = threading.Lock()
+        self._ids: dict[str, set[int]] = {t: set() for t in self.tables}
+        self._pub_ids: dict[str, set[str]] = {t: set() for t in self.tables}
+        self._flood: set[str] = set()
+        #: thread ident -> notes buffered inside that thread's open txn
+        self._pending: dict[int, list[tuple[str, str, Any]]] = {}
+
+    def _apply_locked(self, table: str, key: str, value: Any) -> None:
+        if key == "flood" or value is None:
+            self._flood.add(table)
+        elif key == "id":
+            bucket = self._ids[table]
+            bucket.add(int(value))
+            if len(bucket) > self.CAP:
+                self._flood.add(table)
+        elif key == "pub_id":
+            bucket = self._pub_ids[table]
+            bucket.add(str(value))
+            if len(bucket) > self.CAP:
+                self._flood.add(table)
+
+    def publish_one(self, table: str, key: str, value: Any) -> None:
+        with self._lock:
+            self._apply_locked(table, key, value)
+
+    def buffer(self, ident: int, table: str, key: str, value: Any) -> None:
+        with self._lock:
+            self._pending.setdefault(ident, []).append((table, key, value))
+
+    def publish_thread(self, ident: int) -> None:
+        """Outermost-transaction close: the thread's buffered notes become
+        drainable (the rows are now committed — or rolled back, which a
+        re-select absorbs)."""
+        with self._lock:
+            for table, key, value in self._pending.pop(ident, ()):
+                self._apply_locked(table, key, value)
+
+    def sniff(self, sql: str) -> str | None:
+        """Raw-write detection: returns the tracked table a bypassing
+        write names, or None (the caller then routes a flood note through
+        the txn-aware path)."""
+        if not self._WRITE_VERB.match(sql):
+            return None
+        head = sql[:256].lower()
+        for table in self.tables:
+            if re.search(rf"\b{table}\b", head):
+                return table
+        return None
+
+    def drain(self) -> dict[str, Any]:
+        """Atomically take the published notes (buffered ones stay)."""
+        with self._lock:
+            out = {
+                "ids": {t: s for t, s in self._ids.items() if s},
+                "pub_ids": {t: s for t, s in self._pub_ids.items() if s},
+                "flood": set(self._flood),
+            }
+            self._ids = {t: set() for t in self.tables}
+            self._pub_ids = {t: set() for t in self.tables}
+            self._flood = set()
+        return out
+
+
+# --------------------------------------------------------------------------
 # database handle
 # --------------------------------------------------------------------------
 
@@ -233,6 +335,7 @@ class Database:
             self._read_conn = self._conn
             self._read_lock = SdLock("db.reader")
             self._closed = False
+            self._journal = None
             return
         if self.path != ":memory:":
             Path(self.path).parent.mkdir(parents=True, exist_ok=True)
@@ -263,6 +366,9 @@ class Database:
         self._read_conn: sqlite3.Connection | None = None
         self._read_lock = SdLock("db.reader")
         self._closed = False
+        #: row-change journal (attached by the search engine; None = the
+        #: write path pays nothing)
+        self._journal: RowJournal | None = None
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")
         self._conn.execute("PRAGMA synchronous=NORMAL")
@@ -301,15 +407,63 @@ class Database:
         with self._lock:
             self._conn.close()
 
+    # -- row-change journal (search-engine refresh feed) ---------------------
+    def attach_row_journal(self, tables: Iterable[str],
+                           flood_on_delete: Iterable[str] = ()) -> RowJournal:
+        """Idempotent per table set; the single consumer drains it."""
+        journal = self._journal
+        if journal is None or journal.tables != frozenset(tables):
+            journal = RowJournal(tables, flood_on_delete=flood_on_delete)
+            self._journal = journal
+        return journal
+
+    def _journal_note(self, table: str, key: str, value: Any) -> None:
+        """Txn-aware note routing: inside an open transaction the note is
+        buffered until the OUTERMOST close publishes it — a drainable
+        note must never precede its rows' visibility to readers."""
+        journal = self._journal
+        if journal is None or table not in journal.tables:
+            return
+        if self._txn_depth and self._txn_thread == threading.get_ident():
+            journal.buffer(threading.get_ident(), table, key, value)
+        else:
+            journal.publish_one(table, key, value)
+
+    def _journal_sniff(self, sql: str) -> None:
+        journal = self._journal
+        if journal is not None:
+            table = journal.sniff(sql)
+            if table is not None:
+                self._journal_note(table, "flood", None)
+
     # -- low-level ----------------------------------------------------------
-    def execute(self, sql: str, params: tuple | list = ()) -> sqlite3.Cursor:
+    def execute(self, sql: str, params: tuple | list = (), *,
+                _noted: bool = False) -> sqlite3.Cursor:
         if self.readonly:
             raise sqlite3.ProgrammingError(
                 "read-only database handle (serve-pool reader)")
         with self._lock:
-            return self._conn.execute(sql, params)
+            cur = self._conn.execute(sql, params)
+        if not _noted:
+            # AFTER the statement: an autocommit write is visible now, so
+            # the note can never be drained ahead of its rows (txn-scoped
+            # writes buffer until the outermost close either way)
+            self._journal_sniff(sql)
+        return cur
 
-    def executemany(self, sql: str, seq: list[tuple]) -> None:
+    def executemany_noted(self, sql: str, seq: list[tuple], table: str,
+                          row_ids: Iterable[int]) -> None:
+        """Raw batch write over a journal-tracked table with the touched
+        row ids declared up front — the un-forgettable form of the
+        sniff-suppressing ``_noted`` idiom: the statement and its notes
+        travel in one call, so a caller can never suppress the sniff and
+        then forget the notes (which would serve stale search rows)."""
+        self.executemany(sql, seq, _noted=True)
+        for row_id in row_ids:
+            self._journal_note(table, "id", row_id)
+
+    def executemany(self, sql: str, seq: list[tuple], *,
+                    _noted: bool = False) -> None:
         if self.readonly:
             raise sqlite3.ProgrammingError(
                 "read-only database handle (serve-pool reader)")
@@ -319,6 +473,8 @@ class Database:
             else:  # batch inserts get their own transaction for speed
                 with _Txn(self):
                     self._conn.executemany(sql, seq)
+        if not _noted:
+            self._journal_sniff(sql)
 
     def _reader(self) -> sqlite3.Connection | None:
         """The WAL reader connection (None for :memory:). Opened lazily —
@@ -350,7 +506,14 @@ class Database:
         # exactly where a non-owner belongs.
         if self._txn_depth and self._txn_thread == threading.get_ident():
             with self._lock:
-                return self._conn.execute(sql, params).fetchall()
+                rows = self._conn.execute(sql, params).fetchall()
+            # the txn-owner path CAN carry writes (objects/gc.py issues
+            # DELETEs through query() inside its transaction) — sniff
+            # them like execute() does, or the row journal would
+            # under-note and the search index would serve stale rows.
+            # Reads pay one failed regex match on the first token.
+            self._journal_sniff(sql)
+            return rows
         # request traces (telemetry/requests.py) opt into per-SELECT spans
         # so a slow rspc query shows its SQL/reader-wait breakdown; job
         # traces never set record_db_spans — their per-batch recording
@@ -427,10 +590,26 @@ class Database:
                 params.append(model.encode(c, v))
         return " AND ".join(parts), params
 
+    def _journal_where(self, table: str, where: dict[str, Any]) -> None:
+        """Note an update/delete by its where-key: a unique row key notes
+        that row exactly; anything else floods the table (the consumer
+        full-rebuilds — over-noting is safe, a missed row is not)."""
+        if self._journal is None or table not in self._journal.tables:
+            return
+        if where.get("id") is not None:
+            self._journal_note(table, "id", where["id"])
+        elif where.get("pub_id") is not None:
+            self._journal_note(table, "pub_id", where["pub_id"])
+        else:
+            self._journal_note(table, "flood", None)
+
     def insert(self, model: type[Model], row: dict[str, Any], or_ignore: bool = False) -> int:
         cols = [c for c in row.keys() if c in model.FIELDS]
         sql = self._insert_sql(model, cols, or_ignore)
-        cur = self.execute(sql, [model.encode(c, row[c]) for c in cols])
+        cur = self.execute(sql, [model.encode(c, row[c]) for c in cols],
+                           _noted=True)
+        if cur.rowcount > 0:
+            self._journal_note(model.TABLE, "id", cur.lastrowid)
         return cur.lastrowid
 
     def insert_ignore(self, model: type[Model], row: dict[str, Any]) -> bool:
@@ -438,8 +617,12 @@ class Database:
         one-statement half of rowcount-based upserts (sync apply hot path)."""
         cols = [c for c in row.keys() if c in model.FIELDS]
         sql = self._insert_sql(model, cols, True)
-        cur = self.execute(sql, [model.encode(c, row[c]) for c in cols])
-        return cur.rowcount > 0
+        cur = self.execute(sql, [model.encode(c, row[c]) for c in cols],
+                           _noted=True)
+        inserted = cur.rowcount > 0
+        if inserted:
+            self._journal_note(model.TABLE, "id", cur.lastrowid)
+        return inserted
 
     def insert_many(self, model: type[Model], rows: list[dict[str, Any]], or_ignore: bool = False) -> int:
         if not rows:
@@ -451,7 +634,12 @@ class Database:
         encs = [(c, model.encoder(c)) for c in cols]
         self.executemany(sql, [
             tuple(r.get(c) if e is None else e(r.get(c)) for c, e in encs)
-            for r in rows])
+            for r in rows], _noted=True)
+        # fresh AUTOINCREMENT ids ride the consumer's id > max_id append
+        # scan; only explicit-id rows need notes
+        if "id" in cols:
+            for r in rows:
+                self._journal_note(model.TABLE, "id", r.get("id"))
         return len(rows)
 
     def update(self, model: type[Model], where: dict[str, Any], values: dict[str, Any]) -> int:
@@ -460,12 +648,20 @@ class Database:
         set_sql = ", ".join(f'"{c}" = ?' for c in values)
         where_sql, where_params = self._where_sql(model, where)
         params = [model.encode(c, v) for c, v in values.items()] + where_params
-        cur = self.execute(f"UPDATE {model.TABLE} SET {set_sql} WHERE {where_sql}", params)
+        cur = self.execute(f"UPDATE {model.TABLE} SET {set_sql} WHERE {where_sql}", params,
+                           _noted=True)
+        self._journal_where(model.TABLE, where)
         return cur.rowcount
 
     def delete(self, model: type[Model], where: dict[str, Any]) -> int:
         where_sql, params = self._where_sql(model, where)
-        cur = self.execute(f"DELETE FROM {model.TABLE} WHERE {where_sql}", params)
+        cur = self.execute(f"DELETE FROM {model.TABLE} WHERE {where_sql}", params,
+                           _noted=True)
+        journal = self._journal
+        if journal is not None and model.TABLE in journal.flood_on_delete:
+            self._journal_note(model.TABLE, "flood", None)
+        else:
+            self._journal_where(model.TABLE, where)
         return cur.rowcount
 
     def find(
@@ -563,21 +759,29 @@ class _Txn:
             self.db._txn_depth -= 1
             if self.db._txn_depth == 0:
                 self.db._txn_thread = None
-                if exc_type is None:
-                    try:
-                        retry_call(self._commit, policy=TXN_RETRY,
-                                   classify=is_sqlite_busy,
-                                   label="txn-commit")
-                    except BaseException:
-                        # a COMMIT that stayed busy past the budget leaves
-                        # the transaction open: roll it back so the
-                        # connection is reusable, then surface the failure
+                try:
+                    if exc_type is None:
                         try:
-                            self.db._conn.execute("ROLLBACK")
-                        except sqlite3.Error:
-                            pass
-                        raise
-                else:
-                    self.db._conn.execute("ROLLBACK")
+                            retry_call(self._commit, policy=TXN_RETRY,
+                                       classify=is_sqlite_busy,
+                                       label="txn-commit")
+                        except BaseException:
+                            # a COMMIT that stayed busy past the budget
+                            # leaves the transaction open: roll it back so
+                            # the connection is reusable, then surface it
+                            try:
+                                self.db._conn.execute("ROLLBACK")
+                            except sqlite3.Error:
+                                pass
+                            raise
+                    else:
+                        self.db._conn.execute("ROLLBACK")
+                finally:
+                    # buffered row-journal notes become drainable only now
+                    # (commit OR rollback: the rows are visible or
+                    # unchanged — either way a re-select is truthful)
+                    journal = self.db._journal
+                    if journal is not None:
+                        journal.publish_thread(threading.get_ident())
         finally:
             self.db._lock.release()

@@ -229,6 +229,14 @@ class Node:
         self.resources = ResourceWatcher().start()
         self.profiler = SamplingProfiler().start()
 
+        # device-resident query engine (ISSUE 15): columnar search index
+        # scored by batched JAX/Pallas kernels, refreshed at the commit
+        # watermark off this node's event bus. Gated: SD_SEARCH_ENGINE=
+        # device arms it; default (sqlite) keeps every query on SQL.
+        from .search.engine import SearchEngine
+
+        self.search_engine = SearchEngine.maybe_start(self)
+
         # api::mount last — validates the invalidation-key contract
         # (api/mod.rs:102, invalidate.rs:82)
         from .api.router import mount as api_mount
@@ -270,6 +278,9 @@ class Node:
             # defensive: the owning shell normally stops it first
             pool.stop()
             self.reader_pool = None
+        if getattr(self, "search_engine", None) is not None:
+            self.search_engine.stop()
+            self.search_engine = None
         self.jobs.shutdown()
         from . import telemetry
 

@@ -276,8 +276,10 @@ class FileIdentifierJob(StatefulJob):
         with db.transaction():
             # 1. write cas_ids (one executemany: this loop runs for every
             # file in the location)
-            db.executemany("UPDATE file_path SET cas_id = ? WHERE id = ?",
-                           [(cas, row["id"]) for row, cas in identified])
+            db.executemany_noted(
+                "UPDATE file_path SET cas_id = ? WHERE id = ?",
+                [(cas, row["id"]) for row, cas in identified],
+                "file_path", (row["id"] for row, _cas in identified))
             if emit:
                 for row, cas in identified:
                     ops.append(sync.shared_update(FilePath, row["pub_id"], "cas_id", cas))
@@ -342,8 +344,9 @@ class FileIdentifierJob(StatefulJob):
                             ops.append(sync.shared_update(
                                 FilePath, row["pub_id"], "object_id",
                                 ref_obj(opub)))
-            db.executemany("UPDATE file_path SET object_id = ? WHERE id = ?",
-                           link_rows)
+            db.executemany_noted(
+                "UPDATE file_path SET object_id = ? WHERE id = ?",
+                link_rows, "file_path", (fp_id for _oid, fp_id in link_rows))
             if emit and ops:
                 sync.log_ops(ops)
         # the checkpoint cursor advances ONLY here, after the transaction

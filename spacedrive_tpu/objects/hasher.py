@@ -434,7 +434,20 @@ class BackendRouter:
     #: measuring the losing engine to a sliver of one batch
     PROBE_SLICE = 128
 
-    def __init__(self) -> None:
+    def __init__(self, flips_counter=None, batches_counter=None,
+                 bps_gauge=None, mfu_gauge=None,
+                 event_prefix: str = "hash_router") -> None:
+        # metric handles default to the hash families; other subsystems
+        # (the device search engine, ISSUE 15) reuse the routing logic
+        # with their own sd_* families and event names
+        self._flips_counter = flips_counter if flips_counter is not None \
+            else _ROUTER_FLIPS
+        self._batches_counter = batches_counter \
+            if batches_counter is not None else _ROUTER_BATCHES
+        self._bps_gauge = bps_gauge if bps_gauge is not None else _ROUTER_BPS
+        self._mfu_gauge = mfu_gauge if mfu_gauge is not None else (
+            _ROUTER_MFU if event_prefix == "hash_router" else None)
+        self._event_prefix = event_prefix
         self._lock = threading.Lock()
         self.cpu_bps: float | None = None
         self.dev_bps: float | None = None
@@ -473,16 +486,16 @@ class BackendRouter:
             self._cpu_since_degrade = 0
             if self.current != "cpu":
                 self._flip_locked("cpu")
-        telemetry.event("hash_router_degraded", reason=reason)
+        telemetry.event(f"{self._event_prefix}_degraded", reason=reason)
 
     def _flip_locked(self, to: str) -> None:
         self.current = to
         self.flips += 1
         self._streak = 0
-        _ROUTER_FLIPS.inc()
+        self._flips_counter.inc()
         # flight-recorder edge: router flips are exactly what an operator
         # tails a live node for (telemetry.watch / SSE)
-        telemetry.event("hash_router_flip", to=to,
+        telemetry.event(f"{self._event_prefix}_flip", to=to,
                         cpu_bps=round(self.cpu_bps or 0.0),
                         device_bps=round(self.dev_bps or 0.0))
         logger.info("hash router: engine flipped to %s "
@@ -535,12 +548,12 @@ class BackendRouter:
                                 bps / 1e6)
             else:
                 self.cpu_bps = ewma
-        _ROUTER_BATCHES.inc(backend=engine)
-        _ROUTER_BPS.set(round(ewma, 1), backend=engine)
-        if engine == "device":
+        self._batches_counter.inc(backend=engine)
+        self._bps_gauge.set(round(ewma, 1), backend=engine)
+        if engine == "device" and self._mfu_gauge is not None:
             from ..ops import roofline
 
-            _ROUTER_MFU.set(round(roofline.mfu(ewma), 6))
+            self._mfu_gauge.set(round(roofline.mfu(ewma), 6))
 
 
 class HybridHasher:

@@ -112,7 +112,11 @@ class P2PManager:
         from .throttle import AutoBan, SessionThrottle
 
         self.session_throttle = SessionThrottle()
-        self.auto_ban = AutoBan()
+        # ban/strike state persists under the data dir (atomic writes),
+        # reloaded with an expiry sweep at boot — a rebooted node must
+        # not amnesty a mid-ban abuser (ISSUE 15 satellite, fleet rung c)
+        self.auto_ban = AutoBan(
+            persist_path=node.data_dir / "p2p_autoban.json")
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._stop: asyncio.Event | None = None
@@ -192,6 +196,8 @@ class P2PManager:
             self._thread.join(timeout=10)
         except RuntimeError:
             pass
+        # final strike-state snapshot (ban edges already saved eagerly)
+        self.auto_ban.save()
 
     # -- metadata / events ---------------------------------------------------
     def metadata(self) -> dict[str, Any]:

@@ -1,0 +1,11 @@
+"""Device-resident query engine (ISSUE 15): a columnar in-memory search
+index over FilePath rows scored by batched JAX/Pallas kernels, refreshed
+incrementally at the commit watermark, with SQLite as the oracle and the
+fallback at every rung. See docs/architecture/serving.md ("Device query
+engine") and docs/architecture/search.md."""
+
+from .columnar import ColumnarIndex, Predicate, match_row, parse_predicate
+from .engine import SearchEngine
+
+__all__ = ["ColumnarIndex", "Predicate", "SearchEngine", "match_row",
+           "parse_predicate"]

@@ -1,0 +1,611 @@
+"""The columnar search index: fixed-width byte planes + filter columns.
+
+One :class:`ColumnarIndex` per library holds every ``file_path`` row as a
+fixed-width columnar record (ISSUE 15 tentpole):
+
+- **byte planes** (``(W, N) u8``, plane ``w`` = byte ``w`` of every row,
+  the lane layout ops/blake3_pallas.py set the precedent for): folded
+  ``name`` (W=64) for LIKE-substring scoring, raw ``materialized_path``
+  (W=96) and ``extension`` (W=12) for SQL ``=``/``IN`` byte equality,
+  and ``date_created`` (W=40) for BINARY-collation range compares;
+- **filter columns**: ``location_id``/``kind`` (i64/i32, −1 = NULL),
+  ``hidden``/``favorite`` (i8, −1 = NULL), ``size_in_bytes`` (i64, −1 =
+  NULL) — the date/kind/size/hidden predicate set;
+- a byte-presence bitmap (``(N, 32) u8``) — the CPU engine's substring
+  prescreen (kernels.presence_bitmap);
+- an **overflow sidecar**: the few rows whose value truncated at a plane
+  width (or whose date text is longer than W_DATE) keep their full
+  decoded fields host-side; every query patches those rows through
+  :func:`match_row`, the pure-Python oracle, so truncation can never
+  change an answer.
+
+Rows are kept sorted by ``id`` (AUTOINCREMENT ids are monotonic, so
+appends preserve the invariant and slot lookup is a binary search);
+deletes flip an ``alive`` bit; updates are written in place. The
+:class:`DeviceMirror` keeps jnp copies of the planes + filter columns
+resident on the accelerator, updated by the same incremental deltas —
+the "device-resident" half of the engine's name.
+
+Semantics are the SQL path's, exactly (the engine's byte-identity
+contract): :func:`parse_predicate` normalizes a ``search.paths`` arg
+with the SAME coercions api/routers/search.py applies, and returns None
+for anything the index cannot answer bit-exactly (LIKE wildcards in the
+needle, tag subqueries, NUL bytes, over-long needles) — those queries
+stay on SQLite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable
+
+import numpy as np
+
+from . import kernels
+from .kernels import MAX_NEEDLE, fold
+
+W_NAME = 64
+W_PATH = 96
+W_EXT = 12
+W_DATE = 40
+
+#: sentinel for NULL in integer filter columns (no real value collides:
+#: ids/sizes/kinds/locations are non-negative, hidden/favorite are 0/1)
+NULL_I = -1
+
+_GROW = 4096  # minimum capacity step (one Pallas tile of rows)
+
+
+@dataclasses.dataclass(frozen=True)
+class Predicate:
+    """A normalized, index-answerable ``search.paths`` filter set."""
+
+    location: int | None = None
+    needle: bytes | None = None          # folded LIKE-substring needle
+    exts: tuple[bytes, ...] | None = None
+    kinds: tuple[int, ...] | None = None
+    favorite: int | None = None
+    exclude_hidden: bool = False
+    path: bytes | None = None            # materialized_path equality
+    date_lo: bytes | None = None
+    date_hi: bytes | None = None
+    size_lo: int | None = None
+    size_hi: int | None = None
+
+
+def parse_predicate(arg: dict[str, Any]) -> tuple[Predicate | None, str]:
+    """(predicate, "") when the index can answer this filter set
+    bit-exactly, else (None, reason). Coercions mirror
+    api/routers/search.py `_path_filters` EXACTLY — any divergence is a
+    byte-identity bug, so prefer returning None over approximating."""
+    if arg.get("tags"):
+        return None, "tags"  # subquery over tag_on_object — SQLite's
+    pred: dict[str, Any] = {}
+    if arg.get("location_id") is not None:
+        v = arg["location_id"]
+        if not isinstance(v, int) or isinstance(v, bool):
+            return None, "arg"
+        pred["location"] = v
+    if arg.get("search"):
+        # the SQL path binds f"%{search}%": stringified, % and _ live as
+        # LIKE wildcards there — wildcard semantics stay on SQLite
+        needle = fold(str(arg["search"]).encode("utf-8"))
+        if (b"%" in needle or b"_" in needle or b"\x00" in needle
+                or not 1 <= len(needle) <= MAX_NEEDLE):
+            return None, "needle"
+        pred["needle"] = needle
+    if arg.get("extensions"):
+        try:
+            exts = tuple(e.lstrip(".").lower().encode("utf-8")
+                         for e in arg["extensions"])
+        except AttributeError:
+            return None, "arg"
+        if any(b"\x00" in e for e in exts):
+            return None, "arg"
+        pred["exts"] = exts
+    if arg.get("kinds"):
+        kinds = tuple(arg["kinds"])
+        if not all(isinstance(k, int) and not isinstance(k, bool)
+                   for k in kinds):
+            return None, "arg"
+        pred["kinds"] = kinds
+    if arg.get("favorite") is not None:
+        try:
+            pred["favorite"] = int(arg["favorite"])
+        except (TypeError, ValueError):
+            return None, "arg"
+    if not arg.get("include_hidden"):
+        pred["exclude_hidden"] = True
+    if arg.get("materialized_path"):
+        v = arg["materialized_path"]
+        if not isinstance(v, str):
+            return None, "arg"
+        pred["path"] = v.encode("utf-8")
+    if arg.get("date_range"):
+        rng = arg["date_range"]
+        if not isinstance(rng, (list, tuple)) or len(rng) != 2:
+            return None, "arg"
+        for key, bound in zip(("date_lo", "date_hi"), rng):
+            if bound is None:
+                continue
+            if not isinstance(bound, str):
+                return None, "arg"
+            raw = bound.encode("utf-8")
+            if len(raw) > W_DATE or b"\x00" in raw:
+                return None, "arg"
+            pred[key] = raw
+    if arg.get("size_range"):
+        rng = arg["size_range"]
+        if not isinstance(rng, (list, tuple)) or len(rng) != 2:
+            return None, "arg"
+        for key, bound in zip(("size_lo", "size_hi"), rng):
+            if bound is None:
+                continue
+            if not isinstance(bound, int) or isinstance(bound, bool):
+                return None, "arg"
+            pred[key] = bound
+    return Predicate(**pred), ""
+
+
+def match_row(fields: dict[str, Any], pred: Predicate) -> bool:
+    """Pure-Python row matcher with the SQL path's exact semantics — the
+    overflow-row patch and the parity oracle tests compare every engine
+    against."""
+    if pred.location is not None and fields.get("location_id") != pred.location:
+        return False
+    if pred.exclude_hidden:
+        hidden = fields.get("hidden")
+        if not (hidden is None or not hidden):
+            return False
+    if pred.needle is not None:
+        name = fields.get("name")
+        if name is None or pred.needle not in fold(name.encode("utf-8")):
+            return False
+    if pred.exts is not None:
+        ext = fields.get("extension")
+        if ext is None or ext.encode("utf-8") not in pred.exts:
+            return False
+    if pred.path is not None:
+        path = fields.get("materialized_path")
+        if path is None or path.encode("utf-8") != pred.path:
+            return False
+    if pred.kinds is not None:
+        kind = fields.get("kind")
+        if kind is None or kind not in pred.kinds:
+            return False
+    if pred.favorite is not None:
+        fav = fields.get("favorite")
+        if fav is None or int(fav) != pred.favorite:
+            return False
+    if pred.date_lo is not None or pred.date_hi is not None:
+        date = fields.get("date_created")
+        if date is None:
+            return False
+        raw = str(date).encode("utf-8")
+        if pred.date_lo is not None and raw < pred.date_lo:
+            return False
+        if pred.date_hi is not None and raw > pred.date_hi:
+            return False
+    if pred.size_lo is not None or pred.size_hi is not None:
+        size = fields.get("size_in_bytes")
+        if size is None:
+            return False
+        if pred.size_lo is not None and size < pred.size_lo:
+            return False
+        if pred.size_hi is not None and size > pred.size_hi:
+            return False
+    return True
+
+
+#: the loader SELECT every build/refresh path uses (LEFT JOIN pulls the
+#: object-side filter columns; decode stays cheap — raw sqlite3.Row)
+LOADER_SQL = (
+    "SELECT fp.id AS id, fp.name AS name, fp.extension AS extension, "
+    "fp.materialized_path AS materialized_path, "
+    "fp.location_id AS location_id, fp.hidden AS hidden, "
+    "fp.size_in_bytes AS size_in_bytes, fp.date_created AS date_created, "
+    "o.kind AS kind, o.favorite AS favorite "
+    "FROM file_path fp LEFT JOIN object o ON fp.object_id = o.id")
+
+
+def _text_bytes(value: Any) -> bytes | None:
+    if value is None:
+        return None
+    return str(value).encode("utf-8")
+
+
+class ColumnarIndex:
+    """The numpy master copy (the CPU engine reads it directly)."""
+
+    def __init__(self) -> None:
+        self.n = 0
+        self.cap = 0
+        self.ids = np.empty(0, dtype=np.int64)
+        self.alive = np.empty(0, dtype=bool)
+        self.name_planes = np.empty((W_NAME, 0), dtype=np.uint8)
+        self.name_len = np.empty(0, dtype=np.int32)
+        self.path_planes = np.empty((W_PATH, 0), dtype=np.uint8)
+        self.path_len = np.empty(0, dtype=np.int32)
+        self.ext_planes = np.empty((W_EXT, 0), dtype=np.uint8)
+        self.ext_len = np.empty(0, dtype=np.int32)
+        self.date_planes = np.empty((W_DATE, 0), dtype=np.uint8)
+        self.date_len = np.empty(0, dtype=np.int32)
+        self.location = np.empty(0, dtype=np.int64)
+        self.hidden = np.empty(0, dtype=np.int8)
+        self.kind = np.empty(0, dtype=np.int32)
+        self.favorite = np.empty(0, dtype=np.int8)
+        self.size = np.empty(0, dtype=np.int64)
+        self.bits = np.empty((0, 32), dtype=np.uint8)
+        #: id -> full decoded fields for rows a fixed width truncated
+        self.overflow: dict[int, dict[str, Any]] = {}
+        #: monotonically bumped on every mutation — the DeviceMirror
+        #: resyncs (incrementally) when its generation falls behind
+        self.generation = 0
+        self._delta_slots: list[int] = []
+
+    # -- capacity ------------------------------------------------------------
+    def _ensure_cap(self, extra: int) -> None:
+        need = self.n + extra
+        if need <= self.cap:
+            return
+        new_cap = max(_GROW, self.cap * 2)
+        while new_cap < need:
+            new_cap *= 2
+
+        def grow1(arr, fill=0):
+            out = np.full(new_cap, fill, dtype=arr.dtype)
+            out[: self.n] = arr[: self.n]
+            return out
+
+        def grow2(arr):
+            out = np.zeros((arr.shape[0], new_cap), dtype=np.uint8)
+            out[:, : self.n] = arr[:, : self.n]
+            return out
+
+        self.ids = grow1(self.ids)
+        self.alive = grow1(self.alive, fill=False)
+        self.name_planes = grow2(self.name_planes)
+        self.name_len = grow1(self.name_len)
+        self.path_planes = grow2(self.path_planes)
+        self.path_len = grow1(self.path_len)
+        self.ext_planes = grow2(self.ext_planes)
+        self.ext_len = grow1(self.ext_len)
+        self.date_planes = grow2(self.date_planes)
+        self.date_len = grow1(self.date_len)
+        self.location = grow1(self.location)
+        self.hidden = grow1(self.hidden)
+        self.kind = grow1(self.kind)
+        self.favorite = grow1(self.favorite)
+        self.size = grow1(self.size)
+        bits = np.zeros((new_cap, 32), dtype=np.uint8)
+        bits[: self.n] = self.bits[: self.n]
+        self.bits = bits
+        self.cap = new_cap
+        #: capacity change invalidates every mirror slice — full resync
+        self._delta_slots = None  # type: ignore[assignment]
+
+    # -- row encode ----------------------------------------------------------
+    def _write_plane(self, planes: np.ndarray, lens: np.ndarray,
+                     slot: int, raw: bytes | None) -> bool:
+        """Returns True when the value overflowed its plane width."""
+        width = planes.shape[0]
+        planes[:, slot] = 0
+        if raw is None:
+            lens[slot] = NULL_I
+            return False
+        clipped = raw[:width]
+        if clipped:
+            planes[: len(clipped), slot] = np.frombuffer(
+                clipped, dtype=np.uint8)
+        lens[slot] = len(raw)
+        return len(raw) > width
+
+    def _write_row(self, slot: int, row: Any, bitmap: bool = True) -> None:
+        fields = {k: row[k] for k in row.keys()} if not isinstance(row, dict) \
+            else row
+        self.ids[slot] = fields["id"]
+        self.alive[slot] = True
+        name_raw = _text_bytes(fields.get("name"))
+        over = self._write_plane(self.name_planes, self.name_len, slot,
+                                 None if name_raw is None
+                                 else fold(name_raw))
+        over |= self._write_plane(self.path_planes, self.path_len, slot,
+                                  _text_bytes(fields.get("materialized_path")))
+        over |= self._write_plane(self.ext_planes, self.ext_len, slot,
+                                  _text_bytes(fields.get("extension")))
+        over |= self._write_plane(self.date_planes, self.date_len, slot,
+                                  _text_bytes(fields.get("date_created")))
+        loc = fields.get("location_id")
+        self.location[slot] = NULL_I if loc is None else loc
+        hidden = fields.get("hidden")
+        self.hidden[slot] = NULL_I if hidden is None else int(bool(hidden))
+        kind = fields.get("kind")
+        self.kind[slot] = NULL_I if kind is None else kind
+        fav = fields.get("favorite")
+        self.favorite[slot] = NULL_I if fav is None else int(bool(fav))
+        size = fields.get("size_in_bytes")
+        self.size[slot] = NULL_I if size is None else size
+        row_id = int(fields["id"])
+        if over:
+            self.overflow[row_id] = {
+                "name": fields.get("name"),
+                "extension": fields.get("extension"),
+                "materialized_path": fields.get("materialized_path"),
+                "date_created": fields.get("date_created"),
+                "location_id": loc, "hidden": hidden, "kind": kind,
+                "favorite": fav, "size_in_bytes": size,
+            }
+        else:
+            self.overflow.pop(row_id, None)
+        if bitmap:
+            # per-row presence bitmap for incremental updates; bulk build
+            # overwrites with the vectorized pass instead
+            self.bits[slot] = kernels.presence_bitmap(
+                self.name_planes[:, slot: slot + 1],
+                self.name_len[slot: slot + 1])[0]
+
+    def _note_delta(self, slot: int) -> None:
+        self.generation += 1
+        if self._delta_slots is not None:
+            self._delta_slots.append(slot)
+            if len(self._delta_slots) > 4096:
+                self._delta_slots = None  # type: ignore[assignment]
+
+    # -- bulk build ----------------------------------------------------------
+    def build(self, rows: Iterable[Any]) -> None:
+        rows = list(rows)
+        self.n = 0
+        self.cap = 0
+        self.overflow.clear()
+        self.ids = np.empty(0, dtype=np.int64)  # force regrow
+        self._ensure_cap(max(len(rows), 1))
+        for i, row in enumerate(rows):
+            self._write_row(i, row, bitmap=False)
+        self.n = len(rows)
+        # bulk bitmap (the per-row writes above already set it, but the
+        # vectorized pass is ~10x faster at build scale — overwrite)
+        if self.n:
+            self.bits[: self.n] = kernels.presence_bitmap(
+                self.name_planes[:, : self.n], self.name_len[: self.n])
+        self.generation += 1
+        self._delta_slots = None  # type: ignore[assignment]
+
+    # -- incremental ---------------------------------------------------------
+    def slot_of(self, row_id: int) -> int | None:
+        i = int(np.searchsorted(self.ids[: self.n], row_id))
+        if i < self.n and self.ids[i] == row_id:
+            return i
+        return None
+
+    @property
+    def max_id(self) -> int:
+        return int(self.ids[self.n - 1]) if self.n else 0
+
+    @property
+    def alive_count(self) -> int:
+        return int(self.alive[: self.n].sum())
+
+    def upsert(self, row: Any) -> bool:
+        """Update in place or append; False = the row's id is below
+        ``max_id`` but unknown (an explicit-id insert the sorted-append
+        invariant cannot absorb — the caller full-rebuilds)."""
+        row_id = int(row["id"])
+        slot = self.slot_of(row_id)
+        if slot is None:
+            if row_id <= self.max_id:
+                return False
+            self._ensure_cap(1)
+            slot = self.n
+            self.n += 1
+        self._write_row(slot, row)
+        self._note_delta(slot)
+        return True
+
+    def delete_id(self, row_id: int) -> None:
+        slot = self.slot_of(row_id)
+        if slot is not None and self.alive[slot]:
+            self.alive[slot] = False
+            self.overflow.pop(row_id, None)
+            self._note_delta(slot)
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in (
+            self.ids, self.alive, self.name_planes, self.name_len,
+            self.path_planes, self.path_len, self.ext_planes, self.ext_len,
+            self.date_planes, self.date_len, self.location, self.hidden,
+            self.kind, self.favorite, self.size, self.bits))
+
+    def consume_delta(self) -> list[int] | None:
+        """Changed slots since the last call (None = resync everything);
+        the DeviceMirror's incremental-update feed."""
+        delta = self._delta_slots
+        self._delta_slots = []
+        return delta
+
+
+class DeviceMirror:
+    """jnp copies of the scorable columns, resident on the accelerator
+    and patched incrementally (``.at[].set`` scatters) from the master's
+    delta feed — queries never pay a host→device transfer of the index."""
+
+    def __init__(self) -> None:
+        self.generation = -1
+        self.cap = 0
+        self.arrays: dict[str, Any] = {}
+
+    def sync(self, idx: ColumnarIndex) -> None:
+        import jax.numpy as jnp
+
+        if self.generation == idx.generation and self.cap:
+            idx.consume_delta()  # stay drained
+            return
+        delta = idx.consume_delta()
+        dev_cap = kernels.pad_cap(max(idx.n, 1))
+        if delta is None or dev_cap != self.cap or not self.arrays:
+            self.cap = dev_cap
+
+            def pad2(planes):
+                out = np.zeros((planes.shape[0], dev_cap), dtype=np.uint8)
+                out[:, : idx.n] = planes[:, : idx.n]
+                return jnp.asarray(out)
+
+            def pad1(arr, fill):
+                out = np.full(dev_cap, fill, dtype=arr.dtype)
+                out[: idx.n] = arr[: idx.n]
+                return jnp.asarray(out)
+
+            self.arrays = {
+                "name": pad2(idx.name_planes),
+                "path": pad2(idx.path_planes),
+                "ext": pad2(idx.ext_planes),
+                "date": pad2(idx.date_planes),
+                "name_len": pad1(idx.name_len, NULL_I),
+                "path_len": pad1(idx.path_len, NULL_I),
+                "ext_len": pad1(idx.ext_len, NULL_I),
+                "date_len": pad1(idx.date_len, NULL_I),
+                "location": pad1(idx.location, NULL_I),
+                "hidden": pad1(idx.hidden, NULL_I),
+                "kind": pad1(idx.kind, NULL_I),
+                "favorite": pad1(idx.favorite, NULL_I),
+                "size": pad1(idx.size, NULL_I),
+                "alive": pad1(idx.alive, False),
+            }
+        elif delta:
+            slots = np.unique(np.asarray(delta, dtype=np.int64))
+            for key, planes, lens in (
+                    ("name", idx.name_planes, idx.name_len),
+                    ("path", idx.path_planes, idx.path_len),
+                    ("ext", idx.ext_planes, idx.ext_len),
+                    ("date", idx.date_planes, idx.date_len)):
+                self.arrays[key] = self.arrays[key].at[:, slots].set(
+                    jnp.asarray(planes[:, slots]))
+                self.arrays[f"{key}_len"] = \
+                    self.arrays[f"{key}_len"].at[slots].set(
+                        jnp.asarray(lens[slots]))
+            for key, col in (("location", idx.location),
+                             ("hidden", idx.hidden), ("kind", idx.kind),
+                             ("favorite", idx.favorite), ("size", idx.size),
+                             ("alive", idx.alive)):
+                self.arrays[key] = self.arrays[key].at[slots].set(
+                    jnp.asarray(col[slots]))
+        self.generation = idx.generation
+
+
+# ---------------------------------------------------------------------------
+# mask evaluation — one numpy engine, one device engine, same answers
+# ---------------------------------------------------------------------------
+
+
+def eval_mask_cpu(idx: ColumnarIndex, pred: Predicate) -> np.ndarray:
+    """(n,) bool over the master arrays (prescreened exact matching)."""
+    n = idx.n
+    m = idx.alive[:n].copy()
+    # negative filter values would collide with the NULL sentinel (−1):
+    # SQL `col = -1` matches nothing (no stored negatives), so mirror that
+    if pred.location is not None:
+        m &= (idx.location[:n] == pred.location) if pred.location >= 0 \
+            else np.zeros(n, dtype=bool)
+    if pred.exclude_hidden:
+        m &= idx.hidden[:n] <= 0
+    if pred.kinds is not None:
+        kinds = [k for k in pred.kinds if k >= 0]
+        m &= np.isin(idx.kind[:n], np.asarray(kinds, dtype=np.int64)) \
+            if kinds else np.zeros(n, dtype=bool)
+    if pred.favorite is not None:
+        m &= (idx.favorite[:n] == pred.favorite) if pred.favorite >= 0 \
+            else np.zeros(n, dtype=bool)
+    if pred.size_lo is not None:
+        m &= (idx.size[:n] >= 0) & (idx.size[:n] >= pred.size_lo)
+    if pred.size_hi is not None:
+        m &= (idx.size[:n] >= 0) & (idx.size[:n] <= pred.size_hi)
+    if pred.exts is not None:
+        ext_m = np.zeros(n, dtype=bool)
+        for needle in pred.exts:
+            ext_m |= (kernels.exact_np(idx.ext_planes[:, :n], needle)
+                      & (idx.ext_len[:n] == len(needle)))
+        m &= ext_m
+    if pred.path is not None:
+        m &= (kernels.exact_np(idx.path_planes[:, :n], pred.path)
+              & (idx.path_len[:n] == len(pred.path)))
+    if pred.date_lo is not None or pred.date_hi is not None:
+        valid = idx.date_len[:n] >= 0
+        if pred.date_lo is not None:
+            m &= valid & (kernels.lex_cmp_np(idx.date_planes[:, :n],
+                                             pred.date_lo) >= 0)
+        if pred.date_hi is not None:
+            m &= valid & (kernels.lex_cmp_np(idx.date_planes[:, :n],
+                                             pred.date_hi) <= 0)
+    if pred.needle is not None:
+        cand = m & kernels.prescreen_np(idx.bits[:n], pred.needle)
+        sub_idx = np.flatnonzero(cand)
+        sub_m = np.zeros(n, dtype=bool)
+        if sub_idx.size:
+            sub = np.ascontiguousarray(idx.name_planes[:, sub_idx])
+            sub_m[sub_idx] = kernels.substring_np(sub, pred.needle)
+        m &= sub_m
+    _patch_overflow(idx, pred, m)
+    return m
+
+
+def eval_mask_device(idx: ColumnarIndex, mirror: DeviceMirror,
+                     pred: Predicate, kernel: str) -> np.ndarray:
+    """(n,) bool via the resident jnp arrays + the selected kernel —
+    byte-identical to :func:`eval_mask_cpu` (tests/test_search.py)."""
+    import jax.numpy as jnp
+
+    mirror.sync(idx)
+    arr = mirror.arrays
+    m = np.asarray(arr["alive"]).astype(bool)
+    if pred.location is not None:
+        m &= np.asarray(arr["location"] == pred.location) \
+            if pred.location >= 0 else False
+    if pred.exclude_hidden:
+        m &= np.asarray(arr["hidden"] <= 0)
+    if pred.kinds is not None:
+        kinds = [k for k in pred.kinds if k >= 0]
+        m &= np.asarray(jnp.isin(
+            arr["kind"], jnp.asarray(kinds, dtype=jnp.int32))) \
+            if kinds else False
+    if pred.favorite is not None:
+        m &= np.asarray(arr["favorite"] == pred.favorite) \
+            if pred.favorite >= 0 else False
+    if pred.size_lo is not None:
+        m &= np.asarray((arr["size"] >= 0) & (arr["size"] >= pred.size_lo))
+    if pred.size_hi is not None:
+        m &= np.asarray((arr["size"] >= 0) & (arr["size"] <= pred.size_hi))
+    if pred.exts is not None:
+        ext_m = np.zeros(mirror.cap, dtype=bool)
+        ext_len = np.asarray(arr["ext_len"])
+        for needle in pred.exts:
+            ext_m |= (kernels.exact_jnp(arr["ext"], needle, kernel)
+                      & (ext_len == len(needle)))
+        m &= ext_m
+    if pred.path is not None:
+        m &= (kernels.exact_jnp(arr["path"], pred.path, kernel)
+              & (np.asarray(arr["path_len"]) == len(pred.path)))
+    if pred.date_lo is not None or pred.date_hi is not None:
+        valid = np.asarray(arr["date_len"]) >= 0
+        if pred.date_lo is not None:
+            m &= valid & (kernels.lex_cmp_jnp(arr["date"], pred.date_lo,
+                                              kernel) >= 0)
+        if pred.date_hi is not None:
+            m &= valid & (kernels.lex_cmp_jnp(arr["date"], pred.date_hi,
+                                              kernel) <= 0)
+    if pred.needle is not None:
+        m &= kernels.substring_jnp(arr["name"], pred.needle, kernel)
+    m = m[: idx.n]
+    _patch_overflow(idx, pred, m)
+    return m
+
+
+def _patch_overflow(idx: ColumnarIndex, pred: Predicate,
+                    m: np.ndarray) -> None:
+    """Re-decide every truncated row host-side against the full values —
+    plane scoring may miss (a substring spanning the cut) or over-match
+    (an exact prefix) there; the Python oracle is authoritative."""
+    for row_id, fields in idx.overflow.items():
+        slot = idx.slot_of(row_id)
+        if slot is not None and slot < m.shape[0] and idx.alive[slot]:
+            m[slot] = match_row(fields, pred)

@@ -1,0 +1,591 @@
+"""SearchEngine: the device-resident query engine (ISSUE 15 tentpole).
+
+Turns the TPU from a scan-time tool into a serve-time one: ``search.paths``
+and ``search.pathsCount`` queries are answered from a per-library
+:class:`~.columnar.ColumnarIndex` scored by batched JAX/Pallas kernels
+instead of a SQL LIKE table scan — the "GPUs as Storage System
+Accelerators" framing (PAPERS.md, arxiv 1202.3669) applied to the query
+tier, with SEDD's batched-scan discipline (arxiv 2501.01046) shaping the
+kernels.
+
+Correctness ladder (SQLite stays the oracle at every rung):
+
+1. **Eligibility** — :func:`~.columnar.parse_predicate` accepts only
+   filter sets the index answers bit-exactly; wildcards, tag subqueries
+   and over-long needles stay on SQLite.
+2. **Freshness** — the engine mirrors the PR 11 reader-pool watermark
+   protocol: the same synchronous ``db.commit`` / ``invalidate_query``
+   bus hooks bump a per-library ``pending`` counter, a refresh stamps the
+   index with the watermark it read under, and a query is served from the
+   index ONLY when the two are equal. A post-commit query can therefore
+   never see pre-watermark rows — while a refresh is in flight the query
+   falls back to SQLite.
+3. **Scoring** — the per-query backend (device jnp/Pallas vs CPU numpy)
+   is picked by the PR 6 :class:`~..objects.hasher.BackendRouter` (EWMA
+   transfer-inclusive rates, hysteresis, periodic exploration) publishing
+   ``sd_search_router_*``; a wedged device dispatch is deadline-bounded,
+   degrades the route to CPU, and a CPU failure falls back to SQLite.
+4. **Hydration** — the engine returns ROW IDS only; the router handler
+   re-runs the exact SQL SELECT over ``fp.id IN (...)`` so ORDER BY /
+   LIMIT / cursor semantics reproduce the SQL path byte-for-byte.
+
+Refresh is **incremental**: appends ride an ``id > max_id`` scan
+(AUTOINCREMENT ids are monotonic), updates/deletes ride the
+:class:`~..models.base.RowJournal` change feed (model-helper writes note
+their row; raw writes flood → full rebuild), and a COUNT(*) verify
+catches anything that slipped past both (FK cascades into file_path).
+
+``SD_SEARCH_ENGINE=device`` arms the engine (default ``sqlite`` keeps
+every query on the SQL path); ``sd_search_*`` telemetry is catalogued in
+docs/architecture/observability.md (drift-gated).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import TYPE_CHECKING, Any
+
+import numpy as np
+
+from .. import telemetry
+from ..objects.hasher import BackendRouter, _bounded_call
+from ..utils import env_float as _env_float
+from ..utils import env_int as _env_int
+from ..utils.locks import SdLock
+from . import columnar
+from .columnar import ColumnarIndex, DeviceMirror, Predicate, parse_predicate
+from .kernels import resolve_kernel
+
+if TYPE_CHECKING:
+    from ..library import Library
+    from ..node import Node
+
+logger = logging.getLogger(__name__)
+
+#: the reader-pool watermark bump set (server/pool.py BUMP_KINDS) — one
+#: protocol, two consumers; conservative by design (over-bumping costs a
+#: refresh, under-bumping would serve stale rows)
+BUMP_KINDS = frozenset({"db.commit", "invalidate_query", "sync.newMessage",
+                        "job_progress"})
+#: DB-file swap (backup restore / repair): the whole index is void
+RELOAD_KINDS = frozenset({"library.reload"})
+
+#: procedures the engine can serve
+ENGINE_PROCS = frozenset({"search.paths", "search.pathsCount"})
+
+#: the per-row scan footprint the router's transfer-inclusive EWMA is fed
+#: (plane widths + filter columns) — consistent across engines, which is
+#: all a relative rate needs
+ROW_BYTES = (columnar.W_NAME + columnar.W_PATH + columnar.W_EXT
+             + columnar.W_DATE + 8 * 5 + 32)
+
+# module handles — help text lives in telemetry._declare_core (the single
+# copy); these are get-or-create lookups, the server/pool.py pattern
+_INDEX_ROWS = telemetry.gauge("sd_search_index_rows", labels=("library",))
+_INDEX_BYTES = telemetry.gauge("sd_search_index_bytes", labels=("library",))
+_REFRESH_SECONDS = telemetry.histogram("sd_search_refresh_seconds")
+_REFRESH_TOTAL = telemetry.counter("sd_search_refresh_total",
+                                   labels=("kind",))
+_REFRESH_LAG = telemetry.gauge("sd_search_refresh_lag", labels=("library",))
+_QUERIES = telemetry.counter("sd_search_queries_total", labels=("backend",))
+_QUERY_SECONDS = telemetry.histogram("sd_search_query_seconds",
+                                     labels=("backend",))
+_FALLBACKS = telemetry.counter("sd_search_fallbacks_total",
+                               labels=("reason",))
+_ROUTER_FLIPS = telemetry.counter("sd_search_router_flips_total")
+_ROUTER_BATCHES = telemetry.counter("sd_search_router_batches_total",
+                                    labels=("backend",))
+_ROUTER_BPS = telemetry.gauge("sd_search_router_bytes_per_sec",
+                              labels=("backend",))
+
+
+class _LibState:
+    """Per-library index + watermark state (all mutation under ``lock``)."""
+
+    __slots__ = ("lib_id", "lock", "wm_lock", "refresh_lock", "index",
+                 "mirror", "journal", "pending", "built_wm", "epoch",
+                 "built_epoch")
+
+    def __init__(self, lib_id: str, journal) -> None:
+        self.lib_id = lib_id
+        # one name for every instance: same-role per-library locks must
+        # not register order edges against each other (utils/locks.py
+        # skips same-name edges)
+        self.lock = SdLock("search.engine.lib")
+        # watermark fields get their own tiny lock so the SYNCHRONOUS
+        # post-commit bump hook never waits behind a scoring pass or a
+        # refresh holding ``lock`` — the committing thread must pay a
+        # dict-update, not a 40 ms predicate scan. Nesting order where
+        # both are held: lock → wm_lock.
+        self.wm_lock = SdLock("search.engine.wm")
+        # serializes whole refresh passes (refresher thread vs a
+        # synchronous refresh_now): two interleaved passes could drain
+        # the journal in one and stamp freshness from the other — an
+        # empty incremental pass would then mark the index fresh while
+        # the flood rebuild is still in flight
+        self.refresh_lock = SdLock("search.engine.refresh")
+        self.index: ColumnarIndex | None = None
+        self.mirror = DeviceMirror()
+        self.journal = journal
+        self.pending = 0       # bumped by the bus hook, post-commit
+        self.built_wm = -1     # pending value the index was built under
+        self.epoch = 0         # bumped on library.reload (file swap)
+        self.built_epoch = 0
+
+    def fresh(self) -> bool:
+        """Watermark equality under ``wm_lock`` only — safe to call with
+        or without ``lock`` held (lock → wm_lock nesting order)."""
+        with self.wm_lock:
+            return (self.index is not None
+                    and self.built_wm == self.pending
+                    and self.built_epoch == self.epoch)
+
+
+class SearchEngine:
+    """One per Node (``node.search_engine``); None when the gate is off."""
+
+    def __init__(self, node: "Node") -> None:
+        self.node = node
+        self.enabled = True
+        self.max_hydrate = _env_int("SD_SEARCH_MAX_HYDRATE", 20_000)
+        self.device_deadline_s = _env_float("SD_SEARCH_DEVICE_TIMEOUT_S",
+                                            10.0)
+        self._states: dict[str, _LibState] = {}
+        self._states_lock = SdLock("search.engine.states")
+        #: filter signatures whose candidate set exceeded max_hydrate —
+        #: those dispatches should keep going to the reader pool instead
+        #: of being pulled in-process only to score, overflow and run
+        #: their (heaviest) SQL scan on the node. Bounded; insertion-
+        #: order evicted. A predicate that later turns selective stays
+        #: pooled — correct, merely without the device win.
+        self._toolarge: dict[str, None] = {}
+        self.router = BackendRouter(
+            flips_counter=_ROUTER_FLIPS, batches_counter=_ROUTER_BATCHES,
+            bps_gauge=_ROUTER_BPS, event_prefix="search_router")
+        self._served = {"device": 0, "cpu": 0}
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        node.events.on(self._on_event)
+        self._refresher_thread = threading.Thread(
+            target=self._refresher, name="sd-search-refresher", daemon=True)
+        self._refresher_thread.start()
+
+    @classmethod
+    def maybe_start(cls, node: "Node") -> "SearchEngine | None":
+        """``SD_SEARCH_ENGINE=sqlite|device`` — default sqlite (the gate)."""
+        gate = os.environ.get("SD_SEARCH_ENGINE", "sqlite").strip().lower()
+        if gate != "device":
+            return None
+        return cls(node)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._wake.set()
+        try:
+            self.node.events.off(self._on_event)
+        except Exception:
+            pass
+        self._refresher_thread.join(timeout=5)
+
+    def set_enabled(self, value: bool) -> None:
+        """Runtime bypass (the search bench's engine-vs-SQLite A/B):
+        disabled, every lookup returns None and the handler serves SQL."""
+        self.enabled = bool(value)
+
+    # -- invalidation (the reader-pool protocol, second consumer) ------------
+    def _on_event(self, event) -> None:
+        lib_id = getattr(event, "library_id", None)
+        if not lib_id:
+            return
+        state = self._states.get(lib_id)
+        if state is None:
+            return
+        if event.kind in RELOAD_KINDS:
+            with state.wm_lock:
+                state.epoch += 1
+                state.pending += 1
+            self._wake.set()
+        elif event.kind in BUMP_KINDS:
+            with state.wm_lock:
+                state.pending += 1
+            self._wake.set()
+
+    # -- registration --------------------------------------------------------
+    def _ensure(self, library: "Library") -> _LibState:
+        state = self._states.get(library.id)
+        if state is not None:
+            return state
+        with self._states_lock:
+            state = self._states.get(library.id)
+            if state is None:
+                journal = library.db.attach_row_journal(
+                    ("file_path", "object"), flood_on_delete=("object",))
+                state = _LibState(library.id, journal)
+                self._states[library.id] = state
+                self._wake.set()  # kick the initial build
+        return state
+
+    def ensure_library(self, library: "Library") -> None:
+        self._ensure(library)
+
+    # -- dispatch-time routing (api/router.resolve pool bypass) --------------
+    def prefers_inprocess(self, key: str, library_id: str | None,
+                          arg: Any) -> bool:
+        """True when this dispatch should skip the reader pool because the
+        in-process handler will serve it from the device index. Cheap:
+        one dict lookup + predicate parse, no scoring."""
+        if key not in ENGINE_PROCS or not self.enabled or not library_id:
+            return False
+        state = self._states.get(library_id)
+        if state is None:
+            # first sighting of this library: register it (builds in the
+            # background) and let the pool serve meanwhile
+            try:
+                self._ensure(self.node.libraries.get(library_id))
+            except Exception:
+                pass
+            return False
+        if not state.fresh():
+            return False
+        pred, _why = parse_predicate(arg or {})
+        if pred is None:
+            return False
+        if key == "search.paths":  # counts never hydrate — no size limit
+            sig = self._filter_sig(library_id, arg)
+            with self._states_lock:
+                if sig in self._toolarge:
+                    return False
+        return True
+
+    _FILTER_KEYS = ("location_id", "search", "extensions", "kinds",
+                    "favorite", "include_hidden", "materialized_path",
+                    "tags", "date_range", "size_range")
+
+    @classmethod
+    def _filter_sig(cls, lib_id: str | None, arg: Any) -> str:
+        arg = arg if isinstance(arg, dict) else {}
+        try:
+            return f"{lib_id}|" + json.dumps(
+                {k: arg.get(k) for k in cls._FILTER_KEYS},
+                sort_keys=True, default=str)
+        except (TypeError, ValueError):
+            return f"{lib_id}|?"
+
+    # -- the query surface ---------------------------------------------------
+    def count(self, library: "Library", arg: Any) -> int | None:
+        """search.pathsCount: the full answer (a mask sum), or None →
+        serve SQL."""
+        got = self._query(library, arg)
+        if got is None:
+            return None
+        mask, _ids = got
+        return int(mask.sum())
+
+    def candidate_ids(self, library: "Library",
+                      arg: Any) -> np.ndarray | None:
+        """search.paths: the EXACT matching row-id set for the filter
+        predicates (ordering/cursor/limit stay in SQL), or None → serve
+        SQL. Candidate sets past ``SD_SEARCH_MAX_HYDRATE`` fall back —
+        hydrating an unselective query through an IN-list would lose to
+        the plain scan it replaces."""
+        got = self._query(library, arg)
+        if got is None:
+            return None
+        _mask, ids = got
+        if ids is None or len(ids) > self.max_hydrate:
+            _FALLBACKS.inc(reason="toolarge")
+            with self._states_lock:
+                self._toolarge[self._filter_sig(library.id, arg)] = None
+                while len(self._toolarge) > 256:
+                    self._toolarge.pop(next(iter(self._toolarge)))
+            return None
+        return ids
+
+    def note_sqlite_serve(self, seconds: float) -> None:
+        """The handler served via SQL while the engine is armed — keep the
+        per-backend latency picture complete."""
+        _QUERIES.inc(backend="sqlite")
+        _QUERY_SECONDS.observe(seconds, backend="sqlite")
+
+    def _query(self, library: "Library",
+               arg: Any) -> tuple[np.ndarray, np.ndarray | None] | None:
+        if not self.enabled:
+            return None
+        pred, why = parse_predicate(arg or {})
+        if pred is None:
+            _FALLBACKS.inc(reason=why or "ineligible")
+            return None
+        state = self._ensure(library)
+        with state.lock:
+            if not state.fresh():
+                _FALLBACKS.inc(reason="stale")
+                self._wake.set()
+                return None
+            t0 = time.perf_counter()
+            main, probe = self.router.route()
+            mask = self._score(state, pred, main)
+            if mask is None and main == "device":
+                # degraded mid-query: the CPU engine is the same index
+                mask = self._score(state, pred, "cpu")
+                main = "cpu"
+            if mask is None:
+                _FALLBACKS.inc(reason="error")
+                return None
+            dt = time.perf_counter() - t0
+            n = state.index.n
+            self.router.observe(main, n * ROW_BYTES, max(dt, 1e-9))
+            _QUERIES.inc(backend=main)
+            _QUERY_SECONDS.observe(dt, backend=main)
+            with self._states_lock:  # int += is not atomic across threads
+                self._served[main] += 1
+            if probe is not None:
+                # exploration: re-run this query on the losing engine so
+                # its EWMA stays live (bounded to one query in EXPLORE_EVERY)
+                t1 = time.perf_counter()
+                if self._score(state, pred, probe) is not None:
+                    self.router.observe(probe, n * ROW_BYTES,
+                                        max(time.perf_counter() - t1, 1e-9))
+            ids = state.index.ids[: state.index.n][mask]
+        return mask, ids
+
+    def _score(self, state: _LibState, pred: Predicate,
+               backend: str) -> np.ndarray | None:
+        """One scoring dispatch; a device failure/timeout degrades the
+        route (bounded re-probe un-pins it later, the PR 6 discipline)."""
+        idx = state.index
+        if backend == "cpu":
+            try:
+                return columnar.eval_mask_cpu(idx, pred)
+            except Exception:
+                logger.exception("cpu search scoring failed")
+                return None
+        kernel = resolve_kernel()
+        status, res = _bounded_call(
+            lambda: columnar.eval_mask_device(idx, state.mirror, pred,
+                                              kernel),
+            self.device_deadline_s, "search-device-dispatch")
+        if status == "ok":
+            return res
+        why = repr(res) if status == "error" else \
+            "deadline exceeded (wedged device?)"
+        logger.warning("device search scoring failed (%s); routing CPU", why)
+        self.router.degrade(why)
+        return None
+
+    # -- refresh -------------------------------------------------------------
+    def refresh_now(self, library: "Library") -> None:
+        """Synchronous refresh to the current watermark (tests/bench)."""
+        state = self._ensure(library)
+        self._refresh_state(state)
+
+    def _refresher(self) -> None:
+        while not self._stopped.is_set():
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            if self._stopped.is_set():
+                return
+            for state in list(self._states.values()):
+                if state.fresh():
+                    continue
+                try:
+                    self._refresh_state(state)
+                except Exception:
+                    # a failed refresh leaves the index stale — queries
+                    # keep falling back to SQLite, the next bump retries
+                    logger.exception("search index refresh failed for %s",
+                                     state.lib_id)
+
+    def _refresh_state(self, state: _LibState) -> None:
+        """Bring the index up to the library's current watermark. SELECTs
+        run OUTSIDE the state lock (the reader connection serves them);
+        only the array mutation takes it. Loops until the watermark is
+        stable across a whole pass."""
+        with state.refresh_lock:
+            self._refresh_state_locked(state)
+
+    def _refresh_state_locked(self, state: _LibState) -> None:
+        for _ in range(64):  # watermark churn bound; stale is always safe
+            if self._stopped.is_set():
+                return
+            try:
+                library = self.node.libraries.get(state.lib_id)
+            except KeyError:
+                return  # unloaded: queries 404 before reaching the index
+            with state.lock:
+                with state.wm_lock:
+                    w0 = state.pending
+                    e0 = state.epoch
+                    built_epoch = state.built_epoch
+                idx = state.index
+                needs_full = idx is None or built_epoch != e0
+                max_id = idx.max_id if idx is not None else 0
+            t0 = time.perf_counter()
+            drained = state.journal.drain()
+            flood = bool(drained["flood"])
+            if needs_full or flood:
+                rows = library.db.query(
+                    columnar.LOADER_SQL + " ORDER BY fp.id")
+                with state.lock:
+                    with state.wm_lock:
+                        reloaded = state.epoch != e0
+                    if reloaded:
+                        continue  # reloaded mid-build: rebuild fresh
+                    new_idx = ColumnarIndex()
+                    new_idx.build(rows)
+                    state.index = new_idx
+                    with state.wm_lock:
+                        state.built_epoch = e0
+                        state.built_wm = w0
+                        done = state.pending == w0
+                    self._maybe_seed_router(state)
+                _REFRESH_TOTAL.inc(kind="full")
+            else:
+                dirty = self._resolve_dirty(library, drained)
+                if dirty is None:
+                    # unresolvable note (vanished pub_id): full next pass
+                    state.journal.publish_one("file_path", "flood", None)
+                    continue
+                fresh_rows = self._load_rows(library, dirty)
+                appends = library.db.query(
+                    columnar.LOADER_SQL + " WHERE fp.id > ? ORDER BY fp.id",
+                    [max_id])
+                total = library.db.query(
+                    "SELECT COUNT(*) n FROM file_path")[0]["n"]
+                with state.lock:
+                    with state.wm_lock:
+                        reloaded = state.epoch != e0
+                    if reloaded or state.index is not idx:
+                        continue
+                    ok = True
+                    found = set()
+                    for row in fresh_rows:
+                        found.add(int(row["id"]))
+                        ok = ok and idx.upsert(row)
+                    for row_id in dirty:
+                        if row_id not in found:
+                            idx.delete_id(row_id)
+                    for row in appends:
+                        ok = ok and idx.upsert(row)
+                    ok = ok and idx.alive_count == total
+                    if ok:
+                        with state.wm_lock:
+                            state.built_epoch = e0
+                            state.built_wm = w0
+                            done = state.pending == w0
+                if not ok:
+                    # out-of-order insert or an untracked cascade into
+                    # file_path (e.g. a location CASCADE delete): rebuild
+                    state.journal.publish_one("file_path", "flood", None)
+                    continue
+                _REFRESH_TOTAL.inc(kind="incremental")
+            _REFRESH_SECONDS.observe(time.perf_counter() - t0)
+            self._publish_gauges(state)
+            if done:
+                return
+
+    def _maybe_seed_router(self, state: _LibState) -> None:
+        """After the first full build (caller holds ``state.lock``): time
+        one matches-nothing substring scan on BOTH engines so the router
+        starts from measured rates instead of waiting an exploration
+        cycle to discover the device (the fused-probe discipline the
+        hash router is seeded with)."""
+        if self.router.cpu_bps is not None or state.index is None \
+                or state.index.n == 0:
+            return
+        probe = Predicate(needle=b"\x01\x01\x01")
+        nbytes = state.index.n * ROW_BYTES
+        for backend in ("cpu", "device"):
+            t0 = time.perf_counter()
+            if self._score(state, probe, backend) is not None:
+                self.router.observe(backend, nbytes,
+                                    max(time.perf_counter() - t0, 1e-9))
+
+    def _resolve_dirty(self, library: "Library",
+                       drained: dict[str, Any]) -> set[int] | None:
+        """Journal notes → the file_path row-id set to re-select; None
+        when a note cannot be resolved (forces a full rebuild)."""
+        dirty: set[int] = set(drained["ids"].get("file_path", ()))
+        fp_pubs = drained["pub_ids"].get("file_path", set())
+        if fp_pubs:
+            resolved = self._ids_for(
+                library, "SELECT id FROM file_path WHERE pub_id IN ({})",
+                sorted(fp_pubs))
+            if len(resolved) < len(fp_pubs):
+                return None  # a pub_id vanished: deletion we can't place
+            dirty |= resolved
+        obj_ids = drained["ids"].get("object", set())
+        if obj_ids:
+            dirty |= self._ids_for(
+                library,
+                "SELECT id FROM file_path WHERE object_id IN ({})",
+                sorted(obj_ids))
+        obj_pubs = drained["pub_ids"].get("object", set())
+        if obj_pubs:
+            dirty |= self._ids_for(
+                library,
+                "SELECT id FROM file_path WHERE object_id IN "
+                "(SELECT id FROM object WHERE pub_id IN ({}))",
+                sorted(obj_pubs))
+        return dirty
+
+    @staticmethod
+    def _ids_for(library: "Library", sql_tpl: str,
+                 values: list) -> set[int]:
+        out: set[int] = set()
+        for lo in range(0, len(values), 500):
+            chunk = values[lo: lo + 500]
+            marks = ",".join("?" for _ in chunk)
+            for row in library.db.query(sql_tpl.format(marks), chunk):
+                out.add(int(row["id"]))
+        return out
+
+    @staticmethod
+    def _load_rows(library: "Library", ids: set[int]) -> list:
+        rows: list = []
+        ordered = sorted(ids)
+        for lo in range(0, len(ordered), 500):
+            chunk = ordered[lo: lo + 500]
+            marks = ",".join("?" for _ in chunk)
+            rows.extend(library.db.query(
+                columnar.LOADER_SQL + f" WHERE fp.id IN ({marks})", chunk))
+        return rows
+
+    def _publish_gauges(self, state: _LibState) -> None:
+        label = state.lib_id[:8]
+        with state.lock:
+            idx = state.index
+            if idx is not None:
+                _INDEX_ROWS.set(idx.alive_count, library=label)
+                _INDEX_BYTES.set(idx.nbytes, library=label)
+            with state.wm_lock:
+                lag = max(0, state.pending - state.built_wm)
+        _REFRESH_LAG.set(lag, library=label)
+
+    # -- introspection -------------------------------------------------------
+    def status(self) -> dict[str, Any]:
+        libs = {}
+        for lib_id, state in list(self._states.items()):
+            with state.lock:
+                idx = state.index
+                with state.wm_lock:
+                    pending, built_wm = state.pending, state.built_wm
+                libs[lib_id] = {
+                    "rows": idx.alive_count if idx is not None else 0,
+                    "bytes": idx.nbytes if idx is not None else 0,
+                    "overflow_rows": len(idx.overflow) if idx else 0,
+                    "pending": pending,
+                    "built_wm": built_wm,
+                    "fresh": state.fresh(),
+                }
+        return {
+            "enabled": self.enabled,
+            "kernel": resolve_kernel(),
+            "backend": self.router.current,
+            "degraded": self.router.degraded,
+            "served": dict(self._served),
+            "libraries": libs,
+        }

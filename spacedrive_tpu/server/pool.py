@@ -56,6 +56,10 @@ from typing import TYPE_CHECKING, Any
 
 from .. import faults, telemetry
 from ..telemetry.registry import REQUEST_BUCKETS
+# knob parses that can never take the pool down (malformed → default);
+# hoisted to utils so the search engine shares one implementation
+from ..utils import env_float as _env_float
+from ..utils import env_int as _env_int
 from ..utils.locks import SdLock
 
 if TYPE_CHECKING:
@@ -108,21 +112,6 @@ def configured_workers() -> int:
     return min(4, os.cpu_count() or 1)
 
 
-def _env_float(name: str, default: float) -> float:
-    """Knob parse that can never take the pool down: a malformed value
-    degrades to the default (``configured_workers`` sets the precedent —
-    a typo'd knob must not abort Server.start or crash-loop a worker)."""
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
 
 
 # ---------------------------------------------------------------------------
@@ -260,6 +249,10 @@ class _ReaderNode:
         self.data_dir = Path(data_dir)
         self.libraries = _ReaderLibraries(self.data_dir / "libraries")
         self.reader_pool = None  # a worker never nests a pool
+        # the device search engine lives in the NODE process only (the
+        # router bypasses the pool for engine-served dispatches); inside
+        # a worker the search handlers see None and serve plain SQL
+        self.search_engine = None
 
 
 def _serve_one(runtime_node, router, cache: _PageCache, msg: dict) -> dict:

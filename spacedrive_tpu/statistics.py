@@ -5,11 +5,18 @@ update-on-query pattern of api/libraries.rs:47: counts come from the library
 DB, capacity from the volume the data dir lives on. Byte counters are stored
 as TEXT to match the reference's schema (u64-in-string workaround) even
 though SQLite INTEGER would hold them.
+
+Split (ISSUE 15 satellite, serve rung a): :func:`compute_statistics` is a
+PURE READER over ``(db, data_dir)`` — exactly the surface a serve-pool
+worker holds — so the ``libraries.statistics`` handler runs ``pool=True``
+under the worker-purity lint. :func:`update_statistics` (compute + persist
+the snapshot row) remains for write-capable callers.
 """
 
 from __future__ import annotations
 
 import os
+from pathlib import Path
 from typing import TYPE_CHECKING, Any
 
 from .models import Statistics, utc_now
@@ -19,8 +26,10 @@ if TYPE_CHECKING:
     from .library import Library
 
 
-def update_statistics(library: "Library") -> dict[str, Any]:
-    db = library.db
+def compute_statistics(db, data_dir: str | Path) -> dict[str, Any]:
+    """Read-only statistics over a library DB + the node data dir. Safe
+    on a serve-pool worker's ``Database(readonly=True)`` handle — no
+    write surface, no node backrefs."""
     total_objects = db.query("SELECT COUNT(*) n FROM object")[0]["n"]
     totals = db.query(
         "SELECT COALESCE(SUM(size_in_bytes),0) s FROM file_path WHERE is_dir=0")[0]["s"]
@@ -31,8 +40,8 @@ def update_statistics(library: "Library") -> dict[str, Any]:
         db_size = os.path.getsize(db.path)
     except OSError:
         db_size = 0
-    vol = volume_for_path(os.path.dirname(str(db.path))) or {}
-    row = {
+    vol = volume_for_path(str(data_dir)) or {}
+    return {
         "date_captured": utc_now(),
         "total_object_count": total_objects,
         "library_db_size": str(db_size),
@@ -40,8 +49,19 @@ def update_statistics(library: "Library") -> dict[str, Any]:
         "total_unique_bytes": str(unique),
         "total_bytes_capacity": str(vol.get("total_capacity", 0)),
         "total_bytes_free": str(vol.get("available_capacity", 0)),
-        "preview_media_bytes": str(_thumb_dir_size(library)),
+        "preview_media_bytes": str(_thumb_dir_size(data_dir)),
     }
+
+
+def update_statistics(library: "Library") -> dict[str, Any]:
+    """Compute + persist the Statistics snapshot row (write-capable
+    callers only — the pool-pure query path uses compute_statistics;
+    backups.do_backup persists an as-of snapshot through here)."""
+    node = library.node
+    data_dir = node.data_dir if node is not None \
+        else Path(os.path.dirname(str(library.db.path)))
+    row = compute_statistics(library.db, data_dir)
+    db = library.db
     existing = db.find(Statistics, limit=1)
     if existing:
         db.update(Statistics, {"id": existing[0]["id"]}, row)
@@ -51,11 +71,8 @@ def update_statistics(library: "Library") -> dict[str, Any]:
     return row
 
 
-def _thumb_dir_size(library: "Library") -> int:
-    node = library.node
-    if node is None:
-        return 0
-    thumb_dir = node.data_dir / "thumbnails"
+def _thumb_dir_size(data_dir: str | Path) -> int:
+    thumb_dir = Path(data_dir) / "thumbnails"
     total = 0
     if thumb_dir.is_dir():
         for dirpath, _dirs, files in os.walk(thumb_dir):

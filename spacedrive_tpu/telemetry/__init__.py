@@ -464,6 +464,46 @@ def _declare_core() -> None:
     gauge("sd_serve_workers", "live reader-pool worker processes")
     counter("sd_serve_invalidations_total",
             "per-library watermark bumps pushed to the worker page caches")
+    # device-resident query engine (ISSUE 15): columnar search index +
+    # per-query backend router + refresh machinery (search/engine.py
+    # holds the matching module handles). ``library`` labels are the
+    # 8-hex library-id prefix — bounded like the sync ``peer`` labels.
+    gauge("sd_search_index_rows",
+          "live FilePath rows in the columnar search index per library",
+          labels=("library",))
+    gauge("sd_search_index_bytes",
+          "resident bytes of the columnar search index per library",
+          labels=("library",))
+    histogram("sd_search_refresh_seconds",
+              "latency of one search-index refresh pass (full or "
+              "incremental)")
+    counter("sd_search_refresh_total",
+            "search-index refreshes by kind (full = rebuild, incremental "
+            "= journal-driven delta)", labels=("kind",))
+    gauge("sd_search_refresh_lag",
+          "watermark bumps the search index is behind the library "
+          "(0 = fresh; queries fall back to SQLite while > 0)",
+          labels=("library",))
+    counter("sd_search_queries_total",
+            "search queries served per backend (device = JAX/Pallas "
+            "kernels, cpu = numpy columnar, sqlite = the oracle path "
+            "while the engine is armed)", labels=("backend",))
+    histogram("sd_search_query_seconds",
+              "search predicate-scoring latency per backend",
+              labels=("backend",))
+    counter("sd_search_fallbacks_total",
+            "engine-armed queries that fell back to SQLite, by reason "
+            "(stale | tags | needle | arg | toolarge | error | "
+            "ineligible)", labels=("reason",))
+    counter("sd_search_router_flips_total",
+            "engine flips by the per-query search backend router "
+            "(hysteresis-damped, the PR 6 BackendRouter)")
+    counter("sd_search_router_batches_total",
+            "scoring dispatches the search router measured per backend",
+            labels=("backend",))
+    gauge("sd_search_router_bytes_per_sec",
+          "EWMA scan bytes/s per search backend (router input)",
+          labels=("backend",))
     # concurrency sanitizer (ISSUE 14): named-lock contention telemetry,
     # recorded only on SD_LOCK_SANITIZER=1 runs (disabled, SdLock returns
     # the bare threading primitive). ONE definition: utils/locks.py owns

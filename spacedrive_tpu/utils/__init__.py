@@ -1,1 +1,22 @@
 """Misc infrastructure: versioned-JSON migrator, version manager, helpers."""
+
+from __future__ import annotations
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    """Knob parse that can never take a subsystem down: a malformed value
+    degrades to the default (`server/pool.configured_workers` set the
+    precedent — a typo'd knob must not abort startup or crash-loop)."""
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default

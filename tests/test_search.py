@@ -1,0 +1,516 @@
+"""Device-resident query engine gates (ISSUE 15 tentpole).
+
+Layers under test, bottom-up:
+
+- ``search/kernels.py`` — numpy / XLA / Pallas(interpret) parity on the
+  substring, exact-match and lexicographic-compare scorers;
+- ``search/columnar.py`` — predicate eligibility (anything the index
+  cannot answer bit-exactly must return None), the CPU-vs-device mask
+  parity incl. overflow rows, and the incremental upsert/delete path;
+- ``models/base.RowJournal`` — txn-buffered publishing (a note must
+  never be drainable before its rows are visible), raw-write sniffing,
+  the flood ladder;
+- the engine through the REAL router — byte-identity against the SQL
+  path across the full query matrix, the watermark-freshness gate (a
+  post-commit query never sees pre-watermark rows), router degrade on a
+  dying device backend, and the reader-pool bypass.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu import telemetry
+from spacedrive_tpu.models import FilePath, Location, Object
+from spacedrive_tpu.models.base import RowJournal
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.search import columnar, kernels
+from spacedrive_tpu.search.columnar import (DeviceMirror, eval_mask_cpu,
+                                            eval_mask_device,
+                                            parse_predicate)
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.setenv("SD_SEARCH_ENGINE", "device")
+    monkeypatch.setenv("SD_P2P_DISABLED", "1")
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    yield
+    telemetry.reset()
+    telemetry.reload_enabled()
+
+
+def _canon(value) -> str:
+    return json.dumps(value, sort_keys=True, default=str)
+
+
+# -- kernels -------------------------------------------------------------------
+
+
+def _planes(values: list[bytes], width: int):
+    n = len(values)
+    planes = np.zeros((width, n), dtype=np.uint8)
+    lens = np.zeros(n, dtype=np.int32)
+    for i, raw in enumerate(values):
+        clip = raw[:width]
+        if clip:
+            planes[: len(clip), i] = np.frombuffer(clip, dtype=np.uint8)
+        lens[i] = len(raw)
+    return planes, lens
+
+
+def _dev(planes):
+    import jax.numpy as jnp
+
+    w, n = planes.shape
+    cap = kernels.pad_cap(n)
+    out = np.zeros((w, cap), dtype=np.uint8)
+    out[:, :n] = planes
+    return jnp.asarray(out)
+
+
+def test_kernel_parity_substring_exact_lex():
+    names = [b"hello.txt", b"WORLD.dat", b"", b"abcdefgh" * 12,
+             b"zq-file", "ünïcode.png".encode()]
+    folded = [kernels.fold(v) for v in names]
+    planes, _lens = _planes(folded, 64)
+    dev = _dev(planes)
+    n = len(names)
+    for needle in [b"o", b"world", b"zq", b"abcdefghabc", b"nope",
+                   "ünï".encode()]:
+        f = kernels.fold(needle)
+        ref = kernels.substring_np(planes, f)
+        for kern in ("xla", "pallas"):
+            assert (kernels.substring_jnp(dev, f, kern)[:n] == ref).all()
+    raw_planes, _ = _planes(names, 64)
+    raw_dev = _dev(raw_planes)
+    for needle in [b"hello.txt", b"WORLD.dat", b"", b"x" * 100]:
+        ref = kernels.exact_np(raw_planes, needle)
+        for kern in ("xla", "pallas"):
+            assert (kernels.exact_jnp(raw_dev, needle, kern)[:n]
+                    == ref).all()
+    for bound in [b"hello", b"", b"zz", b"abcdefgh" * 12]:
+        ref = kernels.lex_cmp_np(raw_planes, bound)
+        for kern in ("xla", "pallas"):
+            assert (kernels.lex_cmp_jnp(raw_dev, bound, kern)[:n]
+                    == ref).all()
+
+
+def test_prescreen_never_drops_a_match():
+    names = [kernels.fold(f"name-{i:03d}{'x' * (i % 9)}".encode())
+             for i in range(200)]
+    planes, lens = _planes(names, 64)
+    bits = kernels.presence_bitmap(planes, lens)
+    for needle in [b"name", b"77", b"xxx", b"zzz"]:
+        cand = kernels.prescreen_np(bits, needle)
+        ref = kernels.substring_np(planes, needle)
+        assert not (ref & ~cand).any()  # zero false negatives
+
+
+# -- predicate eligibility -----------------------------------------------------
+
+
+@pytest.mark.parametrize("arg,reason", [
+    ({"search": "a%b"}, "needle"),          # LIKE wildcard
+    ({"search": "a_b"}, "needle"),          # LIKE single-char wildcard
+    ({"search": "a\x00b"}, "needle"),       # NUL can't survive padding
+    ({"search": "x" * 80}, "needle"),       # past MAX_NEEDLE
+    ({"tags": [1]}, "tags"),                # subquery stays on SQLite
+    ({"location_id": "seven"}, "arg"),
+    ({"kinds": ["video"]}, "arg"),
+    ({"date_range": ["2026", "2027", "x"]}, "arg"),
+    ({"date_range": "2026"}, "arg"),
+    ({"size_range": [1.5, None]}, "arg"),
+])
+def test_predicate_rejects_what_it_cannot_answer(arg, reason):
+    pred, why = parse_predicate(arg)
+    assert pred is None
+    assert why == reason
+
+
+def test_predicate_accepts_the_served_surface():
+    pred, why = parse_predicate({
+        "search": "Report", "extensions": [".PDF", "txt"],
+        "kinds": [4, 5], "favorite": True, "location_id": 3,
+        "materialized_path": "/docs/", "include_hidden": False,
+        "date_range": [None, "2026-08-04T00:00:00+00:00"],
+        "size_range": [1024, None],
+        "take": 50, "cursor": ["a", 7], "order_by": "name"})
+    assert pred is not None and why == ""
+    assert pred.needle == b"report"
+    assert pred.exts == (b"pdf", b"txt")
+    assert pred.favorite == 1 and pred.exclude_hidden
+
+
+# -- the row journal -----------------------------------------------------------
+
+
+def test_row_journal_txn_buffering_and_flood(tmp_path):
+    from spacedrive_tpu.models import ALL_MODELS, Database, Instance, utc_now
+
+    db = Database(tmp_path / "j.db", ALL_MODELS)
+    journal = db.attach_row_journal(("file_path", "object"),
+                                    flood_on_delete=("object",))
+    inst = db.insert(Instance, {
+        "pub_id": "in-1", "identity": "i", "node_id": "n",
+        "node_name": "n", "node_platform": 0, "last_seen": utc_now(),
+        "date_created": utc_now()})
+    loc = db.insert(Location, {"pub_id": "l", "name": "l", "path": "/",
+                               "instance_id": inst})
+    journal.drain()
+    with db.transaction():
+        fid = db.insert(FilePath, {"pub_id": "fp-1", "location_id": loc,
+                                   "name": "a", "materialized_path": "/"})
+        # mid-txn: the note must NOT be drainable yet (a drained note for
+        # uncommitted rows would be lost to the next refresh)
+        assert not journal.drain()["ids"].get("file_path")
+    drained = journal.drain()
+    assert fid in drained["ids"]["file_path"]
+    # update by pub_id notes the pub_id; by arbitrary where floods
+    db.update(FilePath, {"pub_id": "fp-1"}, {"name": "b"})
+    db.update(FilePath, {"materialized_path": "/"}, {"hidden": 0})
+    drained = journal.drain()
+    assert "fp-1" in drained["pub_ids"]["file_path"]
+    assert "file_path" in drained["flood"]
+    # raw SQL writes are sniffed into a flood
+    db.execute("UPDATE file_path SET name = 'raw' WHERE id = 1")
+    assert "file_path" in journal.drain()["flood"]
+    # ... including writes routed through query() by a txn-owning thread
+    # (the objects/gc.py idiom: db.query(f"DELETE FROM {table} ..."))
+    with db.transaction():
+        db.query("DELETE FROM object WHERE id = -1")
+        db.query("SELECT COUNT(*) FROM file_path")  # reads never note
+    assert journal.drain()["flood"] == {"object"}
+    # the declared batch-write form notes without flooding
+    db.executemany_noted(
+        "UPDATE file_path SET name = ? WHERE id = ?", [("batched", 1)],
+        "file_path", [1])
+    drained = journal.drain()
+    assert drained["ids"]["file_path"] == {1} and not drained["flood"]
+    # object deletes flood (the FK cascade SETs NULL on file_path rows
+    # the statement never names)
+    oid = db.insert(Object, {"pub_id": "ob-1", "kind": 0})
+    journal.drain()
+    db.delete(Object, {"id": oid})
+    assert "object" in journal.drain()["flood"]
+    # cap overflow floods instead of growing
+    for i in range(RowJournal.CAP + 2):
+        journal.publish_one("file_path", "id", i)
+    assert "file_path" in journal.drain()["flood"]
+    db.close()
+
+
+# -- the engine through the real router ---------------------------------------
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(tmp_path / "data", probe_accelerator=False,
+             watch_locations=False)
+    yield n
+    n.shutdown()
+
+
+def _seed(node, n_files=400):
+    lib = node.libraries.create("search")
+    loc_id = lib.db.insert(Location, {
+        "pub_id": "loc-s", "name": "s", "path": "/x",
+        "instance_id": lib.instance_id})
+    obj_ids = [lib.db.insert(Object, {"pub_id": f"ob-{i}", "kind": i % 6,
+                                      "favorite": i % 4 == 0})
+               for i in range(24)]
+    rows = []
+    for i in range(n_files):
+        rows.append({
+            "pub_id": f"fp-{i:05d}", "location_id": loc_id,
+            "materialized_path": "/" if i % 3 else "/sub/dir/",
+            "name": ("very-" * 30 + f"long{i}.dat") if i % 97 == 0
+            else f"File{i:05d}.MOV" if i % 7 else f"weird_{i}%x",
+            "extension": ["dat", "mov", "png", None][i % 4],
+            "is_dir": int(i % 29 == 0), "hidden": [None, 0, 1][i % 3],
+            "size_in_bytes": i * 100 if i % 5 else None,
+            "object_id": obj_ids[i % 24] if i % 2 else None,
+            "date_created": f"2026-0{1 + i % 9}-11T00:00:{i % 60:02d}+00:00",
+        })
+    lib.db.insert_many(FilePath, rows)
+    node.emit("db.commit", None, lib.id)
+    node.search_engine.refresh_now(lib)
+    return lib, loc_id
+
+
+MATRIX = [
+    {"search": "file000", "take": 50},
+    {"search": "FILE", "take": 20, "order_by": "size_in_bytes",
+     "order_desc": True},
+    {"search": "%x"},  # wildcard → SQLite fallback, still identical
+    {"search": "long"},  # matches the overflow (truncated) rows
+    {"extensions": [".MOV", "png"]},
+    {"materialized_path": "/sub/dir/", "dirs_first": True},
+    {"kinds": [1, 2]},
+    {"favorite": True},
+    {"include_hidden": True, "search": "weird"},
+    {"date_range": ["2026-03-01T00:00:00+00:00",
+                    "2026-05-30T00:00:00+00:00"]},
+    {"size_range": [100, 9000]},
+    {"search": "file", "skip": 10, "take": 5},
+    {"search": "zzz-no-such"},
+    {},
+]
+
+
+def _compare(node, lib, arg):
+    engine = node.search_engine
+    engine.set_enabled(False)
+    sql = node.router.resolve("search.paths", arg, lib.id)
+    sql_n = node.router.resolve("search.pathsCount", arg, lib.id)
+    engine.set_enabled(True)
+    dev = node.router.resolve("search.paths", arg, lib.id)
+    dev_n = node.router.resolve("search.pathsCount", arg, lib.id)
+    assert _canon(sql) == _canon(dev), arg
+    assert sql_n == dev_n, arg
+    return sql
+
+
+def test_engine_byte_identical_across_query_matrix(node):
+    lib, _loc = _seed(node)
+    for arg in MATRIX:
+        _compare(node, lib, arg)
+    served = node.search_engine.status()["served"]
+    assert served["cpu"] + served["device"] >= 2 * (len(MATRIX) - 2)
+    # the SQLite rungs were recorded too (wildcard fallback)
+    assert telemetry.value("sd_search_fallbacks_total",
+                           reason="needle") >= 1
+
+
+def test_engine_cursor_walk_matches_sql(node):
+    lib, _loc = _seed(node)
+    engine = node.search_engine
+
+    def walk(enabled):
+        engine.set_enabled(enabled)
+        pages, cursor = [], None
+        for _ in range(4):
+            page = node.router.resolve(
+                "search.paths",
+                {"search": "file", "take": 9, "cursor": cursor}, lib.id)
+            pages.append(page)
+            cursor = page["cursor"]
+            if cursor is None:
+                break
+        return pages
+
+    assert _canon(walk(True)) == _canon(walk(False))
+    engine.set_enabled(True)
+
+
+def test_device_and_cpu_masks_identical_both_kernels(node):
+    lib, _loc = _seed(node)
+    state = node.search_engine._states[lib.id]
+    idx = state.index
+    assert idx.overflow  # the seed includes truncated rows
+    for arg in MATRIX:
+        pred, _why = parse_predicate(arg)
+        if pred is None:
+            continue
+        ref = eval_mask_cpu(idx, pred)
+        for kern in ("xla", "pallas"):
+            got = eval_mask_device(idx, DeviceMirror(), pred, kern)
+            assert (got == ref).all(), (arg, kern)
+
+
+def test_post_commit_search_never_returns_pre_watermark_rows(node):
+    """The incremental-refresh acceptance gate: after every commit(+bump)
+    the engine either serves the fresh truth or falls back to SQLite —
+    at no round may it return the pre-watermark answer. The final
+    refresh proves the test non-vacuous (the engine really serves)."""
+    lib, loc_id = _seed(node, n_files=120)
+    engine = node.search_engine
+    for round_no in range(12):
+        marker = f"fresh-{round_no:02d}"
+        lib.db.insert(FilePath, {
+            "pub_id": f"fp-{marker}", "location_id": loc_id,
+            "materialized_path": "/", "name": f"{marker}.bin",
+            "extension": "bin", "is_dir": 0})
+        if round_no % 3 == 0 and round_no:
+            lib.db.update(FilePath, {"pub_id": f"fp-fresh-{round_no - 1:02d}"},
+                          {"name": f"renamed-{round_no - 1:02d}.bin"})
+        node.emit("db.commit", None, lib.id)
+        # IMMEDIATELY post-commit: engine answer must equal SQL's truth
+        arg = {"search": marker}
+        engine.set_enabled(False)
+        truth = node.router.resolve("search.pathsCount", arg, lib.id)
+        engine.set_enabled(True)
+        got = node.router.resolve("search.pathsCount", arg, lib.id)
+        assert got == truth == 1, round_no
+        # let the refresher catch up sometimes, so later rounds exercise
+        # the index-serving path too, not only the stale fallback
+        if round_no % 2:
+            engine.refresh_now(lib)
+            _compare(node, lib, {"search": "fresh"})
+    engine.refresh_now(lib)
+    before = engine.status()["served"]
+    _compare(node, lib, {"search": "fresh"})
+    after = engine.status()["served"]
+    assert (after["cpu"] + after["device"]
+            > before["cpu"] + before["device"])  # non-vacuous
+
+
+def test_concurrent_writer_reader_equivalence(node):
+    """A writer inserting rows (with post-commit bumps) races readers:
+    inserts are MONOTONE, so every engine answer must land between the
+    SQL truths read immediately before and after it — a stale serve
+    (engine below the pre-read floor) fails regardless of scheduler
+    interleaving or machine load. Deletes are then applied and the
+    refreshed index re-proven against SQL."""
+    lib, loc_id = _seed(node, n_files=200)
+    engine = node.search_engine
+    stop = threading.Event()
+    # rows whose WATERMARK BUMP has completed — the engine's contract is
+    # "a post-bump query never sees pre-bump state"; between a commit
+    # and its bump the index (like the PR 11 worker page cache) may
+    # legitimately serve the pre-commit snapshot, so the floor must
+    # count completed bumps, not raw DB state
+    published = {"n": 0}
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            lib.db.insert(FilePath, {
+                "pub_id": f"fp-live-{i}", "location_id": loc_id,
+                "materialized_path": "/", "name": f"live-{i}.tmp",
+                "extension": "tmp", "is_dir": 0})
+            node.emit("db.commit", None, lib.id)
+            published["n"] = i
+            time.sleep(0.002)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    errors: list[str] = []
+    try:
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            arg = {"search": "live-"}
+            floor = published["n"]
+            got = node.router.resolve("search.pathsCount", arg, lib.id)
+            engine.set_enabled(False)
+            ceil = node.router.resolve("search.pathsCount", arg, lib.id)
+            engine.set_enabled(True)
+            if not floor <= got <= ceil:
+                errors.append(
+                    f"stale serve: engine={got} outside [{floor},{ceil}]")
+                break
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors, errors
+    # now mutate destructively and re-prove the refreshed index
+    lib.db.delete(FilePath, {"pub_id": "fp-live-1"})
+    lib.db.update(FilePath, {"pub_id": "fp-live-2"},
+                  {"name": "live-renamed.tmp"})
+    node.emit("db.commit", None, lib.id)
+    engine.refresh_now(lib)
+    _compare(node, lib, {"search": "live-"})
+
+
+def test_raw_write_floods_to_full_rebuild_and_stays_correct(node):
+    lib, _loc = _seed(node, n_files=80)
+    engine = node.search_engine
+    # a raw SQL write bypassing the helpers: sniffed → flood → rebuild
+    lib.db.execute("UPDATE file_path SET name = 'rawhit.xyz' WHERE id = 5")
+    node.emit("db.commit", None, lib.id)
+    engine.refresh_now(lib)
+    assert telemetry.value("sd_search_refresh_total", kind="full") >= 2
+    _compare(node, lib, {"search": "rawhit"})
+
+
+def test_object_side_change_reaches_the_index(node):
+    """kind/favorite live on the object row: an object update must dirty
+    the file_path rows that join it."""
+    lib, _loc = _seed(node, n_files=60)
+    engine = node.search_engine
+    obj = lib.db.query("SELECT id FROM object LIMIT 1")[0]["id"]
+    lib.db.update(Object, {"id": obj}, {"favorite": 1, "kind": 5})
+    node.emit("db.commit", None, lib.id)
+    engine.refresh_now(lib)
+    _compare(node, lib, {"kinds": [5]})
+    _compare(node, lib, {"favorite": True})
+
+
+def test_device_failure_degrades_to_cpu_then_sqlite(node, monkeypatch):
+    lib, _loc = _seed(node, n_files=50)
+    engine = node.search_engine
+    engine.router.seed(cpu_bps=1.0, dev_bps=1e12)  # force device route
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("wedged device")
+
+    monkeypatch.setattr(columnar, "eval_mask_device", boom)
+    got = _compare(node, lib, {"search": "file0"})
+    assert got["items"]  # still correct, served via the CPU rung
+    assert calls["n"] >= 1
+    assert engine.router.degraded
+    assert engine.router.current == "cpu"
+    # CPU rung dying too → SQLite (the oracle) serves
+    monkeypatch.setattr(columnar, "eval_mask_cpu", boom)
+    _compare(node, lib, {"search": "file0"})
+    assert telemetry.value("sd_search_fallbacks_total", reason="error") >= 1
+
+
+def test_engine_bypasses_reader_pool_when_fresh(node):
+    from spacedrive_tpu.server.pool import ReaderPool
+
+    lib, _loc = _seed(node, n_files=60)
+    engine = node.search_engine
+    pool = ReaderPool(node, workers=1).start()
+    node.reader_pool = pool
+    try:
+        before = engine.status()["served"]
+        res = node.router.resolve("search.paths", {"search": "file000"},
+                                  lib.id)
+        after = engine.status()["served"]
+        assert after["cpu"] + after["device"] \
+            == before["cpu"] + before["device"] + 1  # engine, not pool
+        # the same query through the pool (engine off) is byte-identical
+        engine.set_enabled(False)
+        via_pool = node.router.resolve("search.paths",
+                                       {"search": "file000"}, lib.id)
+        engine.set_enabled(True)
+        assert _canon(res) == _canon(via_pool)
+        # stale index → the pool serves again (dispatch crosses the
+        # pipe). Halt the refresher first so the staleness can't heal
+        # between the bump and the dispatch.
+        engine._stopped.set()
+        engine._refresher_thread.join(timeout=10)
+        node.emit("db.commit", None, lib.id)
+        t0 = pool.status()["cache_misses"] + pool.status()["cache_hits"]
+        node.router.resolve("search.paths", {"search": "file000"}, lib.id)
+        t1 = pool.status()["cache_misses"] + pool.status()["cache_hits"]
+        assert t1 == t0 + 1
+    finally:
+        pool.stop()
+        node.reader_pool = None
+
+
+def test_toolarge_candidate_set_falls_back(node, monkeypatch):
+    lib, _loc = _seed(node, n_files=120)
+    engine = node.search_engine
+    monkeypatch.setattr(engine, "max_hydrate", 10)
+    arg = {"search": "file"}
+    # before scoring, the dispatcher would pull this in-process...
+    assert engine.prefers_inprocess("search.paths", lib.id, arg)
+    _compare(node, lib, arg)  # >10 matches → SQL, identical
+    assert telemetry.value("sd_search_fallbacks_total",
+                           reason="toolarge") >= 1
+    # ...but once a candidate set overflowed, the signature is memoized
+    # and the dispatch keeps going to the reader pool (the heaviest scan
+    # class must not run on the node process); counts never hydrate, so
+    # pathsCount stays engine-served
+    assert not engine.prefers_inprocess("search.paths", lib.id, arg)
+    assert engine.prefers_inprocess("search.pathsCount", lib.id, arg)

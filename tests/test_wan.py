@@ -220,6 +220,58 @@ def test_autoban_ladder_caps_at_max():
                          pytest.approx(5.0), pytest.approx(5.0)]
 
 
+def test_autoban_ledger_persists_across_restart(tmp_path):
+    """ISSUE 15 satellite (fleet rung c): an active ban survives a node
+    restart — reloaded from the data dir with elapsed-downtime charged
+    against its schedule — and the ladder rung survives with it, so a
+    rebooted node never amnesties (or re-bases) a mid-ban abuser."""
+    path = tmp_path / "p2p_autoban.json"
+    clk, wall = {"t": 0.0}, {"t": 50_000.0}
+    ban = AutoBan(strikes=2, window_s=5.0, ban_s=10.0, max_ban_s=40.0,
+                  clock=lambda: clk["t"], persist_path=path,
+                  wall_clock=lambda: wall["t"])
+    ban.strike("abuser", "throttled")
+    ban.strike("abuser", "throttled")
+    assert ban.is_banned("abuser")
+    assert path.is_file()  # the ban edge saved eagerly
+
+    # restart 3s (wall) later, fresh monotonic clock: still banned, and
+    # the remaining schedule reflects the downtime
+    wall["t"] += 3.0
+    clk2 = {"t": 7_777.0}
+    ban2 = AutoBan(strikes=2, window_s=5.0, ban_s=10.0, max_ban_s=40.0,
+                   clock=lambda: clk2["t"], persist_path=path,
+                   wall_clock=lambda: wall["t"])
+    assert ban2.is_banned("abuser")
+    remaining = ban2.check("abuser")
+    assert remaining == pytest.approx(7.0, abs=0.05)
+    # serves out the ban on schedule, then the unban edge lands
+    clk2["t"] += 7.1
+    assert ban2.check("abuser") is None
+    assert not ban2.is_banned("abuser")
+    # the ladder rung persisted too: the next offense doubles
+    ban2.strike("abuser", "throttled")
+    ban2.strike("abuser", "throttled")
+    assert ban2.check("abuser") == pytest.approx(20.0, abs=0.05)
+
+    # a restart long after expiry reloads a clean slate (expiry sweep at
+    # load, not an amnesty)
+    wall["t"] += 10_000.0
+    ban3 = AutoBan(strikes=2, window_s=5.0, ban_s=10.0, max_ban_s=40.0,
+                   clock=lambda: clk["t"], persist_path=path,
+                   wall_clock=lambda: wall["t"])
+    assert not ban3.is_banned("abuser")
+    # honest peers were never persisted as anything
+    assert not ban3.is_banned("honest")
+
+    # a garbage ledger file must never take the accept layer down
+    path.write_text("{not json")
+    ban4 = AutoBan(strikes=2, window_s=5.0, ban_s=10.0,
+                   clock=lambda: clk["t"], persist_path=path,
+                   wall_clock=lambda: wall["t"])
+    assert not ban4.is_banned("abuser")
+
+
 # -- partition → heal: resume (not restart) + the lag alert --------------------
 
 
@@ -285,6 +337,55 @@ def test_partition_heal_resumes_session_and_lag_alert_cycles(tmp_path):
     finally:
         stop.set()
         fleet.shutdown()
+
+
+def test_one_way_link_shaping_hits_only_the_shaped_direction(tmp_path):
+    """ISSUE 15 satellite: the per-direction ``a>b`` grammar in anger
+    (supported since PR 13, exercised nowhere until now). A fleet soak
+    shapes ONLY peer-00's uplink with loss + latency; the fleet must
+    still converge, the NetModel ledger must show drops and modeled
+    delay exclusively on the shaped ``src>dst`` direction, and every
+    other link (the return path included) must be clean."""
+    shaped = f"fleet-peer-00>{net_harness_target()}"
+    model = net.install(f"{shaped}:lat=4ms,jitter=1ms,drop=0.25",
+                        seed=23, sleep=lambda s: None)
+    fleet = Fleet(tmp_path, peers=2, lanes=2)
+    try:
+        for peer in fleet.peers:
+            peer.emit(400)
+            peer.push_until_drained(batch=25)
+        fleet.drain()
+        fleet.mirror_back()
+        assert fleet.converged()
+        assert len(op_log(fleet.target_lib)) == 2 * 400
+
+        ledger = model.ledger()
+        assert shaped in ledger
+        shaped_log = ledger[shaped]
+        drops = [seq for seq, verdict, _d in shaped_log
+                 if verdict == "drop"]
+        delays = [d for _seq, verdict, d in shaped_log if verdict == "ok"]
+        # the shaped direction really bit: drops near the configured rate
+        # and every delivered message carries the modeled 4±1ms latency
+        assert drops, "configured 25% loss never fired"
+        assert 0.05 <= len(drops) / len(shaped_log) <= 0.5
+        assert delays and min(delays) >= 2.9  # ms: lat − jitter
+        # every OTHER observed link — the target's return leg and the
+        # unshaped peer in both directions — is pristine
+        others = {k: v for k, v in ledger.items() if k != shaped}
+        assert any(k.startswith("fleet-target>") for k in others)
+        for link, log in others.items():
+            for _seq, verdict, delay_ms in log:
+                assert verdict == "ok", (link, verdict)
+                assert delay_ms == 0.0, (link, delay_ms)
+    finally:
+        fleet.shutdown()
+
+
+def net_harness_target() -> str:
+    from .fleet_harness import TARGET_IDENTITY
+
+    return TARGET_IDENTITY
 
 
 def test_harness_net_determinism_same_seed(tmp_path):
