@@ -1739,6 +1739,28 @@ def bench_search() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_analysis_wall() -> None:
+    """Time one whole-tree sdlint run and append the ``analysis_wall_s``
+    headline: lint cost is a gate like every other — when the
+    whole-program passes (ISSUE 16) slow down, the pre-commit hook's
+    wall budget is the first thing to rot, and this history line is how
+    the drift is seen before the hook starts failing."""
+    if os.environ.get("SD_BENCH_NO_ANALYSIS"):
+        return  # combined-mode children: the parent owns the headline
+    try:
+        from spacedrive_tpu.analysis.engine import (build_manager,
+                                                    default_root)
+
+        t0 = time.perf_counter()
+        findings = build_manager(default_root(), None).check_tree()
+        wall = round(time.perf_counter() - t0, 3)
+        _history_extra("analysis_wall_s", wall, "s")
+        print(f"info: sdlint whole tree {wall}s "
+              f"({len(findings)} finding(s))", file=sys.stderr)
+    except Exception as e:
+        print(f"warn: analysis wall bench skipped: {e}", file=sys.stderr)
+
+
 def _history_extra(metric: str, value, unit: str) -> None:
     try:
         from spacedrive_tpu.utils.atomic import append_line
@@ -1984,7 +2006,8 @@ def main() -> int:
             try:
                 out = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)],
-                    env={**os.environ, "SD_BENCH_MODE": sub_mode},
+                    env={**os.environ, "SD_BENCH_MODE": sub_mode,
+                         "SD_BENCH_NO_ANALYSIS": "1"},
                     capture_output=True, text=True, check=True, timeout=3600)
                 record["extra"].append(
                     json.loads(out.stdout.strip().splitlines()[-1]))
@@ -2023,6 +2046,7 @@ def main() -> int:
                                     "below ran on the CPU fallback")
     else:
         record["device_numbers"] = "TPU (relay alive, backend initialized)"
+    _bench_analysis_wall()
     _append_history(record)
     print(json.dumps(record))
     return 0

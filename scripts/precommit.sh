@@ -3,9 +3,17 @@
 #
 #   1. sdlint, scoped to the files this commit touches (`--changed` =
 #      modified-vs-HEAD + untracked *.py; `--json` so tooling parses
-#      the verdict instead of scraping prose) — the ratchet still
-#      applies, so a new finding fails the commit;
-#   2. the fast lint fixture suite (tests/test_analysis.py): the
+#      the verdict instead of scraping prose) — the per-file passes run
+#      on the changed files only, the whole-program passes run over the
+#      full graph pruned to the impacted component, and the ratchet
+#      still applies, so a new finding fails the commit;
+#   2. the whole-tree run under a wall budget (SD_LINT_BUDGET_S,
+#      default 60s): catches cross-module findings the scoped prune
+#      cannot anchor in a changed file, AND fails the commit if the
+#      analysis itself has gotten too slow to keep in a hook —
+#      `bench.py` tracks the same wall time as the `analysis_wall_s`
+#      headline in BENCH_history.jsonl;
+#   3. the fast lint fixture suite (tests/test_analysis.py): the
 #      per-pass red/green fixtures plus the whole-tree ratchet gate,
 #      which catches a pass regression the scoped run can't see.
 #
@@ -16,6 +24,10 @@ cd "$(dirname "$0")/.."
 
 echo "[precommit] sdlint --changed" >&2
 python -m spacedrive_tpu.analysis --changed --json
+
+echo "[precommit] sdlint whole tree (budget ${SD_LINT_BUDGET_S:-60}s)" >&2
+python -m spacedrive_tpu.analysis --json \
+    --max-wall-s "${SD_LINT_BUDGET_S:-60}" > /dev/null
 
 echo "[precommit] lint fixtures (tests/test_analysis.py)" >&2
 JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q \

@@ -5,6 +5,10 @@ Public surface:
   (``python -m spacedrive_tpu.analysis``);
 - :class:`PassManager` / :class:`FileContext` / :class:`AnalysisPass` /
   :class:`Finding` — the framework, for tests and new passes;
+- :class:`ProjectContext` / :class:`ProjectPass` and
+  :func:`build_graph` — the whole-program layer (ISSUE 16): the
+  project call graph, thread-provenance lattice, and the base class
+  for passes that consume them;
 - the baseline ratchet helpers (:func:`load_baseline`, :func:`ratchet`,
   :func:`save_baseline`).
 
@@ -12,13 +16,16 @@ See docs/static-analysis.md for the pass list, waiver syntax, and the
 baseline workflow.
 """
 
+from .callgraph import build_graph
 from .engine import (AnalysisPass, FileContext, Finding, PassManager,
-                     build_manager, default_baseline_path, default_root,
-                     load_baseline, main, ratchet, save_baseline)
+                     ProjectContext, ProjectPass, build_manager,
+                     default_baseline_path, default_root, load_baseline,
+                     main, ratchet, save_baseline)
 from .passes import REGISTRY, all_passes
 
 __all__ = [
     "AnalysisPass", "FileContext", "Finding", "PassManager",
+    "ProjectContext", "ProjectPass", "build_graph",
     "build_manager", "default_baseline_path", "default_root",
     "load_baseline", "main", "ratchet", "save_baseline",
     "REGISTRY", "all_passes",

@@ -1,0 +1,923 @@
+"""Whole-program call graph + thread-provenance lattice for sdlint.
+
+Until ISSUE 16 every pass was file- or class-local: the ``lockset``
+pass could not see a ``db.query()`` buried two modules below a
+``with self._lock:`` body, and ``async-blocking`` could not see a sync
+``socket.recv`` reached through a helper in another module. As the
+serve tier goes distributed, the dominant tail-latency and deadlock
+risks are exactly those cross-module shapes — blocking I/O while
+holding a named lock, event-loop stalls reached interprocedurally —
+which only a project-wide analysis catches before a soak does.
+
+This module is the shared substrate the whole-program passes
+(``hold-blocking``, ``loop-blocking``, ``thread-role``) stand on:
+
+- :class:`ProjectContext` — every :class:`FileContext` of a scan,
+  parsed once by the engine, plus the lazily-built graph;
+- :class:`CallGraph` — one :class:`FunctionInfo` per ``def``/
+  ``async def``/spawned ``lambda`` with **resolved call edges**:
+  module import resolution (absolute, relative, aliased, and
+  re-exported names through ``__init__`` chains), class-method binding
+  through ``self``/``cls`` (including base classes and one-level
+  ``self._x = Ctor()`` attribute types), local ``x = Ctor()``
+  inference, dict-of-callables dispatch tables, decorator-transparent
+  name binding, and ``functools.partial`` unwrapping;
+- **thread roots** — the places concurrency is born:
+  ``threading.Thread(target=...)`` (label = the literal ``name=`` role
+  when present), ``executor.submit/map``, ``loop.run_in_executor``,
+  ``_thread.start_new_thread``, ``asyncio.create_task`` /
+  ``call_soon[_threadsafe]`` / ``call_later``/``call_at`` /
+  ``add_done_callback`` (all ``event-loop``), every ``async def`` in
+  the event-loop subsystems (api/ server/ p2p/ — one shared
+  ``event-loop`` label: the loop is ONE thread), the pipeline stage
+  convention (``pipeline_page``/``pipeline_process`` run on the
+  prefetch/dispatch threads; ``pipeline_commit`` and ``execute_step``
+  on the job worker);
+- the **provenance lattice**: every function carries the set of root
+  labels that can reach it along *direct* call edges (spawn edges
+  start a NEW root — the spawner's provenance does not leak into the
+  target). ``provenance(f) == {"event-loop"}`` is the load-bearing
+  fact the ``thread-role`` pass keys on;
+- the shared **blocking-call classifier** (sleep/socket/subprocess/
+  requests/file-I/O/db.query-class/unbounded joins), import-alias
+  aware so ``from time import sleep as snooze`` still classifies;
+- reverse reachability over the SCC condensation for ``--changed``:
+  a change inside a callee can create or kill a finding anchored at
+  any transitive caller, so the impacted set is the changed functions
+  plus everything that can reach them (cycles ride along whole).
+
+Soundness posture: name resolution is best-effort and *under*-
+approximate (an unresolvable dynamic call contributes no edge), while
+the blocking classifier is *over*-approximate at the call site — so a
+witness path is always a real chain of source-level calls, and the
+deliberate escape hatches (``run_in_executor`` targets, spawned
+threads) never launder provenance.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import TYPE_CHECKING, Iterable, Iterator
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (engine imports us)
+    from .engine import FileContext
+
+from .engine import dotted_name
+
+#: subsystems whose ``async def``s run on the node's asyncio loops
+EVENT_LOOP_DIRS = ("api", "server", "p2p")
+
+#: the one label every event-loop root shares — the loop is a single
+#: thread, so two async handlers are NOT two concurrent roots
+EVENT_LOOP = "event-loop"
+
+#: stage-name convention → root label (pipeline/executor.py threads;
+#: pipeline_commit and execute_step run on the job-worker thread)
+STAGE_ROOTS = {
+    "pipeline_page": "pipeline.page",
+    "pipeline_process": "pipeline.process",
+    "pipeline_commit": "job-worker",
+    "execute_step": "job-worker",
+}
+
+#: fully-qualified external calls that block the calling thread
+BLOCKING_EXT = {
+    "time.sleep": "sleep",
+    "socket.create_connection": "socket",
+    "socket.getaddrinfo": "socket",
+    "socket.gethostbyname": "socket",
+    "subprocess.run": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "subprocess.Popen": "subprocess",
+    "os.system": "subprocess",
+    "shutil.copy": "file-io",
+    "shutil.copy2": "file-io",
+    "shutil.copytree": "file-io",
+    "shutil.move": "file-io",
+    "shutil.rmtree": "file-io",
+    "urllib.request.urlopen": "network",
+}
+
+#: attribute methods that block regardless of receiver resolution
+BLOCKING_METHODS = {
+    "read_bytes": "file-io", "read_text": "file-io",
+    "write_bytes": "file-io", "write_text": "file-io",
+    "recv": "socket", "recv_into": "socket", "accept": "socket",
+    "sendall": "socket",
+}
+
+#: zero-argument waits that can park the thread forever
+UNBOUNDED_METHODS = ("result", "join")
+
+#: the DB surface (models/base.Database) — every one of these takes the
+#: writer or reader lock and runs SQLite I/O
+DB_METHODS = {
+    "query", "transaction", "execute", "executemany", "execute_noted",
+    "executemany_noted", "insert", "insert_ignore", "insert_many",
+    "update", "upsert", "delete",
+}
+
+#: lock factories a ``with`` item can hold (threading + utils/locks)
+LOCK_FACTORIES = {"Lock": False, "SdLock": False,
+                  "RLock": True, "SdRLock": True, "Condition": True}
+
+
+def is_db_receiver(chain: str) -> bool:
+    """'db.query', 'ctx.library.db.update', 'self._db.execute' — the
+    handle-naming idiom shared with the pipeline-ordering pass."""
+    head = chain.rsplit(".", 1)[0] if "." in chain else ""
+    last = head.rsplit(".", 1)[-1].lstrip("_") if head else ""
+    return last in ("db", "database")
+
+
+class FunctionInfo:
+    """One ``def``/``async def``/spawned ``lambda`` in the project."""
+
+    __slots__ = ("qname", "relpath", "modkey", "name", "cls", "node",
+                 "is_async", "lineno", "calls", "local_names", "parent")
+
+    def __init__(self, qname: str, relpath: str, modkey: str, name: str,
+                 cls: "ClassInfo | None", node: ast.AST,
+                 parent: "FunctionInfo | None" = None) -> None:
+        self.qname = qname
+        self.relpath = relpath
+        self.modkey = modkey
+        self.name = name
+        self.cls = cls
+        self.node = node
+        self.parent = parent
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.lineno = getattr(node, "lineno", 0)
+        #: resolved direct-call edges: (callee, call-site node, rendering)
+        self.calls: list[tuple["FunctionInfo", ast.Call, str]] = []
+        #: names bound to nested defs inside this function
+        self.local_names: dict[str, "FunctionInfo"] = {}
+
+    @property
+    def short(self) -> str:
+        """'lanes.IngestLanes._apply' — the witness-path rendering (no
+        line numbers: witness text is part of the baseline key)."""
+        stem = self.relpath.rsplit("/", 1)[-1].removesuffix(".py")
+        return f"{stem}.{self.qname.split('::', 1)[1]}"
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<fn {self.qname}>"
+
+
+class ClassInfo:
+    __slots__ = ("name", "modkey", "relpath", "node", "bases", "methods",
+                 "attr_types", "locks")
+
+    def __init__(self, name: str, modkey: str, relpath: str,
+                 node: ast.ClassDef) -> None:
+        self.name = name
+        self.modkey = modkey
+        self.relpath = relpath
+        self.node = node
+        self.bases: list[ast.expr] = list(node.bases)
+        self.methods: dict[str, FunctionInfo] = {}
+        #: ``self.x = Ctor()`` one-level attribute types: attr -> ClassInfo
+        self.attr_types: dict[str, "ClassInfo"] = {}
+        #: lock attrs assigned anywhere in the class: attr -> reentrant?
+        self.locks: dict[str, bool] = {}
+
+
+class ModuleInfo:
+    __slots__ = ("modkey", "relpath", "ctx", "defs", "classes", "bindings",
+                 "dispatch")
+
+    def __init__(self, modkey: str, relpath: str, ctx: "FileContext") -> None:
+        self.modkey = modkey
+        self.relpath = relpath
+        self.ctx = ctx
+        #: top-level name -> FunctionInfo | ClassInfo
+        self.defs: dict[str, object] = {}
+        self.classes: list[ClassInfo] = []
+        #: imported name -> ("module", key) | ("name", key, orig) |
+        #: ("ext", dotted)
+        self.bindings: dict[str, tuple] = {}
+        #: module-level dict-of-callables tables: name -> value exprs
+        self.dispatch: dict[str, list[ast.expr]] = {}
+
+
+class Root:
+    """One place concurrency is born: a label plus the entry function."""
+
+    __slots__ = ("label", "kind", "fn", "lineno", "site_relpath")
+
+    def __init__(self, label: str, kind: str, fn: FunctionInfo,
+                 lineno: int, site_relpath: str) -> None:
+        self.label = label
+        self.kind = kind
+        self.fn = fn
+        self.lineno = lineno
+        self.site_relpath = site_relpath
+
+
+class CallGraph:
+    """The resolved project graph. Build with :func:`build_graph`."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.roots: list[Root] = []
+        self._provenance: dict[str, frozenset[str]] | None = None
+        self._callers: dict[str, list[FunctionInfo]] | None = None
+
+    # -- queries -------------------------------------------------------------
+    def provenance(self, fn: FunctionInfo) -> frozenset[str]:
+        """Root labels that can reach ``fn`` along direct call edges."""
+        if self._provenance is None:
+            self._provenance = self._compute_provenance()
+        return self._provenance.get(fn.qname, frozenset())
+
+    def callers_of(self, fn: FunctionInfo) -> list[FunctionInfo]:
+        if self._callers is None:
+            rev: dict[str, list[FunctionInfo]] = {}
+            for f in self.functions.values():
+                for callee, _site, _txt in f.calls:
+                    rev.setdefault(callee.qname, []).append(f)
+            self._callers = rev
+        return self._callers.get(fn.qname, [])
+
+    def functions_in(self, relpath: str) -> Iterator[FunctionInfo]:
+        for f in self.functions.values():
+            if f.relpath == relpath:
+                yield f
+
+    def impacted_files(self, changed: Iterable[str]) -> set[str]:
+        """Files owning a function that can REACH a function defined in
+        a changed file (reverse reachability over the condensation: a
+        callee edit can create or kill a finding anchored at any
+        transitive caller; members of a cycle ride along whole)."""
+        changed_set = set(changed)
+        seeds = [f for f in self.functions.values()
+                 if f.relpath in changed_set]
+        seen: set[str] = {f.qname for f in seeds}
+        stack = list(seeds)
+        out = set(changed_set)
+        while stack:
+            fn = stack.pop()
+            out.add(fn.relpath)
+            for caller in self.callers_of(fn):
+                if caller.qname not in seen:
+                    seen.add(caller.qname)
+                    stack.append(caller)
+        return out
+
+    def reachable_blocking(self, fn: FunctionInfo,
+                           classify, max_depth: int = 12,
+                           skip_holder=None,
+                           ) -> "tuple[list[FunctionInfo], int, str] | None":
+        """Shortest chain ``[fn, …, holder-of-blocking-call]`` plus the
+        blocking call's line and rendered reason, or None. ``classify``
+        maps an ``(ast.Call, ModuleInfo)`` pair to a reason string or
+        None — passes plug their own blocking vocabulary in.
+        ``skip_holder(fn)`` exempts a function's OWN body from
+        classification (another pass's domain) while still descending
+        through its callees."""
+        from collections import deque
+
+        queue: deque[tuple[FunctionInfo, tuple[FunctionInfo, ...]]] = \
+            deque([(fn, (fn,))])
+        seen = {fn.qname}
+        while queue:
+            cur, path = queue.popleft()
+            mi = self.modules.get(cur.modkey)
+            if mi is not None and not (skip_holder is not None
+                                       and skip_holder(cur)):
+                hit = first_blocking_call(cur, mi, classify)
+                if hit is not None:
+                    return list(path), hit[0], hit[1]
+            if len(path) > max_depth:
+                continue
+            for callee, _site, _txt in cur.calls:
+                if callee.qname not in seen:
+                    seen.add(callee.qname)
+                    queue.append((callee, path + (callee,)))
+        return None
+
+    # -- provenance ----------------------------------------------------------
+    def _compute_provenance(self) -> dict[str, frozenset[str]]:
+        prov: dict[str, set[str]] = {}
+        from collections import deque
+
+        queue: deque[FunctionInfo] = deque()
+        for root in self.roots:
+            labels = prov.setdefault(root.fn.qname, set())
+            if root.label not in labels:
+                labels.add(root.label)
+                queue.append(root.fn)
+        while queue:
+            fn = queue.popleft()
+            labels = prov.get(fn.qname, set())
+            for callee, _site, _txt in fn.calls:
+                tgt = prov.setdefault(callee.qname, set())
+                if labels - tgt:
+                    tgt |= labels
+                    queue.append(callee)
+        return {q: frozenset(s) for q, s in prov.items()}
+
+
+def first_blocking_call(fn: FunctionInfo, mi: ModuleInfo,
+                        classify) -> tuple[int, str] | None:
+    """Earliest call in ``fn``'s own body that ``classify`` marks
+    blocking. Nested defs/lambdas are deferred execution — skipped
+    (they are their own FunctionInfos when spawned)."""
+    best: tuple[int, str] | None = None
+    for node in walk_own_body(fn.node):
+        if isinstance(node, ast.Call):
+            reason = classify(node, mi)
+            if reason is not None \
+                    and (best is None or node.lineno < best[0]):
+                best = (node.lineno, reason)
+    return best
+
+
+def walk_own_body(func: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` scoped to one function: does not descend into nested
+    ``def``/``async def``/``lambda`` bodies."""
+    from collections import deque
+
+    queue = deque(ast.iter_child_nodes(func))
+    while queue:
+        node = queue.popleft()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        queue.extend(ast.iter_child_nodes(node))
+
+
+def canonical_dotted(call: ast.Call, mi: ModuleInfo) -> str | None:
+    """The dotted call target with its root de-aliased through the
+    module's import bindings: ``snooze()`` after ``from time import
+    sleep as snooze`` canonicalizes to ``time.sleep``."""
+    chain = dotted_name(call.func)
+    if chain is None:
+        return None
+    root, _, rest = chain.partition(".")
+    binding = mi.bindings.get(root)
+    if binding is None:
+        return chain
+    if binding[0] == "ext":
+        return binding[1] + ("." + rest if rest else "")
+    if binding[0] == "ext-name":
+        return binding[1] + ("." + rest if rest else "")
+    return chain
+
+
+def blocking_call_reason(call: ast.Call, mi: ModuleInfo, *,
+                         include_db: bool = True,
+                         include_open: bool = False) -> str | None:
+    """The shared blocking classifier. Returns a short rendered reason
+    ("time.sleep()", "db write '….update()'") or None. ``include_db``
+    adds the models/base query/transaction surface; ``include_open``
+    adds bare ``open()`` (wanted under a lock, too noisy on a loop
+    where async-blocking's narrower file-I/O set already gates)."""
+    dotted = canonical_dotted(call, mi)
+    if dotted is not None:
+        if dotted in BLOCKING_EXT:
+            return f"{dotted}()"
+        if dotted.split(".")[0] == "requests":
+            return f"{dotted}() (requests is synchronous)"
+        if include_open and dotted == "open":
+            return "open()"
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        chain = dotted_name(call.func) or f"?.{attr}"
+        if include_db and attr in DB_METHODS and is_db_receiver(chain):
+            return f"DB call '{chain}()'"
+        if attr in BLOCKING_METHODS:
+            return f".{attr}()"
+        if attr in UNBOUNDED_METHODS and not call.args \
+                and not call.keywords:
+            return f"unbounded .{attr}()"
+    return None
+
+
+def witness(path: list[FunctionInfo]) -> str:
+    """'a.f -> b.g -> c.h' — deterministic (no line numbers: this text
+    lands in baseline keys)."""
+    return " -> ".join(f.short for f in path)
+
+
+# -- graph construction -------------------------------------------------------
+
+def modkey_for(relpath: str) -> str:
+    """'sync/lanes.py' -> 'sync.lanes'; 'sync/__init__.py' -> 'sync';
+    'library.py' -> 'library'."""
+    parts = relpath.removesuffix(".py").split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or ""
+
+
+class _Builder:
+    """Three phases over the parsed project: collect definitions, wire
+    imports, then resolve call/spawn sites per function."""
+
+    def __init__(self, files: dict[str, "FileContext"],
+                 root_names: tuple[str, ...]) -> None:
+        self.graph = CallGraph()
+        self.files = files
+        #: leading components stripped from absolute imports: the scan
+        #: root's own directory name plus the installed package name
+        self.root_names = root_names
+
+    def build(self) -> CallGraph:
+        for relpath, ctx in sorted(self.files.items()):
+            self._collect_module(relpath, ctx)
+        for mi in self.graph.modules.values():
+            self._collect_imports(mi)
+        for mi in self.graph.modules.values():
+            self._resolve_attr_types(mi)
+        for mi in self.graph.modules.values():
+            for fn in list(self._module_functions(mi)):
+                self._resolve_body(fn, mi)
+        self._seed_convention_roots()
+        return self.graph
+
+    # -- phase 1: definitions ------------------------------------------------
+    def _collect_module(self, relpath: str, ctx: "FileContext") -> None:
+        mi = ModuleInfo(modkey_for(relpath), relpath, ctx)
+        self.graph.modules[mi.modkey] = mi
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = self._new_function(stmt.name, relpath, mi.modkey,
+                                        None, stmt)
+                mi.defs[stmt.name] = fn
+                self._collect_nested(fn, stmt, relpath, mi.modkey)
+            elif isinstance(stmt, ast.ClassDef):
+                ci = ClassInfo(stmt.name, mi.modkey, relpath, stmt)
+                mi.defs[stmt.name] = ci
+                mi.classes.append(ci)
+                self._collect_class(ci, stmt, relpath, mi.modkey)
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Dict):
+                values = [v for v in stmt.value.values if v is not None]
+                if values and all(
+                        isinstance(v, (ast.Name, ast.Attribute, ast.Lambda))
+                        for v in values):
+                    mi.dispatch[stmt.targets[0].id] = values
+
+    def _collect_class(self, ci: ClassInfo, cls: ast.ClassDef,
+                       relpath: str, modkey: str) -> None:
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = self._new_function(f"{ci.name}.{stmt.name}", relpath,
+                                        modkey, ci, stmt)
+                ci.methods[stmt.name] = fn
+                self._collect_nested(fn, stmt, relpath, modkey)
+        # lock attrs: ``self.X = Lock()/SdLock(...)…`` anywhere in the class
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            if not isinstance(node.value, ast.Call):
+                continue
+            factory = dotted_name(node.value.func) or ""
+            if factory.split(".")[0] == "asyncio":
+                continue  # asyncio.Lock guards await interleave, not threads
+            leaf = factory.split(".")[-1]
+            if leaf not in LOCK_FACTORIES:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    ci.locks[t.attr] = LOCK_FACTORIES[leaf]
+
+    def _collect_nested(self, parent: FunctionInfo, func: ast.AST,
+                        relpath: str, modkey: str) -> None:
+        """Nested defs become their own FunctionInfos, name-bound in the
+        parent so `def _work(): …; Thread(target=_work)` resolves."""
+        for node in walk_own_body(func):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = self._new_function(
+                    f"{parent.qname.split('::', 1)[1]}.<locals>.{node.name}",
+                    relpath, modkey, parent.cls, node, parent)
+                parent.local_names[node.name] = fn
+                self._collect_nested(fn, node, relpath, modkey)
+
+    def _new_function(self, qualpath: str, relpath: str, modkey: str,
+                      cls: ClassInfo | None, node: ast.AST,
+                      parent: FunctionInfo | None = None) -> FunctionInfo:
+        name = qualpath.rsplit(".", 1)[-1]
+        fn = FunctionInfo(f"{relpath}::{qualpath}", relpath, modkey, name,
+                          cls, node, parent)
+        self.graph.functions[fn.qname] = fn
+        return fn
+
+    # -- phase 2: imports ----------------------------------------------------
+    def _project_modkey(self, dotted: str) -> str | None:
+        """Map an absolute import path onto a scanned module key, or a
+        package that contains scanned modules."""
+        candidates = [dotted]
+        first, _, rest = dotted.partition(".")
+        if first in self.root_names and rest:
+            candidates.append(rest)
+        for cand in candidates:
+            if cand in self.graph.modules:
+                return cand
+            prefix = cand + "."
+            if any(k.startswith(prefix) for k in self.graph.modules):
+                return cand
+        return None
+
+    def _collect_imports(self, mi: ModuleInfo) -> None:
+        # the containing package: for 'sync/ingest.py' AND for the
+        # package module 'sync/__init__.py' itself this is ['sync'],
+        # which is exactly what a level-1 relative import resolves from
+        pkg_parts = mi.relpath.split("/")[:-1]
+        for stmt in ast.walk(mi.ctx.tree):
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    target = (alias.name if alias.asname
+                              else alias.name.split(".")[0])
+                    key = self._project_modkey(target)
+                    mi.bindings[bound] = (("module", key) if key is not None
+                                          else ("ext", target))
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.level:
+                    base = pkg_parts[:len(pkg_parts) - (stmt.level - 1)] \
+                        if stmt.level > 1 else pkg_parts
+                    if stmt.level - 1 > len(pkg_parts):
+                        continue  # escapes the scan root
+                    src = ".".join(base + (stmt.module or "").split(".")) \
+                        if stmt.module else ".".join(base)
+                    src = src.strip(".")
+                    key = src if src in self.graph.modules \
+                        else self._project_modkey(src) if src else None
+                else:
+                    key = self._project_modkey(stmt.module or "")
+                for alias in stmt.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    if key is not None:
+                        sub = f"{key}.{alias.name}"
+                        if sub in self.graph.modules:
+                            mi.bindings[bound] = ("module", sub)
+                        else:
+                            mi.bindings[bound] = ("name", key, alias.name)
+                    elif not stmt.level:
+                        mi.bindings[bound] = \
+                            ("ext-name", f"{stmt.module}.{alias.name}")
+
+    def _resolve_global(self, modkey: str, name: str,
+                        _depth: int = 0) -> object | None:
+        """FunctionInfo/ClassInfo for ``name`` as seen from ``modkey``,
+        following re-export chains (``from .lanes import X`` in
+        ``sync/__init__.py``) to a bounded depth."""
+        if _depth > 8:
+            return None
+        mi = self.graph.modules.get(modkey)
+        if mi is None:
+            return None
+        if name in mi.defs:
+            return mi.defs[name]
+        binding = mi.bindings.get(name)
+        if binding is None:
+            return None
+        if binding[0] == "module":
+            return ("module", binding[1])
+        if binding[0] == "name":
+            return self._resolve_global(binding[1], binding[2], _depth + 1)
+        return None
+
+    # -- phase 2.5: one-level attribute types --------------------------------
+    def _resolve_attr_types(self, mi: ModuleInfo) -> None:
+        for ci in mi.classes:
+            for method in ci.methods.values():
+                for node in walk_own_body(method.node):
+                    if not isinstance(node, ast.Assign) \
+                            or not isinstance(node.value, ast.Call):
+                        continue
+                    target_ci = self._resolve_ctor(node.value.func, mi)
+                    if target_ci is None:
+                        continue
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            ci.attr_types[t.attr] = target_ci
+
+    def _resolve_ctor(self, func: ast.expr,
+                      mi: ModuleInfo) -> ClassInfo | None:
+        obj = self._resolve_callable_expr(func, mi, None, None)
+        return obj if isinstance(obj, ClassInfo) else None
+
+    # -- phase 3: call sites -------------------------------------------------
+    def _module_functions(self, mi: ModuleInfo) -> Iterator[FunctionInfo]:
+        for fn in self.graph.functions.values():
+            if fn.modkey == mi.modkey and fn.relpath == mi.relpath:
+                yield fn
+
+    def _resolve_body(self, fn: FunctionInfo, mi: ModuleInfo) -> None:
+        local_types: dict[str, ClassInfo] = {}
+        # one-level local inference: ``x = Ctor(...); x.m()``
+        for node in walk_own_body(fn.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                ci = self._resolve_ctor(node.value.func, mi)
+                if ci is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            local_types[t.id] = ci
+        lambda_seq = 0
+        # a spawn target that is itself a Call node (``partial(f, x)``,
+        # ``create_task(self._serve())``) must NOT also resolve as a
+        # direct call edge — that would leak the spawner's provenance
+        # into the spawned body (walk order is outer-before-inner, so
+        # the mark lands before the inner node is visited)
+        consumed: set[int] = set()
+        for node in walk_own_body(fn.node):
+            if not isinstance(node, ast.Call) or id(node) in consumed:
+                continue
+            spawn = self._spawn_site(node, fn, mi, local_types)
+            if spawn is not None:
+                kind, target_expr, label_hint = spawn
+                if isinstance(target_expr, ast.Call):
+                    consumed.add(id(target_expr))
+                lambda_seq = self._register_root(
+                    kind, target_expr, label_hint, node, fn, mi,
+                    local_types, lambda_seq)
+                continue
+            for target in self._call_targets(node, fn, mi, local_types):
+                fn.calls.append((target, node,
+                                 dotted_name(node.func) or target.name))
+
+    def _call_targets(self, call: ast.Call, fn: FunctionInfo,
+                      mi: ModuleInfo,
+                      local_types: dict[str, ClassInfo],
+                      ) -> list[FunctionInfo]:
+        func = call.func
+        # dict-of-callables: TABLE[key](...) fans out to every value
+        if isinstance(func, ast.Subscript) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in mi.dispatch:
+            out = []
+            for expr in mi.dispatch[func.value.id]:
+                if isinstance(expr, ast.Lambda):
+                    continue  # table lambdas: no named body to bind
+                tgt = self._resolve_callable_expr(expr, mi, fn, local_types)
+                out.extend(self._as_functions(tgt, call))
+            return out
+        tgt = self._resolve_callable_expr(func, mi, fn, local_types)
+        out = self._as_functions(tgt, call)
+        # functools.partial(f, ...) used INLINE: partial(f)() — and, far
+        # more commonly, partial as an argument to a known wrapper is
+        # handled at spawn sites; a bare partial(...) call contributes
+        # the wrapped callable's edge so later invocation is covered
+        dotted = canonical_dotted(call, mi)
+        if dotted in ("functools.partial", "partial") and call.args:
+            inner = self._resolve_callable_expr(call.args[0], mi, fn,
+                                                local_types)
+            out.extend(self._as_functions(inner, call))
+        return out
+
+    def _as_functions(self, obj: object,
+                      call: ast.Call) -> list[FunctionInfo]:
+        if isinstance(obj, FunctionInfo):
+            return [obj]
+        if isinstance(obj, ClassInfo):
+            init = self._lookup_method(obj, "__init__")
+            return [init] if init is not None else []
+        return []
+
+    def _lookup_method(self, ci: ClassInfo,
+                       name: str, _depth: int = 0) -> FunctionInfo | None:
+        if name in ci.methods:
+            return ci.methods[name]
+        if _depth > 8:
+            return None
+        mi = self.graph.modules.get(ci.modkey)
+        for base in ci.bases:
+            resolved = None
+            if mi is not None:
+                resolved = self._resolve_callable_expr(base, mi, None, None)
+            if isinstance(resolved, ClassInfo):
+                found = self._lookup_method(resolved, name, _depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def _resolve_callable_expr(self, expr: ast.expr, mi: ModuleInfo,
+                               fn: FunctionInfo | None,
+                               local_types: dict[str, ClassInfo] | None,
+                               ) -> object | None:
+        """FunctionInfo/ClassInfo for a callable expression, or None."""
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            cur: FunctionInfo | None = fn
+            while cur is not None:  # the lexical def chain, innermost out
+                if name in cur.local_names:
+                    return cur.local_names[name]
+                cur = cur.parent
+            return _plain(self._resolve_global(mi.modkey, name))
+        if isinstance(expr, ast.Attribute):
+            parts = []
+            node: ast.expr = expr
+            while isinstance(node, ast.Attribute):
+                parts.append(node.attr)
+                node = node.value
+            if not isinstance(node, ast.Name):
+                return None
+            parts.append(node.id)
+            parts.reverse()  # [root, ..., attr]
+            root, rest = parts[0], parts[1:]
+            # self/cls: method binding, incl. one attribute-type hop
+            if root in ("self", "cls") and fn is not None \
+                    and fn.cls is not None:
+                if len(rest) == 1:
+                    return self._lookup_method(fn.cls, rest[0])
+                if len(rest) == 2:
+                    sub = fn.cls.attr_types.get(rest[0])
+                    if sub is not None:
+                        return self._lookup_method(sub, rest[1])
+                return None
+            # local ``x = Ctor()`` then ``x.m()``
+            if local_types and root in local_types and len(rest) == 1:
+                return self._lookup_method(local_types[root], rest[0])
+            # module/class chains: mod.f, mod.Class, mod.sub.f, Class.m
+            base = self._resolve_global(mi.modkey, root)
+            base = _plain(base, keep_module=True)
+            for i, part in enumerate(rest):
+                if isinstance(base, tuple) and base[0] == "module":
+                    base = _plain(
+                        self._resolve_global(base[1], part),
+                        keep_module=True)
+                elif isinstance(base, ClassInfo):
+                    return self._lookup_method(base, part) \
+                        if i == len(rest) - 1 else None
+                else:
+                    return None
+            return base if isinstance(base, (FunctionInfo, ClassInfo)) \
+                else None
+        return None
+
+    # -- spawn sites / roots -------------------------------------------------
+    def _spawn_site(self, call: ast.Call, fn: FunctionInfo, mi: ModuleInfo,
+                    local_types: dict[str, ClassInfo],
+                    ) -> tuple[str, ast.expr, str | None] | None:
+        """(kind, target-expr, label-hint) when this call hands a
+        callable to another execution context, else None."""
+        dotted = canonical_dotted(call, mi)
+        leaf = (dotted or "").split(".")[-1]
+        # threading.Thread(target=...) — label from a literal name=
+        if leaf == "Thread" and self._is_threading(dotted, mi):
+            target = kwarg(call, "target")
+            if target is None and call.args:
+                return None  # positional group arg — not the idiom here
+            if target is not None:
+                name = kwarg(call, "name")
+                hint = name.value if isinstance(name, ast.Constant) \
+                    and isinstance(name.value, str) else None
+                return ("thread", target, hint)
+            return None
+        if dotted in ("_thread.start_new_thread",
+                      "thread.start_new_thread") and call.args:
+            return ("thread", call.args[0], None)
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in ("submit", "map"):
+                # only an executor handoff when the receiver is NOT a
+                # resolvable project method of that name (sync/lanes.py
+                # LanePool.submit) and the first arg IS a callable
+                if self._resolve_callable_expr(call.func, mi, fn,
+                                               local_types) is not None:
+                    return None
+                if call.args and self._looks_callable(
+                        call.args[0], mi, fn, local_types):
+                    return ("executor", call.args[0], None)
+                return None
+            if attr == "run_in_executor" and len(call.args) >= 2:
+                return ("executor", call.args[1], None)
+            if attr in ("create_task", "ensure_future"):
+                if call.args:
+                    return ("event-loop", call.args[0], None)
+                return None
+            if attr in ("call_soon", "call_soon_threadsafe",
+                        "add_done_callback") and call.args:
+                return ("event-loop", call.args[0], None)
+            if attr in ("call_later", "call_at") and len(call.args) >= 2:
+                return ("event-loop", call.args[1], None)
+        if dotted in ("asyncio.create_task", "asyncio.ensure_future",
+                      "asyncio.run") and call.args:
+            return ("event-loop", call.args[0], None)
+        return None
+
+    def _is_threading(self, dotted: str | None, mi: ModuleInfo) -> bool:
+        if dotted == "Thread":
+            b = mi.bindings.get("Thread")
+            return b is not None and b[0] == "ext-name" \
+                and b[1] == "threading.Thread"
+        return dotted == "threading.Thread"
+
+    def _looks_callable(self, expr: ast.expr, mi: ModuleInfo,
+                        fn: FunctionInfo,
+                        local_types: dict[str, ClassInfo]) -> bool:
+        if isinstance(expr, ast.Lambda):
+            return True
+        if isinstance(expr, ast.Call):  # partial(f, ...)
+            d = canonical_dotted(expr, mi)
+            return d in ("functools.partial", "partial")
+        return self._resolve_callable_expr(expr, mi, fn,
+                                           local_types) is not None
+
+    def _register_root(self, kind: str, target_expr: ast.expr,
+                       label_hint: str | None, call: ast.Call,
+                       fn: FunctionInfo, mi: ModuleInfo,
+                       local_types: dict[str, ClassInfo],
+                       lambda_seq: int) -> int:
+        # unwrap functools.partial(f, ...) — and for event-loop spawns a
+        # coroutine-CALL target (``create_task(self._serve())``: the call
+        # only builds the coroutine object; the body runs on the loop)
+        if isinstance(target_expr, ast.Call):
+            d = canonical_dotted(target_expr, mi)
+            if d in ("functools.partial", "partial") and target_expr.args:
+                target_expr = target_expr.args[0]
+            elif kind == "event-loop":
+                target_expr = target_expr.func
+        if isinstance(target_expr, ast.Lambda):
+            lambda_seq += 1
+            qual = (f"{fn.qname.split('::', 1)[1]}"
+                    f".<lambda#{lambda_seq}>")
+            tgt = self._new_function(qual, fn.relpath, fn.modkey, fn.cls,
+                                     target_expr)
+            # the lambda body's calls resolve in the parent's scope
+            self._resolve_lambda_body(tgt, fn, mi, local_types)
+        else:
+            resolved = self._resolve_callable_expr(target_expr, mi, fn,
+                                                   local_types)
+            tgt = resolved if isinstance(resolved, FunctionInfo) else None
+            if tgt is None:
+                return lambda_seq  # external/dynamic target: no root
+        label = (EVENT_LOOP if kind == "event-loop"
+                 else f"{kind}:{label_hint or tgt.short}")
+        self.graph.roots.append(
+            Root(label, kind, tgt, call.lineno, fn.relpath))
+        return lambda_seq
+
+    def _resolve_lambda_body(self, fn: FunctionInfo, parent: FunctionInfo,
+                             mi: ModuleInfo,
+                             local_types: dict[str, ClassInfo]) -> None:
+        fn.local_names = parent.local_names
+        consumed: set[int] = set()
+        for node in walk_own_body(fn.node):
+            if not isinstance(node, ast.Call) or id(node) in consumed:
+                continue
+            spawn = self._spawn_site(node, parent, mi, local_types)
+            if spawn is not None and isinstance(spawn[1], ast.Call):
+                consumed.add(id(spawn[1]))
+            if spawn is not None:
+                continue  # a lambda that spawns: root registration is
+                # not modeled one level deep; just avoid a false edge
+            for target in self._call_targets(node, parent, mi,
+                                             local_types):
+                fn.calls.append((target, node,
+                                 dotted_name(node.func) or target.name))
+
+    def _seed_convention_roots(self) -> None:
+        for fn in list(self.graph.functions.values()):
+            stage = STAGE_ROOTS.get(fn.name)
+            if stage is not None and fn.cls is not None:
+                self.graph.roots.append(
+                    Root(stage, "stage", fn, fn.lineno, fn.relpath))
+            if fn.is_async and top_dir(fn.relpath) in EVENT_LOOP_DIRS:
+                self.graph.roots.append(
+                    Root(EVENT_LOOP, "event-loop", fn, fn.lineno,
+                         fn.relpath))
+
+
+def _plain(obj: object, keep_module: bool = False) -> object | None:
+    if isinstance(obj, tuple) and obj and obj[0] == "module":
+        return obj if keep_module else None
+    return obj
+
+
+def kwarg(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def top_dir(relpath: str) -> str:
+    return relpath.split("/")[0] if "/" in relpath else ""
+
+
+def build_graph(files: dict[str, "FileContext"],
+                root_name: str = "") -> CallGraph:
+    """Build the project graph over already-parsed files (relpath ->
+    FileContext). ``root_name`` is the scan root's directory name, so
+    ``from <root_name>.sync import X`` resolves in fixture trees the
+    way ``from spacedrive_tpu.sync import X`` does in the real one."""
+    names = tuple(n for n in {root_name, "spacedrive_tpu"} if n)
+    return _Builder(files, names).build()

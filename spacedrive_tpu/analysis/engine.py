@@ -38,6 +38,8 @@ import ast
 import json
 import re
 import subprocess
+import sys
+import time
 from collections import Counter
 from dataclasses import dataclass
 from pathlib import Path
@@ -147,6 +149,43 @@ class AnalysisPass:
         raise NotImplementedError
 
 
+class ProjectContext:
+    """Every parsed file of one scan plus the lazily-built call graph —
+    what a :class:`ProjectPass` analyzes. ``files`` maps relpath →
+    :class:`FileContext` (parsed once by the manager, shared with the
+    per-file passes)."""
+
+    def __init__(self, files: dict[str, FileContext], root: Path) -> None:
+        self.files = files
+        self.root = root
+        self._graph = None
+
+    @property
+    def graph(self):
+        """The project call graph (analysis/callgraph.py), built on
+        first use and shared by every project pass of the run."""
+        if self._graph is None:
+            from .callgraph import build_graph
+
+            self._graph = build_graph(self.files, self.root.name)
+        return self._graph
+
+
+class ProjectPass(AnalysisPass):
+    """A whole-program pass: sees every file of the scan at once (plus
+    the call graph), so it can report the cross-module shapes —
+    blocking I/O two calls below a lock, an event-loop stall through a
+    helper in another module — that no per-file pass can."""
+
+    def run_project(self, project: ProjectContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        # a project pass has no per-file form; the manager routes it
+        # through run_project() over however many files the scan holds
+        return iter(())
+
+
 def dotted_name(node: ast.AST) -> str | None:
     """'jax.numpy.zeros' for a Name/Attribute chain, else None. The shared
     call-classification helper every pass uses."""
@@ -161,15 +200,30 @@ def dotted_name(node: ast.AST) -> str | None:
 
 
 class PassManager:
-    """Run registered passes over a file or tree; apply waivers."""
+    """Run registered passes over a file or tree; apply waivers.
+
+    Per-file passes see one :class:`FileContext` at a time; project
+    passes (:class:`ProjectPass`) see the whole parsed scan at once.
+    ``check_file`` builds a single-file project (the fixture form:
+    intra-file interprocedural analysis still works), ``check_tree``
+    the full one, and ``check_changed`` parses the WHOLE tree to keep
+    the call graph sound but prunes the project passes' reporting to
+    the impacted component (changed functions plus every transitive
+    caller — a callee edit can create or kill a finding anchored
+    upstream)."""
 
     def __init__(self, passes: Iterable[AnalysisPass], root: Path) -> None:
         self.passes = list(passes)
         self.root = root
+        self.file_passes = [p for p in self.passes
+                            if not isinstance(p, ProjectPass)]
+        self.project_passes = [p for p in self.passes
+                               if isinstance(p, ProjectPass)]
 
-    def check_file(self, path: Path) -> list[Finding]:
+    def _parse(self, path: Path) -> "tuple[FileContext | None, " \
+                                    "Finding | None]":
         try:
-            ctx = FileContext.parse(path, self.root)
+            return FileContext.parse(path, self.root), None
         except SyntaxError as e:
             relpath = path.name
             try:
@@ -177,41 +231,92 @@ class PassManager:
                     self.root.resolve()).as_posix()
             except ValueError:
                 pass
-            return [Finding(str(path), relpath, e.lineno or 0, "syntax",
-                            f"syntax error: {e.msg}")]
+            return None, Finding(str(path), relpath, e.lineno or 0,
+                                 "syntax", f"syntax error: {e.msg}")
+
+    def _run_project(self, project: ProjectContext) -> list[Finding]:
         findings: list[Finding] = []
-        for ap in self.passes:
-            for f in ap.run(ctx):
-                if not ctx.waived(f.lineno, f.pass_id):
-                    findings.append(f)
-        findings.sort(key=lambda f: (f.lineno, f.pass_id, f.message))
+        for pp in self.project_passes:
+            findings.extend(pp.run_project(project))
         return findings
 
-    def check_tree(self) -> list[Finding]:
-        findings: list[Finding] = []
-        for path in sorted(self.root.rglob("*.py")):
-            if any(part in SKIP_PARTS for part in path.parts):
+    def _apply_waivers(self, findings: list[Finding],
+                       files: dict[str, FileContext]) -> list[Finding]:
+        out = []
+        for f in findings:
+            ctx = files.get(f.relpath)
+            if ctx is not None and ctx.waived(f.lineno, f.pass_id):
                 continue
-            findings.extend(self.check_file(path))
-        return findings
+            out.append(f)
+        out.sort(key=lambda f: (f.relpath, f.lineno, f.pass_id, f.message))
+        return out
+
+    def check_file(self, path: Path) -> list[Finding]:
+        ctx, syntax = self._parse(path)
+        if ctx is None:
+            return [syntax]
+        findings: list[Finding] = []
+        for ap in self.file_passes:
+            findings.extend(ap.run(ctx))
+        files = {ctx.relpath: ctx}
+        findings.extend(self._run_project(ProjectContext(files, self.root)))
+        return self._apply_waivers(findings, files)
+
+    def _tree_paths(self) -> list[Path]:
+        return [p for p in sorted(self.root.rglob("*.py"))
+                if not any(part in SKIP_PARTS for part in p.parts)]
+
+    def check_tree(self) -> list[Finding]:
+        files: dict[str, FileContext] = {}
+        findings: list[Finding] = []
+        for path in self._tree_paths():
+            ctx, syntax = self._parse(path)
+            if ctx is None:
+                findings.append(syntax)
+                continue
+            files[ctx.relpath] = ctx
+            for ap in self.file_passes:
+                findings.extend(ap.run(ctx))
+        findings.extend(self._run_project(ProjectContext(files, self.root)))
+        return self._apply_waivers(findings, files)
 
     def check_changed(self) -> tuple[list[Finding], list[str]]:
         """Scan only the ``*.py`` files under the root that git reports
         as modified vs HEAD or untracked — the fast pre-commit scope.
-        Returns (findings, scanned-relpaths)."""
-        paths = sorted(set(changed_files(self.root)))
-        findings: list[Finding] = []
-        scanned: list[str] = []
-        for path in paths:
+        The per-file passes run on exactly those files; the project
+        passes run over the whole parsed tree (anything less would
+        blind the call graph) with their findings pruned to the
+        impacted component. Returns (findings, scanned-relpaths)."""
+        changed = sorted(set(changed_files(self.root)))
+        changed_rel: list[str] = []
+        for path in changed:
             if any(part in SKIP_PARTS for part in path.parts):
                 continue
-            findings.extend(self.check_file(path))
             try:
-                scanned.append(path.resolve().relative_to(
+                changed_rel.append(path.resolve().relative_to(
                     self.root.resolve()).as_posix())
             except ValueError:
-                scanned.append(path.name)
-        return findings, scanned
+                changed_rel.append(path.name)
+        files: dict[str, FileContext] = {}
+        findings: list[Finding] = []
+        for path in self._tree_paths():
+            ctx, syntax = self._parse(path)
+            in_scope = syntax.relpath in changed_rel if ctx is None \
+                else ctx.relpath in changed_rel
+            if ctx is None:
+                if in_scope:
+                    findings.append(syntax)
+                continue
+            files[ctx.relpath] = ctx
+            if in_scope:
+                for ap in self.file_passes:
+                    findings.extend(ap.run(ctx))
+        if self.project_passes and changed_rel:
+            project = ProjectContext(files, self.root)
+            impacted = project.graph.impacted_files(changed_rel)
+            findings.extend(f for f in self._run_project(project)
+                            if f.relpath in impacted)
+        return self._apply_waivers(findings, files), changed_rel
 
 
 def changed_files(root: Path) -> list[Path]:
@@ -351,9 +456,17 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--passes", default=None,
                         help="comma-separated pass ids to run (default: all)")
     parser.add_argument("--list-passes", action="store_true")
-    parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="machine-readable verdict on stdout (findings, "
-                             "new, stale keys); exit code unchanged")
+    fmt = parser.add_mutually_exclusive_group()
+    fmt.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable verdict on stdout (findings, "
+                          "new, stale keys); exit code unchanged")
+    fmt.add_argument("--sarif", action="store_true", dest="as_sarif",
+                     help="SARIF 2.1.0 log on stdout (baselined findings "
+                          "carry a suppression); exit code unchanged")
+    parser.add_argument("--max-wall-s", type=float, default=None,
+                        metavar="S",
+                        help="fail (exit 1) if the scan itself takes longer "
+                             "than S seconds — the pre-commit wall budget")
     parser.add_argument("--changed", action="store_true",
                         help="scan only *.py files modified vs HEAD or "
                              "untracked (git-scoped pre-commit run); the "
@@ -375,6 +488,7 @@ def main(argv: list[str] | None = None) -> int:
                 if args.passes else None)
     manager = build_manager(root, pass_ids)
     scanned: list[str] | None = None
+    t0 = time.monotonic()
     if args.changed:
         if args.update_baseline:
             raise SystemExit("--update-baseline needs the full tree "
@@ -383,6 +497,8 @@ def main(argv: list[str] | None = None) -> int:
         findings, scanned = manager.check_changed()
     else:
         findings = manager.check_tree()
+    wall_s = round(time.monotonic() - t0, 3)
+    over_budget = (args.max_wall_s is not None and wall_s > args.max_wall_s)
 
     if args.update_baseline:
         save_baseline(baseline_path, findings)
@@ -395,17 +511,24 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if args.no_baseline:
-        if args.as_json:
+        if args.as_sarif:
+            print(json.dumps(_sarif_doc(findings, findings, manager, root),
+                             indent=2))
+        elif args.as_json:
             print(json.dumps({
                 "root": str(root), "baseline": None,
-                "scanned": scanned,
+                "scanned": scanned, "wall_s": wall_s,
                 "findings": [f.as_dict() for f in findings],
                 "new": [f.as_dict() for f in findings], "stale": [],
             }, indent=2))
         else:
             for f in findings:
                 print(f.render())
-            print(f"{len(findings)} finding(s)")
+            print(f"{len(findings)} finding(s) in {wall_s}s")
+        if over_budget:
+            print(f"WALL BUDGET EXCEEDED: {wall_s}s > "
+                  f"{args.max_wall_s}s", file=sys.stderr)
+            return 1
         return 1 if findings else 0
 
     new, stale = ratchet(findings, load_baseline(baseline_path))
@@ -415,24 +538,46 @@ def main(argv: list[str] | None = None) -> int:
         scanned_set = set(scanned)
         stale = Counter({k: v for k, v in stale.items()
                          if k.split("::", 1)[0] in scanned_set})
+    if args.as_sarif:
+        print(json.dumps(_sarif_doc(findings, new, manager, root), indent=2))
+        if over_budget:
+            print(f"WALL BUDGET EXCEEDED: {wall_s}s > "
+                  f"{args.max_wall_s}s", file=sys.stderr)
+            return 1
+        return 1 if new else 0
     if args.as_json:
         print(json.dumps({
             "root": str(root), "baseline": str(baseline_path),
-            "scanned": scanned,
+            "scanned": scanned, "wall_s": wall_s,
             "findings": [f.as_dict() for f in findings],
             "new": [f.as_dict() for f in new],
             "stale": sorted(stale.elements()),
         }, indent=2))
+        if over_budget:
+            print(f"WALL BUDGET EXCEEDED: {wall_s}s > "
+                  f"{args.max_wall_s}s", file=sys.stderr)
+            return 1
         return 1 if new else 0
     for f in new:
         print(f.render())
     print(f"{len(findings)} finding(s): {len(new)} new, "
           f"{len(findings) - len(new)} baselined, "
           f"{sum(stale.values())} stale baseline entr"
-          f"{'y' if sum(stale.values()) == 1 else 'ies'}")
+          f"{'y' if sum(stale.values()) == 1 else 'ies'} ({wall_s}s)")
     if stale:
         print("stale baseline entries (fixed findings — shrink with "
               "--update-baseline):")
         for key in sorted(stale):
             print(f"  {key}")
+    if over_budget:
+        print(f"WALL BUDGET EXCEEDED: {wall_s}s > "
+              f"{args.max_wall_s}s", file=sys.stderr)
+        return 1
     return 1 if new else 0
+
+
+def _sarif_doc(findings: list[Finding], new: list[Finding],
+               manager: PassManager, root: Path) -> dict:
+    from .sarif import to_sarif
+
+    return to_sarif(findings, new, manager.passes, root)

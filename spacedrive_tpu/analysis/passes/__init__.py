@@ -11,10 +11,12 @@ from ..engine import AnalysisPass
 from .async_blocking import AsyncBlockingPass
 from .commit_discipline import CommitDisciplinePass
 from .durability_discipline import DurabilityDisciplinePass
+from .hold_blocking import HoldBlockingPass
 from .jax_wedge import JaxWedgePass
 from .legacy import BareExceptPass, DuplicateDefPass, UnusedImportPass
 from .lock_discipline import LockDisciplinePass
 from .lockset import LocksetPass
+from .loop_blocking import LoopBlockingPass
 from .pipeline_ordering import PipelineOrderingPass
 from .query_discipline import QueryDisciplinePass
 from .queue_discipline import QueueDisciplinePass
@@ -22,6 +24,8 @@ from .resource_leak import ResourceLeakPass
 from .retry_discipline import RetryDisciplinePass
 from .swallowed import SwallowedExceptionPass
 from .telemetry_discipline import TelemetryDisciplinePass
+from .thread_role import ThreadRolePass
+from .waiver_ledger import WaiverLedgerPass
 from .worker_purity import WorkerPurityPass
 
 REGISTRY: tuple[type[AnalysisPass], ...] = (
@@ -44,6 +48,11 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     DurabilityDisciplinePass,
     QueryDisciplinePass,
     WorkerPurityPass,
+    # whole-program passes (ISSUE 16): run last, over the project graph
+    HoldBlockingPass,
+    LoopBlockingPass,
+    ThreadRolePass,
+    WaiverLedgerPass,
 )
 
 

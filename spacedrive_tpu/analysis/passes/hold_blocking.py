@@ -1,0 +1,154 @@
+"""hold-blocking: no blocking call reachable while a named lock is held.
+
+The interprocedural upgrade of the lockset family. The per-class
+``lockset`` pass proves mutations happen *under* a lock; this pass
+proves nothing SLOW happens under one: ``time.sleep``, socket and
+subprocess calls, ``db.query``/``db.transaction``-class DB work, and
+file I/O must not be reachable — at any call depth, across modules —
+from inside a ``with <named-lock>:`` body. Blocking under a contended
+lock is the canonical serve-tail killer: every waiter inherits the
+holder's I/O latency, and under ``SD_LOCK_SANITIZER=1`` the soak only
+catches the shape when the slow path actually fires. This pass catches
+it at parse time with a transitive witness path in the finding.
+
+What counts as a held lock: a ``with self.X:`` item where ``X`` is a
+lock attribute of the enclosing class (``Lock``/``SdLock``/``RLock``/
+``SdRLock``/``Condition``, asyncio locks excluded — they guard await
+interleave, not threads), or a ``with NAME:`` over a module-level lock.
+Bare ``.acquire()`` pairs stay the per-file ``lock-discipline`` pass's
+domain. ``async with`` never holds a thread lock here.
+
+Scoping: ``models/`` holders are exempt by design — ``db.writer`` /
+``db.reader`` exist precisely to serialize SQLite I/O, so "DB call
+under the DB lock" is the intended shape there, not a defect. The
+witness path renders function names only (never line numbers): the
+message is part of the baseline key and must survive unrelated edits.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..callgraph import (LOCK_FACTORIES, FunctionInfo, ModuleInfo,
+                         blocking_call_reason, walk_own_body, witness)
+from ..engine import Finding, ProjectContext, ProjectPass, dotted_name
+
+
+def _classify(call: ast.Call, mi: ModuleInfo) -> str | None:
+    # under a lock even bare open() is a finding: the page cache does
+    # not bound first-touch latency and the lock serializes every waiter
+    return blocking_call_reason(call, mi, include_db=True,
+                                include_open=True)
+
+
+def _module_locks(mi: ModuleInfo) -> set[str]:
+    """Module-level ``NAME = Lock()/SdLock(...)`` bindings."""
+    out: set[str] = set()
+    for stmt in mi.ctx.tree.body:
+        if not isinstance(stmt, ast.Assign) \
+                or not isinstance(stmt.value, ast.Call):
+            continue
+        factory = dotted_name(stmt.value.func) or ""
+        if factory.split(".")[0] == "asyncio":
+            continue
+        if factory.split(".")[-1] not in LOCK_FACTORIES:
+            continue
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _held_lock(expr: ast.expr, fn: FunctionInfo,
+               module_locks: set[str]) -> str | None:
+    """Rendered lock name when a with-item expression holds one."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" and fn.cls is not None \
+            and expr.attr in fn.cls.locks:
+        return f"self.{expr.attr}"
+    if isinstance(expr, ast.Name) and expr.id in module_locks:
+        return expr.id
+    return None
+
+
+def _with_body_walk(with_node: ast.With) -> Iterator[ast.AST]:
+    """Every node lexically inside the with-body, not descending into
+    nested defs/lambdas (deferred execution is not 'under the lock')."""
+    from collections import deque
+
+    queue = deque(with_node.body)
+    while queue:
+        node = queue.popleft()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        queue.extend(ast.iter_child_nodes(node))
+
+
+class HoldBlockingPass(ProjectPass):
+    id = "hold-blocking"
+    description = ("no sleep/socket/subprocess/DB/file-I/O call reachable "
+                   "(cross-module) while holding a named lock")
+
+    #: call depth explored below the with-body (witness stays readable;
+    #: real chains in this tree are 2-4 deep)
+    MAX_DEPTH = 12
+
+    def run_project(self, project: ProjectContext) -> Iterator[Finding]:
+        graph = project.graph
+        module_locks_cache: dict[str, set[str]] = {}
+        for fn in graph.functions.values():
+            if fn.relpath.startswith("models/"):
+                continue  # db.writer/db.reader serialize SQLite I/O by design
+            mi = graph.modules.get(fn.modkey)
+            if mi is None or mi.relpath != fn.relpath:
+                continue
+            if mi.modkey not in module_locks_cache:
+                module_locks_cache[mi.modkey] = _module_locks(mi)
+            mlocks = module_locks_cache[mi.modkey]
+            yield from self._check_function(fn, mi, mlocks, graph)
+
+    def _check_function(self, fn: FunctionInfo, mi: ModuleInfo,
+                        mlocks: set[str], graph) -> Iterator[Finding]:
+        seen: set[str] = set()
+        for node in walk_own_body(fn.node):
+            if not isinstance(node, ast.With):
+                continue
+            locks = [lock for item in node.items
+                     if (lock := _held_lock(item.context_expr, fn, mlocks))
+                     is not None]
+            if not locks:
+                continue
+            held = " + ".join(locks)
+            # edges indexed by call-site node so the transitive check
+            # only follows calls lexically inside THIS with-body
+            edges: dict[int, list] = {}
+            for callee, site, txt in fn.calls:
+                edges.setdefault(id(site), []).append((callee, site, txt))
+            for inner in _with_body_walk(node):
+                if not isinstance(inner, ast.Call):
+                    continue
+                reason = _classify(inner, mi)
+                if reason is not None:
+                    msg = (f"blocking {reason} while holding {held} "
+                           f"in {fn.short}")
+                    if msg not in seen:
+                        seen.add(msg)
+                        yield Finding(str(mi.ctx.path), fn.relpath,
+                                      inner.lineno, self.id, msg)
+                    continue
+                for callee, site, txt in edges.get(id(inner), ()):
+                    hit = graph.reachable_blocking(
+                        callee, _classify, max_depth=self.MAX_DEPTH)
+                    if hit is None:
+                        continue
+                    path, _blk_line, blk_reason = hit
+                    msg = (f"blocking {blk_reason} reachable while "
+                           f"holding {held}: "
+                           f"{witness([fn] + path)}")
+                    if msg not in seen:
+                        seen.add(msg)
+                        yield Finding(str(mi.ctx.path), fn.relpath,
+                                      site.lineno, self.id, msg)

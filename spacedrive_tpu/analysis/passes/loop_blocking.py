@@ -1,0 +1,76 @@
+"""loop-blocking: async-blocking upgraded to cross-module reachability.
+
+The per-file ``async-blocking`` pass gates the LEXICAL body of every
+``async def`` in api/ server/ p2p/ — it cannot see a sync
+``socket.recv`` two calls below a helper in another module. This pass
+closes that gap: starting from every ``async def`` root in the
+event-loop subsystems it follows resolved call edges (the project
+graph) and reports any blocking primitive reachable at depth >= 1,
+anchored at the root's own call site with the full witness path.
+
+Division of labor (so one defect is one finding):
+
+- depth 0 (a blocking call lexically inside the async body) stays
+  ``async-blocking``'s report;
+- the bodies of OTHER event-loop-subsystem async defs are skipped as
+  holders too — their own lexical sins are again ``async-blocking``'s
+  — but the walk still descends *through* them, so a chain
+  ``handler -> other_handler -> sync_helper -> time.sleep`` is found
+  exactly once, here;
+- spawn edges (``run_in_executor``, ``Thread(target=...)``) are not
+  call edges, so the sanctioned offload idiom never reports.
+
+DB calls are included: a ``db.query()`` on the loop stalls every
+connected peer for the full SQLite round-trip, which is exactly the
+WAN-soak tail shape PR 13 chased.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..callgraph import (EVENT_LOOP_DIRS, FunctionInfo, ModuleInfo,
+                         blocking_call_reason, top_dir, witness)
+from ..engine import Finding, ProjectContext, ProjectPass
+
+
+def _classify(call: ast.Call, mi: ModuleInfo) -> str | None:
+    return blocking_call_reason(call, mi, include_db=True,
+                                include_open=False)
+
+
+def _is_loop_async(fn: FunctionInfo) -> bool:
+    return fn.is_async and top_dir(fn.relpath) in EVENT_LOOP_DIRS
+
+
+class LoopBlockingPass(ProjectPass):
+    id = "loop-blocking"
+    description = ("no blocking call reachable (cross-module, depth>=1) "
+                   "from an async def in api|server|p2p")
+
+    MAX_DEPTH = 12
+
+    def run_project(self, project: ProjectContext) -> Iterator[Finding]:
+        graph = project.graph
+        for fn in graph.functions.values():
+            if not _is_loop_async(fn):
+                continue
+            mi = graph.modules.get(fn.modkey)
+            if mi is None or mi.relpath != fn.relpath:
+                continue
+            seen: set[str] = set()
+            for callee, site, _txt in fn.calls:
+                hit = graph.reachable_blocking(
+                    callee, _classify, max_depth=self.MAX_DEPTH,
+                    skip_holder=_is_loop_async)
+                if hit is None:
+                    continue
+                path, _blk_line, reason = hit
+                msg = (f"event-loop blocking: {reason} reachable from "
+                       f"async {fn.short} via {witness(path)}")
+                if msg in seen:
+                    continue
+                seen.add(msg)
+                yield Finding(str(mi.ctx.path), fn.relpath, site.lineno,
+                              self.id, msg)

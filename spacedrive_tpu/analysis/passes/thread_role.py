@@ -1,0 +1,277 @@
+"""thread-role: provenance-driven role checks over the call graph.
+
+Two checks, both powered by the thread-provenance lattice (the set of
+root labels — event loop, job worker, pipeline stages, lane appliers,
+serve-pool workers, supervisor, telemetry ticker — that can reach each
+function along direct call edges):
+
+**(a) event-loop-only functions must not block.** A sync function whose
+provenance is exactly ``{event-loop}`` runs nowhere but on the loop —
+typically a ``call_soon``/``add_done_callback`` callback or a
+``create_task`` target. Blocking primitives in its body stall every
+connected peer. Functions already covered by ``loop-blocking`` (those
+reachable from an ``async def`` root in api|server|p2p) are excluded,
+so each defect reports exactly once; what remains is the callback-only
+surface neither async pass can see.
+
+**(b) cross-class lockset round 2.** The per-class ``lockset`` pass
+proves guarded-attr discipline but cannot tell WHICH threads run each
+method. With provenance it can: an attribute mutated from >= 2 distinct
+thread roots with no lock held in common across all mutation sites is a
+data race no single-file view exposes (the two mutation sites may sit
+in methods that per-file analysis has no reason to relate). Lock credit
+at a site = locks lexically held in ``with`` blocks + the entry-lock
+fixpoint for underscore-private helpers (every in-class caller holds L
+=> the helper's body is credited with L — the ``_locked()`` idiom).
+``__init__`` is exempt (construction happens-before publication), lock
+attributes themselves are exempt, and only sites with non-empty
+provenance count (a method no root reaches is dead or external API —
+flagging it would be noise).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..callgraph import (EVENT_LOOP, CallGraph, ClassInfo, FunctionInfo,
+                         ModuleInfo, blocking_call_reason, walk_own_body)
+from ..engine import Finding, ProjectContext, ProjectPass
+from .loop_blocking import _is_loop_async
+from .lockset import MUTATOR_METHODS
+
+
+def _classify(call: ast.Call, mi: ModuleInfo) -> str | None:
+    return blocking_call_reason(call, mi, include_db=True,
+                                include_open=False)
+
+
+class _Site:
+    """One ``self.X`` mutation site with its lock credit + provenance."""
+
+    __slots__ = ("attr", "lineno", "locks", "roots", "method")
+
+    def __init__(self, attr: str, lineno: int, locks: frozenset[str],
+                 roots: frozenset[str], method: FunctionInfo) -> None:
+        self.attr = attr
+        self.lineno = lineno
+        self.locks = locks
+        self.roots = roots
+        self.method = method
+
+
+class ThreadRolePass(ProjectPass):
+    id = "thread-role"
+    description = ("event-loop-only functions must not block; attrs "
+                   "mutated from >=2 thread roots need a common lock")
+
+    def run_project(self, project: ProjectContext) -> Iterator[Finding]:
+        graph = project.graph
+        yield from self._check_loop_only(graph)
+        yield from self._check_cross_root_attrs(graph)
+
+    # -- (a) event-loop-only callbacks ---------------------------------------
+    def _async_reach(self, graph: CallGraph) -> set[str]:
+        """qnames reachable from any async-def root in api|server|p2p —
+        loop-blocking's territory, excluded here."""
+        from collections import deque
+
+        seeds = [f for f in graph.functions.values() if _is_loop_async(f)]
+        seen = {f.qname for f in seeds}
+        queue = deque(seeds)
+        while queue:
+            fn = queue.popleft()
+            for callee, _site, _txt in fn.calls:
+                if callee.qname not in seen:
+                    seen.add(callee.qname)
+                    queue.append(callee)
+        return seen
+
+    def _check_loop_only(self, graph: CallGraph) -> Iterator[Finding]:
+        async_reach = self._async_reach(graph)
+        for fn in graph.functions.values():
+            if fn.is_async or fn.qname in async_reach:
+                continue
+            if graph.provenance(fn) != frozenset({EVENT_LOOP}):
+                continue
+            mi = graph.modules.get(fn.modkey)
+            if mi is None or mi.relpath != fn.relpath:
+                continue
+            for node in walk_own_body(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = _classify(node, mi)
+                if reason is None:
+                    continue
+                yield Finding(
+                    str(mi.ctx.path), fn.relpath, node.lineno, self.id,
+                    f"{fn.short} runs only on the event loop "
+                    f"(provenance {{event-loop}}) but calls blocking "
+                    f"{reason}")
+
+    # -- (b) cross-root attribute mutations ----------------------------------
+    def _check_cross_root_attrs(self, graph: CallGraph) -> Iterator[Finding]:
+        for mi in graph.modules.values():
+            for ci in mi.classes:
+                yield from self._check_class(ci, mi, graph)
+
+    def _check_class(self, ci: ClassInfo, mi: ModuleInfo,
+                     graph: CallGraph) -> Iterator[Finding]:
+        if not ci.locks:
+            return  # an unlocked class is plain lockset's problem space
+        entry = self._entry_locks(ci)
+        sites: dict[str, list[_Site]] = {}
+        for name, method in ci.methods.items():
+            if name == "__init__":
+                continue
+            roots = graph.provenance(method)
+            if not roots:
+                continue
+            for attr, lineno, held in self._mutations(method, ci):
+                if attr in ci.locks:
+                    continue
+                locks = frozenset(held) | entry.get(name, frozenset())
+                sites.setdefault(attr, []).append(
+                    _Site(attr, lineno, locks, roots, method))
+        for attr in sorted(sites):
+            group = sites[attr]
+            all_roots = frozenset().union(*(s.roots for s in group))
+            if len(all_roots) < 2:
+                continue
+            common = frozenset.intersection(*(s.locks for s in group))
+            if common:
+                continue
+            first = min(group, key=lambda s: s.lineno)
+            roots_txt = ", ".join(sorted(all_roots))
+            methods_txt = ", ".join(sorted({s.method.name for s in group}))
+            yield Finding(
+                str(mi.ctx.path), ci.relpath, first.lineno, self.id,
+                f"attr 'self.{attr}' of {ci.name} mutated from roots "
+                f"{{{roots_txt}}} (in {methods_txt}) with no common lock")
+
+    def _mutations(self, method: FunctionInfo, ci: ClassInfo,
+                   ) -> Iterator[tuple[str, int, frozenset[str]]]:
+        """(attr, lineno, locks-lexically-held) per self.X mutation."""
+        for kind, payload, held in _walk_held(method.node.body,
+                                              frozenset(), ci):
+            if kind == "mut":
+                attr, lineno = payload
+                yield attr, lineno, held
+
+    def _entry_locks(self, ci: ClassInfo) -> dict[str, frozenset[str]]:
+        """Locks every in-class caller provably holds when calling each
+        underscore-private helper — iterated to fixpoint so credit flows
+        through helper chains (``_locked() -> _locked_inner()``)."""
+        call_sites: dict[str, list[tuple[str, frozenset[str]]]] = {}
+        for name, method in ci.methods.items():
+            for kind, payload, held in _walk_held(method.node.body,
+                                                  frozenset(), ci):
+                if kind == "call":
+                    call_sites.setdefault(payload, []).append((name, held))
+        entry: dict[str, frozenset[str]] = {}
+        for _ in range(len(ci.methods) + 1):
+            changed = False
+            for helper, sites in call_sites.items():
+                if not helper.startswith("_") or helper == "__init__":
+                    continue
+                credit = frozenset.intersection(*(
+                    held | entry.get(caller, frozenset())
+                    for caller, held in sites))
+                if entry.get(helper, frozenset()) != credit:
+                    entry[helper] = credit
+                    changed = True
+            if not changed:
+                break
+        return entry
+
+
+def _is_lock_item(expr: ast.expr, ci: ClassInfo) -> bool:
+    return (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self" and expr.attr in ci.locks)
+
+
+def _stmt_mutations(stmt: ast.stmt) -> Iterator[tuple[str, int]]:
+    """self.X writes in ONE simple statement."""
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    else:
+        targets = []
+    for t in targets:
+        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for e in elts:
+            if isinstance(e, ast.Attribute) \
+                    and isinstance(e.value, ast.Name) \
+                    and e.value.id == "self":
+                yield e.attr, stmt.lineno
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        f = stmt.value.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATOR_METHODS \
+                and isinstance(f.value, ast.Attribute) \
+                and isinstance(f.value.value, ast.Name) \
+                and f.value.value.id == "self":
+            yield f.value.attr, stmt.lineno
+
+
+_BLOCK_FIELDS = ("body", "orelse", "finalbody")
+
+
+def _walk_held(stmts, held: frozenset[str], ci: ClassInfo,
+               ) -> Iterator[tuple[str, object, frozenset[str]]]:
+    """Walk a statement list tracking which of the class's locks are
+    lexically held, yielding ``("mut", (attr, lineno), held)`` for each
+    self.X mutation and ``("call", method-name, held)`` for each
+    in-class ``self.m()`` call. Each node is visited exactly once with
+    the correct lock set (a ``with`` nested inside an ``if`` credits
+    its lock); nested defs/lambdas are deferred execution and skipped."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(stmt, ast.With):
+            inner = held | {item.context_expr.attr for item in stmt.items
+                            if _is_lock_item(item.context_expr, ci)}
+            for item in stmt.items:  # lock exprs evaluate BEFORE acquire
+                yield from _expr_events(item.context_expr, held, ci)
+            yield from _walk_held(stmt.body, inner, ci)
+            continue
+        blocks = [getattr(stmt, f, None) for f in _BLOCK_FIELDS]
+        blocks = [b for b in blocks if b]
+        extra = [h.body for h in getattr(stmt, "handlers", ())] + \
+                [c.body for c in getattr(stmt, "cases", ())]
+        if blocks or extra:
+            # compound statement: header expressions (If.test, For.iter,
+            # While.test, Match.subject...) evaluate with the CURRENT set
+            for field, value in ast.iter_fields(stmt):
+                if field in _BLOCK_FIELDS + ("handlers", "cases"):
+                    continue
+                for node in (value if isinstance(value, list) else [value]):
+                    if isinstance(node, ast.AST):
+                        yield from _expr_events(node, held, ci)
+            for block in blocks + extra:
+                yield from _walk_held(block, held, ci)
+        else:
+            for attr, lineno in _stmt_mutations(stmt):
+                yield "mut", (attr, lineno), held
+            yield from _expr_events(stmt, held, ci)
+
+
+def _expr_events(node: ast.AST, held: frozenset[str], ci: ClassInfo,
+                 ) -> Iterator[tuple[str, object, frozenset[str]]]:
+    """In-class self.m() calls inside one expression/simple statement."""
+    from collections import deque
+
+    queue = deque([node])
+    while queue:
+        cur = queue.popleft()
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue
+        if isinstance(cur, ast.Call) \
+                and isinstance(cur.func, ast.Attribute) \
+                and isinstance(cur.func.value, ast.Name) \
+                and cur.func.value.id == "self" \
+                and cur.func.attr in ci.methods:
+            yield "call", cur.func.attr, held
+        queue.extend(ast.iter_child_nodes(cur))

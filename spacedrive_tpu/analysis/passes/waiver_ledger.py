@@ -1,0 +1,127 @@
+"""waiver-ledger: concurrency waivers and robustness.md must agree.
+
+A scoped ``lint: ok(lockset)``-style waiver comment is an argument that
+a flagged shape is safe — and arguments belong where reviewers read
+them, not buried in a trailing comment. The ledger in docs/architecture/robustness.md (the
+"known waivers" table) is that place. This meta-pass enforces the
+contract in both directions, the way the /metrics drift gate pins the
+telemetry doc:
+
+- every in-tree waiver naming a concurrency pass (``lockset``,
+  ``hold-blocking``, ``loop-blocking``, ``thread-role``) must have a
+  ledger row whose site names the waiver's file;
+- every ledger row must still correspond to at least one such waiver in
+  the named file — a fixed site whose row lingers is a stale argument
+  that will mislead the next reader (and rows for files that no longer
+  exist are flagged too).
+
+Fixture trees have no robustness.md; the pass is silent then, so the
+red/green fixtures of the other passes stay self-contained. The ledger
+is looked up at ``<root>/docs/architecture/robustness.md`` first and
+``<root>/../docs/architecture/robustness.md`` second (the real layout:
+the scan root is the package directory, docs live beside it).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Iterator
+
+from ..engine import WAIVER_RE, FileContext, Finding, ProjectContext, \
+    ProjectPass
+
+#: the pass families whose waivers demand a written argument
+LEDGER_PASSES = frozenset(
+    {"lockset", "hold-blocking", "loop-blocking", "thread-role"})
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+def _ledger_path(root: Path) -> Path | None:
+    for base in (root, root.parent):
+        cand = base / "docs" / "architecture" / "robustness.md"
+        if cand.is_file():
+            return cand
+    return None
+
+
+def parse_ledger(text: str) -> list[tuple[str, str]]:
+    """(relpath, row-text) per known-waiver table row. The table is
+    recognized by its header (a markdown row containing both ``site``
+    and ``waived``); the site cell's first backticked token is the
+    file path."""
+    rows: list[tuple[str, str]] = []
+    in_table = False
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        low = stripped.lower()
+        if "site" in low and "waived" in low:
+            in_table = True
+            continue
+        if not in_table or set(stripped) <= {"|", "-", " ", ":"}:
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        m = _BACKTICK_RE.search(cells[0])
+        if m:
+            rows.append((m.group(1), stripped))
+    return rows
+
+
+def _file_waivers(ctx: FileContext) -> Iterator[tuple[int, frozenset[str]]]:
+    """(lineno, ledger-relevant pass ids) per scoped waiver comment."""
+    for i, line in enumerate(ctx.lines, start=1):
+        m = WAIVER_RE.search(line)
+        if m is None or m.group(1) is None:
+            continue  # no waiver, or the blanket form (hygiene-pass use)
+        ids = frozenset(p.strip() for p in m.group(1).split(",")
+                        if p.strip()) & LEDGER_PASSES
+        if ids:
+            yield i, ids
+
+
+class WaiverLedgerPass(ProjectPass):
+    id = "waiver-ledger"
+    description = ("every concurrency-pass waiver has a robustness.md "
+                   "ledger row and no ledger row is stale")
+
+    def run_project(self, project: ProjectContext) -> Iterator[Finding]:
+        ledger = _ledger_path(project.root)
+        if ledger is None:
+            return  # fixture tree: nothing to cross-check against
+        try:
+            rows = parse_ledger(ledger.read_text())
+        except OSError:
+            return
+        ledger_files = {relpath for relpath, _row in rows}
+        waived_files: set[str] = set()
+        for relpath, ctx in sorted(project.files.items()):
+            for lineno, ids in _file_waivers(ctx):
+                waived_files.add(relpath)
+                if relpath not in ledger_files:
+                    yield Finding(
+                        str(ctx.path), relpath, lineno, self.id,
+                        f"waiver for {'/'.join(sorted(ids))} has no "
+                        f"known-waiver ledger row in robustness.md "
+                        f"(add `{relpath}` to the table, with the "
+                        f"argument)")
+        for relpath, _row in rows:
+            if relpath in waived_files:
+                continue
+            ctx = project.files.get(relpath)
+            if ctx is not None:
+                yield Finding(
+                    str(ctx.path), relpath, 1, self.id,
+                    f"stale known-waiver ledger row: `{relpath}` has no "
+                    f"{'/'.join(sorted(LEDGER_PASSES))} waiver left — "
+                    f"drop the robustness.md row")
+            else:
+                yield Finding(
+                    relpath, relpath, 0, self.id,
+                    f"stale known-waiver ledger row: `{relpath}` is not "
+                    f"in the scanned tree — drop the robustness.md row")

@@ -1,0 +1,85 @@
+"""SARIF 2.1.0 export for sdlint findings.
+
+SARIF (Static Analysis Results Interchange Format, OASIS standard) is
+what code-review tooling ingests — GitHub code scanning, VS Code SARIF
+viewers, reviewdog. ``python -m spacedrive_tpu.analysis --sarif`` emits
+one run with every registered pass as a ``reportingDescriptor`` rule
+and every finding as a ``result``; findings the baseline ratchet
+tolerates carry a ``suppressions`` entry (kind ``external``,
+justification ``baseline``) so viewers show them greyed-out instead of
+hiding the debt entirely.
+
+Only the stable core of the spec is emitted — tool metadata, rules,
+results with physical locations, suppressions — because the consumers
+above need nothing more and every extra property is another thing the
+round-trip test must pin.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable
+
+from .engine import AnalysisPass, Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _rule(ap: AnalysisPass) -> dict:
+    return {
+        "id": ap.id,
+        "shortDescription": {"text": ap.description or ap.id},
+    }
+
+
+def _result(f: Finding, baselined: bool) -> dict:
+    result = {
+        "ruleId": f.pass_id,
+        "level": "warning",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.relpath},
+                # findings from a missing file (stale ledger rows) have
+                # lineno 0; SARIF regions are 1-based so clamp up
+                "region": {"startLine": max(1, f.lineno)},
+            },
+        }],
+    }
+    if baselined:
+        result["suppressions"] = [{
+            "kind": "external",
+            "justification": "baseline",
+        }]
+    return result
+
+
+def to_sarif(findings: Iterable[Finding], new: Iterable[Finding],
+             passes: Iterable[AnalysisPass], root: Path) -> dict:
+    """Findings → a SARIF 2.1.0 log dict (one run). ``new`` is the
+    subset beyond the baseline; everything else is marked suppressed.
+    Membership is by identity — the ratchet hands back the same Finding
+    objects it was given, and two findings with equal fields at
+    different sites must not alias each other's suppression state."""
+    new_ids = {id(f) for f in new}
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "sdlint",
+                    "informationUri":
+                        "docs/static-analysis.md",
+                    "rules": [_rule(ap) for ap in passes],
+                },
+            },
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": root.resolve().as_uri() + "/"},
+            },
+            "results": [_result(f, id(f) not in new_ids)
+                        for f in findings],
+        }],
+    }

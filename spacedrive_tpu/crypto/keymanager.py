@@ -68,6 +68,13 @@ class KeyManager:
         self._root: Protected | None = None
         self._mounted: dict[str, Protected] = {}
         self._store = self._load()
+        # persistence runs OUTSIDE self._lock (mount/get_key on the
+        # encrypt/decrypt job path must never wait on keystore disk
+        # I/O); the save lock only orders writers, and the version gate
+        # keeps a stale snapshot from clobbering a newer one on disk
+        self._save_lock = threading.Lock()
+        self._store_version = 0
+        self._saved_version = 0
 
     # -- persistence ---------------------------------------------------------
     def _load(self) -> dict:
@@ -78,11 +85,24 @@ class KeyManager:
                 pass
         return {"root_slot": None, "keys": {}, "default": None}
 
-    def _save(self) -> None:
-        self.store_path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = self.store_path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(self._store, indent=1))
-        tmp.replace(self.store_path)
+    def _snapshot(self) -> tuple[int, str]:
+        """Serialize the store (call under ``self._lock``); hand the
+        result to :meth:`_persist` AFTER releasing the lock."""
+        self._store_version += 1
+        return self._store_version, json.dumps(self._store, indent=1)
+
+    def _persist(self, snap: tuple[int, str]) -> None:
+        version, payload = snap
+        with self._save_lock:
+            if version <= self._saved_version:
+                return  # a newer snapshot already reached disk
+            self.store_path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self.store_path.with_suffix(".tmp")
+            # the ONE write under a lock: _save_lock exists solely to
+            # order keystore writes (see robustness.md known waivers)
+            tmp.write_text(payload)  # lint: ok(hold-blocking)
+            tmp.replace(self.store_path)
+            self._saved_version = version
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -103,8 +123,9 @@ class KeyManager:
             slot = Keyslot.new(Algorithm.XCHACHA20_POLY1305,
                                HashingAlgorithm.argon2id(), pw, root)
             self._store["root_slot"] = _slot_to_json(slot)
-            self._save()
             self._root = root
+            snap = self._snapshot()
+        self._persist(snap)
 
     def unlock(self, master_password: str | Protected) -> None:
         with self._lock:
@@ -132,7 +153,8 @@ class KeyManager:
             slot = Keyslot.new(Algorithm.XCHACHA20_POLY1305,
                                HashingAlgorithm.argon2id(), pw, self._root)
             self._store["root_slot"] = _slot_to_json(slot)
-            self._save()
+            snap = self._snapshot()
+        self._persist(snap)
 
     def clear_master_password(self) -> None:
         """Drop the in-memory root key WITHOUT unmounting keys: already-
@@ -195,8 +217,10 @@ class KeyManager:
             # about the random 256-bit key)
             self._store["auto_unlock_check"] = hashlib.sha256(
                 b"sd-km-check|" + root.expose()).hexdigest()
-            self._save()
-            return store.name
+            name = store.name
+            snap = self._snapshot()
+        self._persist(snap)
+        return name
 
     def disable_auto_unlock(self, store=None) -> None:
         with self._lock:
@@ -204,7 +228,8 @@ class KeyManager:
             store.delete(self._keyring_account())
             self._store.pop("auto_unlock", None)
             self._store.pop("auto_unlock_check", None)
-            self._save()
+            snap = self._snapshot()
+        self._persist(snap)
 
     def try_auto_unlock(self, store=None) -> bool:
         """Unlock from the secret store when enabled; False when the store
@@ -259,9 +284,10 @@ class KeyManager:
                 "name": name, "algorithm": algorithm.value,
                 "nonce": _b64(nonce), "key": _b64(sealed),
             }
-            self._save()
             self._mounted[kid] = key
-            return kid
+            snap = self._snapshot()
+        self._persist(snap)
+        return kid
 
     def mount(self, kid: str) -> None:
         with self._lock:
@@ -291,7 +317,8 @@ class KeyManager:
         with self._lock:
             self.unmount(kid)
             self._store["keys"].pop(kid, None)
-            self._save()
+            snap = self._snapshot()
+        self._persist(snap)
 
     def unmount_all(self) -> int:
         with self._lock:
@@ -319,7 +346,8 @@ class KeyManager:
             if kid not in self._store["keys"]:
                 raise KeyManagerError(f"no stored key {kid}")
             self._store["default"] = kid
-            self._save()
+            snap = self._snapshot()
+        self._persist(snap)
 
     def get_default(self) -> str | None:
         with self._lock:
@@ -331,26 +359,29 @@ class KeyManager:
             if rec is None:
                 raise KeyManagerError(f"no stored key {kid}")
             rec["automount"] = bool(status)
-            self._save()
+            snap = self._snapshot()
+        self._persist(snap)
 
     # -- keystore backup / restore -------------------------------------------
     def backup_keystore(self, path: str | Path) -> int:
         """Copy the (everything-sealed) keystore out; returns key count."""
         with self._lock:
-            Path(path).write_text(json.dumps(self._store, indent=1))
-            return len(self._store["keys"])
+            payload = json.dumps(self._store, indent=1)
+            count = len(self._store["keys"])
+        Path(path).write_text(payload)
+        return count
 
     def restore_keystore(self, path: str | Path,
                          password: str | Protected) -> int:
         """Merge keys from a backup keystore, verifying with THAT keystore's
         master password and re-sealing each key under our root key. Returns
         how many keys were imported (duplicates skipped)."""
+        try:
+            backup = json.loads(Path(path).read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            raise KeyManagerError(f"unreadable backup: {e}") from e
         with self._lock:
             root = self._require_root()
-            try:
-                backup = json.loads(Path(path).read_text())
-            except (OSError, json.JSONDecodeError) as e:
-                raise KeyManagerError(f"unreadable backup: {e}") from e
             if not backup.get("root_slot"):
                 raise KeyManagerError("backup has no root keyslot")
             pw = password if isinstance(password, Protected) \
@@ -381,6 +412,7 @@ class KeyManager:
                 raw.zeroize()
                 imported += 1
             their_root.zeroize()
-            if imported:
-                self._save()
-            return imported
+            snap = self._snapshot() if imported else None
+        if snap is not None:
+            self._persist(snap)
+        return imported

@@ -33,7 +33,9 @@ def init_core(data_dir: str) -> str:
         from .node import Node
 
         try:
-            _node = Node(data_dir)
+            # boot-once guard: the lock EXISTS to make concurrent callers
+            # wait for the one Node construction (robustness.md waivers)
+            _node = Node(data_dir)  # lint: ok(hold-blocking)
             _events = _node.events.subscribe()
         except Exception as e:
             return json.dumps({"ok": False, "error": repr(e)})

@@ -205,9 +205,10 @@ class ThumbnailRemoverActor:
     def process_marked(self) -> int:
         with self._marked_lock:
             marked, self._marked = self._marked, set()
+        base = self._thumb_dir()
         removed = 0
         for cas_id in marked:
-            if self._delete_thumb(cas_id):
+            if self._delete_thumb(base, cas_id):
                 removed += 1
         return removed
 
@@ -241,6 +242,10 @@ class ThumbnailRemoverActor:
             self._ephemeral = {c: t for c, t in self._ephemeral.items()
                                if t >= cutoff}
         removed = 0
+        # resolve the cache dir BEFORE the loop: the first call per
+        # process mkdirs + version-stamps it (blocking file I/O that must
+        # not run under the registrar's lock — browses mark() through it)
+        base = self._thumb_dir()
         for cas_id in on_disk:
             if cas_id in alive:
                 continue
@@ -250,14 +255,14 @@ class ThumbnailRemoverActor:
             with self._marked_lock:
                 if cas_id in self._ephemeral:
                     continue
-                if self._delete_thumb(cas_id):
+                if self._delete_thumb(base, cas_id):
                     removed += 1
         if removed:
             logger.info("thumbnail GC removed %d stale thumbnails", removed)
         return removed
 
-    def _delete_thumb(self, cas_id: str) -> bool:
-        path = self._thumb_dir() / cas_id[:2] / f"{cas_id}.webp"
+    def _delete_thumb(self, base: Path, cas_id: str) -> bool:
+        path = base / cas_id[:2] / f"{cas_id}.webp"
         try:
             path.unlink()
         except FileNotFoundError:

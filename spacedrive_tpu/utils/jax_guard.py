@@ -150,7 +150,9 @@ def ensure_jax_safe(timeout: float | None = None) -> bool:
     with _LOCK:
         if _STATE["checked"]:
             return _STATE["device_ok"]
-        ok = _probe(PROBE_TIMEOUT if timeout is None else timeout)
+        # probe-once guard: the lock EXISTS to make every other caller
+        # wait for the single device probe (robustness.md known waivers)
+        ok = _probe(PROBE_TIMEOUT if timeout is None else timeout)  # lint: ok(hold-blocking)
         _STATE.update(checked=True, device_ok=ok)
         return ok
 

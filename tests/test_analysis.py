@@ -1037,3 +1037,559 @@ def test_cli_update_baseline_and_passes_filter(tmp_path, capsys):
     assert "unused-import" in out and "jax-wedge" not in out
     with pytest.raises(SystemExit):
         main([str(tmp_path), "--passes", "no-such-pass"])
+
+
+# -- whole-program fixtures (ISSUE 16) ----------------------------------------
+
+def run_tree(tmp_path: Path, files: dict[str, str],
+             pass_id: str | None = None):
+    """Write a multi-file fixture tree and run every pass over it —
+    the project passes see the full call graph."""
+    for relpath, source in files.items():
+        f = tmp_path / relpath
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(source)
+    findings = PassManager(all_passes(), tmp_path).check_tree()
+    if pass_id is not None:
+        findings = [x for x in findings if x.pass_id == pass_id]
+    return findings
+
+
+# -- pass 17: hold-blocking ---------------------------------------------------
+
+def test_hold_blocking_lexical_and_deferred(tmp_path):
+    findings = run_tree(tmp_path, {"sync/reg.py": (
+        "import threading, time\n"
+        "_LOCK = threading.Lock()\n"
+        "def entry():\n"
+        "    with _LOCK:\n"
+        "        time.sleep(1)\n"
+        "def entry_def(path):\n"
+        "    with _LOCK:\n"
+        "        def later():\n"                  # deferred: not under
+        "            time.sleep(1)\n"             # the lock at runtime
+        "        return later\n")}, "hold-blocking")
+    assert [(f.lineno, f.message) for f in findings] == [
+        (5, "blocking time.sleep() while holding _LOCK in reg.entry")]
+
+
+def test_hold_blocking_cross_module_witness_path(tmp_path):
+    """The interprocedural acceptance case: the blocking call lives two
+    modules away and the finding quotes the full witness chain."""
+    findings = run_tree(tmp_path, {
+        "sync/util.py": (
+            "def flush(path, payload):\n"
+            "    path.write_text(payload)\n"),
+        "sync/reg.py": (
+            "import threading\n"
+            "from sync.util import flush\n"
+            "_LOCK = threading.Lock()\n"
+            "def entry(path):\n"
+            "    with _LOCK:\n"
+            "        flush(path, 'x')\n"
+            "def entry_ok(path):\n"               # same callee AFTER the
+            "    with _LOCK:\n"                   # lock is released: clean
+            "        payload = 'x'\n"
+            "    flush(path, payload)\n"),
+    }, "hold-blocking")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.relpath == "sync/reg.py" and f.lineno == 6
+    assert f.message == ("blocking .write_text() reachable while holding "
+                         "_LOCK: reg.entry -> util.flush")
+
+
+def test_hold_blocking_models_exempt(tmp_path):
+    """db.writer/db.reader exist to serialize SQLite I/O — 'blocking
+    under the lock' is the designed shape in models/, not a defect."""
+    src = (
+        "import threading\n"
+        "_LOCK = threading.Lock()\n"
+        "def write(path):\n"
+        "    with _LOCK:\n"
+        "        path.write_text('x')\n")
+    assert run_tree(tmp_path, {"models/db.py": src}, "hold-blocking") == []
+    assert len(run_tree(tmp_path, {"sync/db.py": src},
+                        "hold-blocking")) == 1
+
+
+def test_hold_blocking_keymanager_regression(tmp_path):
+    """The shipped crypto/keymanager.py defect: every mutator persisted
+    the keystore to disk from INSIDE ``with self._lock:`` via _save(),
+    so mount/get_key on the job path inherited disk latency. Red is the
+    old shape; green is the snapshot-under-lock/persist-outside split
+    it was rewritten to."""
+    red = run_tree(tmp_path / "red", {"crypto2/km.py": (
+        "import json, threading\n"
+        "class KeyManager:\n"
+        "    def __init__(self, store_path):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._store = {}\n"
+        "        self.store_path = store_path\n"
+        "    def _save(self):\n"
+        "        self.store_path.write_text(json.dumps(self._store))\n"
+        "    def add_key(self, kid):\n"
+        "        with self._lock:\n"
+        "            self._store[kid] = 1\n"
+        "            self._save()\n")}, "hold-blocking")
+    assert [f.message for f in red] == [
+        "blocking .write_text() reachable while holding self._lock: "
+        "km.KeyManager.add_key -> km.KeyManager._save"]
+
+    green = run_tree(tmp_path / "green", {"crypto2/km_ok.py": (
+        "import json, threading\n"
+        "class KeyManager:\n"
+        "    def __init__(self, store_path):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._store = {}\n"
+        "        self.store_path = store_path\n"
+        "    def _snapshot(self):\n"
+        "        return json.dumps(self._store)\n"
+        "    def _persist(self, snap):\n"
+        "        self.store_path.write_text(snap)\n"
+        "    def add_key(self, kid):\n"
+        "        with self._lock:\n"
+        "            self._store[kid] = 1\n"
+        "            snap = self._snapshot()\n"
+        "        self._persist(snap)\n")}, "hold-blocking")
+    assert green == []
+
+
+def test_hold_blocking_gc_thumb_dir_regression(tmp_path):
+    """The shipped objects/gc.py defect: _delete_thumb resolved the
+    thumbnail base dir per call, and the FIRST resolution runs mkdir +
+    version-stamp I/O (open()) — all under the registrar's lock. Red is
+    the old shape with the open() three frames down; green hoists the
+    base-dir resolution out of the locked region."""
+    red = run_tree(tmp_path / "red", {"objects2/g.py": (
+        "import threading\n"
+        "class Gc:\n"
+        "    def __init__(self, root):\n"
+        "        self._marked_lock = threading.Lock()\n"
+        "        self._root = root\n"
+        "        self._marked = []\n"
+        "    def _thumb_dir(self):\n"
+        "        p = self._root / 'thumbs'\n"
+        "        with open(p / 'version', 'w') as fh:\n"
+        "            fh.write('1')\n"
+        "        return p\n"
+        "    def _delete(self, cas):\n"
+        "        base = self._thumb_dir()\n"
+        "        (base / cas).unlink()\n"
+        "    def sweep(self):\n"
+        "        with self._marked_lock:\n"
+        "            for cas in self._marked:\n"
+        "                self._delete(cas)\n")}, "hold-blocking")
+    assert [f.message for f in red] == [
+        "blocking open() reachable while holding self._marked_lock: "
+        "g.Gc.sweep -> g.Gc._delete -> g.Gc._thumb_dir"]
+
+    green = run_tree(tmp_path / "green", {"objects2/g_ok.py": (
+        "import threading\n"
+        "class Gc:\n"
+        "    def __init__(self, root):\n"
+        "        self._marked_lock = threading.Lock()\n"
+        "        self._root = root\n"
+        "        self._marked = []\n"
+        "    def _thumb_dir(self):\n"
+        "        p = self._root / 'thumbs'\n"
+        "        with open(p / 'version', 'w') as fh:\n"
+        "            fh.write('1')\n"
+        "        return p\n"
+        "    def _delete(self, base, cas):\n"
+        "        (base / cas).unlink()\n"
+        "    def sweep(self):\n"
+        "        base = self._thumb_dir()\n"
+        "        with self._marked_lock:\n"
+        "            for cas in self._marked:\n"
+        "                self._delete(base, cas)\n")}, "hold-blocking")
+    assert green == []
+
+
+# -- pass 18: loop-blocking ---------------------------------------------------
+
+def test_loop_blocking_cross_module_reachability(tmp_path):
+    """async-blocking sees only the coroutine's lexical body; this pass
+    follows the resolved call into another module."""
+    findings = run_tree(tmp_path, {
+        "objects/helper.py": (
+            "import time\n"
+            "def scan_disk():\n"
+            "    time.sleep(1)\n"),
+        "server/routes.py": (
+            "from objects.helper import scan_disk\n"
+            "async def handler(req):\n"
+            "    scan_disk()\n"),
+    }, "loop-blocking")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.relpath == "server/routes.py" and f.lineno == 3
+    assert f.message == ("event-loop blocking: time.sleep() reachable "
+                         "from async routes.handler via helper.scan_disk")
+
+
+def test_loop_blocking_depth_zero_stays_async_blockings(tmp_path):
+    """A lexical sleep inside the async body is async-blocking's report
+    — loop-blocking must not double it."""
+    files = {"server/direct.py": (
+        "import time\n"
+        "async def handler(req):\n"
+        "    time.sleep(1)\n")}
+    assert run_tree(tmp_path, dict(files), "loop-blocking") == []
+    assert len(run_tree(tmp_path, dict(files), "async-blocking")) == 1
+
+
+def test_loop_blocking_executor_offload_is_sanctioned(tmp_path):
+    """run_in_executor is a spawn edge, not a call edge: the offload
+    idiom never reports — and the offloaded helper gains an executor
+    root, not the loop's."""
+    findings = run_tree(tmp_path, {"server/off.py": (
+        "import time\n"
+        "def blocking_read():\n"
+        "    time.sleep(1)\n"
+        "async def handler(loop):\n"
+        "    await loop.run_in_executor(None, blocking_read)\n")})
+    assert [f for f in findings
+            if f.pass_id in ("loop-blocking", "thread-role")] == []
+
+
+# -- pass 19: thread-role -----------------------------------------------------
+
+def test_thread_role_flags_loop_only_callback(tmp_path):
+    """A call_soon callback runs ON the loop but is invisible to both
+    async passes (it is a sync def, reached by no async body): only the
+    provenance lattice can see it."""
+    findings = run_tree(tmp_path, {"server/cb.py": (
+        "import time\n"
+        "async def boot(loop):\n"
+        "    loop.call_soon(tick)\n"
+        "    loop.call_soon(quick)\n"
+        "def tick():\n"
+        "    time.sleep(1)\n"
+        "def quick():\n"
+        "    return 1\n")}, "thread-role")
+    assert [(f.lineno, f.message) for f in findings] == [
+        (6, "cb.tick runs only on the event loop (provenance "
+            "{event-loop}) but calls blocking time.sleep()")]
+
+
+def test_thread_role_flags_cross_root_attr_mutation(tmp_path):
+    """Two thread roots mutate the same attribute with no common lock —
+    the race no per-file pass can know about, because WHICH threads run
+    each method is a whole-program fact."""
+    findings = run_tree(tmp_path, {"sync/counter.py": (
+        "import threading\n"
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run, name='sd-a').start()\n"
+        "        threading.Thread(target=self._pump, name='sd-b').start()\n"
+        "    def _run(self):\n"
+        "        self._n += 1\n"
+        "    def _pump(self):\n"
+        "        self._n += 1\n")}, "thread-role")
+    assert [f.message for f in findings] == [
+        "attr 'self._n' of Counter mutated from roots "
+        "{thread:sd-a, thread:sd-b} (in _pump, _run) with no common lock"]
+
+
+def test_thread_role_common_lock_and_entry_credit_are_green(tmp_path):
+    """Both mutation sites hold self._lock — one lexically, one through
+    the underscore-helper entry-lock fixpoint (_run holds the lock at
+    _bump's only call site, so _bump's body is credited)."""
+    findings = run_tree(tmp_path, {"sync/counter_ok.py": (
+        "import threading\n"
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run, name='sd-a').start()\n"
+        "        threading.Thread(target=self._pump, name='sd-b').start()\n"
+        "    def _run(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n"
+        "    def _bump(self):\n"
+        "        self._n += 1\n"
+        "    def _pump(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n")}, "thread-role")
+    assert findings == []
+
+
+# -- pass 20: waiver-ledger ---------------------------------------------------
+
+_LEDGER_HEADER = (
+    "# Robustness\n\n"
+    "Known waivers:\n\n"
+    "| site | waived rule | argument |\n"
+    "|---|---|---|\n")
+
+
+def _write_ledger(tmp_path: Path, rows: str) -> None:
+    doc = tmp_path / "docs" / "architecture"
+    doc.mkdir(parents=True, exist_ok=True)
+    (doc / "robustness.md").write_text(_LEDGER_HEADER + rows)
+
+
+def test_waiver_ledger_flags_unledgered_waiver_and_stale_rows(tmp_path):
+    _write_ledger(tmp_path, (
+        "| `sync/gone.py` `foo` | lockset | row for a deleted file |\n"
+        "| `sync/clean.py` `bar` | lockset | row for a fixed site |\n"))
+    findings = run_tree(tmp_path, {
+        "sync/w.py": "X = 1  # lint: ok(lockset)\n",
+        "sync/clean.py": "Y = 1\n",
+    }, "waiver-ledger")
+    messages = sorted(f.message for f in findings)
+    assert messages == [
+        "stale known-waiver ledger row: `sync/clean.py` has no "
+        "hold-blocking/lockset/loop-blocking/thread-role waiver left — "
+        "drop the robustness.md row",
+        "stale known-waiver ledger row: `sync/gone.py` is not in the "
+        "scanned tree — drop the robustness.md row",
+        "waiver for lockset has no known-waiver ledger row in "
+        "robustness.md (add `sync/w.py` to the table, with the "
+        "argument)",
+    ]
+
+
+def test_waiver_ledger_green_when_table_and_tree_agree(tmp_path):
+    _write_ledger(tmp_path,
+                  "| `sync/w.py` `X` | lockset | the argument |\n")
+    findings = run_tree(tmp_path, {
+        "sync/w.py": "X = 1  # lint: ok(lockset)\n",
+        # blanket and non-concurrency waivers need no ledger row
+        "sync/other.py": ("import os  # lint: ok\n"
+                          "Y = 1  # lint: ok(resource-leak)\n"),
+    }, "waiver-ledger")
+    assert findings == []
+
+
+def test_waiver_ledger_silent_without_robustness_md(tmp_path):
+    findings = run_tree(tmp_path, {
+        "sync/w.py": "X = 1  # lint: ok(hold-blocking)\n",
+    }, "waiver-ledger")
+    assert findings == []
+
+
+# -- the call graph: hard edges -----------------------------------------------
+
+def test_callgraph_dict_dispatch_tables(tmp_path):
+    """TABLE[key]() fans out to every table value — the jobs-registry
+    idiom must not be a resolution hole."""
+    findings = run_tree(tmp_path, {"sync/disp.py": (
+        "import threading, time\n"
+        "def do_a():\n"
+        "    time.sleep(1)\n"
+        "def do_b():\n"
+        "    return 1\n"
+        "TABLE = {'a': do_a, 'b': do_b}\n"
+        "_LOCK = threading.Lock()\n"
+        "def entry(key):\n"
+        "    with _LOCK:\n"
+        "        TABLE[key]()\n")}, "hold-blocking")
+    assert [f.message for f in findings] == [
+        "blocking time.sleep() reachable while holding _LOCK: "
+        "disp.entry -> disp.do_a"]
+
+
+def test_callgraph_lambda_thread_target(tmp_path):
+    """A lambda handed to Thread(target=...) becomes its own node whose
+    body resolves in the parent scope — provenance flows through it to
+    the method it invokes."""
+    findings = run_tree(tmp_path, {"sync/lam.py": (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=lambda: self._run(),\n"
+        "                         name='sd-lam').start()\n"
+        "        threading.Thread(target=self._pump, name='sd-p').start()\n"
+        "    def _run(self):\n"
+        "        self._n += 1\n"
+        "    def _pump(self):\n"
+        "        self._n += 1\n")}, "thread-role")
+    assert [f.message for f in findings] == [
+        "attr 'self._n' of C mutated from roots "
+        "{thread:sd-lam, thread:sd-p} (in _pump, _run) with no common lock"]
+
+
+def test_callgraph_reexported_names(tmp_path):
+    """from sync import flush, where sync/__init__.py re-exports it from
+    sync/util.py — the witness path names the real definition."""
+    findings = run_tree(tmp_path, {
+        "sync/util.py": (
+            "def flush(path):\n"
+            "    path.write_text('x')\n"),
+        "sync/__init__.py": "from .util import flush\n",
+        "jobs/reg.py": (
+            "import threading\n"
+            "from sync import flush\n"
+            "_LOCK = threading.Lock()\n"
+            "def entry(path):\n"
+            "    with _LOCK:\n"
+            "        flush(path)\n"),
+    }, "hold-blocking")
+    assert [f.message for f in findings] == [
+        "blocking .write_text() reachable while holding _LOCK: "
+        "reg.entry -> util.flush"]
+
+
+def test_callgraph_decorated_methods(tmp_path):
+    """A decorator does not hide the method body: the call still binds
+    to the decorated def and the witness walks through it."""
+    findings = run_tree(tmp_path, {"sync/deco.py": (
+        "import functools, threading, time\n"
+        "def logged(fn):\n"
+        "    @functools.wraps(fn)\n"
+        "    def inner(*a, **k):\n"
+        "        return fn(*a, **k)\n"
+        "    return inner\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    @logged\n"
+        "    def slow(self):\n"
+        "        time.sleep(1)\n"
+        "    def entry(self):\n"
+        "        with self._lock:\n"
+        "            self.slow()\n")}, "hold-blocking")
+    assert [f.message for f in findings] == [
+        "blocking time.sleep() reachable while holding self._lock: "
+        "deco.S.entry -> deco.S.slow"]
+
+
+def test_cli_changed_prunes_project_passes_to_impacted_component(tmp_path,
+                                                                capsys):
+    """--changed parses the WHOLE tree (the graph must be sound) but a
+    project-pass finding only surfaces when its anchor file is in the
+    impacted component of the diff — reverse reachability over call
+    edges, so editing a callee re-reports its transitive callers and
+    editing an unrelated file does not."""
+    import json
+    import subprocess
+
+    from spacedrive_tpu.analysis import main
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True,
+                       env={"PATH": os.environ["PATH"],
+                            "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+
+    (tmp_path / "sync").mkdir()
+    (tmp_path / "sync" / "lib.py").write_text(
+        "def flush(path):\n"
+        "    path.write_text('x')\n")
+    (tmp_path / "sync" / "reg.py").write_text(
+        "import threading\n"
+        "from sync.lib import flush\n"
+        "_LOCK = threading.Lock()\n"
+        "def entry(path):\n"
+        "    with _LOCK:\n"
+        "        flush(path)\n")
+    (tmp_path / "sync" / "c.py").write_text("def quiet():\n    return 1\n")
+    git("init"); git("add", "-A"); git("commit", "-m", "seed")
+
+    # editing the unrelated file: reg.py's hold-blocking finding is
+    # outside the impacted component — the scoped run stays green
+    (tmp_path / "sync" / "c.py").write_text(
+        "def quiet():\n    return 2\n")
+    rc = main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+               "--changed", "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert data["scanned"] == ["sync/c.py"] and data["new"] == []
+
+    # editing the CALLEE pulls its transitive caller into the component:
+    # the finding anchored in (unchanged) reg.py now surfaces
+    (tmp_path / "sync" / "lib.py").write_text(
+        "def flush(path):\n"
+        "    path.write_text('xx')\n")
+    rc = main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+               "--changed", "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["scanned"] == ["sync/c.py", "sync/lib.py"]
+    assert [(f["relpath"], f["pass"]) for f in data["new"]] == [
+        ("sync/reg.py", "hold-blocking")]
+
+
+# -- SARIF export -------------------------------------------------------------
+
+def test_cli_sarif_output_round_trips(tmp_path, capsys):
+    """--sarif emits a valid-shaped 2.1.0 log: every pass a rule, every
+    finding a result, baselined findings suppressed (not hidden)."""
+    import json
+
+    from spacedrive_tpu.analysis import main
+
+    (tmp_path / "jobs").mkdir()
+    (tmp_path / "jobs" / "bad.py").write_text(
+        "import jax\n"
+        "def execute_step(ctx):\n"
+        "    return jax.devices()\n")
+    rc = main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+               "--sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0" and "sarif-schema" in doc["$schema"]
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "sdlint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert "jax-wedge" in rule_ids and "hold-blocking" in rule_ids \
+        and "waiver-ledger" in rule_ids
+    assert run["originalUriBaseIds"]["SRCROOT"]["uri"].endswith("/")
+    (result,) = run["results"]
+    assert result["ruleId"] == "jax-wedge"
+    assert result["level"] == "warning"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "jobs/bad.py"
+    assert loc["region"]["startLine"] == 3
+    assert "suppressions" not in result
+
+    # adopt the baseline: the run goes green and the SAME finding is
+    # emitted suppressed, not dropped
+    assert main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+                 "--update-baseline"]) == 0
+    capsys.readouterr()
+    rc = main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+               "--sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    (result,) = doc["runs"][0]["results"]
+    assert result["suppressions"] == [
+        {"kind": "external", "justification": "baseline"}]
+
+    # --sarif and --json are mutually exclusive
+    with pytest.raises(SystemExit):
+        main([str(tmp_path), "--sarif", "--json"])
+
+
+# -- the wall budget ----------------------------------------------------------
+
+def test_cli_max_wall_budget(tmp_path, capsys):
+    import json
+
+    from spacedrive_tpu.analysis import main
+
+    (tmp_path / "sync").mkdir()
+    (tmp_path / "sync" / "a.py").write_text("def f():\n    return 1\n")
+    assert main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+                 "--max-wall-s", "1000"]) == 0
+    capsys.readouterr()
+    # an impossible budget fails even a clean tree, loudly
+    rc = main([str(tmp_path), "--baseline", str(tmp_path / "b.txt"),
+               "--max-wall-s", "0", "--json"])
+    captured = capsys.readouterr()
+    data = json.loads(captured.out)
+    assert rc == 1
+    assert data["new"] == [] and data["wall_s"] > 0
+    assert "WALL BUDGET EXCEEDED" in captured.err

@@ -1,0 +1,160 @@
+"""Unit tests for the project call graph (analysis/callgraph.py): the
+thread-provenance lattice, spawn-root isolation, reverse impact
+reachability, and the blocking classifier — the substrate every
+whole-program pass in test_analysis.py stands on, pinned directly so a
+resolution regression fails HERE with a graph-level diff, not three
+layers up in a pass fixture."""
+
+from pathlib import Path
+
+from spacedrive_tpu.analysis import FileContext, build_graph
+from spacedrive_tpu.analysis.callgraph import (blocking_call_reason,
+                                               witness)
+
+
+def graph_of(tmp_path: Path, files: dict[str, str]):
+    ctxs = {}
+    for relpath, src in files.items():
+        p = tmp_path / relpath
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        ctxs[relpath] = FileContext.parse(p, tmp_path)
+    return build_graph(ctxs, tmp_path.name)
+
+
+def fn(graph, short: str):
+    matches = [f for f in graph.functions.values() if f.short == short]
+    assert len(matches) == 1, f"{short}: {[f.short for f in matches]}"
+    return matches[0]
+
+
+def test_thread_roots_and_spawn_isolation(tmp_path):
+    """A spawn starts a NEW root: the target (and everything it calls)
+    carries the thread's label, and the spawner's own provenance never
+    leaks across the spawn edge."""
+    g = graph_of(tmp_path, {"sync/a.py": (
+        "import threading\n"
+        "def boot():\n"
+        "    threading.Thread(target=work, name='sd-w').start()\n"
+        "def work():\n"
+        "    helper()\n"
+        "def helper():\n"
+        "    return 1\n")})
+    assert g.provenance(fn(g, "a.work")) == frozenset({"thread:sd-w"})
+    assert g.provenance(fn(g, "a.helper")) == frozenset({"thread:sd-w"})
+    # nothing spawns or calls boot: empty provenance, not 'main'-guessed
+    assert g.provenance(fn(g, "a.boot")) == frozenset()
+
+
+def test_event_loop_is_one_shared_label(tmp_path):
+    """Every async def in api|server|p2p roots the SAME event-loop
+    label: two coroutines never race each other, so provenance must not
+    manufacture distinct roots for them."""
+    g = graph_of(tmp_path, {"server/s.py": (
+        "async def h1():\n"
+        "    return shared()\n"
+        "async def h2():\n"
+        "    return shared()\n"
+        "def shared():\n"
+        "    return 1\n")})
+    assert g.provenance(fn(g, "s.shared")) == frozenset({"event-loop"})
+
+
+def test_stage_convention_and_executor_roots(tmp_path):
+    g = graph_of(tmp_path, {
+        "jobs/j.py": (
+            "class Exec:\n"
+            "    def pipeline_page(self, ctx):\n"
+            "        return helper()\n"
+            "    def execute_step(self, ctx):\n"
+            "        return 2\n"
+            "def helper():\n"
+            "    return 1\n"),
+        "sync/pool.py": (
+            "def run(pool):\n"
+            "    pool.submit(task, 1)\n"
+            "def task(x):\n"
+            "    return x\n"),
+    })
+    assert g.provenance(fn(g, "j.Exec.pipeline_page")) == \
+        frozenset({"pipeline.page"})
+    assert g.provenance(fn(g, "j.Exec.execute_step")) == \
+        frozenset({"job-worker"})
+    assert g.provenance(fn(g, "j.helper")) == frozenset({"pipeline.page"})
+    assert g.provenance(fn(g, "pool.task")) == \
+        frozenset({"executor:pool.task"})
+
+
+def test_partial_unwrapping_at_spawn_sites(tmp_path):
+    g = graph_of(tmp_path, {"sync/p.py": (
+        "import functools, threading\n"
+        "def boot():\n"
+        "    threading.Thread(target=functools.partial(work, 1),\n"
+        "                     name='sd-p').start()\n"
+        "def work(x):\n"
+        "    return x\n")})
+    assert g.provenance(fn(g, "p.work")) == frozenset({"thread:sd-p"})
+
+
+def test_spawn_call_target_does_not_leak_caller_provenance(tmp_path):
+    """The server/shell.py shape: a thread's run() hands a coroutine to
+    asyncio.run — the inner self._serve() Call is the spawn TARGET, not
+    also a direct call edge, so the coroutine's provenance is exactly
+    {event-loop}, never {event-loop, thread:sd-server}."""
+    g = graph_of(tmp_path, {"server/sh.py": (
+        "import asyncio, threading\n"
+        "class Server:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run,\n"
+        "                         name='sd-server').start()\n"
+        "    def _run(self):\n"
+        "        asyncio.run(self._serve())\n"
+        "    async def _serve(self):\n"
+        "        return 1\n")})
+    assert g.provenance(fn(g, "sh.Server._run")) == \
+        frozenset({"thread:sd-server"})
+    assert g.provenance(fn(g, "sh.Server._serve")) == \
+        frozenset({"event-loop"})
+
+
+def test_impacted_files_is_reverse_reachability(tmp_path):
+    """--changed uses this: editing a CALLEE re-reports every transitive
+    caller's file; editing a leaf nobody calls impacts only itself."""
+    g = graph_of(tmp_path, {
+        "sync/a.py": ("from sync.b import g\n"
+                      "def f():\n"
+                      "    return g()\n"),
+        "sync/b.py": ("def g():\n"
+                      "    return 1\n"),
+        "sync/c.py": ("def h():\n"
+                      "    return 2\n"),
+    })
+    assert g.impacted_files({"sync/b.py"}) == {"sync/a.py", "sync/b.py"}
+    assert g.impacted_files({"sync/a.py"}) == {"sync/a.py"}
+    assert g.impacted_files({"sync/c.py"}) == {"sync/c.py"}
+
+
+def test_reachable_blocking_dealiases_and_renders_witness(tmp_path):
+    """from time import sleep as snooze still classifies as time.sleep,
+    and the witness renders short names only (the text lands in
+    baseline keys — no line numbers allowed)."""
+    g = graph_of(tmp_path, {"sync/al.py": (
+        "from time import sleep as snooze\n"
+        "def outer():\n"
+        "    return inner()\n"
+        "def inner():\n"
+        "    snooze(1)\n")})
+    hit = g.reachable_blocking(fn(g, "al.outer"), blocking_call_reason)
+    assert hit is not None
+    path, lineno, reason = hit
+    assert reason == "time.sleep()" and lineno == 5
+    assert witness(path) == "al.outer -> al.inner"
+
+
+def test_reachable_blocking_depth_cap_and_clean_chain(tmp_path):
+    g = graph_of(tmp_path, {"sync/ok.py": (
+        "def a():\n"
+        "    return b()\n"
+        "def b():\n"
+        "    return 1\n")})
+    assert g.reachable_blocking(fn(g, "ok.a"), blocking_call_reason) is None

@@ -361,3 +361,24 @@ def test_job_checkpoints_never_persist_passwords(tmp_path):
                     blob if isinstance(blob, bytes) else str(blob).encode())
     finally:
         node.shutdown()
+
+
+def test_keymanager_persist_version_gate(tmp_path):
+    """Regression for the hold-blocking refactor (ISSUE 16): mutators
+    snapshot the keystore under the state lock and persist AFTER
+    releasing it, so two racing persists can land out of order — the
+    version gate must keep a stale snapshot from clobbering a newer
+    one, and a newer snapshot must still supersede an older write."""
+    from spacedrive_tpu.crypto.keymanager import KeyManager
+
+    km = KeyManager(tmp_path / "keys.json")
+    v1 = km._snapshot()
+    km._store["marker"] = "newer"
+    v2 = km._snapshot()
+    assert v2[0] > v1[0]
+
+    km._persist(v2)
+    assert "newer" in km.store_path.read_text()
+    km._persist(v1)  # stale write arrives late: must be dropped
+    assert "newer" in km.store_path.read_text()
+    assert not km.store_path.with_suffix(".tmp").exists()

@@ -151,3 +151,21 @@ def test_ephemeral_thumbnails_and_gc_shield(node, tmp_path):
     node.thumbnail_remover._ephemeral[row["cas_id"]] = 0.0
     assert node.thumbnail_remover.full_sweep() == 1
     assert not thumb.exists()
+
+
+def test_thumbnail_sweep_cold_dir_and_hoisted_base(node):
+    """Regression for the hold-blocking refactor (ISSUE 16): the sweep
+    loops resolve the thumbnail base dir ONCE, up front — the first
+    resolution runs mkdir + version-stamp I/O that must never happen
+    under the registrar's lock — and both entry points stay correct on
+    a cold node where that directory does not exist yet."""
+    assert node.thumbnail_remover.full_sweep() == 0
+    assert node.thumbnail_remover.process_marked() == 0
+    # process_marked resolved the base dir (version-stamp I/O included)
+    # outside the lock; the cache dir now exists for later sweeps
+    assert thumbnail_dir(node.data_dir).is_dir()
+    import inspect
+
+    params = list(inspect.signature(
+        ThumbnailRemoverActor._delete_thumb).parameters)
+    assert params == ["self", "base", "cas_id"]
