@@ -73,6 +73,12 @@ if "--serve" in sys.argv[1:]:
 #: BENCH_search.json
 if "--search" in sys.argv[1:]:
     MODE = "search"
+#: ``--scan``: the shard/batch sweep (ISSUE 17) — one identify scan per
+#: (SD_SCAN_SHARDS, BATCH_SIZE) grid cell over the cached tree, per-cell
+#: files/s + gather_share; best cell is the headline, full grid to
+#: BENCH_scan_sweep.json
+if "--scan" in sys.argv[1:]:
+    MODE = "scan_sweep"
 REPEATS = int(os.environ.get("SD_BENCH_REPEATS", "3"))
 #: ``--faults`` (or SD_BENCH_FAULTS=1): bench_scan adds a chaos pass under
 #: an injected fault storm and reports recovery overhead alongside
@@ -676,6 +682,9 @@ def bench_scan() -> dict:
     commit_s = hyb_stages.get("pipeline_commit_s", 0.0)
     wall_s = hyb_stages.get("pipeline_wall_s", 0.0)
     gather_s = hyb_stages.get("gather_s", 0.0)
+    # the scan-ceiling tracker (ISSUE 17): fraction of the page stage spent
+    # in the file-IO gather — the sharded prefetch exists to shrink this
+    gather_share = round(gather_s / page_s, 3) if page_s else 0.0
     # 1.0 = the identify wall clock collapsed to its slowest stage (perfect
     # overlap); 0.0 = stages ran back-to-back like the sequential loop
     serial = page_s + hash_s + commit_s
@@ -695,7 +704,8 @@ def bench_scan() -> dict:
     router_batches = hyb_stages.get("router_batches", {})
     print(f"info: scan {n_files} files e2e: cpu {times['cpu']:.1f}s | "
           f"hybrid {times['hybrid']:.1f}s ({rate:,.0f} files/s) | "
-          f"identify page {page_s:.1f}s (gather {gather_s:.1f}s) "
+          f"identify page {page_s:.1f}s (gather {gather_s:.1f}s, "
+          f"share {gather_share:.2f}) "
           f"hash {hash_s:.1f}s commit {commit_s:.1f}s wall {wall_s:.1f}s "
           f"(overlap {overlap:.2f}) | {batches} pages in {txns} txns "
           f"({txn_pages}/txn) | router flips {router_flips} "
@@ -711,6 +721,8 @@ def bench_scan() -> dict:
         "cpu_files_per_sec": round(n_files / times["cpu"], 1),
         "page_s": round(page_s, 2),
         "gather_s": round(gather_s, 2),
+        "gather_share": gather_share,
+        "scan_shards": hyb_stages.get("pipeline_shards", "1"),
         "hash_s": round(hash_s, 2),
         "commit_s": round(commit_s, 2),
         "identify_wall_s": round(wall_s, 2),
@@ -725,6 +737,128 @@ def bench_scan() -> dict:
     }
     if chaos is not None:
         record["chaos"] = chaos
+    return record
+
+
+def bench_scan_sweep() -> dict:
+    """``--scan`` (ISSUE 17): the shard/batch grid. One identify run per
+    (SD_SCAN_SHARDS, BATCH_SIZE) cell over the cached tree — indexing runs
+    once per cell off the clock, the timed window is the file_identifier
+    job alone, so the cells isolate exactly what the knobs move. Per-cell
+    files/s + gather_share (gather_s / page_s); the best cell is the
+    headline and the full grid lands in BENCH_scan_sweep.json."""
+    import shutil
+
+    from spacedrive_tpu.locations import create_location
+    from spacedrive_tpu.locations.indexer_job import IndexerJob
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.objects.file_identifier import FileIdentifierJob
+
+    n_files = int(os.environ.get("SD_BENCH_SCAN_FILES", "20000"))
+    fixture = _ensure_scan_fixture(n_files)
+
+    # same off-the-clock warmups as bench_scan: the hybrid engine's
+    # one-time probe, then the tree into the page cache so every cell
+    # sees identical (warm) IO
+    from spacedrive_tpu.objects.hasher import get_hasher
+
+    warm: list[tuple[str, int]] = []
+    for p in sorted(fixture.rglob("*.dat")):
+        size = p.stat().st_size
+        if size > 100 * 1024:
+            warm.append((str(p), size))
+        if len(warm) >= 24:
+            break
+    get_hasher("hybrid").hash_batch([p for p, _ in warm],
+                                    [s for _, s in warm])
+    for p in fixture.rglob("*.dat"):
+        with open(p, "rb") as fh:
+            while fh.read(1 << 20):
+                pass
+
+    def one_cell(shards: int, batch: int) -> dict:
+        tmp = Path(tempfile.mkdtemp(prefix="sd_scan_sweep_"))
+        try:
+            node = Node(tmp, probe_accelerator=False, watch_locations=False)
+            node.thumbnail_remover.stop()
+            lib = node.libraries.create(f"sweep-{shards}x{batch}")
+            lib.orphan_remover.stop()
+            loc = create_location(lib, str(fixture), hasher="hybrid")
+            args = {"location_id": loc["id"]}
+            # indexing is identical across cells — run it off the clock
+            node.jobs.spawn(lib, [IndexerJob(dict(args))])
+            assert node.jobs.wait_idle(3600)
+            t0 = time.perf_counter()
+            node.jobs.spawn(lib, [FileIdentifierJob(dict(args))])
+            assert node.jobs.wait_idle(3600)
+            dt = time.perf_counter() - t0
+            n_identified = lib.db.query(
+                "SELECT count(*) c FROM file_path "
+                "WHERE cas_id IS NOT NULL")[0]["c"]
+            assert n_identified == n_files, (n_identified, n_files)
+            row = lib.db.query(
+                "SELECT metadata FROM job WHERE name='file_identifier' "
+                "ORDER BY date_created DESC LIMIT 1")
+            stages = (json.loads(row[0]["metadata"])
+                      if row and row[0]["metadata"] else {})
+            node.shutdown()
+            page_s = stages.get("pipeline_page_s", 0.0)
+            gather_s = stages.get("gather_s", 0.0)
+            return {
+                "shards": shards,
+                "batch": batch,
+                "files_per_sec": round(n_files / dt, 1),
+                "identify_s": round(dt, 2),
+                "gather_share": (round(gather_s / page_s, 3)
+                                 if page_s else 0.0),
+                "gather_s": round(gather_s, 2),
+                "page_s": round(page_s, 2),
+                "hash_s": round(stages.get("pipeline_hash_s", 0.0), 2),
+                "commit_s": round(stages.get("pipeline_commit_s", 0.0), 2),
+                "wall_s": round(stages.get("pipeline_wall_s", 0.0), 2),
+            }
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    shards_grid = [int(s) for s in os.environ.get(
+        "SD_BENCH_SWEEP_SHARDS", "1,2,4").split(",") if s.strip()]
+    batch_grid = [int(b) for b in os.environ.get(
+        "SD_BENCH_SWEEP_BATCH", "512,1024,2048").split(",") if b.strip()]
+    saved = {k: os.environ.get(k)
+             for k in ("SD_SCAN_SHARDS", "SD_SCAN_BATCH")}
+    cells = []
+    try:
+        for shards in shards_grid:
+            for batch in batch_grid:
+                os.environ["SD_SCAN_SHARDS"] = str(shards)
+                os.environ["SD_SCAN_BATCH"] = str(batch)
+                cell = one_cell(shards, batch)
+                cells.append(cell)
+                print(f"info: sweep shards={shards} batch={batch}: "
+                      f"{cell['files_per_sec']:,.0f} files/s, "
+                      f"gather_share {cell['gather_share']:.2f}",
+                      file=sys.stderr)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    best = max(cells, key=lambda c: c["files_per_sec"])
+    record = {
+        "metric": (f"scan_sweep_files_per_sec[{n_files}files,"
+                   f"shards={best['shards']},batch={best['batch']}]"),
+        "value": best["files_per_sec"],
+        "unit": "files/sec",
+        "gather_share": best["gather_share"],
+        "best": {"shards": best["shards"], "batch": best["batch"]},
+        "grid": cells,
+    }
+    out = Path(__file__).resolve().parent / "BENCH_scan_sweep.json"
+    out.write_text(json.dumps(record, indent=1) + "\n")
+    print(f"info: sweep best shards={best['shards']} "
+          f"batch={best['batch']}: {best['files_per_sec']:,.0f} files/s "
+          f"-> {out.name}", file=sys.stderr)
     return record
 
 
@@ -1116,6 +1250,12 @@ def bench_fleet() -> dict:
                           retry=WAN_RETRY)
         else:
             fleet = Fleet(tmp, peers=peers, lanes=lanes)
+
+        def _lane_ops() -> dict[str, float]:
+            return {lbl.get("lane", "?"): v for lbl, v in
+                    telemetry.series_values("sd_sync_ingest_lane_ops_total")}
+
+        lane_ops0 = _lane_ops()
         try:
             res = fleet.run_storm(ops_per_peer=ops_per_peer, batch=500,
                                   emit_chunks=4 if wan else 2,
@@ -1143,6 +1283,16 @@ def bench_fleet() -> dict:
                 == peers * ops_per_peer
         finally:
             fleet.shutdown()
+        # lane-occupancy skew (ISSUE 17 satellite): max/mean of the per-lane
+        # applied-ops deltas over this storm — 1.0 is a perfectly balanced
+        # hash partition, rising values mean hot lanes are serializing the
+        # ingest that the lanes exist to parallelize
+        lane_deltas = [v - lane_ops0.get(k, 0.0)
+                       for k, v in _lane_ops().items()]
+        lane_deltas = [d for d in lane_deltas if d > 0]
+        lane_skew = (round(max(lane_deltas)
+                           / (sum(lane_deltas) / len(lane_deltas)), 3)
+                     if lane_deltas else 0.0)
         record = {
             "metric": (f"fleet_ops_per_sec[{peers}peers,"
                        f"{ops_per_peer}ops,{lanes}lanes"
@@ -1158,6 +1308,7 @@ def bench_fleet() -> dict:
                 "max_peer_lag_ops": res["max_peer_lag_ops"],
             },
             "lanes": lanes,
+            "lane_skew": lane_skew,
             "ops_total": res["ops_total"],
             "elapsed_s": res["elapsed_s"],
             "shed_windows": res["shed_windows"],
@@ -1195,8 +1346,18 @@ def bench_fleet() -> dict:
         print(f"info: fleet {peers} peers x {ops_per_peer} ops, {lanes} "
               f"lanes{f', wan={wan}' if wan else ''}: "
               f"{res['ops_per_sec_total']:,.0f} ops/s total, "
-              f"{res['shed_ops']} ops shed, peak RSS "
-              f"{res['peak_rss_mb']:.0f}MB -> {out.name}", file=sys.stderr)
+              f"{res['shed_ops']} ops shed, lane skew {lane_skew:.2f}, "
+              f"peak RSS {res['peak_rss_mb']:.0f}MB -> {out.name}",
+              file=sys.stderr)
+        if lane_skew:
+            # second fleet headline (standing invariant: every bench mode
+            # appends its headlines): lane-occupancy balance trajectory
+            _append_history({
+                "metric": f"fleet_lane_skew[{peers}peers,{lanes}lanes"
+                          + (f",wan={wan}" if wan else "") + "]",
+                "value": lane_skew,
+                "unit": "max/mean",
+            })
         if wan and heal_to_lag_zero_s is not None:
             # the second WAN headline rides the history too (standing
             # invariant: every bench mode appends its headlines)
@@ -1973,6 +2134,8 @@ def main() -> int:
         record = bench_thumbs()
     elif MODE == "scan":
         record = bench_scan()
+    elif MODE == "scan_sweep":
+        record = bench_scan_sweep()
     elif MODE == "sync":
         record = bench_sync()
     elif MODE == "fleet":
@@ -2086,6 +2249,10 @@ def _append_history(record: dict) -> None:
             entry["vs_baseline"] = record["vs_baseline"]
         if record.get("platform"):
             entry["platform"] = record["platform"]
+        if record.get("gather_share") is not None:
+            # scan-ceiling trajectory (ISSUE 17): gather_s / page_s rides
+            # every scan headline so the shard payoff is visible run-over-run
+            entry["gather_share"] = record["gather_share"]
         append_line(Path(__file__).resolve().parent / "BENCH_history.jsonl",
                     json.dumps(entry))
     except Exception as e:  # the headline must print even if history fails

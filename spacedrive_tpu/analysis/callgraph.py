@@ -78,6 +78,13 @@ STAGE_ROOTS = {
     "pipeline_process": "pipeline.process",
     "pipeline_commit": "job-worker",
     "execute_step": "job-worker",
+    # sharded prefetch (ISSUE 17): split coordinator, gather shard
+    # workers (several concurrent threads share one root label — the
+    # cross-root attr check still sees them as distinct from every other
+    # root), and the ordered merger
+    "pipeline_page_split": "pipeline.page",
+    "pipeline_page_shard": "pipeline.gather",
+    "pipeline_page_merge": "pipeline.merge",
 }
 
 #: fully-qualified external calls that block the calling thread

@@ -35,7 +35,9 @@ from typing import Iterator
 from ..engine import AnalysisPass, FileContext, Finding, dotted_name
 from .pipeline_ordering import WRITE_ATTRS, _is_db_receiver
 
-SPECULATIVE_STAGES = ("pipeline_page", "pipeline_process")
+SPECULATIVE_STAGES = ("pipeline_page", "pipeline_process",
+                      "pipeline_page_split", "pipeline_page_shard",
+                      "pipeline_page_merge")
 
 DATA_MUTATORS = {"update", "setdefault", "pop", "popitem", "clear"}
 

@@ -29,7 +29,12 @@ from typing import Iterator
 
 from ..engine import AnalysisPass, FileContext, Finding, dotted_name
 
-STAGE_NAMES = ("pipeline_page", "pipeline_process")
+STAGE_NAMES = ("pipeline_page", "pipeline_process",
+               # the sharded-prefetch stages (ISSUE 17) run on the split
+               # coordinator / gather shard / merger threads — same
+               # read-only contract as pipeline_page
+               "pipeline_page_split", "pipeline_page_shard",
+               "pipeline_page_merge")
 
 WRITE_ATTRS = {"execute", "executemany", "insert", "insert_ignore",
                "insert_many", "update", "upsert", "delete"}

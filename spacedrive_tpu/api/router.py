@@ -43,6 +43,27 @@ class ApiError(Exception):
         self.code = code
 
 
+class RawJson:
+    """A query result that is ALREADY serialized to wire JSON.
+
+    Serve-pool workers encode their reply once (``json.dumps(result,
+    default=str)`` — the exact encoder ``Response.json`` uses) and ship
+    the bytes; the shell splices them straight into the HTTP envelope
+    instead of decode-in-node + re-encode-in-shell. Callers that want
+    the structured value use ``Router.resolve(raw=False)`` (the default),
+    which decodes transparently — only the shell opts into passthrough."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+
+    def decode(self) -> Any:
+        import json
+
+        return json.loads(self.data)
+
+
 @dataclasses.dataclass
 class Procedure:
     key: str
@@ -112,7 +133,8 @@ class Router:
         except KeyError:
             raise ApiError(f"library {library_id!r} not loaded", code=404) from None
 
-    def resolve(self, key: str, arg: Any = None, library_id: str | None = None) -> Any:
+    def resolve(self, key: str, arg: Any = None, library_id: str | None = None,
+                *, raw: bool = False) -> Any:
         """Execute a query or mutation under per-procedure request
         telemetry (ISSUE 10: ``sd_rspc_*`` families + the slow-request
         ring). Library-scoped procedures receive (node, library, arg);
@@ -124,7 +146,11 @@ class Router:
         read traffic escapes this process's GIL and writer-lock
         pressure. Any pool failure (no pool, worker crash, saturation)
         fails over to the in-process path below — queries are read-only,
-        so re-running one is always safe."""
+        so re-running one is always safe.
+
+        A pool worker replies with pre-encoded wire bytes
+        (:class:`RawJson`); ``raw=True`` passes them through for the
+        shell to splice, anything else gets the decoded value."""
         proc = self._proc(key)
         if proc.kind == SUBSCRIPTION:
             raise ApiError(f"{key} is a subscription; use subscribe()")
@@ -159,7 +185,10 @@ class Router:
                 return proc.fn(self.node, library, arg)
             return proc.fn(self.node, arg)
 
-        return _requests.observed(key, proc.kind, dispatch)
+        result = _requests.observed(key, proc.kind, dispatch)
+        if isinstance(result, RawJson) and not raw:
+            return result.decode()
+        return result
 
     def subscribe(self, key: str, arg: Any = None,
                   library_id: str | None = None) -> "Subscription":

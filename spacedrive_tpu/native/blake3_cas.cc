@@ -974,6 +974,32 @@ bool uring_disabled() {
   return disabled;
 }
 
+// Effective gather queue depth: files in flight per uring round
+// (SD_CAS_GATHER_DEPTH, default 128, clamped 1..2048). Read per call, not
+// statically cached — the bench sweep and tests mutate the environment at
+// runtime. The sampled-file round queues 6 reads per file, so the ring
+// must be sized (and the group clamped) to 6× the depth.
+int32_t gather_depth() {
+  int32_t depth = 128;
+  const char* e = getenv("SD_CAS_GATHER_DEPTH");
+  if (e && *e) {
+    char* end = nullptr;
+    long v = strtol(e, &end, 10);
+    if (end != e && v > 0) depth = static_cast<int32_t>(std::min<long>(v, 2048));
+  }
+  return depth;
+}
+
+// Smallest power-of-two ring that fits a full reads round at this depth
+// (io_uring_setup rounds entries up to a power of two anyway; 32768 is the
+// kernel's default ceiling).
+unsigned ring_entries_for(int32_t depth) {
+  uint64_t need = static_cast<uint64_t>(depth) * 6;
+  unsigned entries = 64;
+  while (entries < need && entries < 32768) entries <<= 1;
+  return entries;
+}
+
 // Fill rows exactly like the synchronous gather loop, via an
 // already-initialized ring (reused across groups by the batch hasher).
 // Returns false only on ring INFRASTRUCTURE failure (enter refused) — the
@@ -981,14 +1007,19 @@ bool uring_disabled() {
 // the synchronous path; per-file IO errors stay in-band as lengths[i]=0.
 bool uring_gather_ring(Uring& ring, const char* const* paths,
                        const uint64_t* sizes, int32_t n, uint8_t* out,
-                       int64_t row_stride, int32_t* lengths) {
+                       int64_t row_stride, int32_t* lengths,
+                       int32_t group_hint) {
   struct Read {
     int32_t file;
     uint8_t* dst;
     uint64_t off;
     uint32_t want;
   };
-  constexpr int32_t GROUP = 128;  // 6 reads/file keeps a round under the ring
+  // 6 reads/file: the group is clamped so one reads round can never
+  // overflow the ring the caller initialized (next_sqe has no overflow
+  // check by design — rounds are sized to fit)
+  const int32_t GROUP = std::max<int32_t>(
+      1, std::min(group_hint, static_cast<int32_t>(ring.sq_entries / 6)));
   std::vector<int> fds(GROUP);
   std::vector<Read> reads, retry;
   std::vector<int32_t> remaining(GROUP);  // per-file outstanding read count
@@ -1110,13 +1141,20 @@ bool uring_gather_ring(Uring& ring, const char* const* paths,
   return true;
 }
 
-// One-shot wrapper: own ring, whole batch.
+// One-shot wrapper: own ring sized to the configured depth, whole batch.
 bool uring_gather(const char* const* paths, const uint64_t* sizes, int32_t n,
                   uint8_t* out, int64_t row_stride, int32_t* lengths) {
   if (uring_disabled()) return false;
+  int32_t depth = gather_depth();
   Uring ring;
-  if (!ring.init(1024)) return false;
-  return uring_gather_ring(ring, paths, sizes, n, out, row_stride, lengths);
+  // a host that refuses the big ring (memlock limits) still gets the
+  // default-depth one — the clamp in uring_gather_ring keeps rounds legal
+  if (!ring.init(ring_entries_for(depth))) {
+    ring.destroy();
+    if (!ring.init(1024)) return false;
+  }
+  return uring_gather_ring(ring, paths, sizes, n, out, row_stride, lengths,
+                           depth);
 }
 
 #else
@@ -1124,8 +1162,10 @@ struct Uring {
   bool init(unsigned) { return false; }
 };
 bool uring_disabled() { return true; }
+int32_t gather_depth() { return 128; }
+unsigned ring_entries_for(int32_t) { return 1024; }
 bool uring_gather_ring(Uring&, const char* const*, const uint64_t*, int32_t,
-                       uint8_t*, int64_t, int32_t*) {
+                       uint8_t*, int64_t, int32_t*, int32_t) {
   return false;
 }
 bool uring_gather(const char* const*, const uint64_t*, int32_t, uint8_t*,
@@ -1292,7 +1332,7 @@ void sd_cas_hash_batch(const char* const* paths, const uint64_t* sizes,
       for (int32_t g0 = 0; g0 < n && uring_ok; g0 += group) {
         int32_t gn = std::min(group, n - g0);
         uring_ok = uring_gather_ring(ring, paths + g0, sizes + g0, gn,
-                                     rows.data(), stride, lens.data());
+                                     rows.data(), stride, lens.data(), group);
         if (!uring_ok) break;  // this group unwritten: done stays at g0
         // cross-message SIMD: sort the group's messages by length (uniform
         // lane groups), hash 16 per pass, then write the cas hex rows

@@ -9,9 +9,12 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
+import time
 from pathlib import Path
 
 from . import build_shared
+from .. import telemetry
 
 _lib = ctypes.CDLL(str(build_shared("sdcas", ["blake3_cas.cc"])))
 
@@ -50,18 +53,53 @@ _lib.sd_cas_gather_batch.argtypes = [
 _lib.sd_cas_gather_batch.restype = None
 
 
+_GATHER_US = telemetry.gauge(
+    "sd_gather_us_per_file",
+    "EWMA serial-equivalent native gather cost per file (µs); drives the "
+    "gather thread autotune")
+
+# Thread autotune: the gather is syscall-WAIT bound, not compute bound, so
+# the right worker count tracks the filesystem's per-file latency, not the
+# core count. We keep an EWMA of the *serial-equivalent* cost per file
+# (wall µs/file × workers used — invariant to the worker count it was
+# measured under) and size the pool so wall/file lands near _TARGET_US.
+# The old static 4×cores heuristic only seeds the cold start.
+_EWMA_ALPHA = 0.3
+_TARGET_US = 25.0
+_EWMA_LOCK = threading.Lock()
+_ewma_us: float | None = None
+
+
+def _observe_gather(wall_s: float, n: int, threads: int) -> None:
+    """Fold one batch's measured cost into the EWMA (µs/file, serialized)."""
+    global _ewma_us
+    if n <= 0 or wall_s <= 0.0:
+        return
+    serial_us = wall_s * 1e6 * max(1, threads) / n
+    with _EWMA_LOCK:
+        if _ewma_us is None:
+            _ewma_us = serial_us
+        else:
+            _ewma_us = _EWMA_ALPHA * serial_us + (1.0 - _EWMA_ALPHA) * _ewma_us
+        _GATHER_US.set(_ewma_us)
+
+
 def _default_gather_threads(n: int) -> int:
-    """Gather workers per batch (``SD_CAS_GATHER_THREADS`` overrides). The
-    gather is syscall-WAIT bound, not compute bound — on slow/overlay
-    filesystems oversubscribing the cores (4× up to 16) keeps the queue of
-    in-flight opens deep enough to hide per-file latency (measured ~25%
-    on the 2-core dev container: 196 → 148 µs/file at 8 threads)."""
+    """Gather workers per batch. ``SD_CAS_GATHER_THREADS`` overrides; with a
+    measured EWMA the count is sized so per-file wall cost lands near
+    ``_TARGET_US``; cold start falls back to oversubscribing the cores
+    (4× up to 16 — measured ~25% on the 2-core dev container: 196 → 148
+    µs/file at 8 threads)."""
     raw = os.environ.get("SD_CAS_GATHER_THREADS", "").strip()
     if raw:
         try:
             return max(1, min(int(raw), n))
         except ValueError:
             pass
+    with _EWMA_LOCK:
+        ewma = _ewma_us
+    if ewma is not None:
+        return min(max(2, round(ewma / _TARGET_US)), 16, n)
     return min(max(2, (os.cpu_count() or 1) * 4), 16, n)
 
 
@@ -80,6 +118,7 @@ def gather_batch(paths: list[str | Path], sizes: list[int], out, lengths,
         n_threads = _default_gather_threads(n)
     c_paths = (ctypes.c_char_p * n)(*[os.fsencode(str(p)) for p in paths])
     c_sizes = (ctypes.c_uint64 * n)(*[int(s) for s in sizes])
+    t0 = time.perf_counter()
     _lib.sd_cas_gather_batch(
         ctypes.cast(c_paths, ctypes.POINTER(ctypes.c_char_p)),
         ctypes.cast(c_sizes, ctypes.POINTER(ctypes.c_uint64)),
@@ -88,6 +127,7 @@ def gather_batch(paths: list[str | Path], sizes: list[int], out, lengths,
         out.strides[0],
         lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
+    _observe_gather(time.perf_counter() - t0, n, n_threads)
 
 
 _lib.sd_blake3_hex_batch.argtypes = [

@@ -28,6 +28,7 @@ the rest of the identify run instead of waiting for it to finish.
 from __future__ import annotations
 
 import logging
+import os
 import uuid
 from typing import Any
 
@@ -72,6 +73,71 @@ logger = logging.getLogger(__name__)
 #: tuning; the TPU kernel amortizes over thousands of lanes)
 BATCH_SIZE = 1024
 
+#: adaptive page-size clamps (ISSUE 17): pages shrink toward finer
+#: pipelining when the hash stage dominates and grow to amortize per-page
+#: fixed costs when gather or commit does
+ADAPT_MIN_BATCH = 256
+ADAPT_MAX_BATCH = 4096
+
+_BATCH_GAUGE = telemetry.gauge(
+    "sd_scan_batch_size",
+    "files per scan page after adaptive sizing (the fixed BATCH_SIZE "
+    "when adaptation is pinned off)")
+
+
+def _env_batch_pin() -> int | None:
+    """Explicit page-size pin (``SD_SCAN_BATCH``) — turns adaptation off
+    and sizes every page to exactly this many files."""
+    raw = os.environ.get("SD_SCAN_BATCH", "").strip()
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            return None
+    return None
+
+
+def _adaptive_batching() -> bool:
+    """Adaptive page sizing is live only at the stock configuration: a
+    monkeypatched ``BATCH_SIZE`` (tests pin page boundaries), an explicit
+    ``SD_SCAN_BATCH``, or ``SD_SCAN_ADAPT=0`` all mean FIXED pages —
+    pipelined page boundaries then match the sequential schedule exactly,
+    which is what the byte-identity matrices assert."""
+    if BATCH_SIZE != 1024 or _env_batch_pin() is not None:
+        return False
+    return os.environ.get("SD_SCAN_ADAPT", "1").lower() not in (
+        "0", "false", "off")
+
+
+def _page_limit(scratch: dict) -> int:
+    """Files in the next page. With adaptation live, sizes from the
+    executor's measured stage balance (``scratch['stage_shares']``,
+    target: no stage above 60% of the pipeline wall): a dominant hash
+    stage shrinks pages (finer overlap, smaller device batches feed the
+    double-buffer sooner), a dominant gather or commit stage grows them
+    (amortize the per-page SELECT / txn / uring-round fixed costs), and a
+    balanced pipeline drifts back toward the static default. The scratch
+    dict is pipeline-local and only the prefetch/split thread touches it."""
+    pin = _env_batch_pin()
+    if pin is not None:
+        return pin
+    if not _adaptive_batching():
+        return BATCH_SIZE
+    cur = int(scratch.get("batch_size") or BATCH_SIZE)
+    shares = scratch.get("stage_shares")
+    if shares:
+        dominant = max(shares, key=shares.get)
+        if shares[dominant] > 0.6:
+            if dominant == "hash":
+                cur = max(cur * 3 // 4, ADAPT_MIN_BATCH)
+            else:
+                cur = min(cur * 3 // 2, ADAPT_MAX_BATCH)
+        else:
+            cur += (BATCH_SIZE - cur) // 4
+    scratch["batch_size"] = cur
+    _BATCH_GAUGE.set(cur)
+    return cur
+
 
 def _orphan_where(location_id: int, sub_path: str | None) -> tuple[str, list]:
     sql = ('object_id IS NULL AND is_dir = 0 AND location_id = ? AND name != ""')
@@ -97,7 +163,12 @@ class FileIdentifierJob(StatefulJob):
         if count == 0:
             raise EarlyFinish("Found no orphan file paths to process")
         logger.info("Found %d orphan file paths", count)
-        steps = [{"kind": "identify"} for _ in range(-(-count // BATCH_SIZE))]
+        # plan steps from the EFFECTIVE page size: an SD_SCAN_BATCH pin
+        # below the default would otherwise exhaust init's step budget
+        # with orphans left over (the executor only grows the step ledger
+        # for adaptive runs, and pinned runs are exact by definition)
+        page = _env_batch_pin() or BATCH_SIZE
+        steps = [{"kind": "identify"} for _ in range(-(-count // page))]
         data = {"location_id": location_id, "location_path": location["path"],
                 # hybrid probes both engines and routes to the winner, so a
                 # production scan never takes a known-losing path on hosts
@@ -115,7 +186,11 @@ class FileIdentifierJob(StatefulJob):
 
         return PipelineSpec(page=self.pipeline_page,
                             process=self.pipeline_process,
-                            commit=self.pipeline_commit)
+                            commit=self.pipeline_commit,
+                            split=self.pipeline_page_split,
+                            shard=self.pipeline_page_shard,
+                            merge=self.pipeline_page_merge,
+                            adaptive=_adaptive_batching())
 
     def execute_step(self, ctx: WorkerContext, data: dict, step: dict,
                      step_number: int) -> StepResult:
@@ -145,12 +220,23 @@ class FileIdentifierJob(StatefulJob):
             f"SELECT id, pub_id, name, extension, materialized_path, is_dir, "
             f"size_in_bytes, date_created FROM file_path "
             f"WHERE {where} AND id > ? ORDER BY id LIMIT ?",
-            params + [cursor, BATCH_SIZE],
+            params + [cursor, _page_limit(scratch)],
         )]
         if not rows:
             return None
         scratch["cursor"] = rows[-1]["id"]
+        hashable, empty, messages, gather_s = \
+            self._gather_rows(ctx, data, rows)
+        return {"cursor": rows[-1]["id"], "hashable": hashable, "empty": empty,
+                "messages": messages, "gather_s": gather_s}
 
+    def _gather_rows(self, ctx: WorkerContext, data: dict,
+                     rows: list[dict]) -> tuple[list, list, list, float]:
+        """SELECT'd page (or page-slice) rows → ``(hashable, empty,
+        messages, gather_s)``: the size split, the fused sample gather and
+        the magic-head attach — shared verbatim by the whole-page and
+        sharded-slice prefetch paths, so a merged page is byte-identical
+        to a sequential one by construction."""
         hashable, empty = [], []
         for row in rows:
             if (row["size_in_bytes"] or 0) > 0:
@@ -161,8 +247,8 @@ class FileIdentifierJob(StatefulJob):
         location_path = data["location_path"]
         # ad-hoc timing goes through spans (telemetry-discipline): the
         # gather duration lands in the report via the span, nests under
-        # pipeline.page in the job trace, and still measures when
-        # telemetry is off (bare-timer degradation)
+        # pipeline.page (or the shard's pipeline.gather) in the job trace,
+        # and still measures when telemetry is off (bare-timer degradation)
         with telemetry.span(getattr(ctx, "trace", None), "identifier.gather",
                             files=len(hashable)) as gather_sp:
             messages = read_sampled_batch(
@@ -181,8 +267,75 @@ class FileIdentifierJob(StatefulJob):
                                  else bytes(msg[8:8 + HEADER_LEN]))
         for row in empty:
             row["_kind_head"] = b""  # what _read_head returns for empty files
-        return {"cursor": rows[-1]["id"], "hashable": hashable, "empty": empty,
-                "messages": messages, "gather_s": gather_sp.duration_s}
+        return hashable, empty, messages, gather_sp.duration_s
+
+    # -- stage 1, sharded (ISSUE 17): split → parallel slices → merge --------
+    def pipeline_page_split(self, ctx: WorkerContext, data: dict,
+                            scratch: dict) -> dict | None:
+        """Split-coordinator half of the page stage: one id-only cursor
+        SELECT, chopped into contiguous id-range slices (one per gather
+        shard). Contiguity in strict id order is the byte-identity
+        argument: the slices' row sets concatenate back into exactly the
+        row set — in exactly the order — the unsharded SELECT returns for
+        the same cursor window, and commits only ever touch rows at
+        ``id <=`` an already-committed cursor, so slice SELECTs re-running
+        the predicate later cannot see different rows."""
+        db = ctx.library.db
+        cursor = scratch.get("cursor", data["cursor"])
+        where, params = _orphan_where(data["location_id"],
+                                      data.get("sub_path"))
+        ids = [r["id"] for r in db.query(
+            f"SELECT id FROM file_path WHERE {where} AND id > ? "
+            f"ORDER BY id LIMIT ?",
+            params + [cursor, _page_limit(scratch)])]
+        if not ids:
+            return None
+        scratch["cursor"] = ids[-1]
+        shards = max(1, int(scratch.get("shards") or 1))
+        per = -(-len(ids) // shards)
+        parts = [{"lo": ids[lo], "hi": ids[min(lo + per, len(ids)) - 1]}
+                 for lo in range(0, len(ids), per)]
+        return {"cursor": ids[-1], "parts": parts}
+
+    def pipeline_page_shard(self, ctx: WorkerContext, data: dict,
+                            part: dict) -> dict:
+        """One slice's row SELECT + sample gather — the same read-only
+        contract as ``pipeline_page``, safe to run concurrently with the
+        other slices (reads serialize on the shared reader connection;
+        the fused native gather releases the GIL for the whole slice)."""
+        db = ctx.library.db
+        where, params = _orphan_where(data["location_id"],
+                                      data.get("sub_path"))
+        rows = [dict(r) for r in db.query(
+            f"SELECT id, pub_id, name, extension, materialized_path, is_dir, "
+            f"size_in_bytes, date_created FROM file_path "
+            f"WHERE {where} AND id >= ? AND id <= ? ORDER BY id",
+            params + [part["lo"], part["hi"]])]
+        hashable, empty, messages, gather_s = \
+            self._gather_rows(ctx, data, rows)
+        return {"hashable": hashable, "empty": empty, "messages": messages,
+                "gather_s": gather_s}
+
+    def pipeline_page_merge(self, ctx: WorkerContext, data: dict,
+                            header: dict, results: list[dict]) -> dict:
+        """Reassemble the slice results (slice order == id order) into
+        exactly the payload ``pipeline_page`` returns. Per-list
+        concatenation preserves the hashable↔messages alignment because
+        each slice's lists are aligned and slices are disjoint id ranges
+        in page order. ``gather_s`` is the MAX slice gather — the page's
+        gather *wall*, the number shard parallelism is supposed to
+        shrink (the per-slice sum would hide the win)."""
+        hashable: list = []
+        empty: list = []
+        messages: list = []
+        gather_s = 0.0
+        for res in results:
+            hashable.extend(res["hashable"])
+            empty.extend(res["empty"])
+            messages.extend(res["messages"])
+            gather_s = max(gather_s, res["gather_s"])
+        return {"cursor": header["cursor"], "hashable": hashable,
+                "empty": empty, "messages": messages, "gather_s": gather_s}
 
     # -- stage 2: dispatch (device/CPU compute) ------------------------------
     def pipeline_process(self, ctx: WorkerContext, data: dict,

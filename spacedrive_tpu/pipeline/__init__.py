@@ -16,7 +16,12 @@ queues (depth ``SD_PIPELINE_DEPTH``, default 2):
 
 - **prefetcher** — ``pipeline_page``: pages the next step's rows and gathers
   sample messages (file I/O) while the current batch is hashing. Reads only;
-  the ``pipeline-ordering`` sdlint pass rejects DB writes here.
+  the ``pipeline-ordering`` sdlint pass rejects DB writes here. With
+  ``SD_SCAN_SHARDS`` > 1 and a spec that provides ``split``/``shard``/
+  ``merge`` callables, this stage fans each cursor page across parallel
+  gather shard workers and an ordered ticket merger (the
+  ``IngestLanes.submit`` shape) re-serializes them, so the dispatcher still
+  sees exactly the sequential page stream.
 - **dispatcher** — ``pipeline_process``: device/CPU compute. Bounded queues
   keep it fed so ≥2 hash batches are enqueued against jax's async dispatch
   (the sampled row pipeline's internal double-buffering supplies the
@@ -36,8 +41,9 @@ Ordering invariants (see docs/architecture/scan-pipeline.md):
    hashes are discarded, never committed out of order.
 """
 
-from .executor import PipelineExecutor, pipeline_depth, pipeline_enabled
+from .executor import (PipelineExecutor, pipeline_depth, pipeline_enabled,
+                       scan_shards)
 from .spec import PipelineSpec
 
 __all__ = ["PipelineExecutor", "PipelineSpec", "pipeline_depth",
-           "pipeline_enabled"]
+           "pipeline_enabled", "scan_shards"]

@@ -65,6 +65,17 @@ _COMMIT_TXNS = telemetry.counter(
 _COMMIT_PAGES = telemetry.counter(
     "sd_commit_txn_pages_total",
     "pipeline pages made durable through group-commit transactions")
+_GATHER_SHARDS = telemetry.gauge(
+    "sd_gather_shards",
+    "parallel gather shards per page in the sharded prefetch stage "
+    "(SD_SCAN_SHARDS; 1 = classic single-thread prefetch)")
+_GATHER_INFLIGHT = telemetry.gauge(
+    "sd_gather_inflight",
+    "gather shard slices currently executing across the shard workers")
+_SHARD_TASKS = telemetry.counter(
+    "sd_gather_shard_tasks_total",
+    "page slices executed per gather shard worker (occupancy skew across "
+    "shards shows up as per-label imbalance)", labels=("shard",))
 
 #: poll quantum for queue waits — also bounds pause latency, like the
 #: sequential loop's between-steps command check cadence
@@ -110,6 +121,29 @@ class _StageFailure:
         self.exc = exc
 
 
+class _PageTicket:
+    """Ordered-merge ticket for one split page — the ``IngestLanes.submit``
+    shape: the coordinator enqueues the ticket to the merger BEFORE its
+    shard slices fan out, so pages re-serialize in exactly split order no
+    matter how the shard workers interleave. Passive holder: the shard
+    workers fill ``results`` and count down ``remaining`` under ``lock``
+    (the last finisher sets ``done``); the merger barriers on ``done``."""
+
+    __slots__ = ("header", "parts", "results", "remaining", "done", "span",
+                 "lock")
+
+    def __init__(self, header: dict, parts: list, span: Any) -> None:
+        self.header = header
+        self.parts = parts
+        self.results: list[Any] = [None] * len(parts)
+        self.remaining = len(parts)
+        self.done = threading.Event()
+        #: the page's detached ``pipeline.page`` span — entered by the
+        #: coordinator, parent of every shard span, exited by the merger
+        self.span = span
+        self.lock = threading.Lock()
+
+
 def pipeline_enabled() -> bool:
     """Streaming execution is the default for jobs that opt in;
     ``SD_PIPELINE=0`` forces every job back onto the sequential step loop
@@ -123,6 +157,20 @@ def pipeline_depth() -> int:
         return max(1, int(os.environ.get("SD_PIPELINE_DEPTH", "2")))
     except ValueError:
         return 2
+
+
+def scan_shards() -> int:
+    """Parallel gather shards per page (``SD_SCAN_SHARDS``, clamped 1..16;
+    default min(4, cores)). 1 disables sharding — the classic single
+    prefetch thread, which is also the byte-identity baseline the shard
+    matrix compares against."""
+    raw = os.environ.get("SD_SCAN_SHARDS", "").strip()
+    if raw:
+        try:
+            return max(1, min(int(raw), 16))
+        except ValueError:
+            pass
+    return min(4, os.cpu_count() or 1)
 
 
 def commit_group() -> int:
@@ -149,6 +197,18 @@ class PipelineExecutor:
         depth = spec.depth or pipeline_depth()
         self._pages: queue.Queue[Any] = queue.Queue(maxsize=depth)
         self._results: queue.Queue[Any] = queue.Queue(maxsize=depth)
+        # sharded prefetch (ISSUE 17): when the spec provides the
+        # split/shard/merge callables and SD_SCAN_SHARDS > 1, the page
+        # stage fans each cursor page across shard workers and an ordered
+        # merger re-serializes them. Both queues are bounded: tickets by
+        # pipeline depth (pages in flight), slices by shards per ticket.
+        self._shards = (scan_shards()
+                        if (spec.split is not None and spec.shard is not None
+                            and spec.merge is not None) else 1)
+        self._sharded = self._shards > 1
+        self._tickets: queue.Queue[Any] = queue.Queue(maxsize=depth)
+        self._shard_q: queue.Queue[Any] = queue.Queue(
+            maxsize=self._shards * (depth + 1))
         self._stop = threading.Event()
         #: the job's trace (set by the worker; None with telemetry off) —
         #: stage spans pin the run() wall span as their parent
@@ -206,14 +266,35 @@ class PipelineExecutor:
                 except queue.Empty:
                     pass
 
+    def _observe_shares(self, scratch: dict[str, Any]) -> None:
+        """Publish measured stage shares (fraction of the pipeline wall
+        each stage has consumed so far) into ``scratch`` — the feedback
+        signal adaptive page sizing (``spec.adaptive``) reads before
+        sizing the next page. Measurement only: the sizing law lives with
+        the job, which knows its own pin/override rules."""
+        wall_sp = self._wall_sp
+        if wall_sp is None:
+            return
+        wall = wall_sp.elapsed_s()
+        if wall <= 0.05:
+            return
+        with self._stats_lock:
+            shares = {"page": self._page_s / wall,
+                      "hash": self._hash_s / wall,
+                      "commit": self._commit_s / wall}
+        scratch["stage_shares"] = shares
+
     # -- stage threads -------------------------------------------------------
     def _prefetch_loop(self, budget: int) -> None:
         scratch: dict[str, Any] = {
             "step_index": self.state.step_number,
             "steps": self.state.steps,
+            "shards": 1,
         }
         try:
-            while budget > 0 and not self._stop.is_set():
+            while (budget > 0 or self.spec.adaptive) \
+                    and not self._stop.is_set():
+                self._observe_shares(scratch)
                 with telemetry.span(self.trace, "pipeline.page",
                                     parent=self._wall_sp) as sp:
                     payload = self.spec.page(self.ctx, self.state.data,
@@ -230,6 +311,151 @@ class PipelineExecutor:
                 if not ok:
                     return  # draining
             self._put(self._pages, _DONE)
+        except BaseException as e:  # noqa: BLE001 — forwarded, fatal
+            self._put_nowait_or_drop(self._pages, _StageFailure(e))
+
+    # -- sharded prefetch: split coordinator / shard workers / merger --------
+    def _split_loop(self, budget: int) -> None:
+        scratch: dict[str, Any] = {
+            "step_index": self.state.step_number,
+            "steps": self.state.steps,
+            "shards": self._shards,
+        }
+        try:
+            while (budget > 0 or self.spec.adaptive) \
+                    and not self._stop.is_set():
+                self._observe_shares(scratch)
+                # the page span is DETACHED: entered here, exited by the
+                # merger once the page reassembles — its duration is the
+                # page's true wall (split + shard fan-out + merge), and
+                # every shard span pins it as parent so the trace tree
+                # keeps one pipeline.page node per page
+                sp = telemetry.span(self.trace, "pipeline.page",
+                                    parent=self._wall_sp, detached=True,
+                                    shards=self._shards)
+                sp.__enter__()
+                try:
+                    with telemetry.span(self.trace, "pipeline.split",
+                                        parent=sp):
+                        header = self.spec.split(self.ctx, self.state.data,
+                                                 scratch)
+                except BaseException:
+                    sp.__exit__(None, None, None)
+                    raise
+                if header is None:
+                    # out-of-work probe: close and count it, exactly like
+                    # the None-returning page call on the classic path
+                    sp.__exit__(None, None, None)
+                    with self._stats_lock:
+                        self._page_s += sp.duration_s
+                    _BUSY.inc(sp.duration_s, stage="page")
+                    break
+                budget -= 1
+                parts = header.pop("parts")
+                ticket = _PageTicket(header, parts, sp)
+                # ticket BEFORE fan-out (the IngestLanes.submit order):
+                # merge order is fixed here, shard completion order is free
+                t0 = time.perf_counter()
+                ok = self._put(self._tickets, ticket)
+                if ok:
+                    for idx in range(len(parts)):
+                        if not self._put(self._shard_q, (ticket, idx)):
+                            return  # draining
+                _BLOCKED.inc(time.perf_counter() - t0, stage="page")
+                if not ok:
+                    return  # draining
+            self._put(self._tickets, _DONE)
+        except BaseException as e:  # noqa: BLE001 — forwarded, fatal
+            self._put_nowait_or_drop(self._tickets, _StageFailure(e))
+
+    def _shard_loop(self, shard_idx: int) -> None:
+        """One gather worker: drains page slices off the shared shard
+        queue in arrival order (work-stealing across pages — a slow slice
+        of page N never idles workers that could start page N+1)."""
+        label = str(shard_idx)
+        while not self._stop.is_set():
+            try:
+                t0 = time.perf_counter()
+                ticket, idx = self._shard_q.get(timeout=_POLL_S)
+            except queue.Empty:
+                _IDLE.inc(time.perf_counter() - t0, stage="gather")
+                continue
+            _GATHER_INFLIGHT.inc()
+            result = None
+            try:
+                with telemetry.span(self.trace, "pipeline.gather",
+                                    parent=ticket.span, shard=shard_idx,
+                                    part=idx) as sp:
+                    try:
+                        result = self.spec.shard(self.ctx, self.state.data,
+                                                 ticket.parts[idx])
+                    except BaseException as e:  # noqa: BLE001 — merged, fatal
+                        result = _StageFailure(e)
+                        sp.set(failed=repr(e))
+                _BUSY.inc(sp.duration_s, stage="gather")
+                _SHARD_TASKS.inc(shard=label)
+            except BaseException as e:  # noqa: BLE001 — span/metric plumbing
+                # a slice result that already exists survives a telemetry
+                # failure; a missing one becomes a failed slice
+                if result is None:
+                    result = _StageFailure(e)
+            finally:
+                # ticket accounting is unconditional: a slice that dies for
+                # ANY reason must fail its page at the merger, never leave
+                # `remaining` stuck and hang the pipeline
+                _GATHER_INFLIGHT.dec()
+                with ticket.lock:
+                    ticket.results[idx] = result
+                    ticket.remaining -= 1
+                    last = ticket.remaining == 0
+                if last:
+                    ticket.done.set()
+
+    def _merge_loop(self) -> None:
+        """The ordered merger: completes tickets strictly in split order,
+        reassembles each page via ``spec.merge`` and forwards it — so the
+        dispatcher (and therefore hash and commit) sees exactly the
+        sequential page stream regardless of shard interleaving."""
+        try:
+            while not self._stop.is_set():
+                try:
+                    t0 = time.perf_counter()
+                    item = self._tickets.get(timeout=_POLL_S)
+                except queue.Empty:
+                    _IDLE.inc(time.perf_counter() - t0, stage="merge")
+                    continue
+                if item is _DONE or isinstance(item, _StageFailure):
+                    self._put(self._pages, item)
+                    return
+                ticket = item
+                t0 = time.perf_counter()
+                while not ticket.done.wait(timeout=_POLL_S):
+                    if self._stop.is_set():
+                        return  # draining; the page span is abandoned
+                _IDLE.inc(time.perf_counter() - t0, stage="merge")
+                failure = next((r for r in ticket.results
+                                if isinstance(r, _StageFailure)), None)
+                if failure is not None:
+                    # first failed slice fails the page — sequential
+                    # parity with a raised pipeline_page; transient
+                    # classification happens in the committer
+                    ticket.span.__exit__(type(failure.exc), failure.exc,
+                                         None)
+                    self._put_nowait_or_drop(self._pages, failure)
+                    return
+                with telemetry.span(self.trace, "pipeline.merge",
+                                    parent=ticket.span):
+                    payload = self.spec.merge(self.ctx, self.state.data,
+                                              ticket.header, ticket.results)
+                ticket.span.__exit__(None, None, None)
+                with self._stats_lock:
+                    self._page_s += ticket.span.duration_s
+                _BUSY.inc(ticket.span.duration_s, stage="page")
+                t0 = time.perf_counter()
+                ok = self._put(self._pages, payload)
+                _BLOCKED.inc(time.perf_counter() - t0, stage="page")
+                if not ok:
+                    return  # draining
         except BaseException as e:  # noqa: BLE001 — forwarded, fatal
             self._put_nowait_or_drop(self._pages, _StageFailure(e))
 
@@ -267,7 +493,10 @@ class PipelineExecutor:
 
         state = self.state
         budget = len(state.steps) - state.step_number
-        if budget <= 0:
+        # an adaptive spec may legitimately need more (or fewer) pages
+        # than init's fixed-size estimate — completion is page()→None,
+        # the budget is only the non-adaptive parity bound
+        if budget <= 0 and not self.spec.adaptive:
             return
         # the wall-clock span the stage spans nest under; its duration IS
         # pipeline_wall_s (metadata reads span data, not a parallel clock).
@@ -277,12 +506,26 @@ class PipelineExecutor:
                                  job=self.dyn_job.job.NAME)
         wall_sp.__enter__()
         self._wall_sp = wall_sp
-        threads = [
-            threading.Thread(target=self._prefetch_loop, args=(budget,),
-                             daemon=True, name="pipeline-prefetch"),
-            threading.Thread(target=self._dispatch_loop,
-                             daemon=True, name="pipeline-dispatch"),
-        ]
+        _GATHER_SHARDS.set(self._shards)
+        if self._sharded:
+            threads = [
+                threading.Thread(target=self._split_loop, args=(budget,),
+                                 daemon=True, name="pipeline-prefetch"),
+                *[threading.Thread(target=self._shard_loop, args=(i,),
+                                   daemon=True, name=f"pipeline-gather-{i}")
+                  for i in range(self._shards)],
+                threading.Thread(target=self._merge_loop,
+                                 daemon=True, name="pipeline-merge"),
+                threading.Thread(target=self._dispatch_loop,
+                                 daemon=True, name="pipeline-dispatch"),
+            ]
+        else:
+            threads = [
+                threading.Thread(target=self._prefetch_loop, args=(budget,),
+                                 daemon=True, name="pipeline-prefetch"),
+                threading.Thread(target=self._dispatch_loop,
+                                 daemon=True, name="pipeline-dispatch"),
+            ]
         for t in threads:
             t.start()
 
@@ -374,6 +617,12 @@ class PipelineExecutor:
                     merge_metadata(state.run_metadata, result.metadata)
                 self.errors.extend(result.errors)
                 state.step_number += 1
+                if state.step_number > len(state.steps):
+                    # adaptive paging produced more pages than init's
+                    # fixed-size estimate: mirror the estimate (content
+                    # cloned from the last step) so progress totals and
+                    # resume budgets stay coherent
+                    state.steps.append(dict(state.steps[-1]))
                 self.ctx.progress(completed_task_count=state.step_number)
             # durable crash checkpoint (ISSUE 9): persist the serialized
             # state now that this group is committed, so a SIGKILL resumes
@@ -446,7 +695,8 @@ class PipelineExecutor:
             wall_sp.__exit__(None, None, None)
             self._stop.set()
             # unblock producers stuck on a full queue, then join
-            for q in (self._pages, self._results):
+            for q in (self._pages, self._results, self._tickets,
+                      self._shard_q):
                 while True:
                     try:
                         q.get_nowait()
@@ -495,6 +745,9 @@ class PipelineExecutor:
             "pipeline_commit_s": self._commit_s,
             "pipeline_wall_s": wall_sp.duration_s,
             "pipeline_batches": self._batches,
+            # a string on purpose: merge_metadata SUMS numerics across
+            # pause/resume cycles, and shard counts must overwrite
+            "pipeline_shards": str(self._shards),
             "commit_txns": self._txns,
         })
         logger.debug(

@@ -45,6 +45,31 @@ class PipelineSpec:
         attempt fails, so nested-structure mutations would leak across a
         rollback. The ``commit-discipline`` sdlint pass enforces the write
         side.
+
+    Optional SHARDED PREFETCH (``SD_SCAN_SHARDS`` > 1 and all three set —
+    otherwise the executor runs ``page`` exactly as before):
+
+    ``split(ctx, data, scratch) -> header | None``
+        Split-coordinator thread. Pages the next cursor window (cheap
+        id-only DB read), advances the speculative cursor in ``scratch``,
+        and returns a header dict whose ``"parts"`` key is a list of
+        disjoint, **contiguous, ordered** work slices — one per gather
+        shard. ``scratch["shards"]`` carries the active shard count.
+        Returns ``None`` when out of work. Same read-only contract as
+        ``page``.
+
+    ``shard(ctx, data, part) -> part_result``
+        Gather-worker threads, several concurrently. Runs one slice's row
+        SELECT + sample gather. MUST be pure per-slice (no DB writes, no
+        shared mutable state): slices of one page may run in any order
+        and interleave with slices of later pages.
+
+    ``merge(ctx, data, header, results) -> payload``
+        Ordered-merger thread. Reassembles the shard results (in slice
+        order) into exactly the payload ``page`` would have produced for
+        the same cursor window — the byte-identity contract: hash and
+        commit must not be able to tell a merged page from a sequential
+        one.
     """
 
     page: Callable[..., Any]
@@ -53,3 +78,12 @@ class PipelineSpec:
     depth: int | None = None
     #: pages per durable transaction; None → executor.commit_group()
     group: int | None = None
+    #: sharded-prefetch callables (all three or none)
+    split: Callable[..., Any] | None = None
+    shard: Callable[..., Any] | None = None
+    merge: Callable[..., Any] | None = None
+    #: True when the job sizes its own pages from the executor's measured
+    #: ``stage_shares`` feedback (scratch) — tells the executor that page
+    #: count may diverge from init's fixed-size step estimate, so the
+    #: page budget becomes advisory and completion is ``page()`` → None
+    adaptive: bool = False

@@ -275,15 +275,20 @@ def _serve_one(runtime_node, router, cache: _PageCache, msg: dict) -> dict:
         hit, cache_key, cached = cache.lookup(
             library_id or "", key, arg, wm)
         if hit:
-            return {"ok": True, "result": cached, "hit": True}
+            return {"ok": True, "raw": cached, "hit": True}
         if proc.scope == "library":
             result = proc.fn(
                 runtime_node,
                 runtime_node.libraries.get(library_id, epoch=epoch), arg)
         else:
             result = proc.fn(runtime_node, arg)
-        cache.store(cache_key, wm, result)
-        return {"ok": True, "result": result, "hit": False}
+        # serialize ONCE, in the worker: the same encoder Response.json
+        # uses, so the shell can splice these bytes into the HTTP
+        # envelope verbatim — the node process neither decodes nor
+        # re-encodes the page, and cache hits replay the encoded bytes
+        encoded = json.dumps(result, default=str).encode()
+        cache.store(cache_key, wm, encoded)
+        return {"ok": True, "raw": encoded, "hit": False}
     except ApiError as e:
         return {"ok": False, "api": True, "error": str(e), "code": e.code}
     except Exception as e:  # 500-class, exactly like an in-process crash
@@ -561,7 +566,12 @@ class ReaderPool:
                 else:
                     self._cache_misses += 1
         if reply.get("ok"):
+            from ..api.router import RawJson
+
             _REQUESTS.inc(worker=label, outcome="ok")
+            raw = reply.get("raw")
+            if raw is not None:
+                return RawJson(raw)
             return reply.get("result")
         if reply.get("api"):
             from ..api.router import ApiError

@@ -44,7 +44,7 @@ from pathlib import Path
 from typing import TYPE_CHECKING, Any
 
 from .. import telemetry
-from ..api.router import ApiError
+from ..api.router import ApiError, RawJson
 from ..telemetry.requests import REQUEST_BUCKETS, record_payload
 from .http import (
     HttpError,
@@ -336,7 +336,7 @@ class Server:
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
             raise HttpError(400, f"malformed request payload: {e}")
         try:
-            result = await self._resolve(key, arg, library_id)
+            result = await self._resolve(key, arg, library_id, raw=True)
         except ApiError as e:
             resp = Response.json({"error": str(e)}, 400)
             if key in self.node.router.procedures:
@@ -344,13 +344,23 @@ class Server:
                 # mint unbounded label cardinality
                 record_payload(key, len(req.body), len(resp.body))
             return resp
-        resp = Response.json({"result": result})
+        if isinstance(result, RawJson):
+            # pool workers hand back wire bytes (encoded with the exact
+            # json.dumps call Response.json makes) — splice them into the
+            # envelope instead of decode + re-encode; the prefix matches
+            # json.dumps' default ': ' separator, so the body is
+            # byte-identical to the in-process encoding
+            resp = Response(200, {"content-type": "application/json"},
+                            b'{"result": ' + result.data + b"}")
+        else:
+            resp = Response.json({"result": result})
         # wire payload sizes per procedure (the router's observed() can't
         # see serialization — only the transport knows wire bytes)
         record_payload(key, len(req.body), len(resp.body))
         return resp
 
-    async def _resolve(self, key: str, arg: Any, library_id: str | None) -> Any:
+    async def _resolve(self, key: str, arg: Any, library_id: str | None,
+                       raw: bool = False) -> Any:
         if self.auth is None:
             from ..api.routers.keys import SECRET_PROCEDURES
 
@@ -361,7 +371,8 @@ class Server:
                     "credentials (--auth / SD_DESKTOP_AUTH) to enable it")
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
-            self._pool, lambda: self.node.router.resolve(key, arg, library_id))
+            self._pool,
+            lambda: self.node.router.resolve(key, arg, library_id, raw=raw))
 
     # -- custom_uri (custom_uri.rs:84) ---------------------------------------
     async def _custom_uri(self, req: Request, parts: list[str]) -> Response:

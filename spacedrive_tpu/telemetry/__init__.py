@@ -102,14 +102,16 @@ def render_prometheus() -> str:
 # -- spans / traces ------------------------------------------------------------
 
 def span(trace: Trace | None, name: str, parent: Span | None = None,
-         **attrs: Any) -> Span:
+         detached: bool = False, **attrs: Any) -> Span:
     """A timed section under ``trace`` — or a bare timer when there is no
     trace (telemetry off, non-job context): callers read
     ``span.duration_s`` either way. ``parent`` pins a cross-thread parent
-    (pipeline stage threads nest under the job thread's run span)."""
+    (pipeline stage threads nest under the job thread's run span);
+    ``detached`` spans join no thread stack, so they may be entered on
+    one thread and exited on another (the sharded-prefetch page span)."""
     if trace is None:
         return Span(name, trace=None, attrs=attrs)
-    return trace.span(name, parent=parent, **attrs)
+    return trace.span(name, parent=parent, detached=detached, **attrs)
 
 
 def start_trace(name: str, trace_id: str | None = None,

@@ -219,6 +219,11 @@ class Family:
             return
         self.labels(**label_values).set(value)
 
+    def dec(self, amount: float = 1.0, **label_values: str) -> None:
+        if not _ENABLED:
+            return
+        self.labels(**label_values).dec(amount)
+
     def observe(self, value: float, **label_values: str) -> None:
         if not _ENABLED:
             return

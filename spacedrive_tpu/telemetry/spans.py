@@ -71,12 +71,14 @@ class Span:
     span object = one enter/exit)."""
 
     __slots__ = ("name", "attrs", "trace", "span_id", "parent_id",
-                 "start_unix", "duration_s", "error", "_t0", "_pinned")
+                 "start_unix", "duration_s", "error", "_t0", "_pinned",
+                 "_detached")
 
     def __init__(self, name: str, trace: "Trace | None" = None,
                  attrs: dict[str, Any] | None = None,
                  parent: "Span | None" = None,
-                 parent_id: int | None = None) -> None:
+                 parent_id: int | None = None,
+                 detached: bool = False) -> None:
         self.name = name
         self.trace = trace
         self.attrs = attrs or {}
@@ -91,6 +93,13 @@ class Span:
         # stack cannot see it). ``parent_id`` pins a parent known only by
         # id — the CROSS-NODE case, where the parent span lives in another
         # process and arrived as a trace-context envelope (telemetry/mesh).
+        # a DETACHED span never joins any thread's nesting stack: it is
+        # entered on one thread and exited on another (the sharded
+        # prefetch page span — opened by the split coordinator, closed by
+        # the ordered merger), so stack-based nesting would corrupt the
+        # opener's chain. Children attach via an explicit ``parent=`` pin;
+        # the detached span itself must pin its own parent (or root).
+        self._detached = detached
         self._pinned = False
         if parent is not None and parent.span_id >= 0:
             self.parent_id = parent.span_id
@@ -148,12 +157,15 @@ class Trace:
 
     # -- span plumbing -------------------------------------------------------
     def span(self, name: str, parent: Span | None = None,
-             parent_id: int | None = None, **attrs: Any) -> Span:
+             parent_id: int | None = None, detached: bool = False,
+             **attrs: Any) -> Span:
         """``parent`` pins an explicit (possibly cross-thread) parent;
         ``parent_id`` pins a remote (cross-node) parent by bare id;
-        otherwise the opening thread's current span is the parent."""
+        ``detached`` makes a span owned by no thread stack (enter and
+        exit may happen on different threads); otherwise the opening
+        thread's current span is the parent."""
         return Span(name, trace=self, attrs=attrs, parent=parent,
-                    parent_id=parent_id)
+                    parent_id=parent_id, detached=detached)
 
     def _stack(self) -> list[Span]:
         stack = getattr(self._tls, "stack", None)
@@ -163,6 +175,11 @@ class Trace:
         return stack
 
     def _enter(self, span: Span) -> None:
+        if span._detached:
+            # no stack, no thread-local bookkeeping: just an id. The
+            # parent must be pinned explicitly (or defaults to the root).
+            span.span_id = next(self._ids)
+            return
         stack = self._stack()
         if not span._pinned:
             span.parent_id = stack[-1].span_id if stack else ROOT_SPAN_ID
@@ -181,6 +198,9 @@ class Trace:
         return stack[-1].span_id if stack else ROOT_SPAN_ID
 
     def _exit(self, span: Span) -> None:
+        if span._detached:
+            self._record(span)
+            return
         stack = self._stack()
         if stack and stack[-1] is span:
             stack.pop()
@@ -194,6 +214,9 @@ class Trace:
             _ACTIVE_BY_THREAD.pop(tid, None)  # lint: ok(lock-discipline)
         if not stack and getattr(_CURRENT, "trace", None) is self:
             _CURRENT.trace = None
+        self._record(span)
+
+    def _record(self, span: Span) -> None:
         record = {
             "span_id": span.span_id,
             "parent_id": span.parent_id,

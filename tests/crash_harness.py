@@ -61,6 +61,15 @@ SYNC_KILLS = ("sync_apply:kill:skip100", "sync_apply:kill:skip700")
 #: artifact_write:kill:once dies INSIDE the atomic-write discipline, with
 #: the temp durable but the destination name not yet created
 BACKUP_KILLS = ("backup:kill:skip1", "artifact_write:kill:once")
+#: ISSUE 17: the sharded-prefetch kill point. SD_SCAN_SHARDS=4 forces the
+#: split → shard → merge prefetch topology in BOTH the crash run and the
+#: restart, and ``gather:kill:skip1`` dies on an early slice INSIDE a
+#: gather shard worker thread — the restart must cold-resume from the
+#: durable checkpoint and converge byte-identical to the UNSHARDED
+#: uninterrupted reference (the ordered-merger equivalence claim, under
+#: SIGKILL)
+SHARDED_SCAN_KILL = "gather:kill:skip1"
+SHARDED_SCAN_ENV = {"SD_SCAN_SHARDS": "4"}
 
 
 # ---------------------------------------------------------------------------
@@ -135,15 +144,19 @@ def child_env() -> dict:
 
 
 def run_child(mode: str, data_dir: Path, args: dict, expect_kill: bool =
-              False, timeout: float = 180.0) -> tuple[int, dict | None]:
+              False, timeout: float = 180.0,
+              extra_env: dict | None = None) -> tuple[int, dict | None]:
     """Run one child; returns (returncode, result-dict-or-None). With
     ``expect_kill`` the caller asserts rc == -SIGKILL itself."""
     out_path = data_dir.parent / f"{data_dir.name}.{mode}.result.json"
     out_path.unlink(missing_ok=True)
+    env = child_env()
+    if extra_env:
+        env.update(extra_env)
     proc = subprocess.run(
         [sys.executable, str(Path(__file__).resolve()), mode, str(data_dir),
          json.dumps({**args, "out": str(out_path)})],
-        env=child_env(), capture_output=True, text=True, timeout=timeout)
+        env=env, capture_output=True, text=True, timeout=timeout)
     result = None
     if out_path.exists():
         result = json.loads(out_path.read_text())
@@ -156,19 +169,23 @@ def run_child(mode: str, data_dir: Path, args: dict, expect_kill: bool =
 
 
 def run_kill_point(base: Path, mode: str, faults_spec: str,
-                   workload_args: dict) -> dict:
+                   workload_args: dict,
+                   extra_env: dict | None = None) -> dict:
     """One matrix entry: crash run (must die by SIGKILL) + restart run
     (must recover). Returns the restart result plus recovery accounting;
-    the caller compares ``result["snapshot"]`` against its reference."""
+    the caller compares ``result["snapshot"]`` against its reference.
+    ``extra_env`` reaches both runs (the sharded kill point pins
+    SD_SCAN_SHARDS in the crash AND the restart)."""
     data_dir = base / f"{mode}-{faults_spec.replace(':', '_')}"
     rc, _ = run_child(mode, data_dir, {**workload_args,
                                        "faults": faults_spec},
-                      expect_kill=True)
+                      expect_kill=True, extra_env=extra_env)
     assert rc == -signal.SIGKILL, \
         f"kill point {mode}/{faults_spec}: child exited rc={rc}, " \
         f"expected SIGKILL (did the seam fire?)"
     t0 = time.perf_counter()
-    rc2, result = run_child(mode, data_dir, workload_args)
+    rc2, result = run_child(mode, data_dir, workload_args,
+                            extra_env=extra_env)
     assert rc2 == 0 and result is not None
     result["recovery_s"] = round(time.perf_counter() - t0, 3)
     result["kill_point"] = f"{mode}:{faults_spec}"

@@ -84,6 +84,23 @@ def test_kill_matrix(tmp_path):
             f"{spec}: restarted scan diverged from the uninterrupted run"
         survived.append(spec)
 
+    # ISSUE 17: the same scan gate with the sharded prefetch forced on —
+    # the kill lands on an early slice INSIDE a gather shard worker, and
+    # the restart (also running SD_SCAN_SHARDS=4) must cold-resume and
+    # converge to the SAME snapshot as the UNSHARDED uninterrupted
+    # reference: the ordered merger's sequential-equivalence claim holds
+    # across a SIGKILL boundary
+    res = ch.run_kill_point(tmp_path, "scan", ch.SHARDED_SCAN_KILL,
+                            scan_args, extra_env=ch.SHARDED_SCAN_ENV)
+    boot = res["boot"]
+    assert boot["quick_check_ok"], boot
+    assert boot["cold_resumed"] >= 1, \
+        "sharded gather kill: the killed job was not cold-resumed"
+    assert res["snapshot"] == scan_ref["snapshot"], \
+        "sharded gather kill: restarted scan diverged from the " \
+        "uninterrupted (unsharded) run"
+    survived.append(f"{ch.SHARDED_SCAN_KILL}[shards=4]")
+
     for spec in SYNC_KILLS:
         res = ch.run_kill_point(tmp_path, "sync", spec, sync_args)
         assert res["boot"]["quick_check_ok"], (spec, res["boot"])

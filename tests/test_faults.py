@@ -266,6 +266,127 @@ def test_stuck_gather_cannot_strand_a_pausing_job(tmp_path, monkeypatch,
     node.shutdown()
 
 
+# -- sharded gather chaos (ISSUE 17) -------------------------------------------
+
+
+def test_sharded_gather_chaos_byte_identical(tmp_path, chaos_tree, monkeypatch,
+                                             clean_faults):
+    """The acceptance-gate storm rerun with the gather stage split across 4
+    parallel shards: EIO retries, busy commits, and the one-shot hash wedge
+    must all be absorbed exactly as in the two-thread topology, and the
+    ordered ticket merger must keep rows + CRDT op order byte-identical to
+    a fault-free (unsharded) run.
+
+    The EIO trigger is a COUNT (2), not the unsharded gate's probability:
+    four shard threads draw from the shared fault RNG in nondeterministic
+    interleave, so a probability storm can land 3 low draws on one file's
+    retry sequence and quarantine it (breaking byte-identity) on an
+    unlucky run. Two count fires can never exhaust GATHER_RETRY's three
+    calls, whatever the interleaving — recovery is guaranteed."""
+    monkeypatch.setattr(fi, "BATCH_SIZE", 256)
+    monkeypatch.setenv("SD_PIPELINE", "1")
+
+    monkeypatch.setenv("SD_SCAN_SHARDS", "1")
+    node_a, lib_a, loc_a = _seed_library(tmp_path / "clean", chaos_tree, "sclean")
+    _identify(node_a, lib_a, loc_a)
+    clean = _snapshot(lib_a)
+    node_a.shutdown()
+
+    monkeypatch.setenv("SD_SCAN_SHARDS", "4")
+    node_b, lib_b, loc_b = _seed_library(tmp_path / "chaos", chaos_tree, "schaos")
+    faults.install("gather:eio:2;commit:sqlite_busy:3;hash:wedge:once",
+                   seed=4321)
+    jid = _identify(node_b, lib_b, loc_b)
+    fired = faults.fired()
+    faults.clear()
+    chaos = _snapshot(lib_b)
+    row = lib_b.db.find_one(JobRow, {"id": jid})
+    meta = _decoded(row["metadata"])
+    node_b.shutdown()
+
+    assert fired.get("gather:eio") == 2, fired
+    assert fired.get("hash:wedge") == 1, fired
+    assert fired.get("commit:sqlite_busy") == 3, fired
+
+    assert row["status"] == JobStatus.COMPLETED_WITH_ERRORS
+    assert meta["quarantined_files"] == 0
+    assert meta["recovered_batches"] == 1
+    assert meta["pipeline_batches"] == 8  # ceil(2000/256)
+    assert meta["pipeline_shards"] == "4"
+
+    assert chaos[0] == clean[0], "cas_id rows diverge under sharded faults"
+    assert chaos[1] == clean[1], "object linkage diverges under sharded faults"
+    assert chaos[2] == clean[2], "CRDT op order diverges under sharded faults"
+
+
+def test_sharded_quarantine_stays_per_item(tmp_path, monkeypatch,
+                                           clean_faults):
+    """Quarantine granularity survives sharding: with 4 gather shards, a
+    vanished file and an injected EACCES each quarantine exactly one item —
+    the failing shard slice must not take its page (or its shard's whole
+    slice) down with it."""
+    monkeypatch.setattr(fi, "BATCH_SIZE", 16)
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    monkeypatch.setenv("SD_SCAN_SHARDS", "4")
+    rng = random.Random(3)
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    for i in range(40):
+        (tree / f"f{i:02d}.dat").write_bytes(rng.randbytes(600 + i))
+
+    node, lib, loc_id = _seed_library(tmp_path / "q", tree, "sq")
+    (tree / "f07.dat").unlink()  # vanishes AFTER indexing, BEFORE identify
+    faults.install("gather:eacces:once")
+    jid = _identify(node, lib, loc_id)
+    faults.clear()
+
+    row = lib.db.find_one(JobRow, {"id": jid})
+    meta = _decoded(row["metadata"])
+    n_identified = lib.db.query(
+        "SELECT count(*) c FROM file_path WHERE cas_id IS NOT NULL")[0]["c"]
+    node.shutdown()
+
+    assert row["status"] == JobStatus.COMPLETED_WITH_ERRORS
+    assert meta["quarantined_files"] == 2
+    assert (row["errors_text"] or "").count("quarantined") == 2
+    assert n_identified == 38  # everything else still identified
+
+
+def test_sharded_stuck_gather_drain_escalates(tmp_path, monkeypatch,
+                                              clean_faults):
+    """A never-returning gather now wedges ONE shard worker while its three
+    siblings finish their slices; the merger is left waiting on a ticket
+    that can never complete. Pause must still land within the bounded
+    drain windows, abandoning the wedged worker as a leak soft error."""
+    monkeypatch.setattr(fi, "BATCH_SIZE", 8)
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    monkeypatch.setenv("SD_SCAN_SHARDS", "4")
+    monkeypatch.setenv("SD_PIPELINE_DRAIN_S", "0.3")
+    rng = random.Random(9)
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    for i in range(24):
+        (tree / f"f{i:02d}.dat").write_bytes(rng.randbytes(400 + i))
+
+    node, lib, loc_id = _seed_library(tmp_path / "hang", tree, "shang")
+    faults.install("gather:hang:once")
+    jid = node.jobs.spawn(lib, [fi.FileIdentifierJob({"location_id": loc_id})])
+    time.sleep(0.3)  # let one shard worker wedge inside the gather
+    assert node.jobs.pause(jid)
+
+    deadline = time.monotonic() + 15
+    row = None
+    while time.monotonic() < deadline:
+        row = lib.db.find_one(JobRow, {"id": jid})
+        if row and row["status"] == JobStatus.PAUSED:
+            break
+        time.sleep(0.05)
+    assert row is not None and row["status"] == JobStatus.PAUSED
+    assert "leaked" in (row["errors_text"] or "")
+    faults.clear()
+    node.shutdown()
+
+
 # -- pause/cancel during a retry backoff window (satellite) --------------------
 
 

@@ -108,6 +108,51 @@ def test_pool_results_byte_identical_to_in_process(node):
     assert str(pool_err.value) == str(in_err.value)
 
 
+def test_pool_preencoded_wire_bytes_byte_identical(node):
+    """Serve rung (b) starter (ISSUE 17): pool workers hand the shell
+    PRE-ENCODED wire JSON (RawJson) — the shell splices the bytes into
+    its envelope without decode + re-encode in the node process. The
+    spliced body must be byte-identical to the Response.json encoding
+    the in-process path produces, and ``raw=False`` callers (ws, ffi)
+    still see the decoded value."""
+    from spacedrive_tpu.api.router import RawJson
+    from spacedrive_tpu.server.http import Response
+
+    lib, loc_id = _seed_library(node)
+    pool = _start_pool(node)
+    cases = [
+        ("search.paths", {"take": 50}),
+        ("search.paths", {"materialized_path": "/sub/",
+                          "dirs_first": True, "take": 200}),
+        ("search.paths", {"search": "f00", "take": 64}),
+        ("search.pathsCount", {"location_id": loc_id}),
+    ]
+    for key, arg in cases:
+        raw = node.router.resolve(key, arg, lib.id, raw=True)
+        assert isinstance(raw, RawJson), key  # actually crossed the pool
+        spliced = b'{"result": ' + raw.data + b"}"
+        pool.set_enabled(False)
+        in_proc = node.router.resolve(key, arg, lib.id, raw=True)
+        pool.set_enabled(True)
+        # in-process results are plain values; the shell re-encodes those
+        assert not isinstance(in_proc, RawJson), key
+        assert spliced == Response.json({"result": in_proc}).body, key
+    # a cache hit replays the identical encoded bytes
+    first = node.router.resolve("search.paths", {"take": 50}, lib.id,
+                                raw=True)
+    assert isinstance(first, RawJson)
+    again = node.router.resolve("search.paths", {"take": 50}, lib.id,
+                                raw=True)
+    assert again.data == first.data
+    # default raw=False decodes transparently for non-shell callers
+    decoded = node.router.resolve("search.paths", {"take": 50}, lib.id)
+    assert not isinstance(decoded, RawJson)
+    pool.set_enabled(False)
+    assert _canon(decoded) == _canon(
+        node.router.resolve("search.paths", {"take": 50}, lib.id))
+    pool.set_enabled(True)
+
+
 def test_ingest_invalidation_never_serves_pre_watermark_rows(node):
     """Acceptance: a read served AFTER a CRDT ingest at watermark W never
     returns pre-W rows, with concurrent reads keeping the worker page
