@@ -141,6 +141,7 @@ export type Procedures = {
 	{ key: "p2p.nlmState", input: null, result: Record<string, unknown> } |
 	{ key: "p2p.peers", input: null, result: PeerMetadata[] } |
 	{ key: "preferences.get", input: unknown, result: unknown } |
+	{ key: "search.chunkDuplicates", input: unknown, result: unknown } |
 	{ key: "search.duplicates", input: { location_id?: number }, result: Record<string, unknown>[] } |
 	{ key: "search.ephemeralPaths", input: { path: string; withHiddenFiles?: boolean }, result: { entries: FilePathRow[] } } |
 	{ key: "search.nearDuplicates", input: unknown, result: unknown } |
@@ -233,6 +234,7 @@ export type Procedures = {
 	{ key: "p2p.pair", input: unknown, result: unknown } |
 	{ key: "p2p.pairingResponse", input: unknown, result: unknown } |
 	{ key: "p2p.spacedrop", input: unknown, result: unknown } |
+	{ key: "p2p.spacedropDelta", input: unknown, result: unknown } |
 	{ key: "preferences.update", input: unknown, result: unknown } |
 	{ key: "spaces.addObjects", input: { id: number; object_ids: number[] }, result: number } |
 	{ key: "spaces.create", input: { name: string; description?: string } | string, result: CollectionRow } |
@@ -316,6 +318,7 @@ export type LibraryProcedureKey =
 	"notifications.testLibrary" |
 	"preferences.get" |
 	"preferences.update" |
+	"search.chunkDuplicates" |
 	"search.duplicates" |
 	"search.nearDuplicates" |
 	"search.objects" |
@@ -394,6 +397,7 @@ export type NodeProcedureKey =
 	"p2p.pairingResponse" |
 	"p2p.peers" |
 	"p2p.spacedrop" |
+	"p2p.spacedropDelta" |
 	"search.ephemeralPaths" |
 	"sync.fleetStatus" |
 	"telemetry.alerts" |
@@ -517,8 +521,10 @@ export const procedures = {
 	"p2p.pairingResponse": { kind: "mutation", scope: "node" },
 	"p2p.peers": { kind: "query", scope: "node" },
 	"p2p.spacedrop": { kind: "mutation", scope: "node" },
+	"p2p.spacedropDelta": { kind: "mutation", scope: "node" },
 	"preferences.get": { kind: "query", scope: "library" },
 	"preferences.update": { kind: "mutation", scope: "library" },
+	"search.chunkDuplicates": { kind: "query", scope: "library" },
 	"search.duplicates": { kind: "query", scope: "library" },
 	"search.ephemeralPaths": { kind: "query", scope: "node" },
 	"search.nearDuplicates": { kind: "query", scope: "library" },

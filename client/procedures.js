@@ -446,12 +446,20 @@ window.SD_PROCEDURES = {
   "kind": "mutation",
   "scope": "node"
  },
+ "p2p.spacedropDelta": {
+  "kind": "mutation",
+  "scope": "node"
+ },
  "preferences.get": {
   "kind": "query",
   "scope": "library"
  },
  "preferences.update": {
   "kind": "mutation",
+  "scope": "library"
+ },
+ "search.chunkDuplicates": {
+  "kind": "query",
   "scope": "library"
  },
  "search.duplicates": {

@@ -37,7 +37,10 @@ from .pipeline_ordering import WRITE_ATTRS, _is_db_receiver
 
 SPECULATIVE_STAGES = ("pipeline_page", "pipeline_process",
                       "pipeline_page_split", "pipeline_page_shard",
-                      "pipeline_page_merge")
+                      "pipeline_page_merge",
+                      # the manifest stage halves (ISSUE 18): same
+                      # speculative-thread contract
+                      "pipeline_chunk_gather", "pipeline_chunk_process")
 
 DATA_MUTATORS = {"update", "setdefault", "pop", "popitem", "clear"}
 

@@ -34,7 +34,12 @@ STAGE_NAMES = ("pipeline_page", "pipeline_process",
                # coordinator / gather shard / merger threads — same
                # read-only contract as pipeline_page
                "pipeline_page_split", "pipeline_page_shard",
-               "pipeline_page_merge")
+               "pipeline_page_merge",
+               # the manifest stage halves (ISSUE 18) ride the prefetch and
+               # dispatch threads respectively — gather is read-only, the
+               # chunk dispatch is compute-only; manifest writes go through
+               # commit_manifest_rows inside pipeline_commit's transaction
+               "pipeline_chunk_gather", "pipeline_chunk_process")
 
 WRITE_ATTRS = {"execute", "executemany", "insert", "insert_ignore",
                "insert_many", "update", "upsert", "delete"}

@@ -1,2 +1,8 @@
 """Per-domain sub-routers, merged by api.router.mount (the 17-router layout
 of core/src/api/mod.rs:102-203)."""
+
+# imported for its import-time sd_delta_* metric families: api.router.mount
+# runs at Node construction, so the families render on /metrics (zero
+# samples) even when SD_P2P_DISABLED keeps the p2p manager itself from
+# starting — the observability.md drift gate holds in both directions
+from ...p2p import delta as _delta  # noqa: F401

@@ -44,6 +44,12 @@ def mount(router) -> None:
     def spacedrop(node, arg):
         return _p2p(node).spacedrop(arg["peer_id"], arg["paths"])
 
+    @router.mutation("p2p.spacedropDelta")
+    def spacedrop_delta(node, arg):
+        """Delta-aware drop: chunk-manifest negotiation ships only the
+        chunks the receiver lacks (docs/architecture/chunking.md)."""
+        return _p2p(node).spacedrop_delta(arg["peer_id"], arg["paths"])
+
     @router.mutation("p2p.acceptSpacedrop")
     def accept_spacedrop(node, arg):
         """target_dir omitted/None declines the drop (api/p2p.rs: accept

@@ -6,8 +6,11 @@ from ...preferences import get_preferences, update_preferences
 
 
 def mount(router) -> None:
-    @router.library_query("preferences.get")
+    @router.library_query("preferences.get", pool=True)
     def get(node, library, _arg):
+        # pure library.db read (preferences.py walks the preference table
+        # only), so it serves byte-identically from the worker pool —
+        # serving rung (c), proven by test_serving_pool.py
         return get_preferences(library)
 
     @router.library_mutation("preferences.update")

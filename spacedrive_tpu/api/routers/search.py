@@ -283,6 +283,24 @@ def mount(router) -> None:
             params + [limit])
         return [dict(r) for r in rows]
 
+    @router.library_query("search.chunkDuplicates", pool=True)
+    def chunk_duplicates(node, library, arg):
+        """Sub-file duplication from the chunk manifests (ISSUE 18): chunk
+        hashes shared by more than one object — the inverted chunk-hash map
+        the delta-transfer sender negotiates against, surfaced for the UI.
+        One indexed GROUP BY over chunk_manifest; pure library.db reads, so
+        it serves from the worker pool."""
+        arg = arg or {}
+        limit = max(0, min(int(arg.get("take", 200)), 1000))
+        rows = library.db.query(
+            "SELECT chunk_hash, COUNT(DISTINCT object_id) AS objects, "
+            "COUNT(*) AS copies, MAX(length) AS length, "
+            "SUM(length) - MAX(length) AS duplicated_bytes "
+            "FROM chunk_manifest GROUP BY chunk_hash "
+            "HAVING COUNT(DISTINCT object_id) > 1 "
+            "ORDER BY duplicated_bytes DESC, chunk_hash LIMIT ?", [limit])
+        return [dict(r) for r in rows]
+
     @router.library_query("search.nearDuplicates")
     def near_duplicates(node, library, arg):
         """TPU MinHash similarity groups (beyond the reference's exact-cas_id

@@ -242,6 +242,8 @@ class NetModel:
         #: "src>dst" -> [(seq, verdict, delay_ms)] — the delivery ledger
         self._ledger: dict[str, list[tuple[int, str, float]]] = {}
         self._seq: dict[str, int] = {}
+        #: "src>dst" -> delivered payload bytes (additive; never capped)
+        self._bytes: dict[str, int] = {}
         self._overflow = 0
         for i, raw in enumerate(p for p in spec.split(";") if p.strip()):
             self._parse_rule(raw.strip(), i)
@@ -354,6 +356,11 @@ class NetModel:
             _DELAY_S.inc(delay)
         if nbytes:
             _BYTES.inc(nbytes)
+            # delivered-bytes tally (additive, unbounded — unlike the
+            # capped ledger): the delta-transfer gate's bytes-on-wire
+            # accounting reads this per link
+            with self._lock:
+                self._bytes[link] = self._bytes.get(link, 0) + nbytes
         return delay
 
     def _announce_locked(self, part: _PartitionRule, inside: bool,
@@ -393,6 +400,15 @@ class NetModel:
         with self._lock:
             return {k: [seq for seq, verdict, _ in v if verdict == "drop"]
                     for k, v in self._ledger.items()}
+
+    def bytes_by_link(self) -> dict[str, int]:
+        """Delivered payload bytes per link ``{"src>dst": n}`` — the
+        delta-transfer gate asserts chunked sends ship strictly less than
+        the whole file from exactly this accounting (the capped ledger
+        keeps its tuple format; byte totals live here so the determinism
+        comparator is untouched)."""
+        with self._lock:
+            return dict(self._bytes)
 
     def status(self) -> dict[str, Any]:
         with self._lock:

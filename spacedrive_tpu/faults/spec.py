@@ -12,8 +12,13 @@ A spec is a ``;``-separated list of rules, each ``seam:kind[:trigger]``:
   path's admission check — kind ``overload`` synthesizes budget
   exhaustion there), ``p2p_send`` (outbound peer requests; kind ``busy``
   synthesizes a peer's BUSY answer), ``relay_probe`` (the jax_guard relay
-  liveness check). The set is open: any string names a seam; rules for
-  seams that never fire are inert.
+  liveness check), ``chunk`` (the manifest stage: per-file payload reads
+  — inside the transient retry, so ``eio`` storms retry clean — and the
+  CDC dispatch, where ``wedge`` exercises the chunk router's degrade
+  ladder), ``manifest_commit`` (inside the identifier's transaction just
+  before the chunk_manifest writes — the kill matrix pins a SIGKILL
+  there). The set is open: any string names a seam; rules for seams that
+  never fire are inert.
 - **kind** — which failure to synthesize (:data:`KINDS`); each maps to
   the exception class the real failure mode raises, so the production
   handlers are exercised, not test doubles. ``hang`` blocks instead of

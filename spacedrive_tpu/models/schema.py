@@ -436,6 +436,28 @@ class NearDuplicate(Model):
     UNIQUES = (("file_path_a_id", "file_path_b_id"),)
 
 
+class ChunkManifest(Model):
+    """One content-defined chunk of an object (ops/cdc.py gear chunker;
+    this framework's extension — the reference has no sub-file identity).
+    Row-per-chunk so the chunk-hash inverted map is one indexed GROUP BY.
+    Derived, local-only data like NearDuplicate: not synced, rebuilt by
+    rescans (the manifest stage overwrites per object), rows cascade away
+    with their objects — but RowJournal-noted so the device query engine
+    sees manifest churn."""
+
+    TABLE = "chunk_manifest"
+    FIELDS = {
+        "id": _pk(),
+        "object_id": Field(_I, nullable=False,
+                           references="object.id", on_delete="CASCADE"),
+        "seq": Field(_I, nullable=False),
+        "chunk_hash": Field(_T, nullable=False),
+        "length": Field(_I, nullable=False),
+    }
+    UNIQUES = (("object_id", "seq"),)
+    INDEXES = (("chunk_hash",),)
+
+
 ALL_MODELS: tuple[type[Model], ...] = (
     Instance,  # referenced by op-log tables, create first
     SharedOperationRow,
@@ -461,6 +483,7 @@ ALL_MODELS: tuple[type[Model], ...] = (
     Preference,
     Notification,
     NearDuplicate,
+    ChunkManifest,
 )
 
 SYNCED_MODELS: dict[str, type[Model]] = {

@@ -39,6 +39,9 @@ from ..models import FilePath, Location, Object, utc_now
 from ..sync.crdt import ref
 from .cas import read_sampled_batch_fast as read_sampled_batch
 from .hasher import HybridHasher, get_hasher
+# imported unconditionally so the sd_chunk_* telemetry families exist on
+# /metrics (and in observability.md's drift gate) even with manifests off
+from . import manifest as chunk_manifest
 
 _QUARANTINED = telemetry.counter(
     "sd_quarantined_files_total",
@@ -179,7 +182,8 @@ class FileIdentifierJob(StatefulJob):
                     location.get("generate_preview_media") is not False}
         return data, steps, {"total_orphan_paths": count, "created_objects": 0,
                              "linked_objects": 0, "hash_time": 0.0,
-                             "quarantined_files": 0, "recovered_batches": 0}
+                             "quarantined_files": 0, "recovered_batches": 0,
+                             "chunked_files": 0, "chunk_quarantined": 0}
 
     def pipeline_spec(self):
         from ..pipeline import PipelineSpec
@@ -249,13 +253,18 @@ class FileIdentifierJob(StatefulJob):
         # gather duration lands in the report via the span, nests under
         # pipeline.page (or the shard's pipeline.gather) in the job trace,
         # and still measures when telemetry is off (bare-timer degradation)
+        paths = [_abs_path(location_path, r) for r in hashable]
         with telemetry.span(getattr(ctx, "trace", None), "identifier.gather",
                             files=len(hashable)) as gather_sp:
             messages = read_sampled_batch(
-                [_abs_path(location_path, r) for r in hashable],
-                [r["size_in_bytes"] for r in hashable])
+                paths, [r["size_in_bytes"] for r in hashable])
             gather_sp.set(bytes=sum(len(m) for m in messages
                                     if not isinstance(m, Exception)))
+            if chunk_manifest.manifests_enabled():
+                # manifest payloads ride the same gather (small files reuse
+                # the cas message body byte-for-byte): attached per row, so
+                # shard-merge concatenation carries them automatically
+                chunk_manifest.pipeline_chunk_gather(paths, hashable, messages)
         # the cas message is size_le_8 ‖ header ‖ … — its head IS the file's
         # first bytes, so magic-byte kind resolution rides the gather for
         # free instead of re-opening every file on the commit thread (the
@@ -387,6 +396,11 @@ class FileIdentifierJob(StatefulJob):
         batch["cas_results"] = cas_results
         batch["hash_s"] = hash_sp.duration_s
         batch["messages"] = None  # the gather buffers are dead weight now
+        if chunk_manifest.manifests_enabled():
+            # the manifest stage rides the same dispatch thread behind its
+            # own router; failures degrade/quarantine inside, never raise
+            chunk_manifest.pipeline_chunk_process(
+                batch["hashable"], trace=getattr(ctx, "trace", None))
         return batch
 
     # -- stage 3: commit (the only stage that writes) ------------------------
@@ -418,6 +432,13 @@ class FileIdentifierJob(StatefulJob):
                 identified.append((row, cas))
         if quarantined:
             _QUARANTINED.inc(quarantined)
+        chunk_errors: list[str] = []
+        if chunk_manifest.manifests_enabled():
+            # per-item manifest quarantine: the file still identifies, only
+            # its manifest is skipped (next scan rebuilds it)
+            chunk_errors = chunk_manifest.quarantine_errors(
+                hashable, location_path)
+            errors.extend(chunk_errors)
         if batch.get("recovered_error"):
             errors.append(f"hash batch recovered on native CPU path after: "
                           f"{batch['recovered_error']}")
@@ -500,6 +521,24 @@ class FileIdentifierJob(StatefulJob):
             db.executemany_noted(
                 "UPDATE file_path SET object_id = ? WHERE id = ?",
                 link_rows, "file_path", (fp_id for _oid, fp_id in link_rows))
+
+            # 4. persist chunk manifests (opt-in) in the SAME transaction —
+            # a crash between the identify writes and the manifest rows can
+            # never surface (the kill matrix pins a SIGKILL here)
+            chunked = 0
+            if chunk_manifest.manifests_enabled():
+                faults.inject("manifest_commit")
+                oid_by_fp = {fp_id: oid for oid, fp_id in link_rows}
+                items: list[tuple[int, list]] = []
+                seen_oids: set[int] = set()
+                for row, _cas in identified:
+                    m = row.get("_chunk_manifest")
+                    oid = oid_by_fp.get(row["id"])
+                    if m is None or oid is None or oid in seen_oids:
+                        continue  # within-batch cas-duplicates: one copy wins
+                    seen_oids.add(oid)
+                    items.append((oid, m))
+                chunked = chunk_manifest.commit_manifest_rows(db, items)
             if emit and ops:
                 sync.log_ops(ops)
         # the checkpoint cursor advances ONLY here, after the transaction
@@ -531,7 +570,9 @@ class FileIdentifierJob(StatefulJob):
                                     "quarantined_files": quarantined,
                                     "recovered_batches":
                                         1 if batch.get("recovered_error")
-                                        else 0},
+                                        else 0,
+                                    "chunked_files": chunked,
+                                    "chunk_quarantined": len(chunk_errors)},
                           errors=errors)
 
     def _media_warm_start(self, ctx: WorkerContext, data: dict,

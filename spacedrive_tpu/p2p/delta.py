@@ -1,0 +1,374 @@
+"""Delta-aware spacedrop (ISSUE 18): ship only the chunks the peer lacks.
+
+A classic spacedrop streams the whole file. With chunk manifests
+(ops/cdc.py — content-defined boundaries, so an insertion early in the
+file shifts nothing downstream), the sender can instead:
+
+1. chunk the file and send an ``H_DELTA`` header carrying the full
+   manifest (``[[chunk_hash, length], ...]`` in file order);
+2. the receiver — after the usual accept decision (same
+   ``accept_spacedrop`` future as a plain drop) — chunks its own copy of
+   the same-named file in the chosen directory with the SAME geometry and
+   answers with the chunk hashes it already holds;
+3. the sender streams only the missing chunks (one copy per distinct
+   hash) as spaceblock block messages, in admission-bounded windows: each
+   window is offered as ``{"window", "count", "nbytes"}``, and the
+   receiver grants it through the node-wide :class:`IngestBudget` — over
+   budget it answers BUSY with a backoff, and the sender re-offers the
+   SAME window after sleeping (acked windows are never re-sent, which is
+   what makes BUSY resumable instead of restart-from-zero);
+4. the receiver reassembles the file from its base copy plus the received
+   chunks, verifies EVERY chunk hash (received chunks are re-hashed;
+   base chunks were hashed during step 2), writes a ``.sdpart`` sibling
+   and ``os.replace``s it into place under ``find_available_name``.
+
+Every frame the sender writes rides the armed :mod:`faults.net` model
+(``_net_link``), so bandwidth-shaped ``SD_NET_PLAN`` runs measure real
+bytes-on-wire per link — ``NetModel.bytes_by_link()`` is the ledger the
+delta gate reads.
+
+This module deliberately does NOT import :mod:`.manager` (manager imports
+us); the manager instance arrives as a duck-typed parameter.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import uuid
+from pathlib import Path
+from typing import Any
+
+from .. import faults, telemetry
+from ..faults import net
+from ..ops import cdc
+from ..telemetry import mesh
+from .proto import (Header, ProtocolError, block_msg, json_frame,
+                    read_block_msg, read_exact, read_json)
+
+logger = logging.getLogger(__name__)
+
+#: chunks per admission window — one IngestBudget spend per window, so a
+#: BUSY sheds ~WINDOW chunks of in-flight buffering, not the whole file
+WINDOW = 64
+
+#: accept decision + per-frame reply deadline (tests shrink via monkeypatch)
+DELTA_TIMEOUT = 60.0
+
+#: defensive bound on a declared manifest (64 GiB at max_size chunks)
+MAX_CHUNKS = 1 << 20
+
+# -- telemetry: declared at import time (api/routers/p2p.py imports this
+# module at mount, i.e. on every Node construction — even with
+# SD_P2P_DISABLED the families render with zero samples, keeping the
+# observability.md drift gate honest in both directions)
+_TRANSFERS = telemetry.counter(
+    "sd_delta_transfers_total", "delta spacedrop transfers completed",
+    labels=("role",))
+_CHUNKS = telemetry.counter(
+    "sd_delta_chunks_total",
+    "sender-side chunk outcomes: shipped over the wire vs reused from "
+    "the receiver's base copy", labels=("kind",))
+_BYTES = telemetry.counter(
+    "sd_delta_bytes_total",
+    "sender-side payload bytes: shipped over the wire vs avoided because "
+    "the receiver already held the chunk", labels=("kind",))
+_BUSY = telemetry.counter(
+    "sd_delta_busy_total",
+    "delta windows shed by the receiver's admission budget (each one is "
+    "a sleep-and-re-offer, never a restart)")
+
+
+async def _net_link(src: str, dst: str, nbytes: int) -> None:
+    """Loop-safe :mod:`faults.net` inject point (nlm.py idiom): the armed
+    model decides synchronously and the modeled delay rides
+    ``asyncio.sleep``; LinkCut/LinkDropped propagate as transient flaps."""
+    model = net.active()
+    if model is None:
+        return
+    delay = model.decide(src, dst, nbytes)
+    if delay > 0.0:
+        await asyncio.sleep(delay)
+
+
+def _manifest_offsets(manifest: list[tuple[str, int]]) -> list[int]:
+    offsets, off = [], 0
+    for _h, ln in manifest:
+        offsets.append(off)
+        off += ln
+    return offsets
+
+
+def _chunk_file(data: bytes) -> list[tuple[str, int]]:
+    """Both ends chunk with DEFAULT_PARAMS and the env-resolved kernel —
+    byte-identical boundaries + ids on every rung is cdc.py's contract,
+    so sender and receiver never disagree about what a chunk is."""
+    return cdc.build_manifest(data)
+
+
+def _verify_ids(datas: list[bytes]) -> list[str]:
+    """Chunk ids of already-cut chunks (one whole-buffer chunk each)."""
+    ids = cdc.chunk_ids(datas, [[(0, len(d))] for d in datas])
+    return [i[0] for i in ids]
+
+
+# -- sender -------------------------------------------------------------------
+
+async def send_delta(mgr: Any, drop_id: str, peer_id: str, path: Path) -> None:
+    """Runs on the p2p loop (``mgr.schedule``). Emits the same
+    Spacedrop{Rejected,Done,Failed,Progress} events as a plain drop, plus
+    delta accounting in the Done payload."""
+    cancel = asyncio.Event()
+    mgr._spacedrop_cancel[drop_id] = cancel
+    loop = asyncio.get_running_loop()
+    try:
+        data = await loop.run_in_executor(None, path.read_bytes)
+        manifest = await loop.run_in_executor(None, _chunk_file, data)
+        offsets = _manifest_offsets(manifest)
+        # chaos seam for outbound peer requests (raising kinds only)
+        faults.inject("p2p_send", key=peer_id)
+        reader, writer, _meta = await mgr.open_stream(peer_id)
+        self_id = mgr.remote_identity.encode()
+        try:
+            hdr = Header.delta(drop_id, path.name, len(data),
+                               [[h, ln] for h, ln in manifest]).to_bytes()
+            await _net_link(self_id, peer_id, len(hdr))
+            writer.write(hdr)
+            await writer.drain()
+            decision = await asyncio.wait_for(read_exact(reader, 1),
+                                              DELTA_TIMEOUT)
+            if decision != b"\x01":
+                mgr.emit({"type": "SpacedropRejected", "id": drop_id})
+                return
+            reply = await asyncio.wait_for(read_json(reader), DELTA_TIMEOUT)
+            if not reply.get("ok"):
+                raise ProtocolError(reply.get("error", "delta refused"))
+            have = set(reply.get("have") or [])
+            # one copy per distinct missing hash: the receiver reassembles
+            # by hash, so within-file duplicate chunks ship once
+            seen: set[str] = set()
+            send_idx: list[int] = []
+            for i, (h, _ln) in enumerate(manifest):
+                if h in have or h in seen:
+                    continue
+                seen.add(h)
+                send_idx.append(i)
+            sent_bytes = 0
+            total_send = sum(manifest[i][1] for i in send_idx) or 1
+            windows = [send_idx[i:i + WINDOW]
+                       for i in range(0, len(send_idx), WINDOW)]
+            for w, idxs in enumerate(windows):
+                while True:
+                    if cancel.is_set():
+                        raise ProtocolError("cancelled")
+                    offer = json_frame({
+                        "window": w, "count": len(idxs),
+                        "nbytes": sum(manifest[i][1] for i in idxs)})
+                    await _net_link(self_id, peer_id, len(offer))
+                    writer.write(offer)
+                    await writer.drain()
+                    grant = await asyncio.wait_for(read_json(reader),
+                                                   DELTA_TIMEOUT)
+                    if grant.get("busy"):
+                        # admission shed the window: sleep the advised
+                        # backoff and re-offer THIS window — everything
+                        # already acked stays acked
+                        _BUSY.inc()
+                        await asyncio.sleep(
+                            max(0, int(grant.get("retry_after_ms") or 0))
+                            / 1000.0)
+                        continue
+                    if not grant.get("go"):
+                        raise ProtocolError("delta window refused")
+                    for i in idxs:
+                        off, ln = offsets[i], manifest[i][1]
+                        msg = block_msg(off, data[off:off + ln])
+                        await _net_link(self_id, peer_id, len(msg))
+                        writer.write(msg)
+                    await writer.drain()
+                    ack = await asyncio.wait_for(read_json(reader),
+                                                 DELTA_TIMEOUT)
+                    if ack.get("ack") != w:
+                        raise ProtocolError(f"bad delta ack: {ack!r}")
+                    sent_bytes += sum(manifest[i][1] for i in idxs)
+                    mgr.emit({"type": "SpacedropProgress", "id": drop_id,
+                              "percent": int(sent_bytes * 100 / total_send)})
+                    break
+            done = json_frame({"done": True})
+            await _net_link(self_id, peer_id, len(done))
+            writer.write(done)
+            await writer.drain()
+            final = await asyncio.wait_for(read_json(reader), DELTA_TIMEOUT)
+            if not final.get("ok"):
+                raise ProtocolError(final.get("error", "delta assembly failed"))
+            reused = len(manifest) - len(send_idx)
+            _TRANSFERS.inc(role="sender")
+            _CHUNKS.inc(len(send_idx), kind="sent")
+            _CHUNKS.inc(reused, kind="reused")
+            _BYTES.inc(sent_bytes, kind="sent")
+            _BYTES.inc(len(data) - sent_bytes, kind="reused")
+            mgr.emit({"type": "SpacedropDone", "id": drop_id,
+                      "bytes": sent_bytes, "delta": True,
+                      "chunks_sent": len(send_idx), "chunks_reused": reused,
+                      "path": final.get("path")})
+        finally:
+            writer.close()
+    except (OSError, asyncio.TimeoutError, ProtocolError) as e:
+        mgr.emit({"type": "SpacedropFailed", "id": drop_id, "error": str(e)})
+    finally:
+        mgr._spacedrop_cancel.pop(drop_id, None)
+
+
+# -- receiver -----------------------------------------------------------------
+
+def _parse_manifest(payload: dict) -> tuple[str, int, list[tuple[str, int]]]:
+    name = str(payload.get("name") or "received.bin")
+    size = int(payload.get("size") or 0)
+    raw = payload.get("chunks") or []
+    if not isinstance(raw, list) or len(raw) > MAX_CHUNKS:
+        raise ProtocolError("bad delta manifest shape")
+    chunks: list[tuple[str, int]] = []
+    for entry in raw:
+        h, ln = str(entry[0]), int(entry[1])
+        if ln <= 0 or len(h) != cdc.CHUNK_ID_HEX:
+            raise ProtocolError("bad delta manifest entry")
+        chunks.append((h, ln))
+    if sum(ln for _h, ln in chunks) != size:
+        raise ProtocolError("delta manifest does not cover the file")
+    return name, size, chunks
+
+
+async def serve_delta(mgr: Any, reader, writer, payload: dict, peer) -> None:
+    """The ``H_DELTA`` responder (dispatched from the manager's substream
+    elif chain). Raises into the dispatcher on protocol violations — the
+    substream RESETs and the sender sees a fast failure."""
+    from ..sync.admission import Busy
+
+    name, size, chunks = _parse_manifest(payload)
+    loop = asyncio.get_running_loop()
+    drop_id = str(uuid.uuid4())
+    fut: asyncio.Future = mgr._loop.create_future()
+    mgr._spacedrop_in[drop_id] = {"future": fut, "req": payload,
+                                  "peer": peer.identity}
+    mgr.emit({"type": "SpacedropRequest", "id": drop_id,
+              "identity": peer.identity, "name": name, "size": size,
+              "delta": True, "chunks": len(chunks)})
+    try:
+        target_dir = await asyncio.wait_for(fut, DELTA_TIMEOUT)
+    except asyncio.TimeoutError:
+        target_dir = None
+    finally:
+        mgr._spacedrop_in.pop(drop_id, None)
+    if target_dir is None:
+        writer.write(b"\x00")
+        await writer.drain()
+        mgr.emit({"type": "SpacedropRejected", "id": drop_id})
+        return
+    writer.write(b"\x01")
+    await writer.drain()
+
+    # the offered name is attacker-controlled: basename only, same as the
+    # plain spacedrop path
+    safe_name = Path(name).name or "received.bin"
+    base_path = Path(target_dir) / safe_name
+    base_data = b""
+    have: dict[str, tuple[int, int]] = {}  # hash -> (offset, length) in base
+    if base_path.is_file():
+        base_data = await loop.run_in_executor(None, base_path.read_bytes)
+        base_manifest = await loop.run_in_executor(None, _chunk_file,
+                                                   base_data)
+        off = 0
+        for h, ln in base_manifest:
+            have.setdefault(h, (off, ln))
+            off += ln
+    # advertise only hashes the sender actually needs, length-checked
+    needed = {h: ln for h, ln in chunks}
+    usable = sorted(h for h, (_o, ln) in have.items()
+                    if needed.get(h) == ln)
+    writer.write(json_frame({"ok": True, "have": usable}))
+    await writer.drain()
+
+    offset_of = {off: i for i, off in
+                 enumerate(_manifest_offsets([(h, ln) for h, ln in chunks]))}
+    received: dict[str, bytes] = {}
+    budget = getattr(mgr.node, "ingest_budget", None)
+    while True:
+        msg = await asyncio.wait_for(read_json(reader), DELTA_TIMEOUT)
+        if msg.get("done"):
+            break
+        w = int(msg.get("window", -1))
+        count = int(msg.get("count", 0))
+        nbytes = int(msg.get("nbytes", 0))
+        if count <= 0 or count > WINDOW or nbytes < 0:
+            raise ProtocolError("bad delta window offer")
+        admission = None
+        if budget is not None:
+            verdict = budget.try_admit(mesh.peer_label(peer.identity),
+                                       count, nbytes)
+            if isinstance(verdict, Busy):
+                mesh.record_busy_sent(mesh.peer_label(peer.identity))
+                writer.write(json_frame(
+                    {"busy": True,
+                     "retry_after_ms": verdict.retry_after_ms}))
+                await writer.drain()
+                continue
+            admission = verdict
+        try:
+            writer.write(json_frame({"go": True}))
+            await writer.drain()
+            blocks: list[tuple[int, bytes]] = []
+            for _ in range(count):
+                blk = await asyncio.wait_for(read_block_msg(reader),
+                                             DELTA_TIMEOUT)
+                if blk is None:
+                    raise ProtocolError("delta transfer cancelled")
+                blocks.append(blk)
+            # per-chunk integrity: re-hash every received chunk and match
+            # it against the manifest entry at its declared offset
+            ids = await loop.run_in_executor(
+                None, _verify_ids, [d for _o, d in blocks])
+            for (off, data_b), cid in zip(blocks, ids):
+                idx = offset_of.get(off)
+                if idx is None:
+                    raise ProtocolError(f"block at unknown offset {off}")
+                h, ln = chunks[idx]
+                if len(data_b) != ln or cid != h:
+                    raise ProtocolError(f"chunk hash mismatch at {off}")
+                received[h] = data_b
+            writer.write(json_frame({"ack": w}))
+            await writer.drain()
+        finally:
+            if admission is not None:
+                admission.release()
+
+    # reassemble: base copy for advertised hashes, wire bytes for the rest
+    parts: list[bytes] = []
+    for h, ln in chunks:
+        if h in received:
+            parts.append(received[h])
+        elif h in have and have[h][1] == ln:
+            off = have[h][0]
+            parts.append(base_data[off:off + ln])
+        else:
+            raise ProtocolError(f"chunk {h} never arrived")
+    blob = b"".join(parts)
+    if len(blob) != size:
+        raise ProtocolError("reassembled size mismatch")
+
+    from ..objects.fs import find_available_name
+
+    target = find_available_name(Path(target_dir) / safe_name)
+    part = target.with_name(target.name + ".sdpart")
+
+    def _persist() -> None:
+        part.write_bytes(blob)
+        os.replace(part, target)
+
+    await loop.run_in_executor(None, _persist)
+    writer.write(json_frame({"ok": True, "path": str(target)}))
+    await writer.drain()
+    _TRANSFERS.inc(role="receiver")
+    mgr.emit({"type": "SpacedropDone", "id": drop_id, "path": str(target),
+              "delta": True, "chunks_received": len(received),
+              "chunks_reused": len(chunks) - len(received)})

@@ -32,10 +32,11 @@ from typing import TYPE_CHECKING, Any
 from .discovery import Discovery, DiscoveredPeer
 from .identity import Identity, RemoteIdentity, remote_identity_of
 from .mux import MuxConn
-from .proto import (Header, H_FILE, H_HASH, H_PAIR, H_PING, H_SPACEDROP,
-                    H_SYNC, H_THUMBNAIL, ProtocolError, Range, SpaceblockRequest,
-                    block_size_for, json_frame, read_block_msg, read_exact,
-                    read_json)
+from . import delta as delta_proto
+from .proto import (Header, H_DELTA, H_FILE, H_HASH, H_PAIR, H_PING,
+                    H_SPACEDROP, H_SYNC, H_THUMBNAIL, ProtocolError, Range,
+                    SpaceblockRequest, block_size_for, json_frame,
+                    read_block_msg, read_exact, read_json)
 from .secure import (SecureReader, SecureWriter, derive_session_keys,
                      gen_ephemeral, transcript)
 from .spaceblock import receive_file, send_file
@@ -586,6 +587,9 @@ class P2PManager:
                 await self._serve_thumbnail(sub, sub, header.payload, peer)
             elif header.kind == H_HASH:
                 await self._serve_hash_batch(sub, sub, header.payload, peer)
+            elif header.kind == H_DELTA:
+                await delta_proto.serve_delta(self, sub, sub,
+                                              header.payload, peer)
             else:
                 logger.warning("unhandled header kind %s", header.kind)
             failed = False
@@ -610,6 +614,18 @@ class P2PManager:
             drop_id = str(uuid.uuid4())
             ids.append(drop_id)
             self.schedule(self._spacedrop_send(drop_id, peer_id, Path(p)))
+        return ids
+
+    def spacedrop_delta(self, peer_id: str, paths: list[str]) -> list[str]:
+        """Delta-aware spacedrop (ISSUE 18): negotiate the peer's chunk
+        manifest and ship only the missing chunks (p2p/delta.py). Same
+        accept/cancel surface and event stream as a plain drop."""
+        ids = []
+        for p in paths:
+            drop_id = str(uuid.uuid4())
+            ids.append(drop_id)
+            self.schedule(delta_proto.send_delta(self, drop_id, peer_id,
+                                                 Path(p)))
         return ids
 
     async def _spacedrop_send(self, drop_id: str, peer_id: str, path: Path) -> None:

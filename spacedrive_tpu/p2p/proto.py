@@ -127,6 +127,7 @@ H_FILE = 4
 H_CONNECTED = 5
 H_THUMBNAIL = 6
 H_HASH = 7
+H_DELTA = 8
 
 
 @dataclass(frozen=True)
@@ -180,6 +181,17 @@ class Header:
             payload["ctx"] = ctx
         return cls(H_HASH, payload)
 
+    @classmethod
+    def delta(cls, transfer_id: str, name: str, size: int,
+              chunks: list[list]) -> "Header":
+        """Delta spacedrop offer (ISSUE 18): the sender's full chunk
+        manifest (``[[chunk_hash, length], ...]`` in file order, ops/cdc.py
+        geometry) rides the header; the receiver answers with the chunk
+        hashes it already holds, and only the missing ones cross the wire
+        as spaceblock block messages."""
+        return cls(H_DELTA, {"transfer_id": transfer_id, "name": name,
+                             "size": size, "chunks": chunks})
+
     # wire -----------------------------------------------------------------
     def to_bytes(self) -> bytes:
         b = bytes([self.kind])
@@ -191,7 +203,7 @@ class Header:
             return b + json_frame(self.payload)
         if self.kind == H_SPACEDROP:
             return b + json_frame(self.payload.to_wire())
-        if self.kind in (H_FILE, H_CONNECTED, H_THUMBNAIL, H_HASH):
+        if self.kind in (H_FILE, H_CONNECTED, H_THUMBNAIL, H_HASH, H_DELTA):
             return b + json_frame(self.payload)
         raise ProtocolError(f"unknown header kind {self.kind}")
 
@@ -204,7 +216,7 @@ class Header:
             return cls(kind, str(await read_json(reader)))
         if kind == H_SPACEDROP:
             return cls(kind, SpaceblockRequest.from_wire(await read_json(reader)))
-        if kind in (H_FILE, H_CONNECTED, H_THUMBNAIL, H_HASH):
+        if kind in (H_FILE, H_CONNECTED, H_THUMBNAIL, H_HASH, H_DELTA):
             return cls(kind, await read_json(reader))
         raise ProtocolError(f"invalid header discriminator {kind}")
 

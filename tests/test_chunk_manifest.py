@@ -1,0 +1,255 @@
+"""Chunk-manifest stage of FileIdentifierJob (ISSUE 18, SD_CHUNK_MANIFESTS=1).
+
+Byte-identity is the gate everywhere: manifests must come out identical
+whatever the shard count, whether the pipeline or the sequential executor
+ran the job, and under a transient-EIO chaos storm (the retry policy eats
+it). Persistent per-item failures quarantine the FILE's manifest without
+touching identification, and a device wedge mid-dispatch degrades the chunk
+router to the numpy rung over the same payloads — identical output by the
+cdc cross-rung contract.
+"""
+
+import random
+
+import pytest
+
+from spacedrive_tpu import faults, telemetry
+from spacedrive_tpu.models import FilePath
+from spacedrive_tpu.objects import manifest
+from spacedrive_tpu.ops import cdc
+
+from .test_pipeline import _identify, _seed_library
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.setenv("SD_CHUNK_MANIFESTS", "1")
+    # the numpy rung keeps these integration runs fast; cross-rung identity
+    # is test_cdc.py's job
+    monkeypatch.setenv("SD_CDC_KERNEL", "numpy")
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    manifest.router.reset()
+    yield
+    faults.clear()
+    manifest.router.reset()
+    telemetry.reset()
+    telemetry.reload_enabled()
+
+
+@pytest.fixture()
+def small_tree(tmp_path):
+    """Compact deterministic tree: empties, duplicates, a sampled-class
+    file, and two DISTINCT files sharing a long common prefix (distinct
+    objects with overlapping chunk hashes — the chunkDuplicates shape)."""
+    rng = random.Random(99)
+    root = tmp_path / "tree"
+    shared = rng.randbytes(64 * 1024)
+    dup = rng.randbytes(3000)
+    for d in range(3):
+        p = root / f"d{d}"
+        p.mkdir(parents=True)
+        (p / "f00.dat").write_bytes(dup)              # cross-dir duplicate
+        (p / "f01.dat").write_bytes(b"")              # empty
+        (p / "f02.dat").write_bytes(rng.randbytes(400 + d * 37))
+        (p / "f03.dat").write_bytes(rng.randbytes(150_000 + d))  # sampled
+        (p / "f04.dat").write_bytes(shared + rng.randbytes(8192 + d * 13))
+        (p / "f05.dat").write_bytes(rng.randbytes(20_000 + d * 7))
+    return root
+
+
+def manifest_snapshot(lib):
+    """{file_path pub_id: ((seq, hash, length), ...)} — pub_ids are pinned
+    by _seed_library, so snapshots compare across independent runs."""
+    out = {}
+    for r in lib.db.query(
+            "SELECT fp.pub_id pid, cm.seq, cm.chunk_hash, cm.length "
+            "FROM chunk_manifest cm JOIN object o ON cm.object_id = o.id "
+            "JOIN file_path fp ON fp.object_id = o.id "
+            "ORDER BY fp.pub_id, cm.seq"):
+        out.setdefault(r["pid"], []).append(
+            (r["seq"], r["chunk_hash"], r["length"]))
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def pid_of_path(tree):
+    """path -> fp pub_id, replicating _seed_library's enumeration."""
+    return {f: f"fp-{i:04d}"
+            for i, f in enumerate(sorted(tree.rglob("*.dat")))}
+
+
+def run_scan(tmp_path, tree, name, monkeypatch=None, env=None):
+    if env:
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+    node, lib, loc = _seed_library(tmp_path / name, tree, name)
+    try:
+        _identify(node, lib, loc)
+        snap = manifest_snapshot(lib)
+        meta = job_meta(node, lib)
+        return snap, meta
+    finally:
+        node.shutdown()
+
+
+def job_meta(node, lib):
+    from spacedrive_tpu.models import JobRow
+
+    rows = lib.db.find(JobRow)
+    import json
+
+    for r in rows:
+        blob = r["metadata"]
+        if isinstance(blob, (bytes, bytearray)):
+            blob = blob.decode()
+        meta = blob if isinstance(blob, dict) else json.loads(blob or "{}")
+        if "chunked_files" in meta:
+            return meta
+    return {}
+
+
+# -- ground truth ---------------------------------------------------------------
+
+
+def test_manifests_match_cdc_ground_truth(tmp_path, small_tree, monkeypatch):
+    snap, meta = run_scan(tmp_path, small_tree, "truth", monkeypatch)
+    pids = pid_of_path(small_tree)
+    checked = 0
+    for path, pid in pids.items():
+        data = path.read_bytes()
+        if not data:
+            assert pid not in snap  # empties carry no manifest
+            continue
+        expect = tuple(
+            (seq, cid, ln) for seq, (cid, ln) in
+            enumerate(cdc.build_manifest(data, kernel="numpy")))
+        assert snap[pid] == expect, path
+        checked += 1
+    assert checked > 10
+    assert meta.get("chunked_files", 0) > 0
+    assert meta.get("chunk_quarantined") == 0
+    assert telemetry.value("sd_chunk_files_total") > 0
+    assert telemetry.value("sd_chunk_chunks_total") > 0
+
+
+# -- byte-identity matrix ---------------------------------------------------------
+
+
+def test_manifests_identical_across_shard_counts(tmp_path, small_tree,
+                                                 monkeypatch):
+    snaps = []
+    for shards in (1, 2, 4):
+        monkeypatch.setenv("SD_SCAN_SHARDS", str(shards))
+        snap, _meta = run_scan(tmp_path, small_tree, f"sh{shards}",
+                               monkeypatch)
+        snaps.append(snap)
+    assert snaps[0] and snaps[0] == snaps[1] == snaps[2]
+
+
+def test_manifests_identical_pipelined_vs_sequential(tmp_path, small_tree,
+                                                     monkeypatch):
+    monkeypatch.setenv("SD_PIPELINE", "0")
+    seq, _ = run_scan(tmp_path, small_tree, "seq", monkeypatch)
+    monkeypatch.setenv("SD_PIPELINE", "1")
+    pipe, _ = run_scan(tmp_path, small_tree, "pipe", monkeypatch)
+    assert seq and seq == pipe
+
+
+# -- chaos gates -------------------------------------------------------------------
+
+
+def test_eio_storm_manifests_byte_identical(tmp_path, small_tree, monkeypatch):
+    """A transient-EIO storm on the chunk payload seam retries clean under
+    PAYLOAD_RETRY: zero quarantines, manifests identical to the calm run."""
+    calm, _ = run_scan(tmp_path, small_tree, "calm", monkeypatch)
+    faults.install("chunk:eio:0.08", seed=11)
+    stormy, meta = run_scan(tmp_path, small_tree, "storm", monkeypatch)
+    assert faults.fired().get("chunk:eio", 0) > 0, "storm never bit"
+    assert stormy == calm
+    assert meta.get("chunk_quarantined") == 0
+
+
+def test_persistent_failure_quarantines_only_that_file(tmp_path, small_tree,
+                                                       monkeypatch):
+    """A non-transient error (eacces, one hit) quarantines exactly that
+    file's manifest; the scan completes and every other file chunks."""
+    calm, _ = run_scan(tmp_path, small_tree, "calm2", monkeypatch)
+    faults.install("chunk:eacces:once")
+    snap, meta = run_scan(tmp_path, small_tree, "sick", monkeypatch)
+    assert meta.get("chunk_quarantined") == 1
+    assert telemetry.value("sd_chunk_quarantined_total") == 1
+    missing = set(calm) - set(snap)
+    assert len(missing) <= 1  # a dup's twin may still supply the manifest
+    assert {k: v for k, v in snap.items() if k in calm and k not in missing} \
+        == {k: v for k, v in calm.items() if k in snap and k not in missing}
+    # identification itself was untouched: every non-dir file has a cas row
+    node, lib, loc = _seed_library(tmp_path / "verify", small_tree, "verify")
+    node.shutdown()
+
+
+def test_wedge_mid_dispatch_degrades_and_stays_correct():
+    """A device wedge inside the chunk dispatch re-dispatches the SAME
+    payloads on the numpy rung and pins the router degraded — output is
+    byte-identical by the cdc cross-rung contract."""
+    rng = random.Random(5)
+    payloads = [rng.randbytes(n) for n in (3000, 40_000, 150)]
+    rows = [{"_chunk_payload": p} for p in payloads]
+    expect = [[(cid, ln) for cid, ln in cdc.build_manifest(p, kernel="numpy")]
+              for p in payloads]
+
+    manifest.router.seed(cpu_bps=1.0, dev_bps=100.0)  # route to device
+    faults.install("chunk:wedge:once")
+    try:
+        manifest.pipeline_chunk_process(rows)
+    finally:
+        faults.clear()
+    assert manifest.router.degraded is True
+    assert [r["_chunk_manifest"] for r in rows] == expect
+    assert all(r["_chunk_payload"] is None for r in rows)
+
+
+def test_oversized_payload_skips_not_quarantines(monkeypatch):
+    monkeypatch.setenv("SD_CHUNK_MAX_BYTES", "1000")
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    rows = [{"size_in_bytes": 5000}]
+    manifest.pipeline_chunk_gather(["/nonexistent"], rows, [b"x" * 5000])
+    assert rows[0]["_chunk_payload"] is None
+    assert telemetry.value("sd_chunk_skipped_total") == 1
+
+
+# -- the dedup consumer -------------------------------------------------------------
+
+
+def test_chunk_duplicates_surfaces_cross_object_overlap(tmp_path, small_tree,
+                                                        monkeypatch):
+    """The three f04 files share a 64 KiB prefix but differ overall:
+    distinct objects, overlapping chunk hashes — exactly what
+    search.chunkDuplicates ranks by reclaimable bytes."""
+    node, lib, loc = _seed_library(tmp_path / "dups", small_tree, "dups")
+    try:
+        _identify(node, lib, loc)
+        rows = node.router.resolve("search.chunkDuplicates",
+                                   {"take": 50}, library_id=lib.id)
+        assert rows, "no cross-object duplicate chunks surfaced"
+        assert all(r["objects"] > 1 for r in rows)
+        assert all(r["duplicated_bytes"] >= 0 for r in rows)
+        by_bytes = [r["duplicated_bytes"] for r in rows]
+        assert by_bytes == sorted(by_bytes, reverse=True)
+        # the shared prefix spans multiple chunks across >= 2 objects
+        assert sum(r["duplicated_bytes"] for r in rows) > 16 * 1024
+    finally:
+        node.shutdown()
+
+
+def test_manifests_off_by_default(tmp_path, small_tree, monkeypatch):
+    monkeypatch.delenv("SD_CHUNK_MANIFESTS", raising=False)
+    node, lib, loc = _seed_library(tmp_path / "off", small_tree, "off")
+    try:
+        _identify(node, lib, loc)
+        assert manifest_snapshot(lib) == {}
+        rows = node.router.resolve("search.chunkDuplicates", {},
+                                   library_id=lib.id)
+        assert rows == []
+    finally:
+        node.shutdown()

@@ -101,6 +101,31 @@ def test_kill_matrix(tmp_path):
         "uninterrupted (unsharded) run"
     survived.append(f"{ch.SHARDED_SCAN_KILL}[shards=4]")
 
+    # ISSUE 18: the manifest-commit kill point. The seam dies INSIDE the
+    # identify transaction just before chunk_manifest rows land, with at
+    # least one group already durable (skip1). The restart must converge
+    # to the manifest-enabled uninterrupted reference — identify rows and
+    # manifest rows are one atomic unit, so no object may ever surface
+    # with a torn manifest — and the identify surface itself must still
+    # match the manifest-free reference exactly
+    _rc, mref = ch.run_child("scan", tmp_path / "scan-manifest-ref",
+                             scan_args, extra_env=ch.MANIFEST_SCAN_ENV)
+    assert mref["snapshot"]["manifests"], \
+        "manifest reference run grew no manifests"
+    res = ch.run_kill_point(tmp_path, "scan", ch.MANIFEST_SCAN_KILL,
+                            scan_args, extra_env=ch.MANIFEST_SCAN_ENV)
+    boot = res["boot"]
+    assert boot["quick_check_ok"], boot
+    assert boot["cold_resumed"] >= 1, \
+        "manifest-commit kill: the killed job was not cold-resumed"
+    assert res["snapshot"] == mref["snapshot"], \
+        "manifest-commit kill: restart diverged from the uninterrupted run"
+    assert {k: v for k, v in res["snapshot"].items() if k != "manifests"} \
+        == {k: v for k, v in scan_ref["snapshot"].items()
+            if k != "manifests"}, \
+        "manifest stage perturbed the identify surface"
+    survived.append(f"{ch.MANIFEST_SCAN_KILL}[manifests=1]")
+
     for spec in SYNC_KILLS:
         res = ch.run_kill_point(tmp_path, "sync", spec, sync_args)
         assert res["boot"]["quick_check_ok"], (spec, res["boot"])

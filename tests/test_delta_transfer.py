@@ -1,0 +1,346 @@
+"""Delta-aware spacedrop (ISSUE 18): p2p/delta.py over in-memory streams.
+
+The protocol gates run wire-less: sender and receiver coroutines talk over
+paired ``asyncio.StreamReader``s through a duck-typed manager stub, so the
+accounting (NetModel bytes-on-wire), admission (BUSY → sleep → re-offer,
+acked windows never re-sent), and reassembly guarantees are all exercised
+without the session-crypto dependency the socket layer needs. A
+socket-level variant rides the real two-node path when ``cryptography``
+is importable (same gate as test_p2p_two_process.py).
+"""
+
+import asyncio
+import random
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from spacedrive_tpu import faults, telemetry
+from spacedrive_tpu.faults import net
+from spacedrive_tpu.p2p import delta, proto
+from spacedrive_tpu.p2p.proto import H_DELTA, Header
+from spacedrive_tpu.sync.admission import IngestBudget
+
+try:  # the socket-level p2p session layer hard-requires it (p2p/secure.py)
+    import cryptography  # noqa: F401
+
+    HAS_SESSION_CRYPTO = True
+except ImportError:
+    HAS_SESSION_CRYPTO = False
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("SD_NET_PLAN", raising=False)
+    monkeypatch.delenv("SD_FAULTS", raising=False)
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    yield
+    faults.clear()
+    net.clear()
+    telemetry.reset()
+    telemetry.reload_enabled()
+
+
+# -- in-memory wire harness ----------------------------------------------------
+
+
+class PipeWriter:
+    """Writer facade feeding a StreamReader — the three methods the delta
+    protocol uses."""
+
+    def __init__(self, reader: asyncio.StreamReader) -> None:
+        self._reader = reader
+        self.bytes_written = 0
+
+    def write(self, b: bytes) -> None:
+        self.bytes_written += len(b)
+        self._reader.feed_data(bytes(b))
+
+    async def drain(self) -> None:
+        pass
+
+    def close(self) -> None:
+        if not self._reader.at_eof():
+            self._reader.feed_eof()
+
+
+class FakeMgr:
+    """The duck-typed manager surface p2p/delta.py touches."""
+
+    def __init__(self, ident: str, loop, budget=None) -> None:
+        self._loop = loop
+        self._spacedrop_in = {}
+        self._spacedrop_cancel = {}
+        self.events = []
+        self.remote_identity = SimpleNamespace(encode=lambda: ident)
+        self.node = SimpleNamespace(ingest_budget=budget)
+        self.streams = {}
+
+    def emit(self, ev: dict) -> None:
+        self.events.append(ev)
+
+    async def open_stream(self, peer_id: str):
+        r, w = self.streams[peer_id]
+        return r, w, {}
+
+
+def make_blob(seed: int, n: int) -> bytes:
+    rng = random.Random(seed)
+    return bytes(rng.getrandbits(8) for _ in range(n))
+
+
+async def _accept_when_asked(mgr: FakeMgr, target_dir: Path | None) -> None:
+    for _ in range(4000):
+        if mgr._spacedrop_in:
+            entry = next(iter(mgr._spacedrop_in.values()))
+            if not entry["future"].done():
+                entry["future"].set_result(
+                    None if target_dir is None else str(target_dir))
+            return
+        await asyncio.sleep(0.005)
+    raise AssertionError("receiver never surfaced the delta request")
+
+
+async def run_delta(tmp_path: Path, src_data: bytes,
+                    base_data: bytes | None = None, budget=None,
+                    accept: bool = True) -> tuple[FakeMgr, FakeMgr, Path]:
+    loop = asyncio.get_running_loop()
+    to_recv = asyncio.StreamReader()   # sender -> receiver
+    to_send = asyncio.StreamReader()   # receiver -> sender
+    sender = FakeMgr("sender", loop)
+    receiver = FakeMgr("receiver", loop, budget=budget)
+    sender.streams["receiver"] = (to_send, PipeWriter(to_recv))
+    recv_writer = PipeWriter(to_send)
+
+    src = tmp_path / "gift.bin"
+    src.write_bytes(src_data)
+    inbox = tmp_path / "inbox"
+    inbox.mkdir(exist_ok=True)
+    if base_data is not None:
+        (inbox / "gift.bin").write_bytes(base_data)
+
+    async def dispatch() -> None:
+        hdr = await Header.from_stream(to_recv)
+        assert hdr.kind == H_DELTA
+        await delta.serve_delta(receiver, to_recv, recv_writer, hdr.payload,
+                                SimpleNamespace(identity="sender-ident"))
+
+    recv_task = asyncio.create_task(dispatch())
+    accept_task = asyncio.create_task(
+        _accept_when_asked(receiver, inbox if accept else None))
+    await asyncio.wait_for(
+        delta.send_delta(sender, "drop-1", "receiver", src), 60)
+    await asyncio.wait_for(accept_task, 10)
+    if accept:
+        await asyncio.wait_for(recv_task, 30)
+    else:
+        recv_task.cancel()
+    return sender, receiver, inbox
+
+
+def done_event(mgr: FakeMgr) -> dict:
+    ev = next((e for e in mgr.events if e["type"] == "SpacedropDone"), None)
+    failed = next((e for e in mgr.events if e["type"] == "SpacedropFailed"),
+                  None)
+    assert ev is not None, f"no SpacedropDone (failed: {failed})"
+    return ev
+
+
+# -- proto round-trip ----------------------------------------------------------
+
+
+def test_delta_header_roundtrip():
+    async def main():
+        h = Header.delta("t-1", "a.bin", 999, [["ab" * 16, 500],
+                                               ["cd" * 16, 499]])
+        reader = asyncio.StreamReader()
+        reader.feed_data(h.to_bytes())
+        reader.feed_eof()
+        back = await Header.from_stream(reader)
+        assert back.kind == H_DELTA
+        assert back.payload == h.payload
+
+    asyncio.run(main())
+
+
+# -- the bytes-on-wire gate (ISSUE 18 acceptance) -------------------------------
+
+
+def test_delta_ships_under_60pct_with_half_shared(tmp_path):
+    """A file sharing ~50% of its chunks with the receiver's base copy
+    must ship <60% of whole-file bytes, measured from the NetModel's
+    per-link byte accounting under a bandwidth-shaped plan — and the
+    reassembled file must be byte-identical."""
+    model = net.install("*>*:bw=256MBps", seed=7)
+    shared = make_blob(1, 256 * 1024)
+    base = shared + make_blob(2, 256 * 1024)
+    fresh = shared + make_blob(3, 256 * 1024)   # 512 KiB, ~50% shared
+
+    sender, receiver, inbox = asyncio.run(
+        run_delta(tmp_path, fresh, base_data=base))
+    ev = done_event(sender)
+    assert ev["delta"] is True and ev["chunks_reused"] > 0
+    out = Path(done_event(receiver)["path"])
+    assert out.read_bytes() == fresh
+
+    wire = sum(v for k, v in model.bytes_by_link().items()
+               if k.startswith("sender>"))
+    assert 0 < wire < 0.6 * len(fresh), (wire, len(fresh))
+    # and the wire total really is dominated by the missing half
+    assert ev["bytes"] <= wire
+    assert telemetry.value("sd_delta_transfers_total", role="sender") == 1
+    assert telemetry.value("sd_delta_transfers_total", role="receiver") == 1
+    assert telemetry.value("sd_delta_bytes_total", kind="reused") > 0
+
+
+def test_delta_identical_base_ships_no_chunks(tmp_path):
+    """Receiver already holds the identical file: zero chunks cross the
+    wire; the copy still lands byte-identical (assembled from base)."""
+    data = make_blob(11, 200 * 1024)
+    sender, receiver, inbox = asyncio.run(
+        run_delta(tmp_path, data, base_data=data))
+    ev = done_event(sender)
+    assert ev["chunks_sent"] == 0 and ev["bytes"] == 0
+    assert Path(done_event(receiver)["path"]).read_bytes() == data
+
+
+def test_delta_cold_receiver_ships_everything_correctly(tmp_path):
+    """No base copy at all: every chunk ships, reassembly is exact, and
+    the per-chunk hash verification path sees only wire chunks."""
+    data = make_blob(21, 150 * 1024)
+    sender, receiver, inbox = asyncio.run(run_delta(tmp_path, data))
+    ev = done_event(sender)
+    assert ev["chunks_reused"] == 0 and ev["bytes"] == len(data)
+    assert Path(done_event(receiver)["path"]).read_bytes() == data
+
+
+def test_delta_reject_writes_nothing(tmp_path):
+    data = make_blob(31, 64 * 1024)
+    sender, receiver, inbox = asyncio.run(
+        run_delta(tmp_path, data, accept=False))
+    assert any(e["type"] == "SpacedropRejected" for e in sender.events)
+    assert not any(e["type"] == "SpacedropDone" for e in sender.events)
+    assert list(inbox.iterdir()) == []
+
+
+# -- BUSY / admission resume ----------------------------------------------------
+
+
+def test_delta_busy_resumes_without_resending_acked(tmp_path, monkeypatch):
+    """An admission shed (injected ``sync_ingest:overload``) answers BUSY;
+    the sender sleeps the advised backoff and re-offers the SAME window.
+    Every distinct missing chunk is serialized exactly ONCE across the
+    whole transfer — acked windows are never re-sent."""
+    monkeypatch.setattr(delta, "WINDOW", 4)  # several windows from a small file
+    sent_blocks = []
+    real_block_msg = delta.block_msg
+    monkeypatch.setattr(
+        delta, "block_msg",
+        lambda off, data: sent_blocks.append(off) or real_block_msg(off, data))
+
+    faults.install("sync_ingest:overload:once")
+    budget = IngestBudget(max_ops=1 << 30, max_bytes=1 << 40)
+    data = make_blob(41, 160 * 1024)  # ~20 chunks -> ~5 windows of 4
+
+    t0 = time.monotonic()
+    sender, receiver, inbox = asyncio.run(
+        run_delta(tmp_path, data, budget=budget))
+    elapsed = time.monotonic() - t0
+
+    ev = done_event(sender)
+    assert Path(done_event(receiver)["path"]).read_bytes() == data
+    # exactly one BUSY, and the sender respected the advised backoff
+    assert telemetry.value("sd_delta_busy_total") == 1
+    assert elapsed >= 0.2  # BASE_RETRY_AFTER_MS default
+    # no chunk serialized twice: the re-offer resumed, not restarted
+    assert len(sent_blocks) == len(set(sent_blocks)) == ev["chunks_sent"]
+    assert ev["chunks_sent"] > delta.WINDOW  # the transfer really spanned windows
+
+
+def test_delta_corrupt_chunk_fails_closed(tmp_path, monkeypatch):
+    """A block whose bytes do not hash to the manifest entry kills the
+    transfer (receiver raises, sender surfaces SpacedropFailed) — nothing
+    is written."""
+    real_block_msg = delta.block_msg
+
+    def corrupting(off, data):
+        if off == 0:
+            data = b"\xff" + data[1:]
+        return real_block_msg(off, data)
+
+    monkeypatch.setattr(delta, "block_msg", corrupting)
+    data = make_blob(51, 64 * 1024)
+
+    async def run():
+        try:
+            await run_delta(tmp_path, data)
+        except Exception:
+            pass
+
+    asyncio.run(run())
+    inbox = tmp_path / "inbox"
+    assert not (inbox / "gift.bin").exists()
+    assert not list(inbox.glob("*.sdpart"))
+
+
+# -- socket-level variant (runs where the session crypto exists) ----------------
+
+
+@pytest.mark.skipif(not HAS_SESSION_CRYPTO,
+                    reason="p2p session crypto requires the 'cryptography' "
+                           "package; the wire-less harness above covers the "
+                           "delta protocol itself")
+def test_delta_spacedrop_over_sockets(tmp_path):
+    from spacedrive_tpu.node import Node
+
+    model = net.install("*>*:bw=256MBps", seed=3)
+    a = Node(tmp_path / "a", probe_accelerator=False)
+    b = Node(tmp_path / "b", probe_accelerator=False)
+    try:
+        shared = make_blob(61, 256 * 1024)
+        base = shared + make_blob(62, 256 * 1024)
+        fresh = shared + make_blob(63, 256 * 1024)
+        src = tmp_path / "gift.bin"
+        src.write_bytes(fresh)
+        inbox = tmp_path / "inbox"
+        inbox.mkdir()
+        (inbox / "gift.bin").write_bytes(base)
+
+        got = []
+        b.events.on(lambda ev: got.append(ev) if ev.kind == "p2p" else None)
+        b.router.resolve("p2p.debugConnect",
+                         {"addr": f"127.0.0.1:{a.p2p.port}"})
+        ids = a.router.resolve("p2p.spacedropDelta",
+                               {"peer_id": f"127.0.0.1:{b.p2p.port}",
+                                "paths": [str(src)]})
+        assert len(ids) == 1
+
+        def ev_of(kind):
+            return next((e for e in list(got)
+                         if e.payload.get("type") == kind), None)
+
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and ev_of("SpacedropRequest") is None:
+            time.sleep(0.05)
+        req = ev_of("SpacedropRequest")
+        assert req is not None and req.payload["delta"] is True
+        b.router.resolve("p2p.acceptSpacedrop",
+                         {"id": req.payload["id"], "target_dir": str(inbox)})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and ev_of("SpacedropDone") is None:
+            assert ev_of("SpacedropFailed") is None, ev_of("SpacedropFailed")
+            time.sleep(0.05)
+        done = ev_of("SpacedropDone")
+        assert done is not None
+        assert Path(done.payload["path"]).read_bytes() == fresh
+
+        a_id = a.p2p.remote_identity.encode()
+        wire = sum(v for k, v in model.bytes_by_link().items()
+                   if k.startswith(a_id + ">"))
+        assert 0 < wire < 0.6 * len(fresh), (wire, len(fresh))
+    finally:
+        a.shutdown()
+        b.shutdown()

@@ -108,6 +108,52 @@ def test_pool_results_byte_identical_to_in_process(node):
     assert str(pool_err.value) == str(in_err.value)
 
 
+def test_preferences_get_pool_byte_identical(node):
+    """ISSUE 18 satellite: preferences.get is purity-audited (pure
+    library.db preference-table read) and served from the pool —
+    byte-identical to the in-process handler, nested trees included."""
+    lib, _loc_id = _seed_library(node)
+    node.router.resolve("preferences.update", {
+        "ui": {"theme": "dark", "density": 3},
+        "explorer": {"sort": "name", "show_hidden": True},
+        "flat": "value",
+    }, lib.id)
+    pool = _start_pool(node)
+    via_pool = node.router.resolve("preferences.get", None, lib.id)
+    pool.set_enabled(False)
+    in_proc = node.router.resolve("preferences.get", None, lib.id)
+    pool.set_enabled(True)
+    assert via_pool["ui"]["theme"] == "dark"
+    assert _canon(via_pool) == _canon(in_proc)
+    assert pool.status()["cache_misses"] > 0  # it really crossed the boundary
+
+
+def test_chunk_duplicates_pool_byte_identical(node):
+    """search.chunkDuplicates (ISSUE 18) rides the pool too: pure
+    chunk_manifest aggregate, byte-identical across serving paths."""
+    lib, _loc_id = _seed_library(node)
+    from spacedrive_tpu.models import ChunkManifest, Object
+
+    with lib.db.transaction():
+        oids = [lib.db.insert(Object, {"pub_id": f"ob-{i}", "kind": 0})
+                for i in range(3)]
+        rows = []
+        for i, oid in enumerate(oids):
+            rows.append({"object_id": oid, "seq": 0,
+                         "chunk_hash": "aa" * 16, "length": 4096})
+            rows.append({"object_id": oid, "seq": 1,
+                         "chunk_hash": f"{i:02x}" * 16, "length": 100})
+        lib.db.insert_many(ChunkManifest, rows)
+    pool = _start_pool(node)
+    via_pool = node.router.resolve("search.chunkDuplicates", {}, lib.id)
+    pool.set_enabled(False)
+    in_proc = node.router.resolve("search.chunkDuplicates", {}, lib.id)
+    pool.set_enabled(True)
+    assert via_pool and via_pool[0]["objects"] == 3
+    assert via_pool[0]["duplicated_bytes"] == 2 * 4096
+    assert _canon(via_pool) == _canon(in_proc)
+
+
 def test_pool_preencoded_wire_bytes_byte_identical(node):
     """Serve rung (b) starter (ISSUE 17): pool workers hand the shell
     PRE-ENCODED wire JSON (RawJson) — the shell splices the bytes into
