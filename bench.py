@@ -63,7 +63,12 @@ if "--crash" in sys.argv[1:]:
 #: threads driving search + directory listing + thumbnail/range fetches
 #: over real HTTP against a mounted router DURING an active pipelined
 #: scan; per-procedure p50/p95/p99 from the sd_rspc_* histograms, to
-#: BENCH_serve.json
+#: BENCH_serve.json. With ``--wan <profile>`` (ISSUE 19) it becomes the
+#: distributed replica serve gate instead: an N-peer fleet with two
+#: armed replicas serves pool-marked queries over the modeled WAN
+#: through flaky-wan's two partition waves — tail SLOs held, zero
+#: pre-watermark rows, every failover accounted, byte-identity at the
+#: quiescent point; record to BENCH_serve_wan.json
 if "--serve" in sys.argv[1:]:
     MODE = "serve"
 #: ``--search``: the device query engine bench (ISSUE 15) — a synthetic
@@ -1697,6 +1702,199 @@ def bench_serve() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_serve_wan() -> dict:
+    """Distributed replica serve bench (ISSUE 19), ``--serve --wan
+    <profile>``: an N-peer fleet with two armed read replicas serves
+    pool-marked queries over the modeled WAN WHILE the ingest storm
+    runs. On flaky-wan the profile's two partition waves each cut one
+    replica from the mesh mid-storm, so the strict ladder
+    replica → local pool → in-process has to degrade and recover twice.
+    Gates: the serve probes hold their tail SLO through both waves,
+    zero pre-watermark (stale) rows ever leave a replica, every
+    degradation is accounted by reason in ``sd_replica_failovers_total``,
+    and at the quiescent point every replica serves the full id-free
+    query matrix byte-identically to the target's in-process path.
+    Headline: serve-probe p99 ms; record to BENCH_serve_wan.json."""
+    import shutil
+
+    from spacedrive_tpu import telemetry
+    from spacedrive_tpu.faults import net
+    from spacedrive_tpu.server.pool import ReaderPool
+    from spacedrive_tpu.telemetry.registry import estimate_quantiles
+    from spacedrive_tpu.telemetry.requests import REQUEST_BUCKETS
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.fleet_harness import WAN_RETRY, Fleet, replica_counters
+
+    wan = WAN_PROFILE
+    peers = int(os.environ.get("SD_BENCH_SERVE_PEERS", "12"))
+    ops_per_peer = int(os.environ.get("SD_BENCH_SERVE_OPS", "400"))
+    lanes = int(os.environ.get("SD_BENCH_SERVE_LANES", "2"))
+    slo_p99_s = float(os.environ.get("SD_BENCH_SERVE_SLO_P99_S", "5.0"))
+    telemetry.set_enabled(True)
+    tmp = Path(tempfile.mkdtemp(prefix="sd_bench_serve_wan_"))
+    model = net.install(net.profile_plan(wan),
+                        seed=int(os.environ.get("SD_NET_SEED", "13")))
+    fleet = None
+    pools: list = []
+    try:
+        fleet = Fleet(tmp, peers=peers, lanes=lanes, retry=WAN_RETRY)
+        # one replica on each side of flaky-wan's partition schedule:
+        # fleet-peer-00 sits in the first wave (fleet-peer-0*), the
+        # second replica in the second wave (fleet-peer-1*) when the
+        # fleet is big enough to have one — each wave then cuts exactly
+        # one replica while the other keeps serving
+        rep_indices = sorted({0, 10 if peers > 10 else peers - 1})
+        replicas = fleet.arm_replicas(indices=rep_indices, max_attempts=2)
+        for peer in replicas:
+            peer.node.reader_pool = ReaderPool(peer.node, workers=1).start()
+            pools.append(peer.node.reader_pool)
+        fleet.target.reader_pool = ReaderPool(fleet.target,
+                                              workers=1).start()
+        pools.append(fleet.target.reader_pool)
+
+        res = fleet.run_storm(ops_per_peer=ops_per_peer, batch=200,
+                              emit_chunks=4, serve_traffic=True,
+                              rich=True,
+                              # paced bursts span flaky-wan's partition
+                              # schedule (last heal at 7.0s) on any
+                              # machine speed
+                              burst_gap_s=2.6)
+        storm_end = time.monotonic()
+        drain_s = fleet.drain()
+        heal_to_lag_zero_s = None
+        if model.last_heal_s() > 0:
+            heal_wall = (storm_end - res["elapsed_s"]
+                         + model.last_heal_s())
+            heal_to_lag_zero_s = round(
+                max(0.0, storm_end + drain_s - heal_wall), 3)
+        net_status = res["net"]
+        net.clear()
+        fleet.stop_replica_mirror(drain=True)
+        ledger = replica_counters()
+        identity = fleet.replica_identity_report()
+
+        # -- gates (the bench IS the acceptance harness) ------------------
+        assert res["errors"] == [], res["errors"]
+        st = fleet.serve_stats
+        assert st["queries"] > 20, st
+        # the zero-pre-watermark claim: count-monotonicity probes never
+        # saw a stale row, and no probe errored
+        assert st["stale"] == 0, st["errors"][:5]
+        assert st["errors"] == [], st["errors"][:5]
+        # the replica rung served real traffic, and every degradation
+        # the ladder took is accounted by reason
+        assert ledger["dispatch"].get("ok", 0) > 0, ledger
+        assert set(ledger["failover"]) <= {"busy", "error",
+                                           "not_eligible", "no_peers"}
+        assert set(ledger["serve"]) <= {"ok", "not_eligible", "busy",
+                                        "error"}
+        if model.last_heal_s() > 0:
+            # the waves really cut links, and the ladder degraded at
+            # least once while they were open
+            assert telemetry.value("sd_net_link_messages_total",
+                                   verdict="cut") > 0
+            assert sum(ledger["failover"].values()) > 0, ledger
+        # quiescent byte-identity: every replica x id-free pool query
+        # serves the exact bytes the target's handler encodes
+        assert identity and all(identity.values()), identity
+
+        # -- tail SLOs: the serve probes (full ladder, partitions and
+        # all) and the replica round-trip histogram ----------------------
+        lats = sorted(st["latencies_s"])
+
+        def q(p: float) -> float:
+            return (lats[min(len(lats) - 1, int(p * len(lats)))]
+                    if lats else 0.0)
+
+        probe = {"count": len(lats),
+                 "p50_ms": round(q(0.50) * 1000, 2),
+                 "p95_ms": round(q(0.95) * 1000, 2),
+                 "p99_ms": round(q(0.99) * 1000, 2)}
+        assert q(0.99) <= slo_p99_s, (probe, slo_p99_s)
+
+        fam = telemetry.histogram("sd_replica_request_seconds",
+                                  labels=("peer",),
+                                  buckets=REQUEST_BUCKETS)
+        agg: list[float] | None = None
+        rtt_total, rtt_n = 0.0, 0
+        for _lbls, series in fam.series_items():
+            counts, total, n = series.read()
+            agg = (list(counts) if agg is None
+                   else [a + c for a, c in zip(agg, counts)])
+            rtt_total += total
+            rtt_n += int(n)
+        replica_rtt = None
+        if agg is not None and rtt_n > 0:
+            rq = estimate_quantiles(tuple(REQUEST_BUCKETS), agg)
+            replica_rtt = {"count": rtt_n,
+                           "p50_ms": round(rq[0.5] * 1000, 2),
+                           "p95_ms": round(rq[0.95] * 1000, 2),
+                           "p99_ms": round(rq[0.99] * 1000, 2),
+                           "mean_ms": round(rtt_total / rtt_n * 1000, 2)}
+
+        dispatched = sum(ledger["dispatch"].values())
+        ok_share = (round(ledger["dispatch"].get("ok", 0.0)
+                          / dispatched, 3) if dispatched else 0.0)
+        record = {
+            "metric": (f"serve_replica_probe_p99_ms[{peers}peers,"
+                       f"{len(replicas)}replicas,wan={wan}]"),
+            "value": probe["p99_ms"],
+            "unit": "ms",
+            "serve_probe": probe,
+            "replica_rtt": replica_rtt,
+            "replica_ledger": ledger,
+            "replica_ok_share": ok_share,
+            "router": fleet.target.replica_router.status(),
+            "identity": identity,
+            "stale": st["stale"],
+            "queries": st["queries"],
+            "wan": {
+                "profile": wan,
+                "plan": net.profile_plan(wan),
+                "heal_to_lag_zero_s": heal_to_lag_zero_s,
+                "net": net_status,
+            },
+            "fleet": {
+                "peers": peers,
+                "replicas": [p.identity for p in replicas],
+                "lanes": lanes,
+                "ops_per_peer": ops_per_peer,
+                "ops_per_sec_total": res["ops_per_sec_total"],
+                "p99_apply_delay_s": res["p99_apply_delay_s"],
+                "max_peer_lag_ops": res["max_peer_lag_ops"],
+                "peak_rss_mb": res["peak_rss_mb"],
+            },
+        }
+        out = Path(__file__).resolve().parent / "BENCH_serve_wan.json"
+        out.write_text(json.dumps(record, indent=1) + "\n")
+        # second headline (standing invariant: every bench mode appends
+        # its headlines): how much of the serve load the replica rung
+        # actually carried through the chaos
+        _append_history({
+            "metric": (f"serve_replica_ok_share[{peers}peers,"
+                       f"{len(replicas)}replicas,wan={wan}]"),
+            "value": ok_share,
+            "unit": "ratio",
+        })
+        print(f"info: serve-wan {peers} peers / {len(replicas)} replicas "
+              f"over wan={wan}: {st['queries']} probes, 0 stale, "
+              f"probe p99 {probe['p99_ms']}ms, replica ok-share "
+              f"{ok_share:.0%}, failovers "
+              f"{ {k: int(v) for k, v in ledger['failover'].items()} }, "
+              f"heal-to-lag-zero "
+              f"{heal_to_lag_zero_s if heal_to_lag_zero_s is not None else 'n/a'}s "
+              f"-> {out.name}", file=sys.stderr)
+        return record
+    finally:
+        net.clear()
+        for pool in pools:
+            pool.stop()
+        if fleet is not None:
+            fleet.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_search() -> dict:
     """Device query engine headline (ISSUE 15): a synthetic corpus
     (SD_BENCH_SEARCH_N objects, default 1M) served through the REAL
@@ -2317,7 +2515,7 @@ def main() -> int:
     elif MODE == "crash":
         record = bench_crash()
     elif MODE == "serve":
-        record = bench_serve()
+        record = bench_serve_wan() if WAN_PROFILE else bench_serve()
     elif MODE == "search":
         record = bench_search()
     elif MODE == "dedup_1m":

@@ -20,6 +20,7 @@ from .loop_blocking import LoopBlockingPass
 from .pipeline_ordering import PipelineOrderingPass
 from .query_discipline import QueryDisciplinePass
 from .queue_discipline import QueueDisciplinePass
+from .replica_purity import ReplicaPurityPass
 from .resource_leak import ResourceLeakPass
 from .retry_discipline import RetryDisciplinePass
 from .swallowed import SwallowedExceptionPass
@@ -48,6 +49,7 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     DurabilityDisciplinePass,
     QueryDisciplinePass,
     WorkerPurityPass,
+    ReplicaPurityPass,
     # whole-program passes (ISSUE 16): run last, over the project graph
     HoldBlockingPass,
     LoopBlockingPass,

@@ -1,0 +1,129 @@
+"""replica-purity: replica-eligible rspc handlers must not read
+node-local divergent state.
+
+The distributed serve tier (ISSUE 19, server/replica.py) dispatches
+``pool=True`` query handlers to watermark-eligible REMOTE peers. Watermark
+eligibility proves the peer's *synced library state* covers the client's
+last write — it proves nothing about state that never syncs. A handler
+that reads node-local mutable state (the volume table, live job rows, the
+node's own data_dir disk stats) would pass worker-purity, serve fine from
+the local pool, and then quietly answer with the REPLICA's volumes/jobs/
+free-space when dispatched over the mesh — a wrong answer no watermark
+check can catch. This pass makes "replica-safe" a static contract on top
+of worker-purity:
+
+- inside any replica-eligible handler (``pool=True`` without
+  ``replica=False``), ``node.data_dir`` access is a finding — the path
+  and the disk behind it are per-node (worker-purity allows it because
+  pool workers share the node's machine; replicas don't);
+- ``db.find/find_one/count(Model, ...)`` over a divergent model
+  (:data:`DIVERGENT_MODELS` — tables with no sync spec whose rows are
+  node-owned: volume, job, node, instance, statistics, notification) is
+  a finding;
+- raw SQL string literals selecting FROM/JOINing those tables are
+  findings too.
+
+Handlers whose answer is *legitimately* node-specific opt out with
+``replica=False`` (libraries.statistics does) — they keep the local pool
+and drop off the replica tier, and this pass skips them. Scoped to
+``api/`` like worker-purity; module-local.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+from .query_discipline import _is_db_receiver
+from .worker_purity import _pool_decorator
+
+#: models whose tables carry no sync spec and whose rows are node-owned —
+#: converged peers still disagree on them (models/schema.py: SYNC = None
+#: or absent)
+DIVERGENT_MODELS = frozenset({
+    "Volume", "JobRow", "NodeRow", "Instance", "Statistics", "Notification",
+})
+#: the same set at the SQL layer
+DIVERGENT_TABLES = ("volume", "job", "node", "instance", "statistics",
+                    "notification")
+_SQL_DIVERGENT = re.compile(
+    r"\b(?:from|join)\s+(" + "|".join(DIVERGENT_TABLES) + r")\b",
+    re.IGNORECASE)
+#: db read entry points (write surfaces are query-discipline's problem)
+READ_ATTRS = frozenset({"find", "find_one", "count"})
+
+
+def _replica_eligible(node: ast.FunctionDef | ast.AsyncFunctionDef
+                      ) -> tuple[str, bool] | None:
+    """(decorator name, library-scoped) when this handler rides the
+    replica tier: pool-marked AND not opted out with ``replica=False``."""
+    marked = _pool_decorator(node)
+    if marked is None:
+        return None
+    for dec in node.decorator_list:
+        if isinstance(dec, ast.Call) and any(
+                kw.arg == "replica" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in dec.keywords):
+            return None
+    return marked
+
+
+class ReplicaPurityPass(AnalysisPass):
+    id = "replica-purity"
+    description = ("replica-eligible query handlers reading node-local "
+                   "divergent state (volumes, jobs, data_dir) — a "
+                   "watermark-eligible peer would still answer with ITS "
+                   "OWN rows; opt out with replica=False")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dirs("api"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            marked = _replica_eligible(node)
+            if marked is None:
+                continue
+            decorator, _library_scoped = marked
+            params = [a.arg for a in node.args.args]
+            node_param = params[0] if params else None
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Attribute) \
+                        and isinstance(inner.value, ast.Name) \
+                        and inner.value.id == node_param \
+                        and inner.attr == "data_dir":
+                    yield ctx.finding(
+                        inner.lineno, self.id,
+                        f"'{inner.value.id}.data_dir' in replica-eligible "
+                        f"{decorator} handler '{node.name}' — the data dir "
+                        f"is per-node; a remote replica would answer from "
+                        f"its own disk (mark replica=False if the answer "
+                        f"is meant to be node-specific)")
+                if isinstance(inner, ast.Call) \
+                        and isinstance(inner.func, ast.Attribute) \
+                        and inner.func.attr in READ_ATTRS:
+                    chain = dotted_name(inner.func)
+                    if chain is None or not _is_db_receiver(chain):
+                        continue
+                    model = inner.args[0] if inner.args else None
+                    if isinstance(model, ast.Name) \
+                            and model.id in DIVERGENT_MODELS:
+                        yield ctx.finding(
+                            inner.lineno, self.id,
+                            f"'{chain}({model.id}, ...)' in replica-"
+                            f"eligible {decorator} handler '{node.name}' — "
+                            f"table '{model.id}' has no sync spec, so "
+                            f"peers diverge on it even when watermark-"
+                            f"eligible (mark replica=False)")
+                if isinstance(inner, ast.Constant) \
+                        and isinstance(inner.value, str):
+                    m = _SQL_DIVERGENT.search(inner.value)
+                    if m:
+                        yield ctx.finding(
+                            inner.lineno, self.id,
+                            f"SQL over node-local table '{m.group(1)}' in "
+                            f"replica-eligible {decorator} handler "
+                            f"'{node.name}' — unsynced rows diverge "
+                            f"across peers (mark replica=False)")

@@ -77,6 +77,13 @@ class Procedure:
     #: process's read-only SQLite connection. The sdlint ``worker-purity``
     #: pass statically enforces the contract on every marked handler.
     pool: bool = False
+    #: replica-eligible (ISSUE 19): pool handlers default to serving from
+    #: watermark-eligible remote peers too. ``replica=False`` keeps a pool
+    #: handler local-only — for pure-but-DIVERGENT reads (node.data_dir
+    #: disk stats, volume rows) whose answer is node-specific even when
+    #: every peer is converged. The sdlint ``replica-purity`` pass enforces
+    #: the stricter no-divergent-state contract on the eligible set.
+    replica: bool = True
 
 
 class Router:
@@ -86,7 +93,7 @@ class Router:
 
     # -- registration -------------------------------------------------------
     def _register(self, key: str, kind: str, scope: str, fn: Callable,
-                  pool: bool = False) -> Callable:
+                  pool: bool = False, replica: bool = True) -> Callable:
         if key in self.procedures:
             raise ValueError(f"duplicate procedure key {key!r}")
         if pool and (kind != QUERY or scope != "library"):
@@ -95,12 +102,18 @@ class Router:
             # cached node-scope response could never be invalidated
             raise ValueError(f"{key}: only library-scoped queries may be "
                              f"pool-dispatched")
+        if not pool and not replica:
+            raise ValueError(f"{key}: replica=False is only meaningful on "
+                             f"pool-dispatched queries")
         self.procedures[key] = Procedure(key, kind, scope, fn,
-                                         inspect.getdoc(fn) or "", pool=pool)
+                                         inspect.getdoc(fn) or "", pool=pool,
+                                         replica=replica)
         return fn
 
-    def query(self, key: str, scope: str = "node", pool: bool = False):
-        return lambda fn: self._register(key, QUERY, scope, fn, pool=pool)
+    def query(self, key: str, scope: str = "node", pool: bool = False,
+              replica: bool = True):
+        return lambda fn: self._register(key, QUERY, scope, fn, pool=pool,
+                                         replica=replica)
 
     def mutation(self, key: str, scope: str = "node"):
         return lambda fn: self._register(key, MUTATION, scope, fn)
@@ -109,8 +122,9 @@ class Router:
         return lambda fn: self._register(key, SUBSCRIPTION, scope, fn)
 
     # library-scoped sugar
-    def library_query(self, key: str, pool: bool = False):
-        return self.query(key, scope="library", pool=pool)
+    def library_query(self, key: str, pool: bool = False,
+                      replica: bool = True):
+        return self.query(key, scope="library", pool=pool, replica=replica)
 
     def library_mutation(self, key: str):
         return self.mutation(key, scope="library")
@@ -164,17 +178,32 @@ class Router:
             if proc.scope == "library":
                 library = self._library(library_id)
             pool = getattr(self.node, "reader_pool", None)
-            if proc.pool and pool is not None:
+            engine_local = False
+            if proc.pool:
                 # device search engine (ISSUE 15): when the in-process
                 # handler would serve this query from the device-resident
-                # index, skip the pool — workers have no index, and the
-                # engine beats a worker's SQL scan (else it wouldn't be
-                # armed). Stale/ineligible dispatches keep pooling.
+                # index, skip the pool AND the replica tier — workers and
+                # peers have no index, and the engine beats both (else it
+                # wouldn't be armed). Stale/ineligible dispatches keep
+                # pooling.
                 engine = getattr(self.node, "search_engine", None)
-                if engine is not None and engine.prefers_inprocess(
-                        proc.key, library_id, arg):
-                    pool = None
-            if proc.pool and pool is not None:
+                engine_local = (engine is not None
+                                and engine.prefers_inprocess(
+                                    proc.key, library_id, arg))
+            # distributed replica rung (ISSUE 19): the TOP of the strict
+            # degradation ladder replica → local reader pool → in-process.
+            # The ReplicaRouter only ever returns a page a watermark-
+            # eligible peer served (byte-identical encoder to the pool
+            # path); any miss — no peers, ineligible, busy, transport
+            # failure — returns None and the local rungs below take over,
+            # accounted in sd_replica_failovers_total.
+            replicas = getattr(self.node, "replica_router", None)
+            if proc.pool and proc.replica and not engine_local \
+                    and replicas is not None:
+                served = replicas.dispatch(proc.key, arg, library_id)
+                if served is not None:
+                    return served
+            if proc.pool and not engine_local and pool is not None:
                 from ..server.pool import PoolUnavailable
 
                 try:

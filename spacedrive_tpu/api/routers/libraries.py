@@ -16,13 +16,17 @@ def mount(router) -> None:
                  "instance_pub_id": (lib.instance() or {}).get("pub_id")}
                 for lib in node.libraries.list()]
 
-    @router.library_query("libraries.statistics", pool=True)
+    @router.library_query("libraries.statistics", pool=True, replica=False)
     def statistics(node, library, _arg):
         """Recomputed on query (api/libraries.rs:47). Pool-pure (ISSUE 15
         satellite): a pure read over (library.db, node.data_dir) — the
         snapshot-row persistence the reference does on query moved to
         statistics.update_statistics for write-capable callers, so this
-        handler runs in serve-pool workers under the worker-purity lint."""
+        handler runs in serve-pool workers under the worker-purity lint.
+        ``replica=False`` (ISSUE 19): the node.data_dir disk stats are
+        node-specific — a converged peer would still answer with ITS OWN
+        free space, so this stays off the replica tier (and out of the
+        replica-purity lint's scope)."""
         row = dict(compute_statistics(library.db, node.data_dir))
         row.pop("date_captured", None)
         return row

@@ -301,14 +301,17 @@ def mount(router) -> None:
             "ORDER BY duplicated_bytes DESC, chunk_hash LIMIT ?", [limit])
         return [dict(r) for r in rows]
 
-    @router.library_query("search.nearDuplicates")
+    @router.library_query("search.nearDuplicates", pool=True)
     def near_duplicates(node, library, arg):
-        """TPU MinHash similarity groups (beyond the reference's exact-cas_id
-        dedup; ops/minhash.py)."""
-        from ...objects.dedup import find_near_duplicates
+        """TPU MinHash similarity groups, served from the PERSISTED
+        ``near_duplicate`` pairs the chained dedup_detector job wrote
+        (ops/minhash.py computes them; this handler only reads). Pure
+        library.db, so it serves from the worker pool and the replica
+        tier — the live filesystem+device probe lives in the job, where
+        compute belongs."""
+        from ...objects.dedup import persisted_near_duplicate_groups
 
         arg = arg or {}
-        return find_near_duplicates(library,
-                                    location_id=arg.get("location_id"),
-                                    threshold=float(arg.get("threshold", 0.8)),
-                                    limit=int(arg.get("limit", 8192)))
+        return persisted_near_duplicate_groups(
+            library.db, location_id=arg.get("location_id"),
+            limit=int(arg.get("take", arg.get("limit", 1000))))

@@ -470,6 +470,22 @@ def link(src: str, dst: str, nbytes: int = 0) -> None:
     model.traverse(src, dst, nbytes)
 
 
+async def alink(src: str, dst: str, nbytes: int = 0) -> None:
+    """Async traversal for sender-side p2p frames (delta offers, whole-file
+    spacedrop blocks, replica queries): ``decide()`` runs inline — a cut or
+    drop raises out of the send exactly like :func:`link` — but the modeled
+    delay is paid with ``asyncio.sleep`` so one shaped transfer never parks
+    the p2p event loop for every other session."""
+    model = _MODEL
+    if model is None:
+        return
+    delay = model.decide(src, dst, nbytes)
+    if delay > 0.0:
+        import asyncio
+
+        await asyncio.sleep(delay)
+
+
 def _seed_from_env() -> int:
     try:
         return int(os.environ.get("SD_NET_SEED", "0"))

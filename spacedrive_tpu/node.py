@@ -137,6 +137,11 @@ class Node:
         # query resolves in-process (the degraded mode). The router reads
         # this attribute on each pool-marked query dispatch.
         self.reader_pool = None
+        # distributed replica rung (ISSUE 19): when armed, pool-marked
+        # queries may be served by watermark-eligible mesh peers before
+        # the local pool — the top of the degradation ladder. Wired after
+        # p2p boots; the fleet harness installs wire-less routers here.
+        self.replica_router = None
 
         accel = None
         if probe_accelerator:
@@ -182,6 +187,10 @@ class Node:
             if revived:
                 logger.info("cold-resumed %d jobs for library %s", revived, library.id[:8])
         self._start_p2p()
+        if self.p2p is not None:
+            from .server.replica import ReplicaRouter
+
+            self.replica_router = ReplicaRouter.maybe_start(self)
 
         # dev fixtures (util/debug_initializer.rs:32-56): applied once the
         # managers are live so declared libraries/locations/scans behave

@@ -220,6 +220,63 @@ def _banded_pairs(sigs: np.ndarray, valid_rows: np.ndarray, thr_k: int,
     return verify_pairs(sigs, cand, thr_k)
 
 
+def persisted_near_duplicate_groups(db, location_id: int | None = None,
+                                    limit: int = 1000) -> dict[str, Any]:
+    """Similarity groups from the PERSISTED ``near_duplicate`` pairs the
+    chained :class:`DedupDetectorJob` wrote — pure ``library.db`` reads
+    (no filesystem, no device), so the ``search.nearDuplicates`` handler
+    serving it is pool- and replica-eligible (ISSUE 19 serve rung).
+
+    Same result shape as :func:`find_near_duplicates` minus the live
+    probe fields: ``{groups: [[file_path rows]], pairs, scanned, method:
+    "persisted", errors: []}`` with ``scanned`` counting the pair rows
+    considered. Ordering is fully deterministic (similarity DESC then
+    pair id; members by id; groups by smallest member id) — replica
+    byte-identity asserts on it."""
+    where, params = "1=1", []
+    if location_id is not None:
+        where = "(fa.location_id = ? OR fb.location_id = ?)"
+        params = [location_id, location_id]
+    limit = max(0, min(int(limit), 5000))
+    pair_rows = db.query(
+        f"SELECT nd.id, nd.file_path_a_id AS a, nd.file_path_b_id AS b, "
+        f"nd.similarity FROM near_duplicate nd "
+        f"JOIN file_path fa ON nd.file_path_a_id = fa.id "
+        f"JOIN file_path fb ON nd.file_path_b_id = fb.id "
+        f"WHERE {where} ORDER BY nd.similarity DESC, nd.id LIMIT ?",
+        params + [limit])
+
+    parent: dict[int, int] = {}
+
+    def find(x: int) -> int:
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    pairs = []
+    for r in pair_rows:
+        parent[find(int(r["b"]))] = find(int(r["a"]))
+        pairs.append({"a": int(r["a"]), "b": int(r["b"]),
+                      "similarity": r["similarity"]})
+    ids = sorted(parent)
+    rows_by_id: dict[int, dict] = {}
+    if ids:
+        marks = ",".join("?" for _ in ids)
+        rows_by_id = {r["id"]: FilePath.decode_row(r) for r in db.query(
+            f"SELECT * FROM file_path WHERE id IN ({marks})", ids)}
+    members: dict[int, list[int]] = {}
+    for i in ids:
+        members.setdefault(find(i), []).append(i)
+    groups = [[rows_by_id[i] for i in sorted(group) if i in rows_by_id]
+              for _root, group in sorted(
+                  members.items(), key=lambda kv: min(kv[1]))
+              if len(group) > 1]
+    return {"groups": [g for g in groups if len(g) > 1], "pairs": pairs,
+            "scanned": len(pair_rows), "method": "persisted", "errors": []}
+
+
 class DedupDetectorJob(StatefulJob):
     """Chained detector persisting near-dup pairs into `near_duplicate`
     (this framework's 4th pipeline stage after indexer → identifier →

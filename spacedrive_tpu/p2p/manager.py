@@ -34,8 +34,8 @@ from .identity import Identity, RemoteIdentity, remote_identity_of
 from .mux import MuxConn
 from . import delta as delta_proto
 from .proto import (Header, H_DELTA, H_FILE, H_HASH, H_PAIR, H_PING,
-                    H_SPACEDROP, H_SYNC, H_THUMBNAIL, ProtocolError, Range,
-                    SpaceblockRequest, block_size_for, json_frame,
+                    H_QUERY, H_SPACEDROP, H_SYNC, H_THUMBNAIL, ProtocolError,
+                    Range, SpaceblockRequest, block_size_for, json_frame,
                     read_block_msg, read_exact, read_json)
 from .secure import (SecureReader, SecureWriter, derive_session_keys,
                      gen_ephemeral, transcript)
@@ -590,6 +590,8 @@ class P2PManager:
             elif header.kind == H_DELTA:
                 await delta_proto.serve_delta(self, sub, sub,
                                               header.payload, peer)
+            elif header.kind == H_QUERY:
+                await self._serve_query(sub, sub, header.payload, peer)
             else:
                 logger.warning("unhandled header kind %s", header.kind)
             failed = False
@@ -636,8 +638,14 @@ class P2PManager:
             req = SpaceblockRequest(name=path.name, size=size,
                                     block_size=block_size_for(size))
             reader, writer, _meta = await self.open_stream(peer_id)
+            # whole-file frames ride the armed faults.net model like delta
+            # frames (ISSUE 19 satellite): shaped/ledgered per link, and a
+            # cut raises out of the send as a transport failure
+            link = self._net_link_hook(_meta.get("identity") or peer_id)
             try:
-                writer.write(Header.spacedrop(req).to_bytes())
+                hdr = Header.spacedrop(req).to_bytes()
+                await link(len(hdr))
+                writer.write(hdr)
                 await writer.drain()
                 decision = await asyncio.wait_for(read_exact(reader, 1),
                                                   SPACEDROP_TIMEOUT)
@@ -649,7 +657,7 @@ class P2PManager:
                     progress=lambda done, total: self.emit(
                         {"type": "SpacedropProgress", "id": drop_id,
                          "percent": int(done * 100 / max(1, total))}),
-                    cancelled=cancel)
+                    cancelled=cancel, link=link)
                 await writer.drain()
                 self.emit({"type": "SpacedropDone", "id": drop_id, "bytes": sent})
             finally:
@@ -754,9 +762,15 @@ class P2PManager:
         rng = Range.from_wire(payload.get("range"))
         req = SpaceblockRequest(name=path.name, size=size,
                                 block_size=block_size_for(size), range=rng)
-        writer.write(json_frame({"ok": True, **req.to_wire()}))
+        # served file frames ride the armed faults.net model too — WE are
+        # the sender on this substream, so the shaped direction is
+        # us -> requesting peer
+        link = self._net_link_hook(peer.identity)
+        head = json_frame({"ok": True, **req.to_wire()})
+        await link(len(head))
+        writer.write(head)
         await writer.drain()
-        await send_file(writer, path, req)
+        await send_file(writer, path, req, link=link)
         await writer.drain()
 
     async def _serve_thumbnail(self, reader, writer, payload: dict,
@@ -966,6 +980,111 @@ class P2PManager:
                 _HASH_REQS.inc()
                 _HASH_REQ_BYTES.inc(sum(len(m) for m in messages))
             return [str(i) for i in ids]
+        finally:
+            writer.close()
+
+    # -- distributed replica serving (H_QUERY, ISSUE 19) ---------------------
+
+    def _net_link_hook(self, dst_identity: str):
+        """Sender-side :mod:`faults.net` hook for per-frame traversal:
+        whole-file spacedrop/file-serve frames ride the armed model like
+        delta frames, so ``bytes_by_link()`` ledgers them and a one-way
+        ``a>b`` shaping plan covers the transfer direction."""
+        from ..faults import net
+
+        self_id = self.remote_identity.encode()
+
+        async def link(nbytes: int) -> None:
+            await net.alink(self_id, dst_identity, nbytes)
+
+        return link
+
+    async def _serve_query(self, reader, writer, payload: dict,
+                           peer: Peer) -> None:
+        """The H_QUERY responder arm: answer a pool-marked query from OUR
+        replica of the library — after the membership gate, through
+        :func:`~..server.replica.serve_query` (watermark eligibility,
+        admission, the ``replica_serve`` chaos seam) in an executor so
+        the SQLite read never parks the p2p loop. Reply wire shape: one
+        JSON head; ``ok`` heads carry ``size`` and the encoded page bytes
+        follow verbatim."""
+        from ..server.replica import serve_query
+
+        def _serve() -> dict:
+            try:
+                library = self.node.libraries.get(payload.get("library_id"))
+            except KeyError:
+                return {"ok": False, "kind": "not_eligible", "watermark": {}}
+            if peer.identity not in self.nlm.member_nodes(library):
+                return {"ok": False, "kind": "error", "error": "not a member"}
+            return serve_query(self.node, payload, peer=peer.identity)
+
+        reply = await asyncio.get_running_loop().run_in_executor(None, _serve)
+        raw = reply.pop("raw", None)
+        link = self._net_link_hook(peer.identity)
+        if reply.get("ok") and isinstance(raw, (bytes, bytearray)):
+            head = json_frame({"ok": True, "size": len(raw)})
+            await link(len(head) + len(raw))
+            writer.write(head)
+            writer.write(bytes(raw))
+        else:
+            head = json_frame(reply)
+            await link(len(head))
+            writer.write(head)
+        await writer.drain()
+
+    def query_peers(self, library_id: str) -> list[str]:
+        """Connected peers paired into ``library_id`` — the ReplicaRouter's
+        candidate set. Membership is the same trust boundary file/preview/
+        hash serving enforces (nlm.member_nodes)."""
+        try:
+            library = self.node.libraries.get(library_id)
+        except KeyError:
+            return []
+        try:
+            members = self.nlm.member_nodes(library)
+        except Exception:
+            return []
+        return [ident for ident in members
+                if (p := self.peers.get(ident)) is not None and p.connected]
+
+    async def request_query(self, peer_id: str, payload: dict) -> dict:
+        """Dispatch one pool-marked query to a replica peer. Returns the
+        reply dict in :func:`~..server.replica.serve_query` shape; raises
+        ``PeerBusyError`` on an explicit BUSY so the ReplicaRouter's
+        cooldown honors the advised backoff, and ConnectionError-family
+        on link failure."""
+        from .. import faults
+        from ..faults import PeerBusyError
+        from ..server.replica import replica_timeout_s
+
+        # chaos seam for outbound peer requests (raising kinds only)
+        faults.inject("p2p_send", key=peer_id)
+        timeout = replica_timeout_s()
+        reader, writer, meta = await self.open_stream(peer_id)
+        link = self._net_link_hook(meta.get("identity") or peer_id)
+        try:
+            hdr = Header.query(payload["library_id"], payload["key"],
+                               payload.get("arg"),
+                               payload.get("require") or {},
+                               ctx=payload.get("ctx")).to_bytes()
+            await link(len(hdr))
+            writer.write(hdr)
+            await writer.drain()
+            head = await asyncio.wait_for(read_json(reader), timeout)
+            if head.get("ok"):
+                size = int(head.get("size") or 0)
+                if size < 0 or size > 64 << 20:
+                    raise ProtocolError(f"absurd query reply size {size}")
+                raw = await asyncio.wait_for(read_exact(reader, size),
+                                             timeout)
+                return {"ok": True, "raw": raw}
+            if head.get("kind") == "busy":
+                mesh.record_busy_received(mesh.peer_label(peer_id))
+                raise PeerBusyError(
+                    "replica busy",
+                    retry_after_ms=int(head.get("retry_after_ms") or 250))
+            return head
         finally:
             writer.close()
 

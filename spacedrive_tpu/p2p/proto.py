@@ -128,6 +128,7 @@ H_CONNECTED = 5
 H_THUMBNAIL = 6
 H_HASH = 7
 H_DELTA = 8
+H_QUERY = 9
 
 
 @dataclass(frozen=True)
@@ -192,6 +193,21 @@ class Header:
         return cls(H_DELTA, {"transfer_id": transfer_id, "name": name,
                              "size": size, "chunks": chunks})
 
+    @classmethod
+    def query(cls, library_id: str, key: str, arg: Any,
+              require: dict[str, int], ctx: dict | None = None) -> "Header":
+        """Replica query dispatch (ISSUE 19): run the pool-marked rspc
+        query ``key`` against the peer's replica of ``library_id``.
+        ``require`` is the client's applied per-instance HLC clock map —
+        the watermark the replica must cover to be eligible; a replica
+        behind it answers NOT_ELIGIBLE, never a stale row. ``ctx`` is the
+        optional trace-context envelope (telemetry/mesh.py)."""
+        payload: dict = {"library_id": library_id, "key": key, "arg": arg,
+                         "require": require}
+        if ctx is not None:
+            payload["ctx"] = ctx
+        return cls(H_QUERY, payload)
+
     # wire -----------------------------------------------------------------
     def to_bytes(self) -> bytes:
         b = bytes([self.kind])
@@ -203,7 +219,8 @@ class Header:
             return b + json_frame(self.payload)
         if self.kind == H_SPACEDROP:
             return b + json_frame(self.payload.to_wire())
-        if self.kind in (H_FILE, H_CONNECTED, H_THUMBNAIL, H_HASH, H_DELTA):
+        if self.kind in (H_FILE, H_CONNECTED, H_THUMBNAIL, H_HASH, H_DELTA,
+                         H_QUERY):
             return b + json_frame(self.payload)
         raise ProtocolError(f"unknown header kind {self.kind}")
 
@@ -216,7 +233,8 @@ class Header:
             return cls(kind, str(await read_json(reader)))
         if kind == H_SPACEDROP:
             return cls(kind, SpaceblockRequest.from_wire(await read_json(reader)))
-        if kind in (H_FILE, H_CONNECTED, H_THUMBNAIL, H_HASH, H_DELTA):
+        if kind in (H_FILE, H_CONNECTED, H_THUMBNAIL, H_HASH, H_DELTA,
+                    H_QUERY):
             return cls(kind, await read_json(reader))
         raise ProtocolError(f"invalid header discriminator {kind}")
 

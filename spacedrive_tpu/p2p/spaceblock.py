@@ -12,7 +12,7 @@ import asyncio
 import logging
 import os
 from pathlib import Path
-from typing import Callable
+from typing import Awaitable, Callable
 
 from .proto import Range  # re-exported: transfer call sites range-slice  # lint: ok
 from .proto import (ProtocolError, SpaceblockRequest, block_msg,
@@ -21,12 +21,17 @@ from .proto import (ProtocolError, SpaceblockRequest, block_msg,
 logger = logging.getLogger(__name__)
 
 Progress = Callable[[int, int], None]  # (bytes_done, bytes_total)
+#: sender-side net-model hook: awaited with each frame's wire length
+#: BEFORE the write, so an armed faults.net plan shapes/ledgers whole-file
+#: transfers exactly like delta frames (a cut raises out of the send)
+Link = Callable[[int], Awaitable[None]]
 
 
 async def send_file(writer: asyncio.StreamWriter, path: Path,
                     req: SpaceblockRequest,
                     progress: Progress | None = None,
-                    cancelled: asyncio.Event | None = None) -> int:
+                    cancelled: asyncio.Event | None = None,
+                    link: Link | None = None) -> int:
     """Stream ``path``'s requested range as blocks; returns bytes sent."""
     loop = asyncio.get_running_loop()
     rng = req.range
@@ -36,7 +41,10 @@ async def send_file(writer: asyncio.StreamWriter, path: Path,
         fh.seek(offset)
         while offset < end:
             if cancelled is not None and cancelled.is_set():
-                writer.write(cancel_msg())
+                msg = cancel_msg()
+                if link is not None:
+                    await link(len(msg))
+                writer.write(msg)
                 await writer.drain()
                 return sent
             # disk reads go through the executor — a 128MiB block read inline
@@ -45,7 +53,10 @@ async def send_file(writer: asyncio.StreamWriter, path: Path,
                 None, fh.read, min(req.block_size, end - offset))
             if not data:
                 break
-            writer.write(block_msg(offset, data))
+            msg = block_msg(offset, data)
+            if link is not None:
+                await link(len(msg))
+            writer.write(msg)
             await writer.drain()
             offset += len(data)
             sent += len(data)

@@ -267,6 +267,12 @@ def _serve_one(runtime_node, router, cache: _PageCache, msg: dict) -> dict:
         # chaos seam: `serve_worker:kill` is the worker-death drill the
         # crash harness arms (the plan is inherited across the fork)
         faults.inject("serve_worker", key=key)
+        # callers may name an EXTRA seam for this dispatch (the replica
+        # tier passes `replica_serve` so its chaos kinds land inside the
+        # worker actually serving the remote query, not the node process)
+        extra_seam = msg.get("seam")
+        if extra_seam:
+            faults.inject(str(extra_seam), key=key)
         proc = router.procedures.get(key)
         if proc is None or proc.kind != QUERY or not proc.pool:
             raise ApiError(f"{key} is not pool-dispatchable")
@@ -504,12 +510,18 @@ class ReaderPool:
             self._failovers += 1
 
     # -- dispatch ------------------------------------------------------------
-    def dispatch(self, key: str, arg: Any, library_id: str | None) -> Any:
+    def dispatch(self, key: str, arg: Any, library_id: str | None,
+                 seam: str | None = None) -> Any:
         """Run one pool-marked query on a worker. Raises ApiError exactly
         as the in-process handler would; raises PoolUnavailable when the
         caller should fail over in-process — including on non-Api worker
         errors, where the in-process re-run reproduces the handler's
-        original exception with full fidelity."""
+        original exception with full fidelity.
+
+        ``seam`` names an extra fault seam injected INSIDE the worker for
+        this dispatch (the replica serve path passes ``replica_serve`` so
+        a `replica_serve:kill` drill takes down the worker serving the
+        remote query, never the dispatching node)."""
         if not (self._running and self._enabled):
             raise PoolUnavailable("pool not running")
         try:
@@ -525,6 +537,8 @@ class ReaderPool:
         wm, epoch = self.watermark(library_id)
         req = {"proc": key, "arg": arg, "library_id": library_id,
                "wm": wm, "epoch": epoch}
+        if seam:
+            req["seam"] = seam
         t0 = time.perf_counter()
         try:
             worker.conn.send(req)

@@ -1,0 +1,455 @@
+"""Distributed read replicas over the sync mesh (ISSUE 19).
+
+CRDT sync already replicates full library state to every paired peer, so
+every device in the mesh is latent serving capacity — this module promotes
+it. Pool-marked rspc queries (``@router.query(..., pool=True)``, the PR 11
+surface, statically vetted by the sdlint ``worker-purity`` and
+``replica-purity`` passes) become dispatchable over p2p to
+**watermark-eligible** replicas.
+
+The robustness contract, in dispatch order:
+
+- **Never a stale row.** A replica may serve a query only when its applied
+  per-instance HLC clock map (``SyncManager.timestamps()`` — the same
+  series the ``sd_sync_peer_lag_*`` lag gauges derive from) covers the
+  client's ``require`` map, re-checked on the replica per dispatch. The
+  require map is the client's **authored floors**
+  (:meth:`~..sync.manager.SyncManager.require_watermark` — per-publisher
+  maxima over the client's own op LOG, which is written in the same
+  transaction that materializes rows), NOT its raw clock map: ``clock.last``
+  merges forward on every ingest, which would make the client's own entry
+  uncoverable by any replica. Eligibility therefore implies the replica
+  has applied every op the client has materialized — read-your-writes
+  holds for the client's own committed writes by construction. A lagging
+  or partitioned replica answers NOT_ELIGIBLE; it never guesses.
+- **Degrade, don't wedge.** The ladder is strict:
+  replica → local reader pool → in-process. :meth:`ReplicaRouter.dispatch`
+  returns ``None`` on any miss (no peers, all ineligible, busy, errors)
+  and the router falls through to ``ReaderPool.dispatch`` and then the
+  in-process handler — both always-safe because queries are read-only.
+  Every degradation is accounted in ``sd_replica_failovers_total``.
+- **Ride the accept layer.** Replica-side serving admits through the
+  node's :class:`~..sync.admission.IngestBudget` (same instance the CRDT
+  receive path uses), so a flooded replica sheds queries with an explicit
+  BUSY + ``retry_after_ms`` instead of buffering, and the p2p
+  throttle/auto-ban layer applies to H_QUERY exactly as to sync frames.
+- **Byte identity.** The replica encodes its reply with the one canonical
+  encoder (``json.dumps(result, default=str).encode()`` — what the serve
+  pool and ``Response.json`` use), so a replica-served page is spliceable
+  and byte-comparable against the local path.
+
+Chaos: replica-side dispatch runs through the ``replica_serve`` fault
+seam (kinds eio/stall/wedge/kill/busy). With a local reader pool armed
+the seam is injected INSIDE the worker serving the query (``seam=`` on
+``ReaderPool.dispatch``), so a ``replica_serve:kill`` drill takes down
+the serving process mid-query — the dispatching node observes a dead
+replica, not its own death.
+
+Peer selection follows the PR 6 BackendRouter shape: EWMA latency per
+peer with hysteresis and a periodic exploration probe, plus per-peer
+cooldowns (NOT_ELIGIBLE → short recheck, BUSY → the peer's own
+``retry_after_ms``, transport error → exponential).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import TYPE_CHECKING, Any, Callable
+
+from .. import faults, telemetry
+from ..faults.spec import PeerBusyError
+from ..sync.admission import Busy
+from ..telemetry import mesh
+from ..telemetry.registry import REQUEST_BUCKETS
+
+if TYPE_CHECKING:
+    from ..node import Node
+
+logger = logging.getLogger(__name__)
+
+# module handles — families declared in telemetry._declare_core
+_DISPATCHES = telemetry.counter("sd_replica_dispatches_total",
+                                labels=("peer", "outcome"))
+_ELIGIBILITY = telemetry.counter("sd_replica_eligibility_rejections_total",
+                                 labels=("peer",))
+_FAILOVERS = telemetry.counter("sd_replica_failovers_total",
+                               labels=("reason",))
+_SECONDS = telemetry.histogram("sd_replica_request_seconds",
+                               labels=("peer",), buckets=REQUEST_BUCKETS)
+_SERVES = telemetry.counter("sd_replica_serves_total", labels=("outcome",))
+
+
+def encode_reply(result: Any) -> bytes:
+    """THE wire encoder for replica-served pages — the same call the
+    serve-pool worker and ``Response.json`` make, so byte-identity vs the
+    local path is an encoder identity, not a coincidence."""
+    return json.dumps(result, default=str).encode()
+
+
+def covers(have: dict[str, int], require: dict[str, int]) -> bool:
+    """Watermark-eligibility rule: ``have`` (the replica's applied
+    per-instance clock map) covers ``require`` (the client's) iff every
+    instance the client has applied ops from is known here at >= the
+    client's clock. An instance the replica has never heard of is only
+    acceptable at floor 0 (it contributed nothing the client could have
+    read)."""
+    for pub, floor in (require or {}).items():
+        if int(floor or 0) <= 0:
+            continue
+        if int(have.get(pub, 0) or 0) < int(floor):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# replica side: serve one remote query
+# ---------------------------------------------------------------------------
+
+def serve_query(node: "Node", payload: dict, peer: str = "") -> dict:
+    """Serve one H_QUERY dispatch on this node (the replica). Returns a
+    reply dict — never raises:
+
+    - ``{"ok": True, "raw": bytes}`` — the encoded page;
+    - ``{"ok": False, "kind": "not_eligible", "watermark": {...}}`` — the
+      replica's applied clocks did not cover ``payload["require"]`` (the
+      watermark rides back so the client can log/derive lag);
+    - ``{"ok": False, "kind": "busy", "retry_after_ms": int}`` — admission
+      shed (or an injected ``replica_serve:busy``);
+    - ``{"ok": False, "kind": "error", "error": str}`` — anything else;
+      the client falls down its ladder and, for a deterministic handler
+      error, reproduces the original exception in-process.
+
+    The local reader pool serves the query when armed (with the
+    ``replica_serve`` seam injected inside the worker); a pool failure is
+    reported as an error — the replica never silently re-runs a remote
+    query in its own node process, so the TARGET's ladder does the
+    failing over and the accounting stays in one place.
+    """
+    key = str(payload.get("key") or "")
+    library_id = payload.get("library_id")
+    arg = payload.get("arg")
+    require = payload.get("require") or {}
+    label = mesh.peer_label(peer)
+
+    from ..api.router import QUERY, ApiError, RawJson
+
+    proc = node.router.procedures.get(key)
+    if proc is None or proc.kind != QUERY or not proc.pool \
+            or not getattr(proc, "replica", True):
+        _SERVES.inc(outcome="error")
+        return {"ok": False, "kind": "error",
+                "error": f"{key!r} is not replica-dispatchable"}
+    try:
+        library = node.libraries.get(library_id)
+    except KeyError:
+        # not a library we replicate — as ineligible as a lagging clock
+        _SERVES.inc(outcome="not_eligible")
+        return {"ok": False, "kind": "not_eligible", "watermark": {}}
+
+    have = library.sync.timestamps()
+    if not covers(have, require):
+        _SERVES.inc(outcome="not_eligible")
+        return {"ok": False, "kind": "not_eligible", "watermark": have}
+
+    # accept layer: one shared budget with the CRDT receive path — a
+    # flooded replica sheds queries exactly like sync windows
+    verdict = node.ingest_budget.try_admit(f"query:{label}", 1, 0)
+    if isinstance(verdict, Busy):
+        _SERVES.inc(outcome="busy")
+        return {"ok": False, "kind": "busy",
+                "retry_after_ms": verdict.retry_after_ms}
+    try:
+        pool = getattr(node, "reader_pool", None)
+        if pool is not None:
+            from .pool import PoolUnavailable
+
+            try:
+                served = pool.dispatch(key, arg, library_id,
+                                       seam="replica_serve")
+            except PoolUnavailable as e:
+                _SERVES.inc(outcome="error")
+                return {"ok": False, "kind": "error",
+                        "error": f"replica pool unavailable: {e}"}
+            raw = (served.data if isinstance(served, RawJson)
+                   else encode_reply(served))
+        else:
+            # in-process serve: the seam fires in THIS process — over real
+            # p2p (or the crash harness) a `kill` here is the whole
+            # replica node dying mid-query, the kill-matrix scenario
+            faults.inject("replica_serve", key=key)
+            result = proc.fn(node, library, arg)
+            raw = encode_reply(result)
+        _SERVES.inc(outcome="ok")
+        return {"ok": True, "raw": raw}
+    except PeerBusyError as e:
+        _SERVES.inc(outcome="busy")
+        return {"ok": False, "kind": "busy",
+                "retry_after_ms": e.retry_after_ms}
+    except ApiError as e:
+        _SERVES.inc(outcome="error")
+        return {"ok": False, "kind": "error", "error": str(e)}
+    except Exception as e:
+        _SERVES.inc(outcome="error")
+        return {"ok": False, "kind": "error",
+                "error": f"{type(e).__name__}: {e}"}
+    finally:
+        verdict.release()
+
+
+# ---------------------------------------------------------------------------
+# client side: the replica rung of the degradation ladder
+# ---------------------------------------------------------------------------
+
+#: how long a NOT_ELIGIBLE peer sits out before re-checking — short by
+#: design: lag drains continuously and the eligibility signal is cheap
+NOT_ELIGIBLE_COOLDOWN_S = 0.25
+#: error-backoff geometry: base * 2^fails, capped
+ERROR_BACKOFF_BASE_S = 0.1
+ERROR_BACKOFF_MAX_S = 5.0
+#: EWMA smoothing + switch hysteresis (the PR 6 BackendRouter constants)
+EWMA_ALPHA = 0.3
+HYSTERESIS = 1.25
+#: every Nth dispatch probes a non-best peer so a recovered one can win back
+EXPLORE_EVERY = 16
+#: peers tried per dispatch before falling down the ladder — bounded so a
+#: partition wave costs at most two timeouts, not a full mesh sweep
+MAX_ATTEMPTS = 2
+
+
+class _PeerState:
+    __slots__ = ("ewma_s", "until", "fails")
+
+    def __init__(self) -> None:
+        self.ewma_s = 0.0       # 0 = never measured
+        self.until = 0.0        # monotonic deadline the peer sits out to
+        self.fails = 0
+
+
+class ReplicaRouter:
+    """Picks a watermark-eligible peer for a pool-marked query and
+    dispatches over the mesh; returns ``None`` whenever the local ladder
+    should take over.
+
+    Transport-agnostic: ``candidates(library_id) -> [peer_id]`` and
+    ``transport(peer_id, payload, nbytes) -> reply dict`` (raising
+    ``ConnectionError``-family on link failure) are injected — production
+    wires the p2p manager (:meth:`maybe_start`), the fleet harness wires
+    wire-less in-process transports through the same net model."""
+
+    def __init__(self, node: "Node",
+                 candidates: Callable[[str], list[str]],
+                 transport: Callable[[str, dict, int], dict]) -> None:
+        self.node = node
+        self._candidates = candidates
+        self._transport = transport
+        self._lock = threading.Lock()
+        self._peers: dict[str, _PeerState] = {}
+        self._dispatch_seq = 0
+        self._clock = time.monotonic
+        #: per-dispatch attempt bound — instance state so harnesses can
+        #: widen it to cover a whole fleet in one ladder descent
+        self.max_attempts = MAX_ATTEMPTS
+
+    @classmethod
+    def maybe_start(cls, node: "Node") -> "ReplicaRouter | None":
+        """Production wiring: serve pool-marked queries from mesh peers
+        that replicate the library, over the p2p H_QUERY stream. None
+        when p2p is down (the ladder starts at the local pool) or when
+        ``SD_REPLICAS=0`` pins all serving local."""
+        import os
+
+        if os.environ.get("SD_REPLICAS", "").strip() == "0":
+            return None
+        p2p = getattr(node, "p2p", None)
+        if p2p is None:
+            return None
+
+        def candidates(library_id: str) -> list[str]:
+            try:
+                return p2p.query_peers(library_id)
+            except Exception:
+                return []
+
+        def transport(peer_id: str, payload: dict, nbytes: int) -> dict:
+            return p2p.run_coro(
+                p2p.request_query(peer_id, payload),
+                timeout=replica_timeout_s() + 5.0)
+
+        return cls(node, candidates, transport)
+
+    # -- require map --------------------------------------------------------
+    def _require(self, library_id: str) -> dict[str, int] | None:
+        try:
+            library = self.node.libraries.get(library_id)
+        except KeyError:
+            return None
+        try:
+            # authored floors, not the raw clock map: clock.last merges
+            # forward on every ingest, which would make this client's own
+            # entry uncoverable by any replica (see require_watermark)
+            return library.sync.require_watermark()
+        except Exception:
+            return None
+
+    # -- peer choice --------------------------------------------------------
+    def _state(self, peer: str) -> _PeerState:
+        st = self._peers.get(peer)
+        if st is None:
+            st = self._peers[peer] = _PeerState()
+        return st
+
+    def _order(self, peers: list[str]) -> list[str]:
+        """Available peers, best EWMA first, with hysteresis (an incumbent
+        best is only displaced by a 1/HYSTERESIS-faster challenger) and a
+        periodic exploration probe promoting the most stale measurement."""
+        now = self._clock()
+        with self._lock:
+            self._dispatch_seq += 1
+            explore = (self._dispatch_seq % EXPLORE_EVERY) == 0
+            avail = [p for p in peers if self._state(p).until <= now]
+            if not avail:
+                return []
+
+            def score(p: str) -> float:
+                e = self._peers[p].ewma_s
+                return e if e > 0 else 0.0  # unmeasured peers sort first
+
+            avail.sort(key=score)
+            if len(avail) > 1:
+                best, runner = avail[0], avail[1]
+                b, r = self._peers[best].ewma_s, self._peers[runner].ewma_s
+                # hysteresis: keep the slightly-slower incumbent stable —
+                # the incumbent is whichever has MORE recent wins, proxied
+                # here by a lower fail count at comparable latency
+                if (b > 0 and r > 0 and b * HYSTERESIS > r
+                        and self._peers[runner].fails
+                        < self._peers[best].fails):
+                    avail[0], avail[1] = runner, best
+                if explore:
+                    # probe the tail so a recovered peer re-measures
+                    avail.insert(0, avail.pop())
+            return avail
+
+    # -- dispatch -----------------------------------------------------------
+    def dispatch(self, key: str, arg: Any, library_id: str | None) -> Any:
+        """Try the replica rung for one pool-marked query. Returns a
+        :class:`~..api.router.RawJson` on success, ``None`` when the
+        caller should fall down the ladder (counted per reason in
+        ``sd_replica_failovers_total`` whenever the rung was live for
+        this library)."""
+        if not library_id:
+            return None
+        peers = self._candidates(library_id)
+        if not peers:
+            return None  # rung not armed for this library: silent
+        require = self._require(library_id)
+        if require is None:
+            return None
+        order = self._order(peers)
+        if not order:
+            _FAILOVERS.inc(reason="no_peers")
+            return None
+        payload = {"library_id": library_id, "key": key, "arg": arg,
+                   "require": require}
+        nbytes = len(json.dumps(payload, default=str))
+        last_reason = "error"
+        for peer in order[:self.max_attempts]:
+            label = mesh.peer_label(peer)
+            st = self._state(peer)
+            t0 = self._clock()
+            try:
+                reply = self._transport(peer, payload, nbytes)
+            except PeerBusyError as e:
+                with self._lock:
+                    st.until = self._clock() + e.retry_after_ms / 1000.0
+                _DISPATCHES.inc(peer=label, outcome="busy")
+                last_reason = "busy"
+                continue
+            except Exception as e:
+                with self._lock:
+                    st.fails += 1
+                    st.until = self._clock() + min(
+                        ERROR_BACKOFF_BASE_S * (2 ** st.fails),
+                        ERROR_BACKOFF_MAX_S)
+                _DISPATCHES.inc(peer=label, outcome="error")
+                logger.debug("replica %s transport failed: %s", label, e)
+                last_reason = "error"
+                continue
+            dt = self._clock() - t0
+            if not isinstance(reply, dict):
+                with self._lock:
+                    st.fails += 1
+                    st.until = self._clock() + min(
+                        ERROR_BACKOFF_BASE_S * (2 ** st.fails),
+                        ERROR_BACKOFF_MAX_S)
+                _DISPATCHES.inc(peer=label, outcome="error")
+                last_reason = "error"
+                continue
+            if reply.get("ok"):
+                raw = reply.get("raw")
+                if not isinstance(raw, (bytes, bytearray)):
+                    _DISPATCHES.inc(peer=label, outcome="error")
+                    last_reason = "error"
+                    continue
+                with self._lock:
+                    st.fails = 0
+                    st.ewma_s = (dt if st.ewma_s <= 0 else
+                                 EWMA_ALPHA * dt
+                                 + (1 - EWMA_ALPHA) * st.ewma_s)
+                _DISPATCHES.inc(peer=label, outcome="ok")
+                _SECONDS.observe(dt, peer=label)
+                from ..api.router import RawJson
+
+                return RawJson(bytes(raw))
+            kind = reply.get("kind")
+            if kind == "not_eligible":
+                with self._lock:
+                    st.until = self._clock() + NOT_ELIGIBLE_COOLDOWN_S
+                _DISPATCHES.inc(peer=label, outcome="not_eligible")
+                _ELIGIBILITY.inc(peer=label)
+                last_reason = "not_eligible"
+            elif kind == "busy":
+                retry_ms = int(reply.get("retry_after_ms") or 250)
+                with self._lock:
+                    st.until = self._clock() + retry_ms / 1000.0
+                _DISPATCHES.inc(peer=label, outcome="busy")
+                last_reason = "busy"
+            else:
+                with self._lock:
+                    st.fails += 1
+                    st.until = self._clock() + min(
+                        ERROR_BACKOFF_BASE_S * (2 ** st.fails),
+                        ERROR_BACKOFF_MAX_S)
+                _DISPATCHES.inc(peer=label, outcome="error")
+                last_reason = "error"
+        _FAILOVERS.inc(reason=last_reason)
+        return None
+
+    # -- introspection ------------------------------------------------------
+    def status(self) -> dict[str, Any]:
+        now = self._clock()
+        with self._lock:
+            return {
+                "peers": {
+                    mesh.peer_label(p): {
+                        "ewma_ms": round(st.ewma_s * 1000.0, 3),
+                        "cooldown_s": round(max(0.0, st.until - now), 3),
+                        "fails": st.fails,
+                    } for p, st in self._peers.items()},
+                "dispatches": self._dispatch_seq,
+            }
+
+
+def replica_timeout_s() -> float:
+    """Per-dispatch transport budget (``SD_REPLICA_TIMEOUT_S``): kept well
+    under the serve-pool request timeout so a wedged replica costs one
+    bounded wait before the ladder's local rungs answer."""
+    import os
+
+    try:
+        return max(0.1, float(os.environ.get("SD_REPLICA_TIMEOUT_S", "5")))
+    except ValueError:
+        return 5.0

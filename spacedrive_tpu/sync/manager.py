@@ -244,6 +244,43 @@ class SyncManager:
                 out[row["pub_id"]] = row["timestamp"] or 0
         return out
 
+    def require_watermark(self) -> dict[str, int]:
+        """Per-publisher floors a replica must cover to serve THIS node's
+        reads (the ``require`` map of server/replica.py's ``covers``).
+        Built from the op-LOG, not :meth:`timestamps`, for two reasons:
+
+        - our own entry there is ``clock.last``, and the HLC merges
+          forward on every ingested remote op — a node that mostly
+          consumes runs its clock ahead of any op a peer could ever
+          replicate, so the raw clock map reads as permanently
+          uncoverable (NOT_ELIGIBLE forever) even at full convergence;
+        - peer entries there are ``instance.timestamp``, which lane-mode
+          ingest persists only at the dispatcher's deferred cross-lane
+          merge — mid-window they UNDERSTATE what is already
+          materialized, and an understated require admits stale pages.
+
+        Ops are logged in the same transaction that materializes them, so
+        ``max(timestamp)`` per origin instance over both log tables is
+        exactly the applied floor — and exactly the sup of what a replica
+        can pull from us, coupling eligibility to byte-equal state.
+        ``instance.timestamp`` is max-merged in for floors whose log
+        entries a future compaction might drop."""
+        db = self.library.db
+        out: dict[str, int] = {}
+        id_to_pub: dict[Any, str] = {}
+        for row in db.find(Instance):
+            id_to_pub[row["id"]] = row["pub_id"]
+            mine = row["id"] == self.library.instance_id
+            out[row["pub_id"]] = 0 if mine else (row["timestamp"] or 0)
+        for table in ("shared_operation", "relation_operation"):
+            for r in db.query(
+                    f"SELECT instance_id, max(timestamp) AS t FROM {table} "
+                    "GROUP BY instance_id"):
+                pub = id_to_pub.get(r["instance_id"])
+                if pub is not None and (r["t"] or 0) > out.get(pub, 0):
+                    out[pub] = r["t"]
+        return out
+
     def ops_pending(self, clocks: dict[str, int] | None = None) -> int:
         """How many logged ops are strictly newer (per origin instance)
         than ``clocks`` — the sender-side backlog count a sync window's
@@ -273,7 +310,18 @@ class SyncManager:
 
         The per-instance floor, ordering, and LIMIT run in SQL (each table
         contributes at most count+1 rows per round), so a full sync is
-        O(count log count) per round instead of loading the whole op-log."""
+        O(count log count) per round instead of loading the whole op-log.
+
+        Relayed ops (origin != our instance) are served only up to the
+        floor WE have durably persisted for their origin: lane-mode
+        ingest commits each lane's shard before the dispatcher's
+        cross-lane floor merge, so the raw log can briefly hold a later
+        op from an origin while an earlier one is still in another
+        lane's transaction — a puller that read past the merge point
+        would advance its scalar clock over the hole and never fetch the
+        backfilled op. Serial ingest persists floors in the same
+        transaction that logs the window, so the cap is invisible there;
+        our own authored ops are append-ordered and need no cap."""
         clocks = clocks or {}
         db = self.library.db
         inst_rows = db.find(Instance)
@@ -282,21 +330,30 @@ class SyncManager:
         # timestamp > (per-instance clock floor, 0 for unknown instances)
         case_parts: list[str] = []
         case_params: list[Any] = []
+        cap_parts: list[str] = []
+        cap_params: list[Any] = []
         for r in inst_rows:
             floor = clocks.get(r["pub_id"], 0)
             if floor:
                 case_parts.append("WHEN ? THEN ?")
                 case_params.extend([r["id"], floor])
+            if r["id"] != self.library.instance_id:
+                cap_parts.append("WHEN ? THEN ?")
+                cap_params.extend([r["id"], r["timestamp"] or 0])
         floor_sql = (f"CASE instance_id {' '.join(case_parts)} ELSE 0 END"
                      if case_parts else "0")
+        no_cap = (1 << 63) - 1
+        cap_sql = (f"CASE instance_id {' '.join(cap_parts)} "
+                   f"ELSE {no_cap} END" if cap_parts else str(no_cap))
 
         import json as _json
 
         def fetch(table: str) -> list:
             return db.query(
                 f"SELECT * FROM {table} WHERE timestamp > {floor_sql} "
+                f"AND timestamp <= {cap_sql} "
                 f"ORDER BY timestamp, id LIMIT ?",
-                case_params + [count + 1])
+                case_params + cap_params + [count + 1])
 
         # wire dicts built straight from the rows (no dataclass round-trip:
         # this is the sender-side hot loop of big pull windows)

@@ -466,6 +466,27 @@ def _declare_core() -> None:
     gauge("sd_serve_workers", "live reader-pool worker processes")
     counter("sd_serve_invalidations_total",
             "per-library watermark bumps pushed to the worker page caches")
+    # distributed read replicas (ISSUE 19): the ReplicaRouter dispatch
+    # seam plus the replica-side serve arm — server/replica.py holds the
+    # matching module handles. ``peer`` labels are mesh.peer_label hashes
+    # (8 hex chars, bounded by fleet size).
+    counter("sd_replica_dispatches_total",
+            "pool-marked queries dispatched to a remote replica, per peer "
+            "and outcome (ok | not_eligible | busy | error)",
+            labels=("peer", "outcome"))
+    counter("sd_replica_eligibility_rejections_total",
+            "replica dispatches answered NOT_ELIGIBLE because the peer's "
+            "applied HLC watermark did not cover the client's last write "
+            "(the never-serve-a-stale-row gate)", labels=("peer",))
+    counter("sd_replica_failovers_total",
+            "replica-tier degradations to the next ladder rung (reason: "
+            "not_eligible | busy | error | no_peers)", labels=("reason",))
+    histogram("sd_replica_request_seconds",
+              "round-trip latency of replica-served queries per peer",
+              labels=("peer",), buckets=REQUEST_BUCKETS)
+    counter("sd_replica_serves_total",
+            "replica-SIDE serve outcomes for remote H_QUERY dispatches "
+            "(ok | not_eligible | busy | error)", labels=("outcome",))
     # device-resident query engine (ISSUE 15): columnar search index +
     # per-query backend router + refresh machinery (search/engine.py
     # holds the matching module handles). ``library`` labels are the

@@ -609,6 +609,91 @@ def _child_serve(data_dir: Path, args: dict) -> dict:
     return result
 
 
+#: the replica-serve kill point (ISSUE 19 satellite): the child IS a
+#: replica node serving watermark-gated queries through serve_query's
+#: in-process path, where the ``replica_serve`` seam firing ``kill`` is
+#: the WHOLE replica node dying mid-query (over real p2p the client's
+#: ladder eats the dropped connection; here the parent eats -SIGKILL)
+REPLICA_LIB_ID = "c0a5c0de-0000-4000-8000-00000000dddd"
+REPLICA_KILL = "replica_serve:kill:skip3"
+REPLICA_SERVES = 8
+
+
+def _child_replica(data_dir: Path, args: dict) -> dict:
+    """Replica-node SIGKILL drill: mirror a deterministic op stream (the
+    client's writes), then serve a fixed watermark-gated query sequence
+    in-process while the armed ``replica_serve:kill`` seam dies mid-
+    query. The restart must boot clean (WAL recovery), be re-eligible
+    straight from its durable floors (no re-mirror needed — every
+    applied window committed with the floors that cover it), and serve
+    the exact bytes the library's in-process handler encodes."""
+    from spacedrive_tpu import faults
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.server.replica import (covers, encode_reply,
+                                               serve_query)
+    from spacedrive_tpu.sync.ingest import Ingester
+
+    lib_id = args.get("lib_id", REPLICA_LIB_ID)
+    window = int(args.get("window", SYNC_WINDOW))
+    wire_ops = [json.loads(line) for line in
+                Path(args["ops_file"]).read_text().splitlines()
+                if line.strip()]
+    wire_ops.sort(key=lambda op: (op["timestamp"], op["id"]))
+    # the client's last-write watermark: every origin floor in the stream
+    require: dict[str, int] = {}
+    for op in wire_ops:
+        if op["timestamp"] > require.get(op["instance"], 0):
+            require[op["instance"]] = op["timestamp"]
+    t0 = time.perf_counter()
+    node = Node(data_dir, probe_accelerator=False, watch_locations=False)
+    if lib_id not in {l.id for l in node.libraries.list()}:
+        lib = node.libraries.create("crash-replica", lib_id=lib_id)
+    else:
+        lib = node.libraries.get(lib_id)
+    boot = _boot_report(node, lib)
+    payload = {"library_id": lib_id, "key": "tags.list", "arg": None,
+               "require": require}
+    # eligibility straight off the durable floors, BEFORE any mirroring:
+    # a fresh replica must refuse (not_eligible, never a partial page); a
+    # restarted one must already cover — its floors committed with the
+    # windows that advanced them
+    pre = serve_query(node, dict(payload), peer="crash-client")
+    eligible_at_boot = bool(pre.get("ok"))
+    ingester = Ingester(lib, peer="crash-client")
+    while True:
+        clocks = lib.sync.timestamps()
+        pending = [op for op in wire_ops
+                   if op["timestamp"] > clocks.get(op["instance"], 0)]
+        if not pending:
+            break
+        ingester.receive(pending[:window])
+        if not ingester.last_floor_advanced:
+            raise RuntimeError("replica mirror made no progress")
+    if args.get("faults"):
+        faults.install(args["faults"], seed=0)
+    serves_ok = []
+    for _ in range(int(args.get("serves", REPLICA_SERVES))):
+        reply = serve_query(node, dict(payload), peer="crash-client")
+        serves_ok.append(bool(reply.get("ok")))
+    proc = node.router.procedures["tags.list"]
+    reference = encode_reply(proc.fn(node, lib, None))
+    final = serve_query(node, dict(payload), peer="crash-client")
+    result = {
+        "boot": boot,
+        "eligible_at_boot": eligible_at_boot,
+        "covers": covers(lib.sync.timestamps(), require),
+        "serves_ok": serves_ok,
+        "identical": bool(final.get("ok"))
+        and final.get("raw") == reference,
+        "tag_count": lib.db.query(
+            "SELECT count(*) AS c FROM tag")[0]["c"],
+        "oplog": oplog_rows(lib.db),
+        "total_s": round(time.perf_counter() - t0, 3),
+    }
+    node.shutdown()
+    return result
+
+
 def _child_inspect(data_dir: Path, args: dict) -> dict:
     """Boot + report only (no workload): how the matrix asserts that a
     crashed-and-not-yet-recovered dir still boots clean, and how the
@@ -635,6 +720,7 @@ CHILD_MODES = {
     "backup": _child_backup,
     "restore": _child_restore,
     "serve": _child_serve,
+    "replica": _child_replica,
     "inspect": _child_inspect,
 }
 

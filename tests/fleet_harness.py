@@ -61,6 +61,17 @@ WAN_RETRY = RetryPolicy(attempts=400, base_s=0.02, max_s=0.25,
 #: groups and link patterns match against these)
 TARGET_IDENTITY = "fleet-target"
 
+#: the id-free pool-query matrix for quiescent byte-identity gates —
+#: every result is keyed by pub_id/hash/count (never a surrogate rowid),
+#: so converged participants must produce IDENTICAL wire bytes
+IDENTITY_KEYS: tuple[tuple[str, dict], ...] = (
+    ("search.objectsCount", {}),
+    ("search.pathsCount", {}),
+    ("search.duplicates", {}),
+    ("search.chunkDuplicates", {}),
+    ("search.nearDuplicates", {}),
+)
+
 
 class PeerThrottledError(ConnectionError):
     """The wire-less analog of the accept-layer RESET the real manager
@@ -507,6 +518,12 @@ class Fleet:
         }
         self.query_errors: list[str] = []
         self.hash_batches = 0
+        #: the serve tier (ISSUE 19): armed by arm_replicas()
+        self.replicas: list[FleetPeer] = []
+        self.serve_stats: dict = {"queries": 0, "stale": 0,
+                                  "errors": [], "latencies_s": []}
+        self._mirror_stop: threading.Event | None = None
+        self._mirror_thread: threading.Thread | None = None
 
     @property
     def honest_peers(self) -> list[FleetPeer]:
@@ -602,7 +619,8 @@ class Fleet:
     # -- orchestration --------------------------------------------------------
     def run_storm(self, ops_per_peer: int, batch: int = 500,
                   emit_chunks: int = 4, hash_traffic: bool = False,
-                  query_traffic: bool = False, rich: bool = False,
+                  query_traffic: bool = False, serve_traffic: bool = False,
+                  rich: bool = False,
                   burst_gap_s: float = 0.0, on_tick=None) -> dict:
         """The storm: every peer emits in ``emit_chunks`` bursts, pushing
         a full session after each burst, all peers concurrent (a
@@ -625,6 +643,13 @@ class Fleet:
             self._threads.append(threading.Thread(
                 target=self._query_traffic, args=(stop,), daemon=True,
                 name="fleet-query"))
+        if serve_traffic:
+            # the serve tier needs replicas converging to be eligible
+            if self.replicas and self._mirror_thread is None:
+                self.start_replica_mirror()
+            self._threads.append(threading.Thread(
+                target=self._serve_traffic, args=(stop,), daemon=True,
+                name="fleet-serve"))
         for t in self._threads:
             t.start()
 
@@ -738,6 +763,163 @@ class Fleet:
                 if not has_more:
                     done = True
 
+    # -- the distributed serve tier (ISSUE 19) -------------------------------
+    def arm_replicas(self, indices: list[int] | None = None,
+                     mirror_interval_s: float = 0.01,
+                     max_attempts: int | None = None) -> list[FleetPeer]:
+        """Designate honest peers as read replicas and install a
+        wire-less :class:`ReplicaRouter` on the target. The transport
+        mirrors ``manager.request_query`` / ``_serve_query``
+        frame-for-frame: the dial inject point (``p2p_send`` keyed by
+        the replica's identity), the request leg and reply leg across
+        the modeled network (partitions and drops cut replica dispatches
+        exactly like sync windows, and ``bytes_by_link`` ledgers them),
+        then :func:`serve_query` on the replica's own node — which
+        re-checks watermark eligibility against the TARGET's full clock
+        map per dispatch. Each peer holds the replicated library under
+        its own local id, so the transport rewrites ``library_id`` the
+        way the real responder resolves membership in its nlm."""
+        from spacedrive_tpu.server.replica import ReplicaRouter, serve_query
+
+        chosen = [p for p in (self.honest_peers if indices is None
+                              else [self.peers[i] for i in indices])
+                  if not isinstance(p, FlooderPeer)]
+        by_identity = {p.identity: p for p in chosen}
+        self.replicas = chosen
+        self._mirror_interval_s = mirror_interval_s
+
+        def candidates(library_id: str) -> list[str]:
+            return list(by_identity) if library_id == self.target_lib.id \
+                else []
+
+        def transport(peer_id: str, payload: dict, nbytes: int) -> dict:
+            peer = by_identity[peer_id]
+            faults.inject("p2p_send", key=peer_id)
+            net.link(TARGET_IDENTITY, peer_id, 64 + nbytes)
+            remote = dict(payload)
+            remote["library_id"] = peer.library.id
+            reply = serve_query(peer.node, remote, peer=TARGET_IDENTITY)
+            raw = reply.get("raw")
+            net.link(peer_id, TARGET_IDENTITY,
+                     len(raw) if raw is not None else 64)
+            return reply
+
+        router = ReplicaRouter(self.target, candidates, transport)
+        if max_attempts is not None:
+            router.max_attempts = max_attempts
+        self.target.replica_router = router
+        return chosen
+
+    def start_replica_mirror(self) -> None:
+        """Target → replica continuous mirror: keeps every replica's
+        applied watermark chasing the target's while a storm runs, so
+        serve-tier eligibility is earned, not a fixture. One thread,
+        round-robin over the replicas (the applies are GIL-bound, same
+        reasoning as mirror_back)."""
+        assert self.replicas, "arm_replicas() first"
+        if self._mirror_thread is not None:
+            return
+        self._mirror_stop = threading.Event()
+        stop = self._mirror_stop
+
+        def pump() -> None:
+            ingesters = {p.identity: Ingester(p.library, peer="fleet-target")
+                         for p in self.replicas}
+            while not stop.is_set():
+                moved = False
+                for peer in self.replicas:
+                    try:
+                        clocks = peer.library.sync.timestamps()
+                        ops, _more = self.target_lib.sync.get_ops(clocks, 400)
+                        if ops:
+                            ing = ingesters[peer.identity]
+                            with ing.session():
+                                ing.receive(ops)
+                            moved = True
+                    except Exception as e:  # noqa: BLE001 — surfaced below
+                        self.serve_stats["errors"].append(
+                            f"mirror {peer.identity}: {e!r}")
+                if not moved:
+                    stop.wait(self._mirror_interval_s)
+
+        self._mirror_thread = threading.Thread(
+            target=pump, daemon=True, name="fleet-replica-mirror")
+        self._mirror_thread.start()
+
+    def stop_replica_mirror(self, drain: bool = True) -> None:
+        """Stop the mirror pump; ``drain`` runs a final synchronous
+        mirror pass so the replicas sit AT the target's watermark (the
+        precondition for the quiescent byte-identity gate)."""
+        if self._mirror_stop is not None:
+            self._mirror_stop.set()
+        if self._mirror_thread is not None:
+            self._mirror_thread.join(timeout=30)
+        self._mirror_stop = self._mirror_thread = None
+        if drain and self.replicas:
+            for peer in self.replicas:
+                ing = Ingester(peer.library, peer="fleet-target")
+                while True:
+                    clocks = peer.library.sync.timestamps()
+                    ops, more = self.target_lib.sync.get_ops(clocks, 2000)
+                    if ops:
+                        with ing.session():
+                            ing.receive(ops)
+                    if not more and not ops:
+                        break
+
+    def _serve_traffic(self, stop: threading.Event) -> None:
+        """The serve-tier storm: pool-marked reads through the FULL
+        degradation ladder (replica → local pool → in-process) while
+        ingest storms. Every dispatch is preceded by a local count floor
+        — the count-monotonicity staleness probe: watermark eligibility
+        means any page a replica serves reflects AT LEAST the state the
+        target held when the dispatch left, so a count below the floor
+        would be a pre-watermark (stale) row. ``serve_stats['stale']``
+        staying 0 is the zero-wrong-or-stale-responses claim."""
+        router = self.target.router
+        st = self.serve_stats
+        while not stop.is_set():
+            floor = self.target_lib.db.query(
+                "SELECT COUNT(*) n FROM object")[0]["n"]
+            t0 = time.perf_counter()
+            try:
+                got = router.resolve("search.objectsCount", {},
+                                     library_id=self.target_lib.id)
+            except Exception as e:  # noqa: BLE001 — recorded, asserted on
+                st["errors"].append(f"serve: {e!r}")
+            else:
+                st["latencies_s"].append(time.perf_counter() - t0)
+                st["queries"] += 1
+                if int(got) < floor:
+                    st["stale"] += 1
+                    st["errors"].append(
+                        f"stale serve: objectsCount={got} < floor={floor}")
+            stop.wait(0.01)
+
+    def replica_identity_report(self,
+                                keys: tuple = IDENTITY_KEYS) -> dict[str, bool]:
+        """Quiescent byte-identity gate: for every replica × id-free pool
+        query, the raw page the replica serves must equal BYTE FOR BYTE
+        what the target's in-process handler encodes (one encoder end to
+        end — serve-pool workers, replicas and Response.json all run
+        ``encode_reply``). Meaningful at converged points only; mid-storm
+        the watermark gate, not identity, is the correctness claim."""
+        from spacedrive_tpu.server.replica import encode_reply, serve_query
+
+        require = dict(self.target_lib.sync.require_watermark())
+        report: dict[str, bool] = {}
+        for key, arg in keys:
+            proc = self.target.router.procedures[key]
+            local = encode_reply(proc.fn(self.target, self.target_lib, arg))
+            for peer in self.replicas:
+                reply = serve_query(
+                    peer.node, {"library_id": peer.library.id, "key": key,
+                                "arg": arg, "require": require},
+                    peer=TARGET_IDENTITY)
+                report[f"{key}@{peer.identity}"] = bool(
+                    reply.get("ok")) and reply.get("raw") == local
+        return report
+
     def converged(self) -> bool:
         want = op_log(self.target_lib)
         return all(op_log(p.library) == want for p in self.peers)
@@ -747,6 +929,28 @@ class Fleet:
         for peer in self.peers:
             peer.shutdown()
         self.target.shutdown()
+
+
+def replica_counters() -> dict:
+    """The ``sd_replica_*`` ledger, collapsed over peer labels: dispatch
+    outcomes, failover reasons, replica-side serve outcomes, eligibility
+    rejections. Every degradation the ladder takes must be accounted in
+    ``failover`` — the serve gates diff this before/after."""
+    out: dict = {"dispatch": {}, "failover": {}, "serve": {},
+                 "eligibility_rejections": 0.0}
+    for lbls, v in telemetry.series_values("sd_replica_dispatches_total"):
+        k = lbls.get("outcome", "")
+        out["dispatch"][k] = out["dispatch"].get(k, 0.0) + v
+    for lbls, v in telemetry.series_values("sd_replica_failovers_total"):
+        k = lbls.get("reason", "")
+        out["failover"][k] = out["failover"].get(k, 0.0) + v
+    for lbls, v in telemetry.series_values("sd_replica_serves_total"):
+        k = lbls.get("outcome", "")
+        out["serve"][k] = out["serve"].get(k, 0.0) + v
+    for _lbls, v in telemetry.series_values(
+            "sd_replica_eligibility_rejections_total"):
+        out["eligibility_rejections"] += v
+    return out
 
 
 def p99_apply_delay() -> float:

@@ -636,6 +636,62 @@ def test_worker_purity_waivable(tmp_path):
         "worker-purity") == []
 
 
+# -- pass: replica-purity -----------------------------------------------------
+
+def test_replica_purity_flags_divergent_state(tmp_path):
+    """ISSUE 19 fixture: a replica-eligible handler reading node-local
+    unsynced state (data_dir, volume/job rows) would answer with the
+    REPLICA's rows when dispatched over the mesh — wrong even when
+    watermark-eligible."""
+    bad = run_on(tmp_path, "api/routers/bad.py", (
+        "def mount(router):\n"
+        "    @router.library_query('nodes.volumes', pool=True)\n"
+        "    def volumes(node, library, arg):\n"
+        "        free = node.data_dir\n"
+        "        rows = library.db.find(Volume, order_by='name')\n"
+        "        job = library.db.find_one(JobRow, {'id': arg})\n"
+        "        return library.db.query('SELECT * FROM job WHERE 1')\n"),
+        "replica-purity")
+    assert [f.lineno for f in bad] == [4, 5, 6, 7]
+    assert "data_dir" in bad[0].message
+    assert "Volume" in bad[1].message
+    assert "no sync spec" in bad[2].message
+    assert "node-local table 'job'" in bad[3].message
+
+
+def test_replica_purity_respects_opt_out_and_synced_reads(tmp_path):
+    # replica=False keeps a divergent reader on the local pool only —
+    # libraries.statistics' shape — and the pass skips it entirely
+    assert run_on(tmp_path, "api/routers/good.py", (
+        "def mount(router):\n"
+        "    @router.library_query('libraries.statistics', pool=True,\n"
+        "                          replica=False)\n"
+        "    def stats(node, library, arg):\n"
+        "        return compute(library.db, node.data_dir)\n"
+        "    @router.library_query('search.ok', pool=True)\n"
+        "    def ok(node, library, arg):\n"
+        "        rows = library.db.find(Location, order_by='name')\n"
+        "        return library.db.query('SELECT * FROM file_path')\n"
+        "    @router.library_query('search.inproc')\n"
+        "    def inproc(node, library, arg):\n"
+        "        return library.db.find(Volume)\n"), "replica-purity") == []
+    # out of scope: api/ only
+    assert run_on(tmp_path, "sync/handlers.py", (
+        "def mount(router):\n"
+        "    @router.query('x', pool=True)\n"
+        "    def q(node, arg):\n"
+        "        return node.data_dir\n"), "replica-purity") == []
+
+
+def test_replica_purity_waivable(tmp_path):
+    assert run_on(tmp_path, "api/routers/waived.py", (
+        "def mount(router):\n"
+        "    @router.library_query('x', pool=True)\n"
+        "    def q(node, library, arg):\n"
+        "        return node.data_dir  # lint: ok(replica-purity)\n"),
+        "replica-purity") == []
+
+
 # -- pass 16: lockset ---------------------------------------------------------
 
 #: the PR 8 bug, verbatim in shape: try_admit holds the non-reentrant

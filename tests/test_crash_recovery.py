@@ -199,6 +199,43 @@ def test_serve_worker_kill_point(tmp_path):
     assert res["snapshot"] == ref["snapshot"]
 
 
+def test_replica_serve_kill_point(tmp_path):
+    """ISSUE 19 satellite: a node SIGKILLed WHILE SERVING as a replica.
+    The child mirrors a deterministic op stream, turns eligible, and the
+    ``replica_serve:kill`` seam kills the whole node mid-query (the
+    in-process serve path — over p2p this is the replica vanishing under
+    the client's ladder). The restart must boot clean through WAL
+    recovery, be watermark-eligible straight from its durable floors
+    (``eligible_at_boot`` — no re-mirror round needed), keep the op-log
+    identical to an unkilled reference, and serve the page byte-identical
+    to the in-process handler."""
+    ops_file = ch.gen_ops_file(tmp_path / "replica-ops.jsonl")
+    args = {"ops_file": str(ops_file)}
+    _rc, ref = ch.run_child("replica", tmp_path / "replica-ref", args)
+    assert ref["eligible_at_boot"] is False  # fresh replica must refuse
+    assert ref["covers"] and all(ref["serves_ok"]) and ref["identical"]
+
+    data_dir = tmp_path / "replica-kill"
+    rc, res = ch.run_child("replica", data_dir,
+                           {**args, "faults": ch.REPLICA_KILL},
+                           expect_kill=True)
+    assert rc == -signal.SIGKILL, \
+        f"replica_serve kill never fired (rc={rc})"
+    assert res is None  # died mid-serve: no result ever written
+
+    rc2, rec = ch.run_child("replica", data_dir, args)
+    assert rc2 == 0 and rec is not None
+    assert rec["boot"]["quick_check_ok"], rec["boot"]
+    # re-eligibility is immediate: the floors that admitted the killed
+    # serve were durable before it started
+    assert rec["eligible_at_boot"] is True
+    assert rec["covers"] and all(rec["serves_ok"])
+    assert rec["identical"], "restarted replica served different bytes"
+    assert rec["tag_count"] == ref["tag_count"]
+    assert rec["oplog"] == ref["oplog"], \
+        "replica kill perturbed the mirrored op-log"
+
+
 # ---------------------------------------------------------------------------
 # boot integrity + the repair ladder (in-process)
 # ---------------------------------------------------------------------------

@@ -19,6 +19,7 @@ from types import SimpleNamespace
 import pytest
 
 from spacedrive_tpu import faults, telemetry
+from spacedrive_tpu.faults import net
 from spacedrive_tpu.models import Object, Tag, TagOnObject
 from spacedrive_tpu.node import Node
 from spacedrive_tpu.sync.admission import Busy, IngestBudget
@@ -27,7 +28,7 @@ from spacedrive_tpu.sync.lanes import IngestLanes, lane_key
 from spacedrive_tpu.telemetry import alerts, mesh
 
 from .fleet_harness import (Fleet, materialized_rows, op_log,
-                            p99_apply_delay)
+                            p99_apply_delay, replica_counters)
 
 
 @pytest.fixture(autouse=True)
@@ -459,6 +460,178 @@ def test_job_lanes_are_per_library(tmp_path):
 
 
 # -- the fleet chaos soak gate (acceptance) ------------------------------------
+
+
+def test_replica_chaos_gate(tmp_path, monkeypatch):
+    """ISSUE 19 acceptance: a serve storm rides the ingest storm over a
+    fleet with two armed replicas while (a) ``replica_serve:kill``
+    SIGKILLs replica pool workers mid-query and (b) two partition waves
+    cut each replica from the mesh mid-storm. The strict ladder
+    replica → local pool → in-process must answer EVERY query with zero
+    wrong-or-stale responses (count-monotonicity probes), every
+    degradation accounted in ``sd_replica_failovers_total``, the
+    post-heal lag alert must resolve, and the quiescent byte-identity
+    matrix must hold on both replicas afterward."""
+    from spacedrive_tpu.server.pool import ReaderPool
+
+    from .fleet_harness import WAN_RETRY
+
+    monkeypatch.setenv("SD_SERVE_HEALTH_S", "0.3")
+    fleet = Fleet(tmp_path, peers=4, lanes=2, retry=WAN_RETRY)
+    evaluator = alerts.AlertEvaluator(
+        [alerts.AlertRule(name="sync-peer-lag", kind="threshold",
+                          series="sd_sync_peer_lag_ops", op="gt",
+                          value=300.0, for_s=0.0)])
+    stop = threading.Event()
+
+    def evaluate():
+        while not stop.is_set():
+            evaluator.evaluate_once()
+            stop.wait(0.05)
+
+    ev_thread = threading.Thread(target=evaluate, daemon=True)
+    ev_thread.start()
+    pools = []
+    try:
+        replicas = fleet.arm_replicas(indices=[0, 1], max_attempts=2)
+        # the kill seam must be armed BEFORE the pools fork so the
+        # replica workers inherit it; it names only `replica_serve`, so
+        # the target's own pool workers never fire it
+        faults.install("replica_serve:kill:0.15", seed=19)
+        for peer in replicas:
+            peer.node.reader_pool = ReaderPool(peer.node, workers=1).start()
+            pools.append(peer.node.reader_pool)
+        fleet.target.reader_pool = ReaderPool(fleet.target,
+                                              workers=1).start()
+        pools.append(fleet.target.reader_pool)
+        # two partition waves, storm-relative: each cuts ONE replica from
+        # everything (its push sessions AND its replica dispatches)
+        net.install("*>*:lat=1ms,jitter=0.5ms;"
+                    "part:fleet-peer-00|*:@1.0+2.0;"
+                    "part:fleet-peer-01|*:@4.5+2.0", seed=19)
+
+        res = fleet.run_storm(ops_per_peer=800, batch=200, emit_chunks=4,
+                              serve_traffic=True, rich=True,
+                              burst_gap_s=1.5)
+        ledger = replica_counters()
+        faults.clear()
+        net.clear()
+        fleet.drain()
+        fleet.stop_replica_mirror(drain=True)
+        evaluator.evaluate_once()
+        stop.set()
+        ev_thread.join(timeout=10)
+
+        assert res["errors"] == []
+        st = fleet.serve_stats
+        # the serve storm really ran, answered every query, and NEVER
+        # returned a wrong-or-stale page — the zero-staleness claim
+        assert st["queries"] > 20, st
+        assert st["stale"] == 0, st["errors"][:5]
+        assert st["errors"] == [], st["errors"][:5]
+        # the replica rung served real traffic...
+        assert ledger["dispatch"].get("ok", 0) > 0, ledger
+        # ...and every degradation (kills surface as transport errors /
+        # replica-side pool failovers, partitions as link cuts, lagging
+        # replicas as not_eligible) is accounted, by reason
+        assert sum(ledger["failover"].values()) > 0, ledger
+        assert set(ledger["failover"]) <= {"busy", "error",
+                                           "not_eligible", "no_peers"}
+        assert telemetry.value("sd_net_link_messages_total",
+                               verdict="cut") > 0  # the waves really cut
+        # every replica-side serve outcome is from the closed set
+        assert set(ledger["serve"]) <= {"ok", "not_eligible", "busy",
+                                        "error"}
+
+        # deterministic kill drill at the quiescent point: replicas are
+        # converged (eligible) and their pools are restarted AFTER the
+        # kill seam is armed, so the fresh workers fork with the plan —
+        # the first dispatch each replica serves SIGKILLs its pool
+        # worker mid-query, the replica answers `error` (never a partial
+        # page), the router backs the peer off, and the target's local
+        # rungs answer. The query keeps succeeding with the right value
+        # throughout.
+        def _pool_failovers() -> float:
+            return sum(v for lbls, v in telemetry.series_values(
+                "sd_serve_worker_requests_total")
+                if lbls.get("outcome") == "failover")
+
+        def _replica_errors() -> float:
+            return sum(v for lbls, v in telemetry.series_values(
+                "sd_replica_dispatches_total")
+                if lbls.get("outcome") == "error")
+
+        want = fleet.target_lib.db.query(
+            "SELECT COUNT(*) n FROM object")[0]["n"]
+        pf0, re0 = _pool_failovers(), _replica_errors()
+        faults.install("replica_serve:kill", seed=19)
+        for peer in replicas:
+            peer.node.reader_pool.stop()
+            peer.node.reader_pool = ReaderPool(peer.node,
+                                               workers=1).start()
+            pools.append(peer.node.reader_pool)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            got = fleet.target.router.resolve(
+                "search.objectsCount", {}, library_id=fleet.target_lib.id)
+            assert int(got) == want  # ladder answered, correctly
+            if _pool_failovers() > pf0 and _replica_errors() > re0:
+                break
+            time.sleep(0.3)  # let cooldowns expire / workers respawn
+        else:
+            raise AssertionError(
+                f"kill drill never surfaced: pool_failovers "
+                f"{pf0}->{_pool_failovers()}, replica_errors "
+                f"{re0}->{_replica_errors()}, "
+                f"router={fleet.target.replica_router.status()}, "
+                f"dispatches={telemetry.series_values('sd_replica_dispatches_total')}")
+        faults.clear()
+        # cycle the replica pools once more so the post-heal probes hit
+        # workers forked with the CLEARED plan (survivors of the drill
+        # still carry the inherited kill seam until their next dispatch)
+        for peer in replicas:
+            peer.node.reader_pool.stop()
+            peer.node.reader_pool = ReaderPool(peer.node,
+                                               workers=1).start()
+            pools.append(peer.node.reader_pool)
+
+        # post-heal: fleet converges byte-identically, lag drains, the
+        # alert cycle closed
+        fleet.mirror_back()
+        assert fleet.converged()
+        for peer in fleet.peers:
+            assert telemetry.value("sd_sync_peer_lag_ops",
+                                   peer=peer.label) == 0.0, peer.identity
+        assert telemetry.value("sd_alerts_firing",
+                               rule="sync-peer-lag") == 0.0
+        # quiescent byte-identity: the full id-free matrix × both
+        # replicas serves the exact bytes the target's in-process
+        # handlers encode
+        report = fleet.replica_identity_report()
+        assert report and all(report.values()), report
+        # re-eligibility after the chaos: a fresh ladder descent serves
+        # from a replica again (cooldowns expire quickly once healthy)
+        deadline = time.monotonic() + 30
+        before_ok = sum(v for lbls, v in telemetry.series_values(
+            "sd_replica_dispatches_total") if lbls.get("outcome") == "ok")
+        while time.monotonic() < deadline:
+            fleet.target.router.resolve("search.objectsCount", {},
+                                        library_id=fleet.target_lib.id)
+            now_ok = sum(v for lbls, v in telemetry.series_values(
+                "sd_replica_dispatches_total")
+                if lbls.get("outcome") == "ok")
+            if now_ok > before_ok:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("replicas never re-served after heal")
+    finally:
+        stop.set()
+        faults.clear()
+        net.clear()
+        for pool in pools:
+            pool.stop()
+        fleet.shutdown()
 
 
 def test_fleet_chaos_soak_gate(tmp_path):

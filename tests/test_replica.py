@@ -1,0 +1,366 @@
+"""Distributed read replicas (ISSUE 19): watermark eligibility, the
+replica-side serve path, EWMA routing with cooldowns, and the strict
+degradation ladder replica → local pool → in-process.
+
+Wire-less like the fleet harness — the socket p2p layer needs the
+``cryptography`` package this container lacks, so the transports here are
+in-process closures with the exact reply contract of
+``manager.request_query``. The H_QUERY wire framing itself round-trips
+in :func:`test_header_query_roundtrip`.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from spacedrive_tpu import faults, telemetry
+from spacedrive_tpu.api.router import RawJson
+from spacedrive_tpu.faults import PeerBusyError
+from spacedrive_tpu.models import Object, Tag
+from spacedrive_tpu.node import Node
+from spacedrive_tpu.p2p.proto import H_QUERY, Header
+from spacedrive_tpu.server.replica import (ReplicaRouter, covers,
+                                           encode_reply, serve_query)
+from spacedrive_tpu.sync.ingest import Ingester
+
+LIB = "lib-aaaa"
+
+
+# -- wire framing -------------------------------------------------------------
+
+def test_header_query_roundtrip():
+    async def main():
+        h = Header.query("lib-1", "search.objectsCount", {"take": 5},
+                         {"pub-a": 7, "pub-b": 0})
+        reader = asyncio.StreamReader()
+        reader.feed_data(h.to_bytes())
+        reader.feed_eof()
+        back = await Header.from_stream(reader)
+        assert back.kind == H_QUERY
+        assert back.payload["library_id"] == "lib-1"
+        assert back.payload["key"] == "search.objectsCount"
+        assert back.payload["arg"] == {"take": 5}
+        assert back.payload["require"] == {"pub-a": 7, "pub-b": 0}
+
+    asyncio.run(main())
+
+
+# -- the eligibility rule -----------------------------------------------------
+
+def test_covers_requires_every_positive_floor():
+    assert covers({"a": 5, "b": 9}, {"a": 5, "b": 3})
+    assert covers({"a": 5}, {"a": 5, "b": 0})   # floor 0 = no writes seen
+    assert covers({}, {})
+    assert not covers({"a": 4}, {"a": 5})       # lagging one origin
+    assert not covers({"b": 99}, {"a": 1})      # missing origin entirely
+    assert covers({"a": 1}, {"a": 1, "a2": -3})  # non-positive floors skip
+
+
+# -- serve_query on a real two-node pair -------------------------------------
+
+def _emit(lib, n, prefix="t"):
+    """n (tag, object) create-op pairs, the harness emit shape."""
+    ops, rows = [], []
+    for i in range(n):
+        tp, op = f"{prefix}-tag{i}", f"{prefix}-obj{i}"
+        ops.append(lib.sync.shared_create(Tag, tp, {"name": tp}))
+        ops.append(lib.sync.shared_create(Object, op, {"kind": i % 5}))
+        rows.append((tp, op, i % 5))
+
+    def _mat(db, rows=rows):
+        for tp, op, kind in rows:
+            db.insert(Tag, {"pub_id": tp, "name": tp})
+            db.insert(Object, {"pub_id": op, "kind": kind})
+
+    lib.sync.write_ops(ops, _mat)
+
+
+def _mirror(src_lib, dst_lib):
+    ing = Ingester(dst_lib, peer="replica-test-src")
+    while True:
+        clocks = dst_lib.sync.timestamps()
+        ops, more = src_lib.sync.get_ops(clocks, 500)
+        if ops:
+            with ing.session():
+                ing.receive(ops)
+        if not more and not ops:
+            return
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    a = Node(tmp_path / "a", probe_accelerator=False, watch_locations=False)
+    b = Node(tmp_path / "b", probe_accelerator=False, watch_locations=False)
+    la = a.libraries.create("replica-src")
+    lb = b.libraries.create("replica-dst")
+    for lib in (la, lb):
+        lib.sync.emit_messages = True
+    la.add_remote_instance(lb.instance())
+    lb.add_remote_instance(la.instance())
+    try:
+        yield a, la, b, lb
+    finally:
+        faults.clear()
+        a.shutdown()
+        b.shutdown()
+
+
+def test_serve_query_gates_on_watermark_then_serves_identical_bytes(pair):
+    a, la, b, lb = pair
+    _emit(la, 8)
+    require = dict(la.sync.timestamps())
+
+    # the replica has NOT applied the writes yet: it must refuse, never
+    # serve the empty (pre-watermark) table
+    reply = serve_query(b, {"library_id": lb.id, "key": "search.objectsCount",
+                            "arg": {}, "require": require})
+    assert reply["ok"] is False and reply["kind"] == "not_eligible"
+    # ...and its answer names its own watermark so the client can reason
+    assert not covers(reply["watermark"], require)
+
+    _mirror(la, lb)
+    reply = serve_query(b, {"library_id": lb.id, "key": "search.objectsCount",
+                            "arg": {}, "require": require})
+    assert reply["ok"] is True
+    local = encode_reply(
+        a.router.procedures["search.objectsCount"].fn(a, la, {}))
+    assert reply["raw"] == local == b"8"
+
+
+def test_serve_query_rejects_non_pool_and_unknown_library(pair):
+    a, la, b, lb = pair
+    # libraries.list is not pool-marked → not replica-dispatchable
+    reply = serve_query(b, {"library_id": lb.id, "key": "libraries.list",
+                            "arg": None, "require": {}})
+    assert reply["ok"] is False and reply["kind"] == "error"
+    # replica=False opt-outs (libraries.statistics) are refused the same
+    # way even though they are pool-marked
+    reply = serve_query(b, {"library_id": lb.id,
+                            "key": "libraries.statistics",
+                            "arg": None, "require": {}})
+    assert reply["ok"] is False and reply["kind"] == "error"
+    # a library this node does not replicate is as ineligible as lag
+    reply = serve_query(b, {"library_id": "nope", "key": "tags.list",
+                            "arg": None, "require": {}})
+    assert reply["ok"] is False and reply["kind"] == "not_eligible"
+    assert reply["watermark"] == {}
+
+
+def test_serve_query_fault_seam(pair):
+    a, la, b, lb = pair
+    _emit(la, 2)
+    _mirror(la, lb)
+    require = dict(la.sync.timestamps())
+    q = {"library_id": lb.id, "key": "search.objectsCount", "arg": {},
+         "require": require}
+
+    faults.install("replica_serve:eio:once")
+    reply = serve_query(b, q)
+    assert reply["ok"] is False and reply["kind"] == "error"
+
+    faults.clear()
+    faults.install("replica_serve:busy:once")
+    reply = serve_query(b, q)
+    assert reply["ok"] is False and reply["kind"] == "busy"
+    assert reply["retry_after_ms"] > 0
+
+    faults.clear()
+    reply = serve_query(b, q)  # seams drained: healthy again
+    assert reply["ok"] is True and reply["raw"] == b"2"
+
+
+# -- ReplicaRouter routing policy ---------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _router(node, replies, clock=None):
+    """A ReplicaRouter over scripted per-peer transports. ``replies``
+    maps peer → callable() -> reply dict (or raising)."""
+    r = ReplicaRouter(node, lambda lib: list(replies),
+                      lambda peer, payload, nbytes: replies[peer]())
+    if clock is not None:
+        r._clock = clock
+    return r
+
+
+def _ok(value=1):
+    raw = json.dumps(value).encode()
+    return lambda: {"ok": True, "raw": raw}
+
+
+def test_router_serves_raw_page_and_tracks_ewma(pair):
+    a, la, _b, _lb = pair
+    clock = _Clock()
+    r = _router(a, {"p1": _ok(41)}, clock)
+    got = r.dispatch("search.objectsCount", {}, la.id)
+    assert isinstance(got, RawJson) and got.decode() == 41
+    st = r.status()
+    assert r.status()["dispatches"] == 1
+    (peer_stats,) = st["peers"].values()
+    assert peer_stats["fails"] == 0
+
+
+def test_router_not_eligible_cooldown_then_recovery(pair):
+    a, la, _b, _lb = pair
+    clock = _Clock()
+    calls = {"n": 0}
+
+    def flappy():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return {"ok": False, "kind": "not_eligible", "watermark": {}}
+        return {"ok": True, "raw": b"7"}
+
+    before = telemetry.value("sd_replica_failovers_total",
+                             reason="not_eligible")
+    r = _router(a, {"p1": flappy}, clock)
+    # first dispatch: the only peer is ineligible → ladder falls through
+    assert r.dispatch("k", {}, la.id) is None
+    assert telemetry.value("sd_replica_failovers_total",
+                           reason="not_eligible") == before + 1
+    # still inside the cooldown window: peer not even tried
+    assert r.dispatch("k", {}, la.id) is None
+    assert calls["n"] == 1
+    # cooldown expires → retried → serves
+    clock.t += 1.0
+    got = r.dispatch("k", {}, la.id)
+    assert isinstance(got, RawJson) and got.data == b"7"
+
+
+def test_router_busy_honors_retry_after(pair):
+    a, la, _b, _lb = pair
+    clock = _Clock()
+
+    def busy():
+        raise PeerBusyError("replica shed", retry_after_ms=2000)
+
+    r = _router(a, {"p1": busy}, clock)
+    assert r.dispatch("k", {}, la.id) is None
+    clock.t += 1.0           # inside retry_after: still cooling
+    assert r.dispatch("k", {}, la.id) is None
+    assert r.status()["peers"]
+    # no_peers failover accounted while everyone cools down
+    assert telemetry.value("sd_replica_failovers_total",
+                           reason="no_peers") >= 1
+
+
+def test_router_transport_error_backs_off_exponentially(pair):
+    a, la, _b, _lb = pair
+    clock = _Clock()
+    calls = {"n": 0}
+
+    def dead():
+        calls["n"] += 1
+        raise ConnectionError("partitioned")
+
+    r = _router(a, {"p1": dead}, clock)
+    assert r.dispatch("k", {}, la.id) is None
+    assert calls["n"] == 1
+    assert r.dispatch("k", {}, la.id) is None  # cooling: not re-dialed
+    assert calls["n"] == 1
+    clock.t += 10.0
+    assert r.dispatch("k", {}, la.id) is None
+    assert calls["n"] == 2
+    (peer_stats,) = r.status()["peers"].values()
+    assert peer_stats["fails"] == 2
+
+
+def test_router_prefers_faster_peer_and_fails_over_between_them(pair):
+    a, la, _b, _lb = pair
+    clock = _Clock()
+    served = {"fast": 0, "slow": 0}
+
+    def fast():
+        clock.t += 0.01
+        served["fast"] += 1
+        return {"ok": True, "raw": b"1"}
+
+    def slow():
+        clock.t += 0.5
+        served["slow"] += 1
+        return {"ok": True, "raw": b"1"}
+
+    r = _router(a, {"fast": fast, "slow": slow}, clock)
+    for _ in range(12):
+        assert r.dispatch("k", {}, la.id) is not None
+    # both got measured (first dispatches + exploration), but the fast
+    # peer owns the steady state
+    assert served["fast"] > served["slow"]
+
+    # fast peer dies mid-wave → the SAME dispatch fails over to slow
+    def fast_dead():
+        raise ConnectionError("cut")
+
+    r2 = _router(a, {"fast": fast_dead, "slow": slow}, clock)
+    got = r2.dispatch("k", {}, la.id)
+    assert isinstance(got, RawJson)
+
+
+def test_router_silent_when_rung_not_armed(pair):
+    a, la, _b, _lb = pair
+    r = ReplicaRouter(a, lambda lib: [], lambda *args: None)
+    before = sum(v for _l, v in telemetry.series_values(
+        "sd_replica_failovers_total"))
+    assert r.dispatch("k", {}, la.id) is None
+    assert r.dispatch("k", {}, None) is None
+    after = sum(v for _l, v in telemetry.series_values(
+        "sd_replica_failovers_total"))
+    assert after == before  # no peers configured ≠ a degradation
+
+
+# -- the full ladder through router.resolve -----------------------------------
+
+def test_resolve_ladder_replica_then_inprocess(pair):
+    a, la, b, lb = pair
+    _emit(la, 5)
+    _mirror(la, lb)
+
+    def transport(peer, payload, nbytes):
+        remote = dict(payload, library_id=lb.id)
+        return serve_query(b, remote, peer="test-client")
+
+    a.replica_router = ReplicaRouter(a, lambda lib: ["peer-b"], transport)
+    try:
+        before = telemetry.value("sd_replica_dispatches_total",
+                                 peer="peer-b", outcome="ok")
+        # replica rung serves, and the decoded value matches in-process
+        assert a.router.resolve("search.objectsCount", {},
+                                library_id=la.id) == 5
+        # (peer label is hashed — sum over outcomes instead)
+        ok_total = sum(v for lbls, v in telemetry.series_values(
+            "sd_replica_dispatches_total") if lbls.get("outcome") == "ok")
+        assert ok_total >= 1
+
+        # replica goes ineligible (new local write) → ladder falls
+        # through to in-process and STILL answers, fresh
+        _emit(la, 1, prefix="late")
+        assert a.router.resolve("search.objectsCount", {},
+                                library_id=la.id) == 6
+        # non-pool queries never touch the replica rung
+        assert isinstance(
+            a.router.resolve("libraries.list", None), list)
+    finally:
+        a.replica_router = None
+    del before
+
+
+def test_resolve_replica_false_skips_replica_rung(pair):
+    a, la, _b, _lb = pair
+
+    def exploding(peer, payload, nbytes):
+        raise AssertionError("replica rung must not be consulted")
+
+    a.replica_router = ReplicaRouter(a, lambda lib: ["peer-b"], exploding)
+    try:
+        res = a.router.resolve("libraries.statistics", None,
+                               library_id=la.id)
+        assert "total_object_count" in res or isinstance(res, dict)
+    finally:
+        a.replica_router = None

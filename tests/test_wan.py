@@ -382,6 +382,78 @@ def test_one_way_link_shaping_hits_only_the_shaped_direction(tmp_path):
         fleet.shutdown()
 
 
+def test_spacedrop_frames_ride_one_way_shaping(tmp_path):
+    """ISSUE 19 satellite: whole-file spacedrop sender frames route
+    through :mod:`faults.net` via ``send_file``'s link hook. A one-way
+    ``a>b`` shaping soak must bite ONLY the transfer direction (the
+    return path stays pristine), the byte ledger must account every
+    delivered block frame on that link, and a partition mid-transfer
+    must raise out of the send as a ``ConnectionError`` — never a torn
+    silent success."""
+    import asyncio
+
+    from spacedrive_tpu.p2p.proto import SpaceblockRequest
+    from spacedrive_tpu.p2p.spaceblock import send_file
+
+    body = bytes(range(256)) * 1024  # 256 KiB → 8 blocks of 32 KiB
+    src = tmp_path / "drop.bin"
+    src.write_bytes(body)
+    req = SpaceblockRequest("drop.bin", len(body), 32 * 1024)
+
+    class _Writer:  # duck-typed asyncio writer: frames land in memory
+        def __init__(self):
+            self.frames = []
+
+        def write(self, data):
+            self.frames.append(bytes(data))
+
+        async def drain(self):
+            return None
+
+    def _link(a, b):
+        async def link(nbytes: int) -> None:
+            await net.alink(a, b, nbytes)
+
+        return link
+
+    # phase 1: shaped soak, sender→receiver only
+    model = net.install("sender>receiver:lat=4ms,jitter=1ms", seed=23)
+    try:
+        w = _Writer()
+        sent = asyncio.run(send_file(w, src, req,
+                                     link=_link("sender", "receiver")))
+        assert sent == len(body)
+        assert len(w.frames) == 8
+        # a control message on the RETURN path: unshaped, instant
+        net.link("receiver", "sender", 64)
+
+        ledger = model.ledger()
+        shaped = ledger["sender>receiver"]
+        assert len(shaped) == 8
+        assert all(verdict == "ok" for _s, verdict, _d in shaped)
+        assert min(d for _s, _v, d in shaped) >= 2.9  # ms: lat − jitter
+        assert all(d == 0.0 for _s, _v, d in ledger["receiver>sender"])
+        # every delivered frame is byte-accounted on exactly that link
+        assert model.bytes_by_link()["sender>receiver"] == \
+            sum(len(f) for f in w.frames)
+    finally:
+        net.clear()
+
+    # phase 2: a partition window opens mid-transfer — the send must
+    # fail loudly with frames missing, not trickle out a torn file
+    model = net.install("part:sender|receiver:@0+60", seed=23)
+    try:
+        w2 = _Writer()
+        with pytest.raises(ConnectionError):
+            asyncio.run(send_file(w2, src, req,
+                                  link=_link("sender", "receiver")))
+        assert len(w2.frames) < 8
+        assert telemetry.value("sd_net_link_messages_total",
+                               verdict="cut") > 0
+    finally:
+        net.clear()
+
+
 def net_harness_target() -> str:
     from .fleet_harness import TARGET_IDENTITY
 
