@@ -92,6 +92,25 @@ if "--scan" in sys.argv[1:]:
 #: BENCH_chunk.json
 if "--chunk" in sys.argv[1:]:
     MODE = "chunk"
+#: ``--load``: the open-loop multi-tenant load harness (ISSUE 20) —
+#: seeded Poisson arrival schedules (arrivals never wait for
+#: completions) over a Zipf tenant mix of SD_LOAD_TENANTS libraries,
+#: dispatched in-process through the real router with the dispatch
+#: admission budget + reader-pool autosizer + SLO burn-rate engine all
+#: live. Emits the latency-vs-offered-load curve (p50/p99/p99.9 + shed
+#: rate per step), the detected knee as the headline, and the
+#: flash-crowd acceptance gates (burn alert fires AND resolves, the
+#: flooding tenant absorbs the sheds, quiet tenants stay fast, the
+#: autosizer grows then shrinks) to BENCH_load.json
+if "--load" in sys.argv[1:]:
+    MODE = "load"
+#: ``--check-history``: the regression sentinel (ISSUE 20) — compare
+#: each (mode, metric)'s latest BENCH_history.jsonl value against the
+#: trailing median of its predecessors and print a verdict table;
+#: always exits 0 (a sentinel, not a gate — combined mode runs it
+#: warn-only at the end of every full bench)
+if "--check-history" in sys.argv[1:]:
+    MODE = "check_history"
 REPEATS = int(os.environ.get("SD_BENCH_REPEATS", "3"))
 #: ``--faults`` (or SD_BENCH_FAULTS=1): bench_scan adds a chaos pass under
 #: an injected fault storm and reports recovery overhead alongside
@@ -2114,6 +2133,8 @@ def _bench_analysis_wall() -> None:
     the drift is seen before the hook starts failing."""
     if os.environ.get("SD_BENCH_NO_ANALYSIS"):
         return  # combined-mode children: the parent owns the headline
+    if MODE == "check_history":
+        return  # the read-only sentinel must stay sub-second
     try:
         from spacedrive_tpu.analysis.engine import (build_manager,
                                                     default_root)
@@ -2140,6 +2161,443 @@ def _history_extra(metric: str, value, unit: str) -> None:
     except Exception as e:
         print(f"warn: BENCH_history.jsonl append failed: {e}",
               file=sys.stderr)
+
+
+def bench_load() -> dict:
+    """Open-loop multi-tenant load bench (ISSUE 20): the serving tier
+    under an arrival *schedule* instead of a client loop. A closed-loop
+    driver slows its own offered rate exactly when the server saturates
+    (k workers can never have more than k requests outstanding), which
+    hides queue growth; the open-loop harness keeps offering load, so
+    saturation lands where operators will see it in production — the
+    latency distribution and the shed rate.
+
+    Three phases against one live node (admission budget + reader-pool
+    autosizer + SLO engine armed, ``serve_worker:stall`` giving every
+    pool-served query an honest service cost):
+
+    1. *flash crowd* — one tenant floods 10x the base rate for a few
+       seconds. Gates: the burn-rate alert fires AND resolves, the
+       flooding tenant absorbs ~all of the sheds, quiet tenants' p99
+       stays near steady state, and the autosizer grows then shrinks.
+    2. *curve* — stepped Poisson rates over the Zipf tenant mix; the
+       knee (last step with p99 <= 3x base and shed rate <= 1%) is the
+       headline ``load_knee_rps``.
+    3. *A/B* — closed-loop throughput telemetry-on vs -off (the 0.95x
+       overhead gate extended to the admission + SLO + tenant-family
+       instrumentation this issue added).
+
+    Scenario grammar via ``SD_LOAD_SCENARIO``: steady | diurnal |
+    flash-crowd | cold-cache | mid-scan | partitioned-replica (unset
+    runs the full acceptance: flash + steady curve + A/B)."""
+    import random
+    import shutil
+    import threading
+
+    # serving-tier knobs must be pinned BEFORE the node boots: the pool
+    # reads worker/autosizer config at construction, the admission
+    # budget at Node.__init__, the SLO engine at alerts start. Every
+    # setdefault stays operator-overridable.
+    stall_s = float(os.environ.get("SD_LOAD_STALL_S", "0.012"))
+    os.environ.setdefault("SD_FAULT_STALL_S", str(stall_s))
+    os.environ.setdefault("SD_SERVE_WORKERS", "3")
+    os.environ.setdefault("SD_SERVE_WORKERS_MIN", "3")
+    os.environ.setdefault("SD_SERVE_WORKERS_MAX", "6")
+    os.environ.setdefault("SD_SERVE_HEALTH_S", "0.1")
+    os.environ.setdefault("SD_SERVE_AUTOSIZE_COOLDOWN_S", "0.5")
+    # the admission budget keeps in-flight near pool capacity, so queue
+    # waits under overload are tens of ms, not the 2 s shell default —
+    # the autosizer thresholds must sit inside that regime to see them
+    os.environ.setdefault("SD_SERVE_GROW_WAIT_S", "0.012")
+    os.environ.setdefault("SD_SERVE_SHRINK_WAIT_S", "0.0015")
+    os.environ.setdefault("SD_SERVE_QUEUE_WAIT_S", "0.25")
+    # the budget counts queued + in-service: 8 against a 3-worker floor
+    # leaves a ~5-deep queue under a flood, so tail-of-line waits cross
+    # the SLO threshold (the burn alert must see real badness before
+    # admission flattens it) while the FIFO checkout keeps the worst
+    # wait ~2 service times — inside the quiet-tenant fairness promise
+    os.environ.setdefault("SD_RSPC_BUDGET", "8")
+    os.environ.setdefault("SD_SLO_INTERVAL_S", "0.2")
+
+    n_tenants = int(os.environ.get("SD_LOAD_TENANTS", "200"))
+    rates = [float(r) for r in os.environ.get(
+        "SD_LOAD_RATES", "25,50,100,200,400").split(",")]
+    step_s = float(os.environ.get("SD_LOAD_STEP_S", "3"))
+    scenario = os.environ.get("SD_LOAD_SCENARIO", "")
+    seed = int(os.environ.get("SD_LOAD_SEED", "0"))
+    flash_base_hz = float(os.environ.get("SD_LOAD_FLASH_BASE", "30"))
+    flash_crowd_hz = float(os.environ.get("SD_LOAD_FLASH_CROWD", "600"))
+
+    tmp = Path(tempfile.mkdtemp(prefix="sd_bench_load_"))
+    # bench-local SLO objective tuned to the stall cost: steady-state
+    # pool-served latency is ~stall + dispatch overhead, overload pushes
+    # queued requests past ~2x stall — that is the "bad" the burn-rate
+    # windows integrate. The threshold snaps UP to a histogram bucket
+    # boundary (SLO good counts come from cumulative buckets, so a
+    # between-boundaries threshold silently rounds down). Sub-minute
+    # windows so firing AND resolution both happen inside one bench run.
+    from spacedrive_tpu.telemetry.requests import REQUEST_BUCKETS
+
+    threshold_s = min((b for b in REQUEST_BUCKETS if b >= 1.8 * stall_s),
+                      default=REQUEST_BUCKETS[-1])
+    slo_path = tmp / "slo_objectives.json"
+    slo_path.write_text(json.dumps([{
+        "name": "load-fast", "threshold_s": threshold_s, "target": 0.9,
+        "window_s": 60.0, "fast_windows": [1.0, 3.0],
+        "slow_windows": [2.0, 6.0], "fast_burn": 2.0, "slow_burn": 1.5,
+        "severity": "page",
+        "description": "bench: pool-served reads under ~2x service cost",
+    }]))
+    os.environ.setdefault("SD_SLO_OBJECTIVES", str(slo_path))
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.load_harness import (ClosedLoopRunner, OpenLoopRunner,
+                                    flash_crowd_arrivals, diurnal_arrivals,
+                                    percentile, poisson_arrivals, summarize)
+
+    from spacedrive_tpu import faults, telemetry
+    from spacedrive_tpu.api.router import ApiError, BusyError
+    from spacedrive_tpu.node import Node
+    from spacedrive_tpu.server.shell import Server
+    from spacedrive_tpu.telemetry import slo as _slo
+
+    # honest per-request service cost: every pool-served query sleeps
+    # SD_FAULT_STALL_S inside the worker (the plan is inherited across
+    # the fork), so pool capacity = workers / stall and the 25..400
+    # req/s ramp genuinely saturates it
+    faults.install("serve_worker:stall", seed=seed)
+    telemetry.set_enabled(True)
+    node = None
+    server = None
+    flight: list[dict] = []
+    flight_lock = threading.Lock()
+
+    def _hook(rec: dict) -> None:
+        # the shed flood churns the 256-deep event ring faster than the
+        # bench reads it — capture the gating kinds at the source
+        if rec.get("name") in ("slo.burn", "pool.resize"):
+            with flight_lock:
+                flight.append(dict(rec))
+
+    def _shed_by_tenant() -> dict[str, float]:
+        return {lbl.get("tenant", ""): v for lbl, v in
+                telemetry.series_values("sd_rspc_shed_total")}
+
+    try:
+        node = Node(tmp / "node", probe_accelerator=False,
+                    watch_locations=False)
+        node.thumbnail_remover.stop()
+        libs = [node.libraries.create(f"tenant-{i:03d}")
+                for i in range(n_tenants)]
+        for lib in libs:
+            lib.orphan_remover.stop()
+        lib_ids = [lib.id for lib in libs]
+        # the shell owns the reader pool; dispatch stays in-process so a
+        # shed is a caught BusyError, not HTTP parsing
+        server = Server(node, port=0)
+        server.start()
+        telemetry.add_event_hook(_hook)
+
+        def submit(lib_id: str) -> str:
+            try:
+                node.router.resolve("search.pathsCount", {},
+                                    library_id=lib_id)
+                return "ok"
+            except BusyError:
+                return "shed"
+            except ApiError:
+                return "error"
+
+        runner = OpenLoopRunner(submit, lib_ids, seed=seed)
+        rng = random.Random(seed)
+
+        # -- warmup + steady baseline ---------------------------------
+        runner.run(poisson_arrivals(20.0, 1.0, rng), drain_s=3.0)
+        steady = summarize(runner.run(
+            poisson_arrivals(flash_base_hz, step_s, rng), drain_s=4.0))
+        steady_p99 = steady["p99_s"] or 1e-9
+
+        # -- flash crowd ----------------------------------------------
+        flash = None
+        if scenario in ("", "flash-crowd"):
+            flood_id = lib_ids[0]
+            flood_label = _slo.tenant_label(flood_id)
+            shed_before = _shed_by_tenant()
+            base = [(t, None) for t in poisson_arrivals(
+                flash_base_hz, 17.0, rng)]
+            crowd = [(3.0 + t, flood_id) for t in poisson_arrivals(
+                flash_crowd_hz, 5.0, rng)]
+            schedule = sorted(base + crowd)
+            tenants_for = [t for _, t in schedule]
+            records = runner.run(
+                [s for s, _ in schedule], drain_s=6.0,
+                tenant_for=lambda i: (tenants_for[i]
+                                      if tenants_for[i] is not None
+                                      else runner.picker.pick()))
+            # resolution needs post-crowd good traffic inside the slow
+            # burn windows; keep a trickle until the alert resolves
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                with flight_lock:
+                    burn_states = [e.get("state") for e in flight
+                                   if e.get("name") == "slo.burn"]
+                if "resolved" in burn_states and "firing" in burn_states:
+                    break
+                runner.run(poisson_arrivals(flash_base_hz, 1.0, rng),
+                           drain_s=2.0)
+            shed_delta = {
+                t: v - shed_before.get(t, 0.0)
+                for t, v in _shed_by_tenant().items()
+                if v - shed_before.get(t, 0.0) > 0}
+            total_shed = sum(shed_delta.values())
+            quiet = [r.latency_s for r in records
+                     if r.outcome == "ok" and r.tenant != flood_id]
+            with flight_lock:
+                burn_events = [e for e in flight
+                               if e.get("name") == "slo.burn"]
+                resizes = [e for e in flight
+                           if e.get("name") == "pool.resize"]
+            flash = {
+                "flood_tenant": flood_label,
+                "offered": len(records),
+                "summary": summarize(records),
+                "quiet_p99_s": round(percentile(quiet, 0.99), 6),
+                "steady_p99_s": round(steady_p99, 6),
+                "quiet_within_2x_steady":
+                    percentile(quiet, 0.99) <= 2.0 * steady_p99,
+                "burn_fired": any(e.get("state") == "firing"
+                                  for e in burn_events),
+                "burn_resolved": any(e.get("state") == "resolved"
+                                     for e in burn_events),
+                "shed_total": int(total_shed),
+                "flood_shed_share": round(
+                    shed_delta.get(flood_label, 0.0) / total_shed, 4)
+                    if total_shed else None,
+                "pool_grew": any(e.get("direction") == "grow"
+                                 for e in resizes),
+                "pool_shrank": any(e.get("direction") == "shrink"
+                                   for e in resizes),
+            }
+            # let the autosizer settle back before the curve phase
+            time.sleep(1.0)
+
+        # -- scenario arms riding the curve ---------------------------
+        scan_lib = None
+        if scenario == "mid-scan":
+            from spacedrive_tpu.locations import create_location
+            from spacedrive_tpu.locations.indexer_job import IndexerJob
+            from spacedrive_tpu.objects.file_identifier import (
+                FileIdentifierJob)
+
+            fixture = _ensure_scan_fixture(
+                int(os.environ.get("SD_LOAD_SCAN_FILES", "2000")))
+            scan_lib = node.libraries.create("load-scan")
+            scan_lib.orphan_remover.stop()
+            loc = create_location(scan_lib, str(fixture), hasher="cpu")
+            node.jobs.spawn(
+                scan_lib,
+                [IndexerJob({"location_id": loc["id"]}),
+                 FileIdentifierJob({"location_id": loc["id"]})],
+                action="scan_location")
+        if scenario == "partitioned-replica":
+            from spacedrive_tpu.faults import net as _net
+
+            _net.install(_net.profile_plan(
+                os.environ.get("SD_NET_PLAN", "flaky-wan")))
+
+        # -- the latency-vs-offered-load curve ------------------------
+        curve = []
+        for rate in rates:
+            if scenario == "cold-cache":
+                # bump every tenant's watermark so the pool page cache
+                # re-misses each step (the post-write regime, not the
+                # hot-cache best case)
+                for lib in libs:
+                    lib.emit("db.commit", {"source": "bench.load"})
+            arrivals = (diurnal_arrivals(rate * 2.0, step_s, rng,
+                                         period_s=step_s)
+                        if scenario == "diurnal"
+                        else poisson_arrivals(rate, step_s, rng))
+            step = summarize(runner.run(arrivals, drain_s=4.0))
+            step["rate_hz"] = rate
+            curve.append(step)
+            print(f"info: load step {rate:g}/s -> p50 "
+                  f"{step['p50_s'] * 1000:.1f}ms p99 "
+                  f"{step['p99_s'] * 1000:.1f}ms shed "
+                  f"{step['shed_rate']:.1%}", file=sys.stderr)
+            time.sleep(0.3)
+        if scan_lib is not None:
+            node.jobs.wait_idle(600)
+        if scenario == "partitioned-replica":
+            from spacedrive_tpu.faults import net as _net
+
+            _net.clear()
+
+        # knee vs the steady-phase baseline, not curve[0]: the first step
+        # pays the autosizer's cold grow (the pool shrank during the
+        # settle gap) and its p99 is not the uncongested floor
+        base_p99 = steady_p99
+        knee = None
+        for step in curve:
+            if (step["p99_s"] <= 3.0 * base_p99
+                    and step["shed_rate"] <= 0.01):
+                knee = step["rate_hz"]
+            else:
+                break
+
+        # -- telemetry overhead A/B (closed-loop: fixed concurrency, so
+        # the two sides offer identical pressure and the ratio isolates
+        # the instrumentation) ----------------------------------------
+        ab_s = float(os.environ.get("SD_LOAD_AB_S", "2.0"))
+        # freeze the autosizer for the A/B: with telemetry off the
+        # queue-wait histogram goes dark, the sizing signal reads empty,
+        # and the pool would shrink under exactly one side — the ratio
+        # must compare instrumentation cost on an identical pool
+        if node.reader_pool is not None:
+            node.reader_pool.autosize_cooldown_s = float("inf")
+        closed = ClosedLoopRunner(submit, lib_ids, seed=seed,
+                                  concurrency=4)
+        closed.run(ab_s)  # warmup to steady caches before either side
+
+        def _closed_rps() -> float:
+            return len([r for r in closed.run(ab_s)
+                        if r.outcome == "ok"]) / ab_s
+
+        # interleaved on/off pairs, best of each side: one unlucky
+        # window (GC pause, autosizer tick) must not decide the gate
+        on_rps, off_rps = [], []
+        for _ in range(2):
+            on_rps.append(_closed_rps())
+            telemetry.set_enabled(False)
+            off_rps.append(_closed_rps())
+            telemetry.set_enabled(True)
+        ab_ratio = (round(max(on_rps) / max(off_rps), 4)
+                    if max(off_rps, default=0.0) else None)
+
+        slo_status = node.slo.status() if getattr(node, "slo", None) else []
+        admission = (node.dispatch_budget.status()
+                     if getattr(node, "dispatch_budget", None) else None)
+        pool_status = (node.reader_pool.status()
+                       if getattr(node, "reader_pool", None) else None)
+        record = {
+            "metric": "load_knee_rps",
+            "value": knee if knee is not None else 0.0,
+            "unit": "req/s",
+            "scenario": scenario or "full",
+            "tenants": n_tenants,
+            "stall_s": stall_s,
+            "step_s": step_s,
+            "steady": steady,
+            "curve": curve,
+            "flash": flash,
+            "telemetry_ab_ratio": ab_ratio,
+            "slo": slo_status,
+            "dispatch_admission": admission,
+            "pool": pool_status,
+        }
+        out_path = Path(__file__).resolve().parent / "BENCH_load.json"
+        out_path.write_text(json.dumps(record, indent=2))
+        if flash is not None:
+            _history_extra("load_flood_shed_share",
+                           flash["flood_shed_share"], "ratio")
+        if ab_ratio is not None:
+            _history_extra("load_telemetry_ab", ab_ratio, "x")
+        return record
+    finally:
+        telemetry.remove_event_hook(_hook)
+        faults.clear()
+        if server is not None:
+            server.stop()
+        if node is not None:
+            node.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _history_verdicts(history_path: Path | None = None
+                      ) -> list[dict]:
+    """The regression-sentinel core: for each (mode, metric) series in
+    BENCH_history.jsonl with >= 4 numeric samples, compare the latest
+    value against the median of its trailing window (up to 8
+    predecessors). Outside a generous +/-40% band -> flagged. The wide
+    band is deliberate: history rows span relay-up and relay-down runs,
+    fixture-size changes, and host noise — the sentinel exists to catch
+    step-function regressions the PR author did not notice, not to
+    relitigate every 10% wobble."""
+    import statistics
+
+    path = history_path or (Path(__file__).resolve().parent
+                            / "BENCH_history.jsonl")
+    if not path.exists():
+        return []
+    series: dict[tuple[str, str], list[float]] = {}
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        mode, metric = row.get("mode"), row.get("metric")
+        value = row.get("value")
+        if not mode or not metric or not isinstance(value, (int, float)):
+            continue
+        series.setdefault((str(mode), str(metric)), []).append(float(value))
+    rows = []
+    for (mode, metric), values in sorted(series.items()):
+        latest = values[-1]
+        prior = values[:-1][-8:]
+        if len(prior) < 3:
+            rows.append({"mode": mode, "metric": metric, "latest": latest,
+                         "median": None, "ratio": None, "verdict": "n/a",
+                         "samples": len(values)})
+            continue
+        med = statistics.median(prior)
+        ratio = latest / med if med else None
+        verdict = ("ok" if ratio is not None and 0.6 <= ratio <= 1.4
+                   else "drift")
+        rows.append({"mode": mode, "metric": metric, "latest": latest,
+                     "median": round(med, 6),
+                     "ratio": round(ratio, 4) if ratio is not None else None,
+                     "verdict": verdict, "samples": len(values)})
+    return rows
+
+
+def _print_history_verdicts(rows: list[dict]) -> None:
+    if not rows:
+        print("check-history: no BENCH_history.jsonl series to check",
+              file=sys.stderr)
+        return
+    w_mode = max(len(r["mode"]) for r in rows)
+    w_metric = max(len(r["metric"]) for r in rows)
+    print(f"{'mode':<{w_mode}}  {'metric':<{w_metric}}  "
+          f"{'latest':>12}  {'median':>12}  {'ratio':>7}  verdict",
+          file=sys.stderr)
+    for r in rows:
+        med = "-" if r["median"] is None else f"{r['median']:>12.4g}"
+        ratio = "-" if r["ratio"] is None else f"{r['ratio']:>7.3f}"
+        print(f"{r['mode']:<{w_mode}}  {r['metric']:<{w_metric}}  "
+              f"{r['latest']:>12.4g}  {med:>12}  {ratio:>7}  "
+              f"{r['verdict']}", file=sys.stderr)
+
+
+def bench_check_history() -> dict:
+    """``--check-history`` (ISSUE 20): the perf-trajectory sentinel.
+    Prints the per-(mode, metric) verdict table and emits a
+    ``history_drift`` record counting out-of-band series. Always a
+    sentinel, never a gate: the exit code stays 0 — a human (or the PR
+    description) decides whether a flagged drift is a regression or an
+    intentional change riding a fixture/knob edit."""
+    rows = _history_verdicts()
+    _print_history_verdicts(rows)
+    flagged = [r for r in rows if r["verdict"] == "drift"]
+    return {
+        "metric": "history_drift",
+        "value": len(flagged),
+        "unit": "series",
+        "checked": len(rows),
+        "flagged": flagged,
+    }
 
 
 def bench_crash() -> dict:
@@ -2484,6 +2942,9 @@ def main() -> int:
     platform = ("cpu(fleet: no device work)" if MODE == "fleet"
                 else "cpu(crash: no device work)" if MODE == "crash"
                 else "cpu(serve: no device work)" if MODE == "serve"
+                else "cpu(load: no device work)" if MODE == "load"
+                else "cpu(check_history: no device work)"
+                if MODE == "check_history"
                 else _guard_device_init())
     # opportunistic recapture: the combined suite runs for many minutes on
     # the CPU fallback — keep watching the relay in the background and, if
@@ -2516,6 +2977,10 @@ def main() -> int:
         record = bench_crash()
     elif MODE == "serve":
         record = bench_serve_wan() if WAN_PROFILE else bench_serve()
+    elif MODE == "load":
+        record = bench_load()
+    elif MODE == "check_history":
+        record = bench_check_history()
     elif MODE == "search":
         record = bench_search()
     elif MODE == "dedup_1m":
@@ -2550,6 +3015,13 @@ def main() -> int:
                     json.loads(out.stdout.strip().splitlines()[-1]))
             except Exception as e:
                 print(f"warn: {sub_mode} bench skipped: {e}", file=sys.stderr)
+        # regression sentinel, warn-only (satellite of ISSUE 20): the
+        # combined run ends with the trajectory verdict table so drift
+        # is visible in every full bench log without gating it
+        try:
+            _print_history_verdicts(_history_verdicts())
+        except Exception as e:
+            print(f"warn: check-history skipped: {e}", file=sys.stderr)
     if watcher is not None:
         watcher.stop()  # instant while idle-polling; 5s grace otherwise
         if watcher.capturing:
@@ -2567,7 +3039,7 @@ def main() -> int:
             record["device_recapture"] = str(watcher.out_path)
             print(f"info: relay recovered mid-run — device suite captured "
                   f"to {watcher.out_path}", file=sys.stderr)
-    if MODE in ("fleet", "serve"):
+    if MODE in ("fleet", "serve", "load", "check_history"):
         # CPU-only by design: no device metrics exist to caveat
         record["platform"] = platform
     elif platform != "device":

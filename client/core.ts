@@ -76,10 +76,34 @@ export interface SlowRequestEntry {
 /** Multi-process reader-pool state (telemetry.requestStats.serve_pool);
  * null while the node serves in the degraded in-process mode. */
 export interface ServePoolStatus {
-  workers: number; alive: number; idle: number; enabled: boolean;
-  running: boolean; restarts: number; failovers: number;
+  workers: number; min_workers: number; max_workers: number;
+  alive: number; idle: number; enabled: boolean;
+  running: boolean; restarts: number; resizes: number; failovers: number;
   cache_hits: number; cache_misses: number; watermarks: number;
   per_worker: Record<string, Record<string, number>>
+}
+/** One SLO objective with live state (telemetry.sloStatus). `burn` maps
+ * window labels ("5m", "1h", ...) to burn-rate multiples of the
+ * error-budget spend rate; `firing` the AND-gated fast/slow pair state. */
+export interface SloObjectiveStatus {
+  name: string; threshold_s: number; target: number; window_s: number;
+  proc: string | null; tenant: string | null;
+  fast_windows: number[]; slow_windows: number[];
+  fast_burn: number; slow_burn: number; severity: string;
+  description: string; sli: number | null; good: number; valid: number;
+  budget_remaining: number; burn: Record<string, number>;
+  firing: Record<string, boolean>
+}
+/** rspc dispatch-admission budget state (telemetry.sloStatus);
+ * null when SD_RSPC_ADMISSION=0 turned the gate off. */
+export interface DispatchAdmissionStatus {
+  budget_inflight: number; in_flight: number; tenants_in_flight: number;
+  shed: number
+}
+/** telemetry.sloStatus: SLO engine + admission state (ISSUE 20). */
+export interface SloStatus {
+  objectives: SloObjectiveStatus[];
+  dispatch_admission: DispatchAdmissionStatus | null
 }
 /** telemetry.requestStats: the serving-tier observability surface. */
 export interface RequestStats {
@@ -160,6 +184,7 @@ export type Procedures = {
 	{ key: "telemetry.alerts", input: null, result: { rules: AlertRuleState[] } } |
 	{ key: "telemetry.jobTrace", input: string | { job_id: string }, result: Record<string, unknown> | null } |
 	{ key: "telemetry.requestStats", input: { slow_limit?: number } | null, result: RequestStats } |
+	{ key: "telemetry.sloStatus", input: null, result: SloStatus } |
 	{ key: "telemetry.snapshot", input: null, result: Record<string, unknown> } |
 	{ key: "volumes.list", input: null, result: Record<string, unknown>[] },
   mutations:
@@ -403,6 +428,7 @@ export type NodeProcedureKey =
 	"telemetry.alerts" |
 	"telemetry.jobTrace" |
 	"telemetry.requestStats" |
+	"telemetry.sloStatus" |
 	"telemetry.snapshot" |
 	"telemetry.watch" |
 	"toggleFeatureFlag" |
@@ -553,6 +579,7 @@ export const procedures = {
 	"telemetry.alerts": { kind: "query", scope: "node" },
 	"telemetry.jobTrace": { kind: "query", scope: "node" },
 	"telemetry.requestStats": { kind: "query", scope: "node" },
+	"telemetry.sloStatus": { kind: "query", scope: "node" },
 	"telemetry.snapshot": { kind: "query", scope: "node" },
 	"telemetry.watch": { kind: "subscription", scope: "node" },
 	"toggleFeatureFlag": { kind: "mutation", scope: "node" },

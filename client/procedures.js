@@ -574,6 +574,10 @@ window.SD_PROCEDURES = {
   "kind": "query",
   "scope": "node"
  },
+ "telemetry.sloStatus": {
+  "kind": "query",
+  "scope": "node"
+ },
  "telemetry.snapshot": {
   "kind": "query",
   "scope": "node"

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from ..engine import AnalysisPass
 from .async_blocking import AsyncBlockingPass
+from .cardinality_discipline import CardinalityDisciplinePass
 from .commit_discipline import CommitDisciplinePass
 from .durability_discipline import DurabilityDisciplinePass
 from .hold_blocking import HoldBlockingPass
@@ -45,6 +46,7 @@ REGISTRY: tuple[type[AnalysisPass], ...] = (
     CommitDisciplinePass,
     RetryDisciplinePass,
     TelemetryDisciplinePass,
+    CardinalityDisciplinePass,
     QueueDisciplinePass,
     DurabilityDisciplinePass,
     QueryDisciplinePass,

@@ -1,0 +1,167 @@
+"""cardinality-discipline: label values on telemetry families must be
+drawn from bounded sets.
+
+ISSUE 20 satellite. The registry caps label *names* at declaration, but
+nothing stops a record site from feeding an unbounded *value* — a file
+path, a UUID, an error string — into ``family.inc(peer=...)``, and one
+such site grows the registry (and every scrape) without limit. The SLO
+engine's per-tenant families made the discipline load-bearing: tenant
+labels are bounded only because ``slo.tenant_label`` LRU-caps them.
+
+Scoped to the production subsystems (jobs|sync|p2p|server|api). Within
+a file, a *metric handle* is any name assigned from a
+``<module>.counter/gauge/histogram(...)`` call; every keyword argument
+on a ``handle.inc/set/observe/labels(...)`` call is a label value and
+must be **bounded**:
+
+- a string literal (closed literal sets: ``outcome="ok"``);
+- a conditional/boolean of bounded parts (``"hit" if ok else "miss"``);
+- ``str(x)`` of a name/attribute/literal (small-int enums:
+  ``lane=str(i)``, ``worker=str(slot)``);
+- a call to a bounding helper — a function whose name ends in
+  ``peer_label`` / ``tenant_label`` / ``route_class`` (the hash-capped
+  and whitelist helpers);
+- an attribute whose name is UPPERCASE (class-constant registries:
+  ``job.NAME``, ``job.LANE``), contains ``label`` (a value that was
+  already bounded at construction), or is ``slot`` (pool slot indices);
+- a name whose in-file bindings are all bounded, or that has no in-file
+  binding at all (parameters and loop targets are the *caller's*
+  contract — the pass checks record sites, not whole-program flow).
+
+Anything else — f-strings, concatenation, ``.format``, arbitrary calls,
+subscripts — is flagged. Genuine closed sets the rules cannot see get
+an explicit ``# lint: ok(cardinality-discipline)`` waiver on the line,
+which the waiver ledger keeps auditable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisPass, FileContext, Finding, dotted_name
+
+SCOPED_DIRS = ("jobs", "sync", "p2p", "server", "api")
+
+#: factory methods that mint a metric handle (same set as
+#: telemetry-discipline's vocabulary rule)
+METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+
+#: handle methods whose keyword arguments are label values
+RECORD_METHODS = frozenset({"inc", "set", "observe", "labels"})
+
+#: helper-name suffixes that bound their return value by construction
+BOUNDING_SUFFIXES = ("peer_label", "tenant_label", "route_class")
+
+#: attribute names that carry an already-bounded value
+BOUNDED_ATTRS = frozenset({"slot"})
+
+
+def _metric_handles(tree: ast.Module) -> set[str]:
+    """Names assigned (anywhere in the file) from a
+    ``<module>.counter/gauge/histogram(...)`` call."""
+    handles: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        chain = dotted_name(value.func)
+        if chain is None or "." not in chain:
+            continue
+        if chain.rsplit(".", 1)[-1] not in METRIC_FACTORIES:
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                handles.add(target.id)
+    return handles
+
+
+def _name_bindings(tree: ast.Module) -> dict[str, list[ast.expr]]:
+    """name -> every expression a plain ``name = expr`` assigns in the
+    file (coarse, flow-insensitive — like the timer-name collection in
+    telemetry-discipline)."""
+    bindings: dict[str, list[ast.expr]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                bindings.setdefault(target.id, []).append(node.value)
+    return bindings
+
+
+class _Boundedness:
+    def __init__(self, bindings: dict[str, list[ast.expr]]) -> None:
+        self.bindings = bindings
+        self._visiting: set[str] = set()
+
+    def bounded(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, (str, int, bool))
+        if isinstance(node, ast.IfExp):
+            return self.bounded(node.body) and self.bounded(node.orelse)
+        if isinstance(node, ast.BoolOp):
+            return all(self.bounded(v) for v in node.values)
+        if isinstance(node, ast.Attribute):
+            return (node.attr.isupper()
+                    or "label" in node.attr.lower()
+                    or node.attr in BOUNDED_ATTRS)
+        if isinstance(node, ast.Name):
+            exprs = self.bindings.get(node.id)
+            if not exprs:
+                # parameter / loop target / comprehension variable: the
+                # value is the caller's contract, not this site's
+                return True
+            if node.id in self._visiting:
+                return True  # self-referential rebind (x = x or "d")
+            self._visiting.add(node.id)
+            try:
+                return all(self.bounded(e) for e in exprs)
+            finally:
+                self._visiting.discard(node.id)
+        if isinstance(node, ast.Call):
+            chain = dotted_name(node.func) or ""
+            if chain.endswith(BOUNDING_SUFFIXES):
+                return True
+            if chain == "str" and len(node.args) == 1 and isinstance(
+                    node.args[0], (ast.Name, ast.Attribute, ast.Constant)):
+                return True
+            return False
+        return False
+
+
+class CardinalityDisciplinePass(AnalysisPass):
+    id = "cardinality-discipline"
+    description = ("label values recorded on telemetry families in "
+                   "jobs|sync|p2p|server|api must come from bounded sets "
+                   "(literals, UPPERCASE registries, *_label helpers) — "
+                   "an unbounded label value grows the registry forever")
+
+    def run(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.in_dirs(*SCOPED_DIRS):
+            return
+        handles = _metric_handles(ctx.tree)
+        if not handles:
+            return
+        check = _Boundedness(_name_bindings(ctx.tree))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr in RECORD_METHODS
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in handles):
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue  # **labels splat: the dict's builder owns it
+                if not check.bounded(kw.value):
+                    yield ctx.finding(
+                        kw.value.lineno, self.id,
+                        f"label {kw.arg!r} on {func.value.id}.{func.attr} "
+                        f"is not drawn from a bounded set — hash/cap it "
+                        f"(slo.tenant_label, mesh.peer_label) or waive "
+                        f"with a comment explaining the bound")

@@ -24,6 +24,7 @@ from typing import TYPE_CHECKING, Any, Callable
 
 from .. import faults
 from ..telemetry import requests as _requests
+from ..telemetry import slo as _slo
 
 if TYPE_CHECKING:
     from ..events import Subscription
@@ -41,6 +42,17 @@ class ApiError(Exception):
     def __init__(self, message: str, code: int = 400) -> None:
         super().__init__(message)
         self.code = code
+
+
+class BusyError(ApiError):
+    """429: admission control shed this dispatch (ISSUE 20). Carries the
+    pressure-scaled ``retry_after_ms`` the client should back off for.
+    Request telemetry classifies this (by type name) as outcome ``shed``
+    — deliberate load management, excluded from SLO error ratios."""
+
+    def __init__(self, message: str, retry_after_ms: int = 0) -> None:
+        super().__init__(message, code=429)
+        self.retry_after_ms = int(retry_after_ms)
 
 
 class RawJson:
@@ -168,6 +180,10 @@ class Router:
         proc = self._proc(key)
         if proc.kind == SUBSCRIPTION:
             raise ApiError(f"{key} is a subscription; use subscribe()")
+        # bounded tenant class for per-tenant telemetry + fair-share
+        # admission (ISSUE 20): an 8-hex library-id hash, "local" for
+        # node-scoped dispatches
+        tenant = _slo.tenant_label(library_id)
 
         def dispatch() -> Any:
             # latency/failure chaos for the serving tier (`rspc:stall`,
@@ -175,6 +191,30 @@ class Router:
             # slowness lands in the histograms and the slow ring exactly
             # like organic slowness
             faults.inject("rspc", key=key)
+            # admission at dispatch (ISSUE 20): the IngestBudget shape
+            # applied to the serving tier — shed with an explicit 429 +
+            # retry-after instead of queueing unboundedly. telemetry.*
+            # stays exempt: observability must survive the overload it
+            # exists to narrate.
+            admission = None
+            budget = getattr(self.node, "dispatch_budget", None)
+            if budget is not None and not key.startswith("telemetry."):
+                from ..sync.admission import Busy
+
+                verdict = budget.try_admit(tenant)
+                if isinstance(verdict, Busy):
+                    raise BusyError(
+                        f"{key}: {verdict.reason}; retry after "
+                        f"{verdict.retry_after_ms} ms",
+                        retry_after_ms=verdict.retry_after_ms)
+                admission = verdict
+            try:
+                return _dispatch_admitted()
+            finally:
+                if admission is not None:
+                    admission.release()
+
+        def _dispatch_admitted() -> Any:
             if proc.scope == "library":
                 library = self._library(library_id)
             pool = getattr(self.node, "reader_pool", None)
@@ -214,7 +254,7 @@ class Router:
                 return proc.fn(self.node, library, arg)
             return proc.fn(self.node, arg)
 
-        result = _requests.observed(key, proc.kind, dispatch)
+        result = _requests.observed(key, proc.kind, dispatch, tenant=tenant)
         if isinstance(result, RawJson) and not raw:
             return result.decode()
         return result

@@ -13,6 +13,9 @@ alert evaluator.
   edges) live over the websocket; the SSE twin is ``GET
   /telemetry/stream`` on the shell.
 - ``telemetry.alerts`` — every alert rule with its live firing state.
+- ``telemetry.sloStatus`` — the SLO engine's objectives with live SLI,
+  error-budget remaining and multi-window burn rates (ISSUE 20), plus
+  the rspc dispatch-admission budget state.
 """
 
 from __future__ import annotations
@@ -48,6 +51,19 @@ def mount(router) -> None:
         """The SLO/alert rule set with live state (telemetry/alerts.py)."""
         evaluator = getattr(node, "alerts", None)
         return {"rules": evaluator.state() if evaluator is not None else []}
+
+    @router.query("telemetry.sloStatus")
+    def slo_status(node, _arg):
+        """SLO objectives with live SLI / error budget / burn rates
+        (telemetry/slo.py), plus dispatch-admission budget state — the
+        serving tier's "are we inside our promises" page (ISSUE 20)."""
+        engine = getattr(node, "slo", None)
+        budget = getattr(node, "dispatch_budget", None)
+        return {
+            "objectives": engine.status() if engine is not None else [],
+            "dispatch_admission":
+                budget.status() if budget is not None else None,
+        }
 
     @router.query("telemetry.requestStats")
     def request_stats(node, arg):

@@ -108,6 +108,17 @@ class Node:
         from .sync.admission import IngestBudget
 
         self.ingest_budget = IngestBudget()
+        # admission at rspc dispatch (ISSUE 20): the same fair-share
+        # budget shape applied to the serving tier — the router sheds
+        # over-budget dispatches with a 429 + retry-after instead of
+        # queueing them unboundedly. SD_RSPC_ADMISSION=0 turns the gate
+        # off (every dispatch admitted, e.g. for A/B benches).
+        if os.environ.get("SD_RSPC_ADMISSION", "1") not in ("0", "off"):
+            from .sync.admission import DispatchBudget
+
+            self.dispatch_budget = DispatchBudget()
+        else:
+            self.dispatch_budget = None
         try:
             from .crypto.keymanager import KeyManager
 
@@ -228,6 +239,15 @@ class Node:
             notify=_alert_notify)
         self.alerts.start()
 
+        # SLO engine (ISSUE 20): error-budget + multi-window burn rates
+        # over the request/tenant families, narrated as `slo.burn` events
+        # next to the alert evaluator's edges. Same ticker discipline.
+        from .telemetry.slo import SloEngine
+
+        self.slo = SloEngine(
+            interval_s=float(os.environ.get("SD_SLO_INTERVAL_S", "5")))
+        self.slo.start()
+
         # serving-tier observability (ISSUE 10): the process resource
         # watcher always runs (cheap slow ticker — sd_proc_* gauges plus
         # the request-p99 gauges the alert rules read); the span-tagged
@@ -294,6 +314,7 @@ class Node:
         from . import telemetry
 
         self.alerts.stop()
+        self.slo.stop()
         self.resources.stop()
         if self.profiler is not None:
             self.profiler.stop()

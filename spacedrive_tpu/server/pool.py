@@ -50,12 +50,12 @@ import multiprocessing
 import os
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from pathlib import Path
 from typing import TYPE_CHECKING, Any
 
 from .. import faults, telemetry
-from ..telemetry.registry import REQUEST_BUCKETS
+from ..telemetry.registry import REQUEST_BUCKETS, estimate_quantiles
 # knob parses that can never take the pool down (malformed → default);
 # hoisted to utils so the search engine shares one implementation
 from ..utils import env_float as _env_float
@@ -92,6 +92,10 @@ _RESTARTS = telemetry.counter("sd_serve_worker_restarts_total",
                               labels=("worker", "reason"))
 _LIVE = telemetry.gauge("sd_serve_workers")
 _INVALIDATIONS = telemetry.counter("sd_serve_invalidations_total")
+_QUEUE_WAIT = telemetry.histogram("sd_serve_queue_wait_seconds",
+                                  buckets=REQUEST_BUCKETS)
+_RESIZES = telemetry.counter("sd_serve_pool_resizes_total",
+                             labels=("direction",))
 
 
 class PoolUnavailable(Exception):
@@ -373,6 +377,9 @@ class ReaderPool:
         self._ctx = multiprocessing.get_context("fork")
         self._slots: list[_Worker | None] = [None] * self.workers
         self._idle: list[_Worker] = []
+        # FIFO checkout tickets: bounded by the number of concurrently
+        # dispatching threads (each waiter holds exactly one ticket)
+        self._tickets: deque[object] = deque()
         self._cv = threading.Condition()
         self._wm_lock = SdLock("serve.pool.watermarks")
         self._watermarks: dict[str, int] = {}
@@ -391,6 +398,27 @@ class ReaderPool:
         self.request_timeout_s = _env_float("SD_SERVE_REQUEST_TIMEOUT_S",
                                             30.0)
         self.queue_wait_s = _env_float("SD_SERVE_QUEUE_WAIT_S", 2.0)
+        # autosizer (ISSUE 20): resize between SD_SERVE_WORKERS_MIN/MAX
+        # driven by the windowed queue-wait p95 the checkouts record.
+        # Both default to the configured worker count, so the pool stays
+        # fixed-size unless an operator opens a range.
+        self.min_workers = max(1, _env_int("SD_SERVE_WORKERS_MIN",
+                                           self.workers))
+        self.max_workers = max(self.min_workers,
+                               _env_int("SD_SERVE_WORKERS_MAX",
+                                        self.workers))
+        self.workers = min(max(self.workers, self.min_workers),
+                           self.max_workers)
+        self._slots = [None] * self.workers
+        self.autosize_cooldown_s = _env_float("SD_SERVE_AUTOSIZE_COOLDOWN_S",
+                                              max(2.0, 2 * self.health_s))
+        self.grow_wait_s = _env_float("SD_SERVE_GROW_WAIT_S", 0.05)
+        self.shrink_wait_s = _env_float("SD_SERVE_SHRINK_WAIT_S", 0.005)
+        #: previous queue-wait bucket snapshot (windowed p95, the
+        #: _P99_PREV pattern from telemetry/requests.py)
+        self._qw_prev: list[int] | None = None
+        self._last_resize = time.monotonic()
+        self._resizes = 0
 
     @classmethod
     def maybe_start(cls, node: "Node") -> "ReaderPool | None":
@@ -610,24 +638,53 @@ class ReaderPool:
         # the in-process path in ~a health interval keeps tail latency
         # bounded — parking for the full 30 s request budget would invert
         # the degradation ladder under exactly the overload it exists for
-        deadline = time.monotonic() + self.queue_wait_s
+        t0 = time.monotonic()
+        deadline = t0 + self.queue_wait_s
+        # FIFO ticketing: a bare condvar race lets late arrivals barge —
+        # a freed worker goes to whichever dispatcher re-acquires the
+        # lock first, and under a sustained burst an unlucky waiter can
+        # lose every race until it spills at the deadline (measured as
+        # the multi-tenant flood's quiet-tenant p99 collapsing to the
+        # spill timeout). Tickets make the wait bound deterministic:
+        # depth-ahead x service time, head of line served first.
+        ticket = object()
         with self._cv:
-            while True:
-                if not (self._running and self._enabled):
-                    raise PoolUnavailable("pool stopping")
-                if self._idle:
-                    return self._idle.pop()
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise PoolUnavailable("pool saturated")
-                self._cv.wait(timeout=remaining)
+            self._tickets.append(ticket)
+            try:
+                while True:
+                    if not (self._running and self._enabled):
+                        raise PoolUnavailable("pool stopping")
+                    if self._idle and self._tickets[0] is ticket:
+                        _QUEUE_WAIT.observe(time.monotonic() - t0)
+                        return self._idle.pop()
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        # the saturation spill records its full wait too —
+                        # the autosizer's grow signal must see exactly the
+                        # overload that is spilling dispatches in-process
+                        _QUEUE_WAIT.observe(time.monotonic() - t0)
+                        raise PoolUnavailable("pool saturated")
+                    self._cv.wait(timeout=remaining)
+            finally:
+                try:
+                    self._tickets.remove(ticket)
+                except ValueError:
+                    pass
+                if self._idle and self._tickets:
+                    # served or abandoned with capacity free: the new
+                    # head may already be parked — wake the line
+                    self._cv.notify_all()
 
     def _checkin(self, worker: _Worker) -> None:
         with self._cv:
             if worker.dead or self._slots[worker.slot] is not worker:
                 return
             self._idle.append(worker)
-            self._cv.notify()
+            # notify_all, not notify: only the head ticket may take the
+            # worker, and a single notify can land on a non-head waiter
+            # (which re-parks), leaving the head asleep until its
+            # timeout poll
+            self._cv.notify_all()
 
     # -- supervision ---------------------------------------------------------
     def _spawn(self, slot: int) -> None:
@@ -651,7 +708,7 @@ class ReaderPool:
                 worker = _Worker(slot, proc, parent_conn, self._generation)
                 self._slots[slot] = worker
                 self._idle.append(worker)
-                self._cv.notify()
+                self._cv.notify_all()  # the head ticket must see it
                 installed = True
             live = float(sum(1 for w in self._slots
                              if w is not None and w.proc.is_alive()))
@@ -733,6 +790,93 @@ class ReaderPool:
                     logger.warning("worker %d respawn failed: %s", slot, e)
                     break
             self._ping_idle_workers()
+            try:
+                self._autosize()
+            except Exception:
+                # a resize must never take the supervisor down with it
+                logger.exception("pool autosize failed")
+
+    def _autosize(self) -> None:
+        """One autosizer decision per supervisor tick (ISSUE 20): grow
+        when the windowed queue-wait p95 says dispatches are parking
+        behind busy workers, shrink when the pool is comfortably idle.
+        Inactive unless an operator opened a SD_SERVE_WORKERS_MIN/MAX
+        range — both default to the configured count."""
+        if self.max_workers <= self.min_workers or not self._running:
+            return
+        now = time.monotonic()
+        if now - self._last_resize < self.autosize_cooldown_s:
+            return
+        counts = None
+        for _labels, series in _QUEUE_WAIT.series_items():
+            counts, _total, _n = series.read()
+            break
+        if counts is None:
+            return
+        prev = self._qw_prev or [0] * len(counts)
+        window = [c - p for c, p in zip(counts, prev)]
+        self._qw_prev = counts
+        if sum(window) > 0:
+            p95 = estimate_quantiles(_QUEUE_WAIT.buckets, window,
+                                     qs=(0.95,))[0.95]
+        else:
+            # no checkouts at all since the last tick: the strongest
+            # possible shrink signal, not a missing one
+            p95 = 0.0
+        if p95 > self.grow_wait_s and self.workers < self.max_workers:
+            self._resize("grow", p95)
+        elif p95 < self.shrink_wait_s and self.workers > self.min_workers:
+            self._resize("shrink", p95)
+
+    def _resize(self, direction: str, p95: float) -> None:
+        if direction == "grow":
+            with self._cv:
+                if not self._running or self.workers >= self.max_workers:
+                    return
+                slot = self.workers
+                self._slots.append(None)
+                self.workers += 1
+            try:
+                self._spawn(slot)  # forks outside the pool lock
+            except Exception as e:
+                # slot stays empty; the supervisor's respawn sweep retries
+                logger.warning("grown worker %d spawn failed: %s", slot, e)
+        else:
+            with self._cv:
+                if self.workers <= self.min_workers:
+                    return
+                slot = self.workers - 1
+                w = self._slots[slot]
+                if w is None or w not in self._idle:
+                    # only an IDLE top slot may be removed — a checked-out
+                    # worker's dispatcher indexes _slots by slot number,
+                    # so the list may never shrink under it. Busy top
+                    # slot: try again next tick.
+                    return
+                self._idle.remove(w)
+                self._slots.pop()
+                self.workers -= 1
+                w.dead = True
+                _LIVE.set(float(sum(1 for x in self._slots
+                                    if x is not None and x.proc.is_alive())))
+            try:
+                w.conn.send({"ctl": "shutdown"})
+            except (BrokenPipeError, OSError):
+                pass
+            try:
+                w.conn.close()
+            except OSError:
+                pass
+        with self._wm_lock:  # int += is not atomic across threads
+            self._resizes += 1
+        self._last_resize = time.monotonic()
+        _RESIZES.inc(direction=direction)
+        telemetry.event("pool.resize", direction=direction,
+                        workers=self.workers,
+                        queue_wait_p95_ms=round(p95 * 1000.0, 2),
+                        min=self.min_workers, max=self.max_workers)
+        logger.info("pool %s -> %d workers (queue-wait p95 %.1f ms)",
+                    direction, self.workers, p95 * 1000.0)
 
     def _ping_idle_workers(self) -> None:
         with self._wm_lock:
@@ -771,11 +915,14 @@ class ReaderPool:
             idle = len(self._idle)
         return {
             "workers": self.workers,
+            "min_workers": self.min_workers,
+            "max_workers": self.max_workers,
             "alive": alive,
             "idle": idle,
             "enabled": self._enabled,
             "running": self._running,
             "restarts": self._restarts,
+            "resizes": self._resizes,
             "failovers": self._failovers,
             # instance counters, NOT the process-global _CACHE family: a
             # restarted shell's fresh pool must report its own traffic,

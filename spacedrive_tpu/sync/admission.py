@@ -1,4 +1,4 @@
-"""Admission control for the CRDT receive path: shed, don't buffer.
+"""Admission control: shed, don't buffer — for sync ingest AND rspc dispatch.
 
 Before this module the ingest side accepted every window a peer handed it
 and queued the work behind one serialized lane — overload meant unbounded
@@ -26,6 +26,20 @@ The ``sync_ingest`` fault seam lives at the admission check: an armed
 ``sync_ingest:overload`` rule sheds windows exactly as a real over-budget
 node would, which is how the fleet chaos soak exercises the whole
 BUSY/backoff/resume loop deterministically.
+
+ISSUE 20 extends the same shape to the SERVING tier:
+:class:`DispatchBudget` bounds concurrent rspc dispatches per node,
+keyed by **tenant** (the bounded library-id hash from
+``telemetry/slo.py tenant_label``) instead of peer. Identical fairness
+algebra — a tenant under its fair share (budget ÷ tenants in flight)
+with nothing in flight is never shed, so a flooding tenant absorbs the
+shedding while quiet tenants keep their latency — and an identical
+pressure-scaled ``retry_after_ms``. The router turns a
+:class:`Busy` verdict into a ``BusyError`` (HTTP 429) which request
+telemetry classifies as outcome ``shed``, excluded from SLO error
+ratios: admission control is load management, not an outage. The
+``rspc_admission`` fault seam sheds dispatches deterministically for
+chaos runs, mirroring ``sync_ingest``.
 """
 
 from __future__ import annotations
@@ -45,6 +59,13 @@ DEFAULT_BUDGET_BYTES = int(os.environ.get("SD_SYNC_INGEST_BUDGET_BYTES",
 #: how far over budget the node is
 BASE_RETRY_AFTER_MS = int(os.environ.get("SD_SYNC_RETRY_AFTER_MS", "200"))
 
+#: default max concurrent rspc dispatches (DispatchBudget); generous —
+#: the point is bounding queue collapse under open-loop overload, not
+#: throttling healthy traffic
+DEFAULT_DISPATCH_INFLIGHT = 64
+#: what a shed rspc client is told to wait (ms), scaled by pressure
+BASE_DISPATCH_RETRY_AFTER_MS = 50
+
 _SHED_WINDOWS = telemetry.counter(
     "sd_sync_shed_windows_total",
     "ingest windows answered BUSY instead of buffered", labels=("peer",))
@@ -62,6 +83,10 @@ _BUDGET_OPS = telemetry.gauge(
     "sd_sync_admission_budget_ops", "configured ingest budget (ops)")
 _BUDGET_BYTES = telemetry.gauge(
     "sd_sync_admission_budget_bytes", "configured ingest budget (bytes)")
+# dispatch-admission families (help text lives in _declare_core)
+_D_SHED = telemetry.counter("sd_rspc_shed_total", labels=("tenant",))
+_D_INFLIGHT = telemetry.gauge("sd_rspc_admission_in_flight")
+_D_BUDGET = telemetry.gauge("sd_rspc_admission_budget")
 
 
 @dataclass(frozen=True)
@@ -215,4 +240,104 @@ class IngestBudget:
                 "peers_in_flight": len(self._per_peer),
                 "shed_windows": self._shed_windows,
                 "shed_ops": self._shed_ops,
+            }
+
+
+class DispatchBudget:
+    """The IngestBudget shape at the rspc dispatch seam (ISSUE 20):
+    bounded CONCURRENT dispatches per node, keyed by tenant.
+
+    One unit of budget = one in-flight dispatch (``ops=1`` on the shared
+    :class:`Admission` token). Fairness is IngestBudget's verbatim: a
+    tenant under its fair share (budget ÷ tenants in flight) with
+    nothing in flight is never shed — only the hard global bound sheds a
+    tenant that already holds in-flight work. ``Node.dispatch_budget``
+    holds one instance; the router admits every non-telemetry dispatch
+    through it (telemetry.* stays exempt — observability must survive
+    the overload it exists to narrate)."""
+
+    def __init__(self, max_inflight: int | None = None) -> None:
+        if max_inflight is None:
+            # read at construction, not import: bench/tests retune via
+            # env between Node boots (the ReaderPool knob pattern)
+            try:
+                max_inflight = int(os.environ.get(
+                    "SD_RSPC_BUDGET", str(DEFAULT_DISPATCH_INFLIGHT)))
+            except ValueError:
+                max_inflight = DEFAULT_DISPATCH_INFLIGHT
+        self.max_inflight = max(1, int(max_inflight))
+        try:
+            self.base_retry_after_ms = int(os.environ.get(
+                "SD_RSPC_RETRY_AFTER_MS",
+                str(BASE_DISPATCH_RETRY_AFTER_MS)))
+        except ValueError:
+            self.base_retry_after_ms = BASE_DISPATCH_RETRY_AFTER_MS
+        self._lock = SdLock("api.admission.budget")
+        self._inflight = 0
+        #: tenant label -> dispatches currently in flight
+        self._per_tenant: dict[str, int] = {}
+        self._shed = 0
+        _D_BUDGET.set(self.max_inflight)
+
+    # -- admission -----------------------------------------------------------
+    def try_admit(self, tenant: str) -> Admission | Busy:
+        """Admit one dispatch for ``tenant`` or return a Busy verdict."""
+        try:
+            # chaos seam: an armed rspc_admission rule sheds this dispatch
+            # exactly as a real over-budget node would
+            faults.inject("rspc_admission", key=tenant)
+        except Exception:
+            with self._lock:
+                pressure = self._shed_locked()
+            return self._busy(tenant, pressure, "injected overload")
+        with self._lock:
+            t_inflight = self._per_tenant.get(tenant, 0)
+            active = len(self._per_tenant) + (0 if tenant in self._per_tenant
+                                              else 1)
+            over_global = self._inflight + 1 > self.max_inflight
+            fair = self.max_inflight // max(1, active)
+            under_share = t_inflight + 1 <= max(fair, 1)
+            if over_global and (not under_share or t_inflight > 0):
+                pressure = self._shed_locked()
+                inflight = self._inflight
+            else:
+                self._inflight += 1
+                self._per_tenant[tenant] = t_inflight + 1
+                pressure = None
+                inflight = self._inflight
+        if pressure is not None:
+            return self._busy(tenant, pressure, "over budget")
+        _D_INFLIGHT.set(inflight)
+        return Admission(self, tenant, 1, 0)
+
+    def _shed_locked(self) -> float:
+        self._shed += 1
+        return max(1.0, self._inflight / self.max_inflight)
+
+    def _busy(self, tenant: str, pressure: float, reason: str) -> Busy:
+        _D_SHED.inc(tenant=tenant)
+        telemetry.event("rspc.shed", tenant=tenant, reason=reason)
+        return Busy(retry_after_ms=int(self.base_retry_after_ms * pressure),
+                    reason=reason)
+
+    def _release(self, tenant: str, ops: int, nbytes: int) -> None:
+        # Admission-token callback (the shared token passes its ops=1)
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            t_inflight = max(0, self._per_tenant.get(tenant, 0) - 1)
+            if t_inflight == 0:
+                self._per_tenant.pop(tenant, None)
+            else:
+                self._per_tenant[tenant] = t_inflight
+            inflight = self._inflight
+        _D_INFLIGHT.set(inflight)
+
+    # -- introspection -------------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "budget_inflight": self.max_inflight,
+                "in_flight": self._inflight,
+                "tenants_in_flight": len(self._per_tenant),
+                "shed": self._shed,
             }

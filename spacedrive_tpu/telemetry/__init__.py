@@ -233,8 +233,10 @@ def reset() -> None:
     with _EVENTS_LOCK:
         _EVENTS.clear()
     from . import requests as _requests  # local: requests imports this module
+    from . import slo as _slo  # local: slo imports this module
 
     _requests.clear_slow_requests()
+    _slo.reset_tenant_labels()
     _declare_core()
 
 
@@ -421,6 +423,33 @@ def _declare_core() -> None:
           "estimated p99 of sd_rspc_request_seconds per procedure "
           "(published by the resource-watcher tick; alert target — "
           "histograms are not rule targets)", labels=("proc",))
+    # serve-tier SLO engine (ISSUE 20): bounded-cardinality per-tenant
+    # request families (tenant = 8-hex library-id hash, LRU-capped with an
+    # `other` overflow — telemetry/slo.py tenant_label), the per-objective
+    # SLO gauges the engine publishes, and the rspc dispatch-admission
+    # families (sync/admission.py DispatchBudget holds those handles)
+    counter("sd_rspc_tenant_requests_total",
+            "rspc dispatches per tenant class and outcome (tenant = "
+            "bounded library-id hash; shed = admission-control BUSY, "
+            "excluded from SLO error ratios)",
+            labels=("tenant", "outcome"))
+    histogram("sd_rspc_tenant_request_seconds",
+              "rspc dispatch latency per tenant class",
+              labels=("tenant",), buckets=REQUEST_BUCKETS)
+    gauge("sd_slo_budget_remaining",
+          "error budget remaining per SLO objective over its budget "
+          "window (1 = untouched, 0 = exhausted)", labels=("objective",))
+    gauge("sd_slo_burn_rate",
+          "error-budget burn rate per SLO objective and trailing window "
+          "(1 = burning exactly the sustainable rate)",
+          labels=("objective", "window"))
+    counter("sd_rspc_shed_total",
+            "rspc dispatches answered BUSY by admission control, per "
+            "tenant class", labels=("tenant",))
+    gauge("sd_rspc_admission_in_flight",
+          "rspc dispatches currently admitted by the dispatch budget")
+    gauge("sd_rspc_admission_budget",
+          "configured max concurrent rspc dispatches (SD_RSPC_BUDGET)")
     counter("sd_http_requests_total",
             "HTTP requests served by the shell, by route class and status",
             labels=("route", "status"))
@@ -466,6 +495,14 @@ def _declare_core() -> None:
     gauge("sd_serve_workers", "live reader-pool worker processes")
     counter("sd_serve_invalidations_total",
             "per-library watermark bumps pushed to the worker page caches")
+    histogram("sd_serve_queue_wait_seconds",
+              "time a pool dispatch waited for an idle worker (saturation "
+              "spills record the full SD_SERVE_QUEUE_WAIT_S wait) — the "
+              "autosizer's input signal", buckets=REQUEST_BUCKETS)
+    counter("sd_serve_pool_resizes_total",
+            "autosizer resize decisions by direction (grow | shrink), "
+            "each also a pool.resize flight-recorder event",
+            labels=("direction",))
     # distributed read replicas (ISSUE 19): the ReplicaRouter dispatch
     # seam plus the replica-side serve arm — server/replica.py holds the
     # matching module handles. ``peer`` labels are mesh.peer_label hashes

@@ -5,6 +5,7 @@ in-process, or to verify the vocabulary). Against a running shell:
 
     python -m spacedrive_tpu.telemetry --url http://127.0.0.1:8080
     python -m spacedrive_tpu.telemetry --url ... --job <job_id>
+    python -m spacedrive_tpu.telemetry --url ... --slo
     python -m spacedrive_tpu.telemetry --prometheus
 
 ``--url`` fetches ``telemetry.snapshot`` (or ``telemetry.jobTrace``) over
@@ -239,6 +240,44 @@ def _print_profile(target: str, data_dir: str, top: int = 20,
     return 1
 
 
+def _print_slo(status: dict[str, Any], out=None) -> int:
+    """``--slo``: render ``telemetry.sloStatus`` — one block per
+    objective (SLI, budget remaining, burn per window, firing pairs)
+    plus the dispatch-admission budget line."""
+    out = out if out is not None else sys.stdout  # call-time, like above
+    objectives = status.get("objectives") or []
+    if not objectives:
+        print("no SLO objectives configured", file=out)
+    for o in objectives:
+        scope = (f"proc={o['proc']}" if o.get("proc")
+                 else f"tenant={o['tenant']}" if o.get("tenant")
+                 else "all dispatches")
+        firing = [p for p, f in (o.get("firing") or {}).items() if f]
+        print(f"\n{o['name']} ({scope}): {o['target']:.2%} under "
+              f"{o['threshold_s'] * 1000:.0f} ms over "
+              f"{o['window_s'] / 3600:.1f} h", file=out)
+        sli = o.get("sli")
+        print(f"  sli={sli:.4%}  good={_fmt_value(o.get('good'))} "
+              f"valid={_fmt_value(o.get('valid'))}  "
+              f"budget_remaining={o.get('budget_remaining', 0) * 100:.1f}%",
+              file=out)
+        burns = o.get("burn") or {}
+        if burns:
+            rendered = "  ".join(f"{w}={r:g}x" for w, r in burns.items())
+            print(f"  burn: {rendered}", file=out)
+        print(f"  firing: {', '.join(firing) if firing else 'none'}",
+              file=out)
+    admission = status.get("dispatch_admission")
+    if admission is not None:
+        print(f"\ndispatch admission: {admission.get('in_flight', 0)}/"
+              f"{admission.get('budget_inflight', 0)} in flight, "
+              f"{admission.get('tenants_in_flight', 0)} tenants, "
+              f"{_fmt_value(admission.get('shed', 0))} shed", file=out)
+    else:
+        print("\ndispatch admission: off (SD_RSPC_ADMISSION=0)", file=out)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m spacedrive_tpu.telemetry",
@@ -263,7 +302,16 @@ def main(argv: list[str] | None = None) -> int:
                         help="with --url: tail the node's live event "
                              "stream (GET /telemetry/stream, SSE) — job "
                              "transitions, fault firings, router flips, "
-                             "sync sessions, alert edges; Ctrl-C to stop")
+                             "sync sessions, alert edges, SLO burn edges "
+                             "(slo.burn), admission sheds (rspc.shed), "
+                             "pool resizes (pool.resize); Ctrl-C to stop")
+    parser.add_argument("--slo", action="store_true",
+                        help="render telemetry.sloStatus: each objective's "
+                             "live SLI, error-budget remaining, multi-"
+                             "window burn rates and firing pairs, plus the "
+                             "dispatch-admission budget (without --url: "
+                             "evaluated once against this process's own "
+                             "registry)")
     parser.add_argument("--after", type=int, default=None, metavar="SEQ",
                         help="with --follow: replay ring events newer "
                              "than this sequence number first")
@@ -286,6 +334,22 @@ def main(argv: list[str] | None = None) -> int:
                          "an in-process registry has no live producer)")
         return _follow(args.url, auth=args.auth, after=args.after,
                        as_json=args.json)
+
+    if args.slo:
+        if args.url:
+            status = _fetch(args.url, "telemetry.sloStatus", auth=args.auth)
+        else:
+            # no live shell: evaluate the configured objectives once
+            # against this process's own registry (useful after driving
+            # work in-process, same spirit as the default snapshot)
+            from .slo import SloEngine
+
+            status = {"objectives": SloEngine().evaluate_once(),
+                      "dispatch_admission": None}
+        if args.json:
+            print(json.dumps(status, indent=2, default=str))
+            return 0
+        return _print_slo(status)
 
     if args.job:
         if args.url:

@@ -278,6 +278,13 @@ class AlertEvaluator:
             return True, worst[0], worst[1]
         # RATE: per-second increase of the summed series over the window
         total = sum(v for _lbls, v in matching)
+        if state.history and total < state.history[-1][1]:
+            # counter reset (pool/shell restart, telemetry.reset()): every
+            # older sample is a stale-high baseline — keeping any would
+            # clamp the computed rate to 0 for a full window (max() below)
+            # and, worse, the next increments would be measured against
+            # the pre-reset total. Start the window over from here.
+            state.history.clear()
         state.history.append((now, total))
         floor = now - rule.window_s
         while len(state.history) > 1 and state.history[1][0] <= floor:

@@ -16,9 +16,14 @@ sees :func:`spans.current_trace` with ``record_db_spans`` set and nests
 one ``db.query`` span per SELECT — the breakdown the ring serves.
 
 Cardinality: ``proc`` is the router's procedure key — a closed set
-(~100 keys, fixed at mount). ``outcome`` ∈ {ok, api_error, error}:
+(~100 keys, fixed at mount). ``outcome`` ∈ {ok, api_error, error, shed}:
 ``api_error`` is a well-formed 4xx-class rejection (``ApiError``),
-``error`` an unexpected 5xx-class crash.
+``error`` an unexpected 5xx-class crash, and ``shed`` an admission-
+control BUSY (``BusyError``, a 429 with retry-after) — kept distinct so
+the SLO engine (telemetry/slo.py) can exclude deliberate load shedding
+from error ratios. With ``tenant=`` the same observation also lands in
+the bounded-cardinality ``sd_rspc_tenant_*`` families (tenant = the
+8-hex library-id hash from ``slo.tenant_label``).
 
 Exposure: ``telemetry.requestStats`` (rspc) serves :func:`stats` — the
 per-procedure p50/p95/p99 estimates plus the slow ring — and every slow
@@ -67,6 +72,10 @@ _P99 = gauge(
     "estimated p99 of sd_rspc_request_seconds per procedure (published "
     "by the resource-watcher tick; alert target — histograms are not "
     "rule targets)", labels=("proc",))
+_T_REQUESTS = counter("sd_rspc_tenant_requests_total",
+                      labels=("tenant", "outcome"))
+_T_SECONDS = histogram("sd_rspc_tenant_request_seconds",
+                       labels=("tenant",), buckets=REQUEST_BUCKETS)
 
 _SLOW_RING: deque[dict[str, Any]] = deque(maxlen=SLOW_RING)
 _SLOW_LOCK = threading.Lock()
@@ -89,9 +98,12 @@ def slow_threshold_s() -> float:
         return 0.25
 
 
-def observed(proc: str, kind: str, fn: Callable[[], Any]) -> Any:
+def observed(proc: str, kind: str, fn: Callable[[], Any],
+             tenant: str | None = None) -> Any:
     """Run one rspc dispatch under full request telemetry. The router's
-    only integration point — transports stay unaware."""
+    only integration point — transports stay unaware. ``tenant`` (a
+    bounded ``slo.tenant_label`` hash) additionally records the dispatch
+    in the per-tenant families the SLO engine reads."""
     if not enabled():
         return fn()
     # raw paired series writes, NOT the gated Family.inc: a runtime
@@ -111,9 +123,13 @@ def observed(proc: str, kind: str, fn: Callable[[], Any]) -> Any:
             return fn()
     except BaseException as e:
         # classified by name, not import — telemetry must not import the
-        # api layer (the no-cycles rule this package is built on)
-        outcome = ("api_error" if type(e).__name__ == "ApiError"
-                   else "error")
+        # api layer (the no-cycles rule this package is built on).
+        # BusyError (an ApiError subclass) is checked first: an
+        # admission-control shed is deliberate load management, and the
+        # SLO engine excludes the `shed` outcome from error ratios.
+        name = type(e).__name__
+        outcome = ("shed" if name == "BusyError"
+                   else "api_error" if name == "ApiError" else "error")
         raise
     finally:
         duration_s = time.perf_counter() - t0
@@ -121,6 +137,9 @@ def observed(proc: str, kind: str, fn: Callable[[], Any]) -> Any:
             in_flight.value -= 1.0
         _REQUESTS.inc(proc=proc, kind=kind, outcome=outcome)
         _SECONDS.observe(duration_s, proc=proc)
+        if tenant is not None:
+            _T_REQUESTS.inc(tenant=tenant, outcome=outcome)
+            _T_SECONDS.observe(duration_s, tenant=tenant)
         if duration_s >= slow_threshold_s():
             _capture_slow(proc, kind, outcome, duration_s, trace)
 

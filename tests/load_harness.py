@@ -1,0 +1,292 @@
+"""Open-loop multi-tenant load harness (ISSUE 20).
+
+Shared by ``bench.py --load`` and the soak tests. The load model is
+**open-loop**: arrivals follow a pre-drawn schedule and are dispatched
+by the wall clock, *never* waiting for earlier requests to complete.
+That distinction is the whole point — a closed-loop driver (k workers
+in a request/response loop) slows its own offered rate exactly when the
+server saturates, which hides queue growth and caps observed latency at
+k x service time. Real tenants do not politely stop clicking because
+the server is slow; an open-loop schedule keeps offering load, so
+saturation shows up where it belongs: in the latency distribution and
+the shed rate. (:class:`ClosedLoopRunner` exists precisely to
+demonstrate the difference in the soak test.)
+
+Pieces:
+
+- arrival schedules: :func:`poisson_arrivals` (seeded exponential
+  inter-arrivals), :func:`flash_crowd_arrivals` (piecewise base/crowd
+  rates), :func:`diurnal_arrivals` (sinusoidal thinning);
+- tenant mix: :func:`zipf_weights` + :class:`TenantPicker` — a few hot
+  libraries dominate, a long tail stays warm, like real multi-library
+  nodes;
+- :class:`OpenLoopRunner` — dispatches a schedule against a ``submit``
+  callable on a wide thread pool and collects per-arrival records.
+  Latency is measured from the *scheduled* arrival time, so dispatch
+  lateness under overload (the runner itself failing to keep up) counts
+  against the server, never silently shrinks the offered load.
+
+Everything is stdlib + seeded ``random.Random`` — schedules are
+deterministic per seed.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Callable
+
+#: outcome vocabulary a submit callable returns (mirrors the rspc
+#: outcome label set; "censored" is added by the runner for arrivals
+#: still in flight when the drain deadline passes)
+OUTCOMES = ("ok", "shed", "error", "censored")
+
+
+# -- arrival schedules --------------------------------------------------------
+
+def poisson_arrivals(rate_hz: float, duration_s: float,
+                     rng: random.Random) -> list[float]:
+    """Seeded Poisson process: arrival offsets (seconds from start) with
+    exponential inter-arrival times at ``rate_hz``."""
+    if rate_hz <= 0:
+        return []
+    out: list[float] = []
+    t = rng.expovariate(rate_hz)
+    while t < duration_s:
+        out.append(t)
+        t += rng.expovariate(rate_hz)
+    return out
+
+
+def flash_crowd_arrivals(base_hz: float, crowd_hz: float, duration_s: float,
+                         crowd_start: float, crowd_len: float,
+                         rng: random.Random) -> list[float]:
+    """Piecewise Poisson: ``base_hz`` everywhere, ``crowd_hz`` during
+    ``[crowd_start, crowd_start + crowd_len)`` — the thundering herd
+    that must make burn-rate alerts fire and then resolve."""
+    crowd_end = min(duration_s, crowd_start + crowd_len)
+    out = poisson_arrivals(base_hz, duration_s, rng)
+    if crowd_end > crowd_start and crowd_hz > base_hz:
+        extra = poisson_arrivals(crowd_hz - base_hz,
+                                 crowd_end - crowd_start, rng)
+        out.extend(crowd_start + t for t in extra)
+        out.sort()
+    return out
+
+
+def diurnal_arrivals(peak_hz: float, duration_s: float, rng: random.Random,
+                     period_s: float = 60.0) -> list[float]:
+    """Sinusoidal rate between ~0 and ``peak_hz`` with period
+    ``period_s``, drawn by thinning a peak-rate Poisson process."""
+    out = []
+    for t in poisson_arrivals(peak_hz, duration_s, rng):
+        keep = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / period_s))
+        if rng.random() < keep:
+            out.append(t)
+    return out
+
+
+# -- tenant mix ---------------------------------------------------------------
+
+def zipf_weights(n: int, s: float = 1.1) -> list[float]:
+    """Zipf(s) popularity weights for ``n`` tenants (rank 1 hottest),
+    normalized to sum 1."""
+    raw = [1.0 / (rank ** s) for rank in range(1, n + 1)]
+    total = sum(raw)
+    return [w / total for w in raw]
+
+
+class TenantPicker:
+    """Seeded weighted tenant choice via cumulative-weight bisect."""
+
+    def __init__(self, tenants: list[Any], rng: random.Random,
+                 s: float = 1.1) -> None:
+        if not tenants:
+            raise ValueError("need at least one tenant")
+        self.tenants = list(tenants)
+        self.rng = rng
+        self._cum: list[float] = []
+        acc = 0.0
+        for w in zipf_weights(len(tenants), s):
+            acc += w
+            self._cum.append(acc)
+        self._cum[-1] = 1.0  # float-drift guard: bisect must never IndexError
+
+    def pick(self) -> Any:
+        return self.tenants[bisect.bisect_left(self._cum,
+                                               self.rng.random())]
+
+
+# -- statistics ---------------------------------------------------------------
+
+def percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 1]); 0.0 on empty input."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, max(0, math.ceil(q * len(ordered)) - 1))
+    return ordered[idx]
+
+
+def summarize(records: list["ArrivalRecord"]) -> dict[str, Any]:
+    """Headline stats for one run/step: latency quantiles over completed
+    requests, outcome counts, shed rate over offered load."""
+    latencies = [r.latency_s for r in records if r.outcome == "ok"]
+    counts = {o: 0 for o in OUTCOMES}
+    for r in records:
+        counts[r.outcome] = counts.get(r.outcome, 0) + 1
+    offered = len(records)
+    return {
+        "offered": offered,
+        "completed": counts["ok"],
+        "shed": counts["shed"],
+        "errors": counts["error"],
+        "censored": counts["censored"],
+        "shed_rate": counts["shed"] / offered if offered else 0.0,
+        "p50_s": round(percentile(latencies, 0.50), 6),
+        "p99_s": round(percentile(latencies, 0.99), 6),
+        "p999_s": round(percentile(latencies, 0.999), 6),
+    }
+
+
+# -- runners ------------------------------------------------------------------
+
+@dataclass
+class ArrivalRecord:
+    scheduled_s: float      #: offset in the schedule
+    tenant: Any
+    outcome: str            #: ok | shed | error | censored
+    latency_s: float        #: completion - scheduled arrival (wall)
+    late_s: float = 0.0     #: dispatch lateness (runner falling behind)
+
+
+class OpenLoopRunner:
+    """Dispatch an arrival schedule against ``submit`` without ever
+    waiting for completions.
+
+    ``submit(tenant)`` runs one request and returns an outcome string
+    from :data:`OUTCOMES` (raising maps to ``error``). The pool is wide
+    (``max_workers``) so in-flight requests pile up exactly as an open
+    queue would; if even the pool saturates, dispatch lateness is
+    *measured* (``late_s``) and included in latency rather than
+    shrinking the offered load."""
+
+    def __init__(self, submit: Callable[[Any], str], tenants: list[Any],
+                 seed: int = 0, max_workers: int = 128,
+                 zipf_s: float = 1.1) -> None:
+        self.submit = submit
+        self.rng = random.Random(seed)
+        self.picker = TenantPicker(tenants, self.rng, s=zipf_s)
+        self.max_workers = max_workers
+
+    def run(self, arrivals: list[float],
+            drain_s: float = 10.0,
+            tenant_for: Callable[[int], Any] | None = None
+            ) -> list[ArrivalRecord]:
+        """Dispatch every arrival at its scheduled wall-clock time;
+        after the last dispatch, wait up to ``drain_s`` for stragglers
+        (still-running arrivals come back ``censored`` with the drain
+        deadline as their latency — dropping them would bias the tail
+        optimistic, exactly the open-loop sin this harness exists to
+        avoid). ``tenant_for(i)`` overrides the Zipf mix per arrival."""
+        records: list[ArrivalRecord | None] = [None] * len(arrivals)
+        done = threading.Event()
+        remaining = [len(arrivals)]
+        lock = threading.Lock()
+        if not arrivals:
+            return []
+
+        def _one(i: int, scheduled: float, tenant: Any,
+                 t_sched_wall: float, late: float) -> None:
+            try:
+                outcome = self.submit(tenant)
+                if outcome not in OUTCOMES:
+                    outcome = "ok"
+            except Exception:
+                outcome = "error"
+            records[i] = ArrivalRecord(
+                scheduled_s=scheduled, tenant=tenant, outcome=outcome,
+                latency_s=time.monotonic() - t_sched_wall, late_s=late)
+            with lock:
+                remaining[0] -= 1
+                if remaining[0] <= 0:
+                    done.set()
+
+        pool = ThreadPoolExecutor(max_workers=self.max_workers,
+                                  thread_name_prefix="sd-load")
+        t_start = time.monotonic()
+        for i, scheduled in enumerate(arrivals):
+            tenant = (tenant_for(i) if tenant_for is not None
+                      else self.picker.pick())
+            t_sched_wall = t_start + scheduled
+            delay = t_sched_wall - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            late = max(0.0, time.monotonic() - t_sched_wall)
+            pool.submit(_one, i, scheduled, tenant, t_sched_wall, late)
+        done.wait(timeout=drain_s)
+        # snapshot NOW and censor stragglers at the deadline: a blocking
+        # shutdown would wait out every wedged request (up to the 30 s
+        # rspc timeout each), unbounding the drain — instead the pool is
+        # released non-blocking and late finishers write into slots this
+        # snapshot no longer reads
+        drain_deadline = time.monotonic()
+        out: list[ArrivalRecord] = []
+        for i, r in enumerate(records):
+            out.append(r if r is not None else ArrivalRecord(
+                scheduled_s=arrivals[i], tenant=None, outcome="censored",
+                latency_s=drain_deadline - (t_start + arrivals[i])))
+        pool.shutdown(wait=False, cancel_futures=True)
+        return out
+
+
+class ClosedLoopRunner:
+    """The control: ``concurrency`` threads in a submit/await loop for
+    ``duration_s``. Its offered rate collapses when the server slows —
+    which is exactly the self-throttling blind spot the open-loop soak
+    test demonstrates against."""
+
+    def __init__(self, submit: Callable[[Any], str], tenants: list[Any],
+                 seed: int = 0, concurrency: int = 4,
+                 zipf_s: float = 1.1) -> None:
+        self.submit = submit
+        self.rng = random.Random(seed)
+        self.picker = TenantPicker(tenants, self.rng, s=zipf_s)
+        self.concurrency = concurrency
+
+    def run(self, duration_s: float) -> list[ArrivalRecord]:
+        records: list[ArrivalRecord] = []
+        lock = threading.Lock()
+        t_start = time.monotonic()
+
+        def _loop() -> None:
+            while True:
+                now = time.monotonic()
+                if now - t_start >= duration_s:
+                    return
+                tenant = self.picker.pick()
+                t0 = time.monotonic()
+                try:
+                    outcome = self.submit(tenant)
+                    if outcome not in OUTCOMES:
+                        outcome = "ok"
+                except Exception:
+                    outcome = "error"
+                rec = ArrivalRecord(
+                    scheduled_s=t0 - t_start, tenant=tenant,
+                    outcome=outcome, latency_s=time.monotonic() - t0)
+                with lock:
+                    records.append(rec)
+
+        threads = [threading.Thread(target=_loop, name=f"sd-closed-{i}")
+                   for i in range(self.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return records

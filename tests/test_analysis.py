@@ -436,6 +436,63 @@ def test_telemetry_discipline_scoped_and_call_args_exempt(tmp_path):
         "telemetry-discipline") == []
 
 
+# -- pass: cardinality-discipline ----------------------------------------------
+
+def test_cardinality_discipline_flags_unbounded_label_values(tmp_path):
+    """ISSUE 20 fixture: raw ids, f-strings, .format and arbitrary calls
+    fed into label kwargs on a metric handle are registry growth."""
+    bad = run_on(tmp_path, "sync/bad_labels.py", (
+        "from spacedrive_tpu import telemetry\n"
+        "C = telemetry.counter('sd_x_total', 'x', labels=('peer', 'path'))\n"
+        "def record(peer_id, path):\n"
+        "    C.inc(peer=f'peer-{peer_id}')\n"
+        "    C.inc(path=str(path.resolve()))\n"
+        "    C.inc(peer='p: ' + peer_id)\n"
+        "    C.inc(peer=make_key(peer_id))\n"),
+        "cardinality-discipline")
+    assert [f.lineno for f in bad] == [4, 5, 6, 7]
+    assert all("bounded" in f.message for f in bad)
+
+
+def test_cardinality_discipline_allows_bounded_label_values(tmp_path):
+    """Literals, IfExp of literals, UPPERCASE registries, *_label
+    helpers, str() of enums, params, and bounded rebinds stay silent."""
+    assert run_on(tmp_path, "server/good_labels.py", (
+        "from spacedrive_tpu import telemetry\n"
+        "from ..p2p.mesh import peer_label\n"
+        "C = telemetry.counter('sd_y_total', 'y', labels=('a',))\n"
+        "def record(job, identity, lane, hit, slot):\n"
+        "    C.inc(a='ok')\n"
+        "    C.inc(a='hit' if hit else 'miss')\n"
+        "    C.inc(a=job.NAME)\n"
+        "    C.inc(a=peer_label(identity))\n"
+        "    C.inc(a=str(lane))\n"
+        "    C.inc(a=lane)\n"
+        "    label = str(slot)\n"
+        "    C.inc(a=label)\n"
+        "    outcome = 'ok'\n"
+        "    outcome = 'error'\n"
+        "    C.inc(a=outcome)\n"), "cardinality-discipline") == []
+
+
+def test_cardinality_discipline_scoped_and_non_handles_exempt(tmp_path):
+    src = (
+        "from spacedrive_tpu import telemetry\n"
+        "C = telemetry.counter('sd_z_total', 'z', labels=('k',))\n"
+        "def record(x):\n"
+        "    C.inc(k=f'raw-{x}')\n")
+    # telemetry/ itself is out of scope (the registry's own plumbing)
+    assert run_on(tmp_path, "telemetry/t.py", src,
+                  "cardinality-discipline") == []
+    # a non-handle object with an .inc method is not a metric family
+    assert run_on(tmp_path, "jobs/notmetric.py", (
+        "class Thing:\n"
+        "    def inc(self, **kw): pass\n"
+        "t = Thing()\n"
+        "def f(x):\n"
+        "    t.inc(k=f'raw-{x}')\n"), "cardinality-discipline") == []
+
+
 # -- pass 12: queue-discipline -------------------------------------------------
 
 def test_queue_discipline_flags_unbounded_constructions(tmp_path):

@@ -1,0 +1,159 @@
+"""Load-harness gate (ISSUE 20): seeded-schedule determinism, the Zipf
+tenant mix, the stats helpers, and the open-vs-closed mini-soak — the
+demonstration that a closed-loop driver *hides* saturation (it throttles
+its own offered rate to the server's completion rate) while the
+open-loop runner keeps offering load and surfaces the queue growth in
+the latency tail. That contrast is the reason ``bench.py --load`` is
+open-loop at all, so it gets a test, not just a docstring.
+
+The mini-soak runs against a pure in-process fake server (a semaphore of
+k slots, each holding for a fixed service time) — no Node, no sockets —
+so the physics are exact: capacity = k / service_s.
+"""
+
+import random
+import threading
+import time
+
+from .load_harness import (
+    ArrivalRecord,
+    ClosedLoopRunner,
+    OpenLoopRunner,
+    TenantPicker,
+    diurnal_arrivals,
+    flash_crowd_arrivals,
+    percentile,
+    poisson_arrivals,
+    summarize,
+    zipf_weights,
+)
+
+
+# -- schedules -----------------------------------------------------------------
+
+def test_poisson_schedule_is_seeded_and_in_range():
+    a = poisson_arrivals(50.0, 2.0, random.Random(7))
+    b = poisson_arrivals(50.0, 2.0, random.Random(7))
+    assert a == b and a  # deterministic per seed, non-empty
+    assert a != poisson_arrivals(50.0, 2.0, random.Random(8))
+    assert all(0.0 <= t < 2.0 for t in a)
+    assert a == sorted(a)
+    # the realized rate is within Poisson noise of the asked-for rate
+    assert 0.5 * 100 < len(a) < 1.5 * 100
+    assert poisson_arrivals(0.0, 2.0, random.Random(7)) == []
+
+
+def test_flash_crowd_rate_is_piecewise():
+    arr = flash_crowd_arrivals(base_hz=20.0, crowd_hz=400.0, duration_s=9.0,
+                               crowd_start=3.0, crowd_len=3.0,
+                               rng=random.Random(3))
+    assert arr == sorted(arr)
+    before = sum(1 for t in arr if t < 3.0)
+    during = sum(1 for t in arr if 3.0 <= t < 6.0)
+    after = sum(1 for t in arr if t >= 6.0)
+    # ~60 base arrivals either side, ~1200 in the crowd window
+    assert during > 5 * max(before, after)
+    assert before and after
+
+
+def test_diurnal_thins_the_trough():
+    arr = diurnal_arrivals(200.0, 60.0, random.Random(5), period_s=60.0)
+    # keep-probability peaks mid-period and touches zero at the edges
+    mid = sum(1 for t in arr if 20.0 <= t < 40.0)
+    edges = sum(1 for t in arr if t < 10.0 or t >= 50.0)
+    assert mid > 2 * edges
+
+
+# -- tenant mix + stats --------------------------------------------------------
+
+def test_zipf_weights_and_picker_skew():
+    w = zipf_weights(100, s=1.1)
+    assert abs(sum(w) - 1.0) < 1e-9
+    assert w == sorted(w, reverse=True)  # rank 1 hottest
+    picker = TenantPicker(list(range(100)), random.Random(11))
+    picks = [picker.pick() for _ in range(2000)]
+    counts = {t: picks.count(t) for t in set(picks)}
+    # the hot head dominates but the tail stays warm
+    assert counts[0] > 10 * counts.get(50, 1)
+    assert len(counts) > 20
+    # deterministic per seed
+    picker2 = TenantPicker(list(range(100)), random.Random(11))
+    assert [picker2.pick() for _ in range(2000)] == picks
+
+
+def test_percentile_nearest_rank_and_summarize():
+    assert percentile([], 0.99) == 0.0
+    vals = [float(i) for i in range(1, 101)]  # 1..100
+    assert percentile(vals, 0.50) == 50.0
+    assert percentile(vals, 0.99) == 99.0
+    assert percentile(vals, 1.0) == 100.0
+    records = (
+        [ArrivalRecord(0.0, "t", "ok", 0.010)] * 98
+        + [ArrivalRecord(0.0, "t", "ok", 0.900)] * 2
+        + [ArrivalRecord(0.0, "t", "shed", 0.001)] * 25
+        + [ArrivalRecord(0.0, "t", "error", 0.001)] * 5
+        + [ArrivalRecord(0.0, None, "censored", 10.0)] * 2
+    )
+    s = summarize(records)
+    assert (s["offered"], s["completed"], s["shed"]) == (132, 100, 25)
+    assert (s["errors"], s["censored"]) == (5, 2)
+    assert s["shed_rate"] == 25 / 132
+    # shed/error/censored latencies must NOT pollute the quantiles
+    assert s["p50_s"] == 0.010 and s["p99_s"] == 0.900
+
+
+# -- the open-vs-closed mini-soak ----------------------------------------------
+
+class _FakeServer:
+    """k slots x service_s: a server with exact capacity k/service_s."""
+
+    def __init__(self, slots: int, service_s: float) -> None:
+        self._sem = threading.Semaphore(slots)
+        self.service_s = service_s
+
+    def submit(self, _tenant) -> str:
+        with self._sem:
+            time.sleep(self.service_s)
+        return "ok"
+
+
+def test_open_loop_surfaces_saturation_closed_loop_hides_it():
+    # capacity: 2 slots x 10 ms = 200 req/s
+    server = _FakeServer(slots=2, service_s=0.01)
+    tenants = [f"t{i}" for i in range(8)]
+
+    # closed loop at concurrency 4 against 2 slots: every request waits
+    # ~1 service time, and — the blind spot — the OFFERED rate collapses
+    # to the completion rate, so nothing in its numbers says "saturated"
+    closed = ClosedLoopRunner(server.submit, tenants, seed=1,
+                              concurrency=4).run(duration_s=1.0)
+    closed_stats = summarize(closed)
+    closed_rate = closed_stats["offered"] / 1.0
+    assert closed_rate <= 250.0  # self-throttled to ~capacity
+    # the typical request looks FINE (p50, not p99 — a bare Semaphore
+    # barges like any condvar, so one unlucky thread can starve and
+    # smear the closed tail without changing the blindness story)
+    assert closed_stats["p50_s"] < 5 * server.service_s
+
+    # open loop offers 2x capacity from a fixed schedule: the backlog
+    # grows for the whole second and even the MEDIAN records it
+    schedule = poisson_arrivals(400.0, 1.0, random.Random(2))
+    opened = OpenLoopRunner(server.submit, tenants, seed=2).run(
+        schedule, drain_s=8.0)
+    open_stats = summarize(opened)
+    assert open_stats["offered"] == len(schedule)  # never self-throttles
+    assert open_stats["censored"] == 0  # drain covered the backlog
+    # the queue-growth signature: latency measured from *scheduled*
+    # arrival blows past anything the closed loop's typical request sees
+    assert open_stats["p50_s"] > 4 * closed_stats["p50_s"]
+    # and it is genuinely queue growth, not noise: offered work exceeds
+    # capacity (len(schedule) x 10 ms across 2 slots ~= 2x the 1 s
+    # schedule), so the backlog keeps draining long after the last
+    # arrival. Checked via completion offsets, not per-arrival waits —
+    # the bare-Semaphore server serves in barging (roughly LIFO) order,
+    # so individual waits are wildly non-monotone even as the backlog
+    # grows strictly.
+    done = [r.scheduled_s + r.latency_s for r in opened if r.outcome == "ok"]
+    work_s = len(schedule) * server.service_s / 2  # total demand, seconds
+    assert work_s > 1.5
+    assert max(done) > 0.9 * work_s

@@ -403,6 +403,27 @@ def test_alert_rate_and_absence():
     assert not st["missing"]["firing"]
 
 
+def test_alert_rate_restarts_window_on_counter_reset():
+    """Regression (ISSUE 20): a cumulative counter falling (registry
+    reset, shell restart) used to leave the stale-high total as the rate
+    baseline — ``max(0, total - v0)`` then clamped the rate to zero for
+    a full window, masking a real post-restart spike."""
+    c = telemetry.counter("sd_quarantined_files_total")
+    ev = alerts.AlertEvaluator([alerts.AlertRule(
+        name="spike", kind="rate", series="sd_quarantined_files_total",
+        op="gt", value=5.0, window_s=60.0, for_s=0.0)])
+    c.inc(1000)
+    ev.evaluate_once(now=0.0)
+    telemetry.reset()  # the counter falls to 0 — a restart
+    assert not ev.evaluate_once(now=1.0)[0]["firing"]
+    # post-reset increments are measured against the POST-reset baseline:
+    # 100 in 5 s is 20/s and must fire, not be clamped to zero against
+    # the 1000-high pre-reset history
+    c.inc(100)
+    st = ev.evaluate_once(now=6.0)[0]
+    assert st["firing"] and st["live_value"] == 20.0
+
+
 def test_alert_notify_hook_and_validation():
     calls = []
     g = telemetry.gauge("sd_jobs_queued")

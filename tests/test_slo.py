@@ -1,0 +1,260 @@
+"""Serve-tier SLO engine gate (ISSUE 20): bounded tenant labels,
+objective validation, and the multi-window burn-rate math — all driven
+on a **virtual clock** via ``evaluate_once(now=...)``, so hour-long burn
+windows evaluate in microseconds (the same injected-clock contract the
+alert evaluator tests use).
+
+The synthetic traffic helper writes straight into the rspc request
+families the engine reads (``sd_rspc_request_seconds`` buckets +
+``sd_rspc_requests_total`` outcomes), which keeps these tests honest
+about the one subtlety of bucket-derived SLIs: "good" is a *cumulative
+bucket read*, so the latency threshold must sit on a bucket boundary or
+it silently rounds down.
+"""
+
+import pytest
+
+from spacedrive_tpu import telemetry
+from spacedrive_tpu.telemetry.registry import REQUEST_BUCKETS
+from spacedrive_tpu.telemetry.slo import (
+    LOCAL_TENANT,
+    OTHER_TENANT,
+    SloEngine,
+    SloObjective,
+    SloObjectiveError,
+    default_objectives,
+    load_objectives,
+    reset_tenant_labels,
+    tenant_label,
+    tenant_labels,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry.reset()
+    telemetry.set_enabled(True)
+    reset_tenant_labels()
+    yield
+    telemetry.reset()
+    reset_tenant_labels()
+    telemetry.reload_enabled()
+
+
+_REQ = telemetry.counter("sd_rspc_requests_total",
+                         labels=("proc", "kind", "outcome"))
+_SEC = telemetry.histogram("sd_rspc_request_seconds", labels=("proc",),
+                           buckets=REQUEST_BUCKETS)
+_T_REQ = telemetry.counter("sd_rspc_tenant_requests_total",
+                           labels=("tenant", "outcome"))
+_T_SEC = telemetry.histogram("sd_rspc_tenant_request_seconds",
+                             labels=("tenant",), buckets=REQUEST_BUCKETS)
+
+
+def _traffic(good=0, slow=0, shed=0, error=0, proc="search.paths"):
+    """Synthetic dispatches, shaped like api/router.py records them:
+    every outcome (sheds and errors included — both are fast rejections)
+    lands in the latency histogram AND the outcome counter."""
+    for count, latency, outcome in ((good, 0.01, "ok"), (slow, 0.6, "ok"),
+                                    (shed, 0.001, "shed"),
+                                    (error, 0.001, "error")):
+        for _ in range(count):
+            _SEC.observe(latency, proc=proc)
+            _REQ.inc(proc=proc, kind="query", outcome=outcome)
+
+
+def _objective(**over):
+    """A tight test objective: 250 ms threshold (a bucket boundary),
+    90% target (budget fraction 0.1 — burn = bad-ratio x 10), 60 s
+    budget window, 5 s/60 s fast pair at burn 2.0; the slow pair's
+    threshold is parked above the 10.0 burn ceiling so only the fast
+    pair can fire unless a test opts in."""
+    kw = dict(name="reads", threshold_s=0.25, target=0.9, window_s=60.0,
+              fast_windows=(5.0, 60.0), slow_windows=(10.0, 120.0),
+              fast_burn=2.0, slow_burn=50.0, severity="page")
+    kw.update(over)
+    return SloObjective(**kw)
+
+
+# -- bounded tenant labels -----------------------------------------------------
+
+def test_tenant_label_lru_cap_and_overflow(monkeypatch):
+    monkeypatch.setenv("SD_TENANT_LABEL_CAP", "2")
+    assert tenant_label(None) == LOCAL_TENANT
+    a, b = tenant_label("lib-a"), tenant_label("lib-b")
+    assert len(a) == 8 and int(a, 16) >= 0 and a != b
+    assert tenant_label("lib-a") == a  # stable per library
+    # past the cap: new tenants share the overflow label, assigned ones
+    # keep their labels forever (the registry is hard-bounded at cap + 2)
+    assert tenant_label("lib-c") == OTHER_TENANT
+    assert tenant_label("lib-d") == OTHER_TENANT
+    assert tenant_label("lib-b") == b
+    assert set(tenant_labels()) == {a, b}
+    reset_tenant_labels()
+    assert tenant_label("lib-c") not in (OTHER_TENANT, a, b)
+
+
+# -- objective grammar ---------------------------------------------------------
+
+def test_objective_validation_rejects_malformed():
+    with pytest.raises(SloObjectiveError):
+        _objective(threshold_s=0.0)
+    with pytest.raises(SloObjectiveError):
+        _objective(target=1.0)
+    with pytest.raises(SloObjectiveError):
+        _objective(window_s=0.0)
+    with pytest.raises(SloObjectiveError):
+        _objective(fast_windows=(60.0, 5.0))  # short must precede long
+    with pytest.raises(SloObjectiveError):
+        _objective(slow_burn=0.0)
+    with pytest.raises(SloObjectiveError):
+        _objective(proc="search.paths", tenant="*")  # exclusive filters
+    with pytest.raises(SloObjectiveError):
+        SloObjective.from_dict({"name": "incomplete"})
+    with pytest.raises(SloObjectiveError):
+        SloEngine([_objective(), _objective()])  # duplicate names
+
+
+def test_objectives_roundtrip_and_env_fallback(tmp_path, monkeypatch):
+    for obj in default_objectives():
+        assert SloObjective.from_dict(obj.to_dict()) == obj
+    # SD_SLO_OBJECTIVES names a FILE; a good one loads...
+    good = tmp_path / "slo.json"
+    good.write_text('[{"name": "mine", "threshold_s": 0.05, '
+                    '"target": 0.95}]')
+    monkeypatch.setenv("SD_SLO_OBJECTIVES", str(good))
+    assert [o.name for o in load_objectives()] == ["mine"]
+    # ...and a malformed one falls back to the stock set (SLO config
+    # must never wedge node boot)
+    bad = tmp_path / "bad.json"
+    bad.write_text('[{"threshold_s": "not even close"}')
+    monkeypatch.setenv("SD_SLO_OBJECTIVES", str(bad))
+    assert ([o.name for o in load_objectives()]
+            == [o.name for o in default_objectives()])
+
+
+# -- SLI accounting ------------------------------------------------------------
+
+def test_sheds_leave_valid_set_errors_do_not():
+    eng = SloEngine([_objective()], interval_s=999.0)
+    # 90% sheds: admission control at work, NOT an outage — the SLI
+    # only judges the requests that were actually admitted
+    _traffic(good=10, shed=90)
+    st = eng.evaluate_once(now=0.0)[0]
+    assert (st["valid"], st["good"], st["sli"]) == (10.0, 10.0, 1.0)
+    # unexpected errors stay in the valid set and count as bad
+    _traffic(error=10)
+    st = eng.evaluate_once(now=1.0)[0]
+    assert (st["valid"], st["good"], st["sli"]) == (20.0, 10.0, 0.5)
+
+
+def test_tenant_objectives_read_tenant_families():
+    hot, cold = "aaaa1111", "bbbb2222"
+    for tenant, latency in ((hot, 0.01), (cold, 0.6)):
+        for _ in range(50):
+            _T_SEC.observe(latency, tenant=tenant)
+            _T_REQ.inc(tenant=tenant, outcome="ok")
+    eng = SloEngine([
+        _objective(name="all-tenants", tenant="*"),
+        _objective(name="hot-only", tenant=hot),
+    ], interval_s=999.0)
+    st = {s["name"]: s for s in eng.evaluate_once(now=0.0)}
+    # "*" aggregates every tenant series; a pinned label sees only its own
+    assert st["all-tenants"]["valid"] == 100.0
+    assert st["all-tenants"]["sli"] == 0.5
+    assert (st["hot-only"]["valid"], st["hot-only"]["sli"]) == (50.0, 1.0)
+
+
+# -- burn math on the virtual clock --------------------------------------------
+
+def test_burn_and_gate_fires_then_resolves():
+    eng = SloEngine([_objective()], interval_s=999.0)
+    # 60 s of clean traffic fills the long window with good baseline
+    for t in range(0, 61, 5):
+        _traffic(good=100)
+        eng.evaluate_once(now=float(t))
+    st = eng.status()[0]
+    assert st["sli"] == 1.0 and st["budget_remaining"] == 1.0
+    assert not any(st["firing"].values())
+
+    # a 50% bad burst: the 5 s window burns at ~5x budget instantly, but
+    # the 60 s window is still diluted by the clean hour — the AND-gate
+    # must hold (a blip is not an incident)
+    _traffic(good=50, slow=50)
+    st = eng.evaluate_once(now=65.0)[0]
+    assert st["burn"]["5s"] > 2.0
+    assert st["burn"]["1m"] < 2.0
+    assert not st["firing"]["fast"]
+
+    # sustained burn: the long window eventually agrees and the pair fires
+    t, fired_at = 65.0, None
+    while t < 65.0 + 120.0:
+        t += 5.0
+        _traffic(good=50, slow=50)
+        st = eng.evaluate_once(now=t)[0]
+        if st["firing"]["fast"]:
+            fired_at = t
+            break
+    assert fired_at is not None
+    assert st["budget_remaining"] < 1.0
+    assert telemetry.value("sd_slo_burn_rate", objective="reads",
+                           window="5s") > 2.0
+
+    # recovery: clean traffic drains the SHORT window first and the pair
+    # resolves as soon as either window drops — the AND-gate in reverse
+    resolved_at = None
+    while t < fired_at + 120.0:
+        t += 5.0
+        _traffic(good=200)
+        st = eng.evaluate_once(now=t)[0]
+        if not st["firing"]["fast"]:
+            resolved_at = t
+            break
+    assert resolved_at is not None and resolved_at - fired_at <= 15.0
+
+    # both edges hit the flight recorder with the pair's evidence
+    edges = [e for e in telemetry.recent_events(limit=256)
+             if e["name"] == "slo.burn"]
+    assert [e["state"] for e in edges] == ["firing", "resolved"]
+    assert edges[0]["objective"] == "reads"
+    assert edges[0]["pair"] == "fast"
+    assert edges[0]["severity"] == "page"
+    assert edges[0]["windows"] == ["5s", "1m"]
+    assert edges[0]["burn"]["5s"] > 2.0
+
+    # a further 60 s of clean traffic refills the budget completely
+    for _ in range(13):
+        t += 5.0
+        _traffic(good=200)
+        eng.evaluate_once(now=t)
+    assert eng.status()[0]["budget_remaining"] == 1.0
+
+
+def test_budget_exhausts_under_sustained_burn():
+    eng = SloEngine([_objective()], interval_s=999.0)
+    # bad ratio 0.5 >> the 10% budget fraction: the 60 s budget window
+    # is overspent almost immediately
+    for t in range(0, 31, 5):
+        _traffic(good=50, slow=50)
+        eng.evaluate_once(now=float(t))
+    assert eng.status()[0]["budget_remaining"] == 0.0
+    assert telemetry.value("sd_slo_budget_remaining",
+                           objective="reads") == 0.0
+
+
+def test_registry_reset_restarts_windows_not_phantom_burn():
+    eng = SloEngine([_objective()], interval_s=999.0)
+    for t in range(0, 31, 5):
+        _traffic(slow=100)
+        eng.evaluate_once(now=float(t))
+    st = eng.status()[0]
+    assert st["firing"]["fast"] and st["burn"]["5s"] > 2.0
+    # the registry resets (shell restart / tests): cumulative counts fall,
+    # so every retained sample is a stale-high baseline — the window must
+    # restart cleanly instead of smearing phantom burn (or phantom calm)
+    # over the next minute
+    telemetry.reset()
+    st = eng.evaluate_once(now=35.0)[0]
+    assert set(st["burn"].values()) == {0.0}
+    assert st["budget_remaining"] == 1.0
+    assert not st["firing"]["fast"]  # the edge resolves on the reset tick
